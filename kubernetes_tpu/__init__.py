@@ -1,0 +1,32 @@
+"""kubernetes_tpu — a TPU-native cluster-scheduling framework.
+
+Re-implements the capability surface of Kubernetes' kube-scheduler
+(reference: pkg/scheduler in M00nF1sh/kubernetes @ 2024-10-08) as a batched
+constraint-satisfaction and scoring system on TPU via JAX/XLA.
+
+The reference schedules one pod per cycle, running a Filter→Score plugin
+pipeline over all nodes with a 16-way host thread pool
+(pkg/scheduler/schedule_one.go:65).  This framework instead:
+
+- mirrors the scheduler cache snapshot (pkg/scheduler/backend/cache/snapshot.go)
+  into HBM as packed, interned int/float tensors,
+- evaluates every Filter/Score plugin as a vmapped kernel over a
+  ``(pending_pods × nodes)`` problem,
+- commits a whole batch of pods with a sequential-equivalent ``lax.scan``
+  so decisions match the reference's serial assume/bind protocol.
+
+Package layout:
+    api/        core object model (Pod, Node, quantities, selectors)
+    snapshot/   string interning + packed device tensor schema
+    oracle/     scalar golden model of plugin semantics (for property tests)
+    ops/        batched JAX kernels, one per device-backed plugin
+    framework/  plugin interface: extension points, Status, CycleState, runtime
+    plugins/    in-tree plugins (device-backed or host-backed)
+    cache/      host cache with assume protocol + incremental device mirror
+    queue/      activeQ/backoffQ/unschedulable queue with queueing hints
+    config/     KubeSchedulerConfiguration-shaped profile/config surface
+    metrics/    Prometheus-style metrics registry
+    utils/      misc helpers
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,35 @@
+"""Core object model: the scheduler-relevant subset of the Kubernetes API.
+
+Mirrors the semantics (not the code) of:
+  - staging/src/k8s.io/apimachinery/pkg/api/resource (Quantity)
+  - pkg/scheduler/framework/types.go (Resource, NodeInfo, PodInfo)
+  - staging/src/k8s.io/apimachinery/pkg/labels (selectors)
+"""
+
+from kubernetes_tpu.api.resource import (  # noqa: F401
+    Resource,
+    parse_quantity,
+    parse_cpu_millis,
+)
+from kubernetes_tpu.api.labels import (  # noqa: F401
+    Requirement,
+    Selector,
+    selector_from_label_selector,
+)
+from kubernetes_tpu.api.types import (  # noqa: F401
+    Affinity,
+    Container,
+    LabelSelector,
+    LabelSelectorRequirement,
+    Node,
+    NodeSelector,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    Pod,
+    PodAffinityTerm,
+    PreferredSchedulingTerm,
+    Taint,
+    Toleration,
+    TopologySpreadConstraint,
+    WeightedPodAffinityTerm,
+)

@@ -1,0 +1,16 @@
+"""Scalar golden model of the north-star plugins (SURVEY.md §7.2).
+
+A direct, slow, obviously-correct Python implementation of the reference
+plugin *semantics* — the per-plugin ground truth the batched device kernels
+(kubernetes_tpu/ops) are property-tested against, and the host fallback for
+plugins without kernels.
+"""
+
+from kubernetes_tpu.oracle.state import OracleState  # noqa: F401
+from kubernetes_tpu.oracle import filters, scores  # noqa: F401
+from kubernetes_tpu.oracle.pipeline import (  # noqa: F401
+    DEFAULT_SCORE_WEIGHTS,
+    feasible_nodes,
+    prioritize,
+    schedule_one,
+)

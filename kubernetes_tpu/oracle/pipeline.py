@@ -1,0 +1,177 @@
+"""Oracle scheduling pipeline: filter → score → select.
+
+Serial reimplementation of findNodesThatFitPod / prioritizeNodes /
+selectHost (reference schedule_one.go:408-917) with the default plugin set
+and weights (apis/config/v1/default_plugins.go:30-52):
+
+    TaintToleration 3, NodeAffinity 2, PodTopologySpread 2,
+    InterPodAffinity 2, NodeResourcesFit 1, BalancedAllocation 1,
+    ImageLocality 1.
+
+Tie-breaking: the reference reservoir-samples among max-score nodes
+(schedule_one.go:870).  The oracle (and the device pipeline) default to the
+deterministic "first max in node order" policy; an optional seeded RNG
+reproduces reservoir sampling when bit-compat with a recorded run is needed.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from kubernetes_tpu.api.types import Pod
+from kubernetes_tpu.oracle import filters as F
+from kubernetes_tpu.oracle import scores as S
+from kubernetes_tpu.oracle.state import NodeState, OracleState
+
+DEFAULT_SCORE_WEIGHTS = {
+    "TaintToleration": 3,
+    "NodeAffinity": 2,
+    "PodTopologySpread": 2,
+    "InterPodAffinity": 2,
+    "NodeResourcesFit": 1,
+    "NodeResourcesBalancedAllocation": 1,
+    "ImageLocality": 1,
+}
+
+
+@dataclass
+class FitResult:
+    feasible: List[str]
+    # node name → list of reasons (Diagnosis.NodeToStatusMap analogue)
+    reasons: Dict[str, List[str]] = field(default_factory=dict)
+
+
+def feasible_nodes(pod: Pod, state: OracleState) -> FitResult:
+    """All default-profile Filter plugins, in the reference's iteration
+    shape (every node, all reasons collected)."""
+    spread_counts = F.spread_pair_counts(pod, state)
+    feasible: List[str] = []
+    reasons: Dict[str, List[str]] = {}
+    for name, ns in state.nodes.items():
+        rs: List[str] = []
+        r = F.filter_node_name(pod, ns)
+        if r:
+            rs.append(r)
+        r = F.filter_node_unschedulable(pod, ns)
+        if r:
+            rs.append(r)
+        r = F.filter_taints(pod, ns)
+        if r:
+            rs.append(r)
+        r = F.filter_node_affinity(pod, ns)
+        if r:
+            rs.append(r)
+        r = F.filter_node_ports(pod, ns)
+        if r:
+            rs.append(r)
+        rs.extend(F.filter_node_resources(pod, ns))
+        r = F.filter_interpod_affinity(pod, ns, state)
+        if r:
+            rs.append(r)
+        r = F.filter_topology_spread(pod, ns, state, spread_counts)
+        if r:
+            rs.append(r)
+        if rs:
+            reasons[name] = rs
+        else:
+            feasible.append(name)
+    return FitResult(feasible=feasible, reasons=reasons)
+
+
+def prioritize(
+    pod: Pod,
+    state: OracleState,
+    feasible: Sequence[str],
+    weights: Optional[Dict[str, int]] = None,
+) -> Dict[str, int]:
+    """Weighted sum of normalized plugin scores per feasible node
+    (prioritizeNodes, schedule_one.go:752)."""
+    w = dict(DEFAULT_SCORE_WEIGHTS if weights is None else weights)
+    nodes = [state.nodes[n] for n in feasible]
+    totals = {n: 0 for n in feasible}
+
+    def accumulate(name: str, scores: List[int]):
+        weight = w.get(name, 0)
+        for node_name, s in zip(feasible, scores):
+            totals[node_name] += s * weight
+
+    if w.get("TaintToleration"):
+        raw = [S.score_taint_toleration(pod, ns) for ns in nodes]
+        accumulate("TaintToleration", S.normalize_taint_toleration(raw))
+    if w.get("NodeAffinity"):
+        raw = [S.score_node_affinity(pod, ns) for ns in nodes]
+        accumulate("NodeAffinity", S.normalize_node_affinity(raw))
+    if w.get("PodTopologySpread"):
+        raw = S.score_topology_spread_all(pod, state, list(feasible))
+        accumulate("PodTopologySpread", S.normalize_topology_spread(raw))
+    if w.get("InterPodAffinity"):
+        raw = S.score_interpod_affinity_all(pod, state, list(feasible))
+        accumulate("InterPodAffinity", S.normalize_interpod_affinity(raw))
+    if w.get("NodeResourcesFit"):
+        accumulate(
+            "NodeResourcesFit",
+            [S.score_least_allocated(pod, ns) for ns in nodes],
+        )
+    if w.get("NodeResourcesBalancedAllocation"):
+        accumulate(
+            "NodeResourcesBalancedAllocation",
+            [S.score_balanced_allocation(pod, ns) for ns in nodes],
+        )
+    if w.get("ImageLocality"):
+        accumulate(
+            "ImageLocality",
+            [S.score_image_locality(pod, ns, state) for ns in nodes],
+        )
+    return totals
+
+
+def select_host(
+    totals: Dict[str, int], rng: Optional[random.Random] = None
+) -> Optional[str]:
+    """Max score; ties broken deterministically by node order, or by
+    reservoir sampling when an rng is supplied (schedule_one.go:870)."""
+    if not totals:
+        return None
+    best = max(totals.values())
+    tied = [n for n, s in totals.items() if s == best]
+    if rng is None or len(tied) == 1:
+        return tied[0]
+    selected = tied[0]
+    cnt = 1
+    for cand in tied[1:]:
+        cnt += 1
+        if rng.randrange(cnt) == 0:
+            selected = cand
+    return selected
+
+
+@dataclass
+class ScheduleResult:
+    node: Optional[str]
+    feasible: List[str] = field(default_factory=list)
+    reasons: Dict[str, List[str]] = field(default_factory=dict)
+    scores: Dict[str, int] = field(default_factory=dict)
+
+
+def schedule_one(
+    pod: Pod,
+    state: OracleState,
+    weights: Optional[Dict[str, int]] = None,
+    rng: Optional[random.Random] = None,
+) -> ScheduleResult:
+    fit = feasible_nodes(pod, state)
+    if not fit.feasible:
+        return ScheduleResult(node=None, feasible=[], reasons=fit.reasons)
+    if len(fit.feasible) == 1:
+        return ScheduleResult(
+            node=fit.feasible[0], feasible=fit.feasible, reasons=fit.reasons
+        )
+    totals = prioritize(pod, state, fit.feasible, weights)
+    return ScheduleResult(
+        node=select_host(totals, rng),
+        feasible=fit.feasible,
+        reasons=fit.reasons,
+        scores=totals,
+    )

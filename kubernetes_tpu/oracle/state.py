@@ -1,0 +1,77 @@
+"""Host-side cluster state the oracle evaluates against.
+
+Equivalent in role to the reference's Snapshot (a consistent view of nodes +
+placed pods, pkg/scheduler/backend/cache/snapshot.go) but kept as plain
+Python objects.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional
+
+from kubernetes_tpu.api.resource import Resource
+from kubernetes_tpu.api.types import Node, Pod
+
+
+@dataclass
+class NodeState:
+    """Per-node accounting mirroring framework.NodeInfo (types.go:585)."""
+
+    node: Node
+    pods: List[Pod] = field(default_factory=list)
+    requested: Resource = field(default_factory=Resource)
+    non_zero_requested: Resource = field(default_factory=Resource)
+
+    def add_pod(self, pod: Pod) -> None:
+        req = pod.compute_requests()
+        self.requested.add(req)
+        self.non_zero_requested.add(req.non_zero_defaulted())
+        self.pods.append(pod)
+
+    def remove_pod(self, pod: Pod) -> bool:
+        for i, p in enumerate(self.pods):
+            if p.uid == pod.uid:
+                req = p.compute_requests()
+                self.requested.sub(req)
+                self.non_zero_requested.sub(req.non_zero_defaulted())
+                del self.pods[i]
+                return True
+        return False
+
+
+@dataclass
+class OracleState:
+    nodes: Dict[str, NodeState] = field(default_factory=dict)
+    namespace_labels: Dict[str, Dict[str, str]] = field(default_factory=dict)
+
+    @classmethod
+    def build(
+        cls,
+        nodes: Iterable[Node],
+        placed_pods: Iterable[Pod] = (),
+        namespace_labels: Optional[Dict[str, Dict[str, str]]] = None,
+    ) -> "OracleState":
+        st = cls(namespace_labels=dict(namespace_labels or {}))
+        for n in nodes:
+            st.nodes[n.name] = NodeState(node=n)
+        for p in placed_pods:
+            st.place(p)
+        return st
+
+    def place(self, pod: Pod) -> None:
+        ns = self.nodes.get(pod.node_name)
+        if ns is None:
+            raise KeyError(f"pod {pod.key} placed on unknown node {pod.node_name!r}")
+        ns.add_pod(pod)
+
+    def unplace(self, pod: Pod) -> None:
+        ns = self.nodes.get(pod.node_name)
+        if ns is not None:
+            ns.remove_pod(pod)
+
+    def node_list(self) -> List[NodeState]:
+        return list(self.nodes.values())
+
+    def all_pods(self) -> List[Pod]:
+        return [p for ns in self.nodes.values() for p in ns.pods]

@@ -1,0 +1,30 @@
+"""String interning and packed device-tensor schema for the cluster snapshot.
+
+This is the TPU-native replacement for the reference's
+pkg/scheduler/backend/cache/snapshot.go: instead of a list of Go NodeInfo
+structs, the cluster state lives as padded int32/float32 arrays in HBM.
+Everything string-shaped (label keys/values, namespaces, taint keys,
+resource names) is interned to dense int ids (SURVEY.md §7.1).
+"""
+
+from kubernetes_tpu.snapshot.interner import Interner, Vocab  # noqa: F401
+from kubernetes_tpu.snapshot.selectors import (  # noqa: F401
+    OP_IN,
+    OP_NOT_IN,
+    OP_EXISTS,
+    OP_DOES_NOT_EXIST,
+    OP_GT,
+    OP_LT,
+    CompiledRequirements,
+    compile_node_selector_dnf,
+    compile_label_selector,
+)
+from kubernetes_tpu.snapshot.schema import (  # noqa: F401
+    NodeTensors,
+    ExistingPodTensors,
+    PodBatch,
+    ResourceLanes,
+    pack_nodes,
+    pack_existing_pods,
+    pack_pod_batch,
+)

@@ -1,0 +1,190 @@
+"""Unit tests for the core object model (quantities, selectors, taints,
+pod request computation).  Table-driven in the style of the reference's
+framework/types_test.go."""
+
+import pytest
+
+from kubernetes_tpu.api import (
+    Container,
+    Node,
+    Pod,
+    Resource,
+    Taint,
+    Toleration,
+)
+from kubernetes_tpu.api import labels as k8slabels
+from kubernetes_tpu.api.resource import parse_cpu_millis, parse_quantity
+from kubernetes_tpu.api.types import (
+    NodeAffinity,
+    NodeSelector,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    Affinity,
+    find_untolerated_taint,
+    required_node_affinity_matches,
+)
+
+
+@pytest.mark.parametrize(
+    "s,expected",
+    [
+        ("100m", 0.1),
+        ("1", 1.0),
+        ("2.5", 2.5),
+        ("1Gi", 1024**3),
+        ("512Mi", 512 * 1024**2),
+        ("1k", 1000),
+        ("1e3", 1000),
+        ("0", 0),
+    ],
+)
+def test_parse_quantity(s, expected):
+    assert parse_quantity(s) == pytest.approx(expected)
+
+
+def test_parse_cpu_millis():
+    assert parse_cpu_millis("100m") == 100
+    assert parse_cpu_millis("1") == 1000
+    assert parse_cpu_millis("1.5") == 1500
+    assert parse_cpu_millis("0.0001") == 1  # MilliValue rounds up
+
+
+def test_invalid_quantity():
+    with pytest.raises(ValueError):
+        parse_quantity("abc")
+
+
+def test_resource_from_map_and_arith():
+    r = Resource.from_map({"cpu": "2", "memory": "4Gi", "nvidia.com/gpu": "1"})
+    assert r.milli_cpu == 2000
+    assert r.memory == 4 * 1024**3
+    assert r.scalars["nvidia.com/gpu"] == 1
+    r2 = r.clone().add(r)
+    assert r2.milli_cpu == 4000
+    assert r.milli_cpu == 2000  # clone isolated
+
+
+def test_pod_requests_init_container_max():
+    # Sum-of-containers vs max-of-init-containers (calculateResource).
+    pod = Pod(
+        name="p",
+        containers=[
+            Container(requests={"cpu": "100m", "memory": "100Mi"}),
+            Container(requests={"cpu": "200m", "memory": "200Mi"}),
+        ],
+        init_containers=[Container(requests={"cpu": "1", "memory": "50Mi"})],
+    )
+    req = pod.compute_requests()
+    assert req.milli_cpu == 1000  # init dominates cpu
+    assert req.memory == 300 * 1024**2  # sum dominates memory
+
+
+def test_pod_requests_sidecar():
+    pod = Pod(
+        name="p",
+        containers=[Container(requests={"cpu": "100m"})],
+        init_containers=[
+            Container(requests={"cpu": "300m"}, restart_policy="Always"),
+        ],
+    )
+    assert pod.compute_requests().milli_cpu == 400
+
+
+def test_pod_overhead():
+    pod = Pod(
+        name="p",
+        containers=[Container(requests={"cpu": "1"})],
+        overhead={"cpu": "250m"},
+    )
+    assert pod.compute_requests().milli_cpu == 1250
+
+
+@pytest.mark.parametrize(
+    "op,values,labels,want",
+    [
+        ("In", ("a", "b"), {"k": "a"}, True),
+        ("In", ("a", "b"), {"k": "c"}, False),
+        ("In", ("a",), {}, False),
+        ("NotIn", ("a",), {"k": "b"}, True),
+        ("NotIn", ("a",), {}, True),  # absent key matches NotIn
+        ("NotIn", ("a",), {"k": "a"}, False),
+        ("Exists", (), {"k": "x"}, True),
+        ("Exists", (), {}, False),
+        ("DoesNotExist", (), {}, True),
+        ("DoesNotExist", (), {"k": "x"}, False),
+        ("Gt", ("5",), {"k": "6"}, True),
+        ("Gt", ("5",), {"k": "5"}, False),
+        ("Lt", ("5",), {"k": "4"}, True),
+        ("Gt", ("5",), {"k": "abc"}, False),  # non-integer ⇒ no match
+        ("Gt", ("5",), {}, False),
+    ],
+)
+def test_requirement_matches(op, values, labels, want):
+    r = k8slabels.Requirement("k", op, values)
+    assert r.matches(labels) is want
+
+
+def test_toleration_semantics():
+    t_sched = Taint(key="a", value="v", effect="NoSchedule")
+    assert Toleration(key="a", operator="Equal", value="v").tolerates(t_sched)
+    assert not Toleration(key="a", operator="Equal", value="w").tolerates(t_sched)
+    assert Toleration(key="a", operator="Exists").tolerates(t_sched)
+    assert Toleration(operator="Exists").tolerates(t_sched)  # wildcard
+    assert not Toleration(key="b", operator="Exists").tolerates(t_sched)
+    # effect-scoped
+    assert not Toleration(key="a", operator="Exists", effect="NoExecute").tolerates(
+        t_sched
+    )
+
+
+def test_find_untolerated_taint_skips_prefer():
+    taints = [Taint(key="soft", effect="PreferNoSchedule"), Taint(key="hard")]
+    t = find_untolerated_taint(taints, [])
+    assert t is not None and t.key == "hard"
+    assert find_untolerated_taint(taints, [Toleration(key="hard", operator="Exists")]) is None
+
+
+def test_required_node_affinity():
+    node = Node(name="n1", labels={"zone": "us-a", "disk": "ssd"})
+    pod = Pod(name="p", node_selector={"zone": "us-a"})
+    assert required_node_affinity_matches(pod, node)
+    pod2 = Pod(name="p2", node_selector={"zone": "us-b"})
+    assert not required_node_affinity_matches(pod2, node)
+    # affinity terms ORed
+    aff = Affinity(
+        node_affinity=NodeAffinity(
+            required_during_scheduling_ignored_during_execution=NodeSelector(
+                (
+                    NodeSelectorTerm(
+                        match_expressions=(
+                            NodeSelectorRequirement("zone", "In", ("us-b",)),
+                        )
+                    ),
+                    NodeSelectorTerm(
+                        match_expressions=(
+                            NodeSelectorRequirement("disk", "In", ("ssd",)),
+                        )
+                    ),
+                )
+            )
+        )
+    )
+    pod3 = Pod(name="p3", affinity=aff)
+    assert required_node_affinity_matches(pod3, node)
+
+
+def test_node_allocatable_defaults_to_capacity():
+    n = Node(name="n", capacity=Resource.from_map({"cpu": "4", "memory": "8Gi"}))
+    assert n.allocatable.milli_cpu == 4000
+
+
+def test_host_ports_host_network():
+    from kubernetes_tpu.api.types import ContainerPort
+
+    pod = Pod(
+        name="p",
+        host_network=True,
+        containers=[Container(ports=(ContainerPort(container_port=8080),))],
+    )
+    ports = pod.host_ports()
+    assert len(ports) == 1 and ports[0].host_port == 8080

@@ -1,0 +1,402 @@
+"""Golden-model tests: oracle filter/score semantics against hand-computed
+expectations (shapes mirror the reference's plugin unit tests, e.g.
+noderesources/fit_test.go, interpodaffinity/filtering_test.go)."""
+
+import pytest
+
+from kubernetes_tpu.api import Container, Node, Pod, Resource, Taint, Toleration
+from kubernetes_tpu.api.types import (
+    Affinity,
+    LabelSelector,
+    PodAffinity,
+    PodAffinityTerm,
+    PodAntiAffinity,
+    NodeAffinity,
+    NodeSelector,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    PreferredSchedulingTerm,
+    TopologySpreadConstraint,
+    WeightedPodAffinityTerm,
+)
+from kubernetes_tpu.oracle import OracleState, filters as F, scores as S
+from kubernetes_tpu.oracle.pipeline import feasible_nodes, schedule_one
+
+
+def mknode(name, cpu="4", mem="8Gi", labels=None, taints=(), pods_cap=110, **kw):
+    return Node(
+        name=name,
+        labels=labels or {},
+        capacity=Resource.from_map({"cpu": cpu, "memory": mem, "pods": pods_cap}),
+        taints=tuple(taints),
+        **kw,
+    )
+
+
+def mkpod(name, cpu="0", mem="0", node=None, labels=None, ns="default", **kw):
+    return Pod(
+        name=name,
+        namespace=ns,
+        labels=labels or {},
+        node_name=node or "",
+        containers=[Container(requests={"cpu": cpu, "memory": mem})],
+        **kw,
+    )
+
+
+class TestResourcesFit:
+    def test_fits(self):
+        st = OracleState.build([mknode("n1", cpu="2")])
+        assert F.filter_node_resources(mkpod("p", cpu="1"), st.nodes["n1"]) == []
+
+    def test_insufficient_cpu(self):
+        st = OracleState.build([mknode("n1", cpu="2")], [mkpod("e", cpu="1500m", node="n1")])
+        reasons = F.filter_node_resources(mkpod("p", cpu="1"), st.nodes["n1"])
+        assert reasons == ["Insufficient cpu"]
+
+    def test_multiple_reasons(self):
+        st = OracleState.build([mknode("n1", cpu="1", mem="1Gi")])
+        reasons = F.filter_node_resources(mkpod("p", cpu="2", mem="2Gi"), st.nodes["n1"])
+        assert set(reasons) == {"Insufficient cpu", "Insufficient memory"}
+
+    def test_pods_limit(self):
+        st = OracleState.build(
+            [mknode("n1", pods_cap=1)], [mkpod("e", node="n1")]
+        )
+        assert F.filter_node_resources(mkpod("p"), st.nodes["n1"]) == ["Too many pods"]
+
+    def test_zero_request_always_fits_capacity(self):
+        st = OracleState.build([mknode("n1", cpu="1")], [mkpod("e", cpu="1", node="n1")])
+        assert F.filter_node_resources(mkpod("p"), st.nodes["n1"]) == []
+
+    def test_extended_resource(self):
+        n = mknode("n1")
+        n.allocatable.scalars["example.com/foo"] = 2
+        st = OracleState.build([n])
+        pod = Pod(name="p", containers=[Container(requests={"example.com/foo": "4"})])
+        assert F.filter_node_resources(pod, st.nodes["n1"]) == [
+            "Insufficient example.com/foo"
+        ]
+
+
+class TestTaints:
+    def test_untolerated(self):
+        st = OracleState.build([mknode("n1", taints=[Taint(key="k", value="v")])])
+        assert F.filter_taints(mkpod("p"), st.nodes["n1"]) is not None
+
+    def test_tolerated(self):
+        st = OracleState.build([mknode("n1", taints=[Taint(key="k", value="v")])])
+        pod = mkpod("p", tolerations=(Toleration(key="k", operator="Equal", value="v"),))
+        assert F.filter_taints(pod, st.nodes["n1"]) is None
+
+    def test_prefer_no_schedule_passes_filter(self):
+        st = OracleState.build(
+            [mknode("n1", taints=[Taint(key="k", effect="PreferNoSchedule")])]
+        )
+        assert F.filter_taints(mkpod("p"), st.nodes["n1"]) is None
+
+    def test_score_counts_intolerable_prefer(self):
+        st = OracleState.build(
+            [
+                mknode(
+                    "n1",
+                    taints=[
+                        Taint(key="a", effect="PreferNoSchedule"),
+                        Taint(key="b", effect="PreferNoSchedule"),
+                    ],
+                )
+            ]
+        )
+        pod = mkpod("p", tolerations=(Toleration(key="a", operator="Exists"),))
+        assert S.score_taint_toleration(pod, st.nodes["n1"]) == 1
+        assert S.normalize_taint_toleration([0, 1, 2]) == [100, 50, 0]
+
+
+class TestInterPodAffinity:
+    def zone_nodes(self):
+        return [
+            mknode("n1", labels={"zone": "a", "kubernetes.io/hostname": "n1"}),
+            mknode("n2", labels={"zone": "b", "kubernetes.io/hostname": "n2"}),
+        ]
+
+    def test_required_affinity_needs_match_in_domain(self):
+        st = OracleState.build(
+            self.zone_nodes(), [mkpod("e", node="n1", labels={"app": "db"})]
+        )
+        pod = mkpod(
+            "p",
+            affinity=Affinity(
+                pod_affinity=PodAffinity(
+                    required_during_scheduling_ignored_during_execution=(
+                        PodAffinityTerm(
+                            topology_key="zone",
+                            label_selector=LabelSelector(match_labels={"app": "db"}),
+                        ),
+                    )
+                )
+            ),
+        )
+        assert F.filter_interpod_affinity(pod, st.nodes["n1"], st) is None
+        assert F.filter_interpod_affinity(pod, st.nodes["n2"], st) is not None
+
+    def test_first_pod_self_match_escape(self):
+        st = OracleState.build(self.zone_nodes())
+        pod = mkpod(
+            "p",
+            labels={"app": "db"},
+            affinity=Affinity(
+                pod_affinity=PodAffinity(
+                    required_during_scheduling_ignored_during_execution=(
+                        PodAffinityTerm(
+                            topology_key="zone",
+                            label_selector=LabelSelector(match_labels={"app": "db"}),
+                        ),
+                    )
+                )
+            ),
+        )
+        # No pod matches anywhere + self-match ⇒ allowed.
+        assert F.filter_interpod_affinity(pod, st.nodes["n1"], st) is None
+
+    def test_incoming_anti_affinity(self):
+        st = OracleState.build(
+            self.zone_nodes(), [mkpod("e", node="n1", labels={"app": "db"})]
+        )
+        pod = mkpod(
+            "p",
+            affinity=Affinity(
+                pod_anti_affinity=PodAntiAffinity(
+                    required_during_scheduling_ignored_during_execution=(
+                        PodAffinityTerm(
+                            topology_key="zone",
+                            label_selector=LabelSelector(match_labels={"app": "db"}),
+                        ),
+                    )
+                )
+            ),
+        )
+        assert F.filter_interpod_affinity(pod, st.nodes["n1"], st) is not None
+        assert F.filter_interpod_affinity(pod, st.nodes["n2"], st) is None
+
+    def test_existing_anti_affinity_symmetry(self):
+        existing = mkpod(
+            "e",
+            node="n1",
+            affinity=Affinity(
+                pod_anti_affinity=PodAntiAffinity(
+                    required_during_scheduling_ignored_during_execution=(
+                        PodAffinityTerm(
+                            topology_key="zone",
+                            label_selector=LabelSelector(match_labels={"app": "web"}),
+                        ),
+                    )
+                )
+            ),
+        )
+        st = OracleState.build(self.zone_nodes(), [existing])
+        pod = mkpod("p", labels={"app": "web"})
+        assert F.filter_interpod_affinity(pod, st.nodes["n1"], st) is not None
+        assert F.filter_interpod_affinity(pod, st.nodes["n2"], st) is None
+
+    def test_namespace_scoping(self):
+        st = OracleState.build(
+            self.zone_nodes(),
+            [mkpod("e", node="n1", labels={"app": "db"}, ns="other")],
+        )
+        pod = mkpod(
+            "p",
+            affinity=Affinity(
+                pod_affinity=PodAffinity(
+                    required_during_scheduling_ignored_during_execution=(
+                        PodAffinityTerm(
+                            topology_key="zone",
+                            label_selector=LabelSelector(match_labels={"app": "db"}),
+                        ),
+                    )
+                )
+            ),
+        )
+        # Term defaults to pod's own namespace; existing pod is in "other".
+        assert F.filter_interpod_affinity(pod, st.nodes["n1"], st) is not None
+        pod2 = mkpod(
+            "p2",
+            affinity=Affinity(
+                pod_affinity=PodAffinity(
+                    required_during_scheduling_ignored_during_execution=(
+                        PodAffinityTerm(
+                            topology_key="zone",
+                            namespaces=("other",),
+                            label_selector=LabelSelector(match_labels={"app": "db"}),
+                        ),
+                    )
+                )
+            ),
+        )
+        assert F.filter_interpod_affinity(pod2, st.nodes["n1"], st) is None
+
+    def test_preferred_scoring(self):
+        st = OracleState.build(
+            self.zone_nodes(), [mkpod("e", node="n1", labels={"app": "db"})]
+        )
+        pod = mkpod(
+            "p",
+            affinity=Affinity(
+                pod_affinity=PodAffinity(
+                    preferred_during_scheduling_ignored_during_execution=(
+                        WeightedPodAffinityTerm(
+                            weight=5,
+                            pod_affinity_term=PodAffinityTerm(
+                                topology_key="zone",
+                                label_selector=LabelSelector(
+                                    match_labels={"app": "db"}
+                                ),
+                            ),
+                        ),
+                    )
+                )
+            ),
+        )
+        raw = S.score_interpod_affinity_all(pod, st, ["n1", "n2"])
+        assert raw == [5, 0]
+        assert S.normalize_interpod_affinity(raw) == [100, 0]
+
+
+class TestTopologySpread:
+    def nodes(self):
+        return [
+            mknode("n1", labels={"zone": "a", "kubernetes.io/hostname": "n1"}),
+            mknode("n2", labels={"zone": "a", "kubernetes.io/hostname": "n2"}),
+            mknode("n3", labels={"zone": "b", "kubernetes.io/hostname": "n3"}),
+        ]
+
+    def spread_pod(self, name, max_skew=1, when="DoNotSchedule", **kw):
+        return mkpod(
+            name,
+            labels={"app": "x"},
+            topology_spread_constraints=(
+                TopologySpreadConstraint(
+                    max_skew=max_skew,
+                    topology_key="zone",
+                    when_unsatisfiable=when,
+                    label_selector=LabelSelector(match_labels={"app": "x"}),
+                ),
+            ),
+            **kw,
+        )
+
+    def test_skew_rejects(self):
+        st = OracleState.build(
+            self.nodes(),
+            [
+                mkpod("e1", node="n1", labels={"app": "x"}),
+                mkpod("e2", node="n2", labels={"app": "x"}),
+            ],
+        )
+        pod = self.spread_pod("p")
+        # zone a has 2, zone b has 0; placing in a gives skew 3-0 > 1.
+        assert F.filter_topology_spread(pod, st.nodes["n1"], st) is not None
+        assert F.filter_topology_spread(pod, st.nodes["n3"], st) is None
+
+    def test_missing_label_rejects(self):
+        ns = self.nodes() + [mknode("n4", labels={"kubernetes.io/hostname": "n4"})]
+        st = OracleState.build(ns)
+        pod = self.spread_pod("p")
+        assert F.filter_topology_spread(pod, st.nodes["n4"], st) is not None
+
+    def test_min_domains(self):
+        st = OracleState.build(
+            self.nodes()[:2],  # only zone a exists
+            [mkpod("e1", node="n1", labels={"app": "x"})],
+        )
+        pod = self.spread_pod("p")
+        pod.topology_spread_constraints = (
+            TopologySpreadConstraint(
+                max_skew=1,
+                topology_key="zone",
+                when_unsatisfiable="DoNotSchedule",
+                label_selector=LabelSelector(match_labels={"app": "x"}),
+                min_domains=2,
+            ),
+        )
+        # Only 1 domain < minDomains 2 ⇒ minMatch=0 ⇒ skew=1+1-0=2 > 1.
+        assert F.filter_topology_spread(pod, st.nodes["n1"], st) is not None
+
+    def test_soft_scoring_prefers_empty_domain(self):
+        st = OracleState.build(
+            self.nodes(),
+            [
+                mkpod("e1", node="n1", labels={"app": "x"}),
+                mkpod("e2", node="n2", labels={"app": "x"}),
+            ],
+        )
+        pod = self.spread_pod("p", when="ScheduleAnyway")
+        raw = S.score_topology_spread_all(pod, st, ["n1", "n2", "n3"])
+        norm = S.normalize_topology_spread(raw)
+        assert norm[2] > norm[0] and norm[2] > norm[1]
+
+
+class TestScores:
+    def test_least_allocated(self):
+        st = OracleState.build([mknode("n1", cpu="4", mem="4Gi")])
+        pod = mkpod("p", cpu="1", mem="1Gi")
+        # cpu: (4000-1000)*100/4000=75; mem: (4Gi-1Gi)*100/4Gi=75 → 75
+        assert S.score_least_allocated(pod, st.nodes["n1"]) == 75
+
+    def test_least_allocated_nonzero_defaults(self):
+        st = OracleState.build([mknode("n1", cpu="1", mem="1000Mi")])
+        pod = mkpod("p")  # zero requests default to 100m/200Mi
+        # cpu: (1000-100)*100/1000=90; mem: (1000-200)*100/1000=80 → 85
+        assert S.score_least_allocated(pod, st.nodes["n1"]) == 85
+
+    def test_balanced_allocation(self):
+        st = OracleState.build([mknode("n1", cpu="4", mem="4Gi")])
+        pod = mkpod("p", cpu="2", mem="2Gi")
+        # fractions equal → std 0 → 100
+        assert S.score_balanced_allocation(pod, st.nodes["n1"]) == 100
+        pod2 = mkpod("p2", cpu="4", mem="0")
+        # fractions 1.0, 0.0 → std 0.5 → 50
+        assert S.score_balanced_allocation(pod2, st.nodes["n1"]) == 50
+
+    def test_node_affinity_preferred(self):
+        st = OracleState.build([mknode("n1", labels={"disk": "ssd"})])
+        pod = mkpod(
+            "p",
+            affinity=Affinity(
+                node_affinity=NodeAffinity(
+                    preferred_during_scheduling_ignored_during_execution=(
+                        PreferredSchedulingTerm(
+                            weight=10,
+                            preference=NodeSelectorTerm(
+                                match_expressions=(
+                                    NodeSelectorRequirement("disk", "In", ("ssd",)),
+                                )
+                            ),
+                        ),
+                    )
+                )
+            ),
+        )
+        assert S.score_node_affinity(pod, st.nodes["n1"]) == 10
+
+
+class TestPipeline:
+    def test_schedule_one_picks_least_loaded(self):
+        st = OracleState.build(
+            [mknode("n1"), mknode("n2")],
+            [mkpod("e", cpu="2", node="n1")],
+        )
+        res = schedule_one(mkpod("p", cpu="1"), st)
+        assert res.node == "n2"
+
+    def test_schedule_one_unschedulable(self):
+        st = OracleState.build([mknode("n1", cpu="1")])
+        res = schedule_one(mkpod("p", cpu="2"), st)
+        assert res.node is None
+        assert "Insufficient cpu" in res.reasons["n1"]
+
+    def test_node_selector_filter(self):
+        st = OracleState.build(
+            [mknode("n1", labels={"zone": "a"}), mknode("n2", labels={"zone": "b"})]
+        )
+        res = schedule_one(mkpod("p", node_selector={"zone": "b"}), st)
+        assert res.node == "n2"

@@ -1,0 +1,150 @@
+"""Packing tests: object model → interned tensors round-trip sanity."""
+
+import numpy as np
+
+from kubernetes_tpu.api import Container, Node, Pod, Resource, Taint, Toleration
+from kubernetes_tpu.api.types import (
+    Affinity,
+    NodeAffinity,
+    NodeSelector,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+)
+from kubernetes_tpu.snapshot import (
+    Vocab,
+    pack_existing_pods,
+    pack_nodes,
+    pack_pod_batch,
+)
+from kubernetes_tpu.snapshot.interner import ABSENT, PAD
+from kubernetes_tpu.snapshot.schema import LANE_CPU, LANE_MEM, write_node_row
+
+
+def test_pack_nodes_basic():
+    vocab = Vocab()
+    nodes = [
+        Node(
+            name="n1",
+            labels={"zone": "a"},
+            capacity=Resource.from_map({"cpu": "4", "memory": "8Gi", "pods": 110}),
+            taints=(Taint(key="gpu", value="true", effect="NoSchedule"),),
+        ),
+        Node(
+            name="n2",
+            labels={"zone": "b"},
+            capacity=Resource.from_map({"cpu": "2", "memory": "4Gi", "pods": 110}),
+            unschedulable=True,
+        ),
+    ]
+    nt = pack_nodes(nodes, vocab)
+    assert nt.valid[:2].all() and not nt.valid[2:].any()
+    assert nt.allocatable[0, LANE_CPU] == 4000
+    assert nt.allocatable[1, LANE_MEM] == 4 * 1024 * 1024  # KiB
+    zone_key = vocab.label_keys.lookup("zone")
+    assert nt.label_vals[0, zone_key] == vocab.label_vals.lookup("a")
+    assert nt.label_vals[1, zone_key] == vocab.label_vals.lookup("b")
+    # metadata.name pseudo-label present
+    name_key = vocab.label_keys.lookup("metadata.name")
+    assert nt.label_vals[0, name_key] == vocab.label_vals.lookup("n1")
+    assert nt.taint_key[0, 0] == vocab.label_keys.lookup("gpu")
+    assert (nt.taint_key[1] == PAD).all()
+    assert nt.unschedulable[1] and not nt.unschedulable[0]
+    assert nt.name_to_idx == {"n1": 0, "n2": 1}
+
+
+def test_write_node_row_update():
+    vocab = Vocab()
+    nodes = [Node(name="n1", capacity=Resource.from_map({"cpu": "4", "memory": "8Gi"}))]
+    nt = pack_nodes(nodes, vocab)
+    updated = Node(
+        name="n1",
+        labels={"disk": "ssd"},
+        capacity=Resource.from_map({"cpu": "8", "memory": "8Gi"}),
+    )
+    write_node_row(nt, 0, updated, vocab)
+    assert nt.allocatable[0, LANE_CPU] == 8000
+    disk = vocab.label_keys.lookup("disk")
+    assert nt.label_vals[0, disk] == vocab.label_vals.lookup("ssd")
+
+
+def test_pack_existing_pods_and_anti_terms():
+    from kubernetes_tpu.api.types import (
+        LabelSelector,
+        PodAffinityTerm,
+        PodAntiAffinity,
+    )
+
+    vocab = Vocab()
+    nodes = [Node(name="n1", capacity=Resource.from_map({"cpu": "4", "memory": "1Gi"}))]
+    nt = pack_nodes(nodes, vocab)
+    pods = [
+        Pod(name="e1", node_name="n1", labels={"app": "db"}),
+        Pod(
+            name="e2",
+            node_name="n1",
+            affinity=Affinity(
+                pod_anti_affinity=PodAntiAffinity(
+                    required_during_scheduling_ignored_during_execution=(
+                        PodAffinityTerm(
+                            topology_key="zone",
+                            label_selector=LabelSelector(match_labels={"app": "web"}),
+                        ),
+                    )
+                )
+            ),
+        ),
+    ]
+    ep = pack_existing_pods(pods, nt.name_to_idx, vocab)
+    assert ep.valid[:2].all()
+    assert ep.node_idx[0] == 0
+    app = vocab.label_keys.lookup("app")
+    assert ep.label_vals[0, app] == vocab.label_vals.lookup("db")
+    # one anti term row, attached to pod 1
+    assert ep.anti_term_pod[0] == 1
+    assert ep.anti_topo_key[0] == vocab.label_keys.lookup("zone")
+    assert ep.anti_table.term_valid[0, 0]
+
+
+def test_pack_pod_batch_selectors_and_tolerations():
+    vocab = Vocab()
+    nodes = [Node(name="n1", capacity=Resource.from_map({"cpu": "4", "memory": "1Gi"}))]
+    nt = pack_nodes(nodes, vocab)
+    pod = Pod(
+        name="p",
+        containers=[Container(requests={"cpu": "500m", "memory": "256Mi"})],
+        node_selector={"zone": "a"},
+        tolerations=(Toleration(key="gpu", operator="Exists", effect="NoSchedule"),),
+        affinity=Affinity(
+            node_affinity=NodeAffinity(
+                required_during_scheduling_ignored_during_execution=NodeSelector(
+                    (
+                        NodeSelectorTerm(
+                            match_expressions=(
+                                NodeSelectorRequirement("disk", "In", ("ssd", "nvme")),
+                            )
+                        ),
+                    )
+                )
+            )
+        ),
+    )
+    pb = pack_pod_batch([pod], vocab, k_cap=nt.k_cap, p_cap=4)
+    assert pb.valid[0] and not pb.valid[1:].any()
+    assert pb.requests[0, LANE_CPU] == 500
+    assert pb.requests[0, LANE_MEM] == 256 * 1024
+    # merged DNF: one term with zone req AND disk req
+    assert pb.node_sel.term_valid[0, 0]
+    assert not pb.node_sel.term_valid[0, 1:].any()
+    keys = set(pb.node_sel.req_key[0, 0][pb.node_sel.req_op[0, 0] != PAD].tolist())
+    assert keys == {vocab.label_keys.lookup("zone"), vocab.label_keys.lookup("disk")}
+    # tolerations packed
+    assert pb.tol_key[0, 0] == vocab.label_keys.lookup("gpu")
+    # padded pods match nothing
+    assert not pb.node_sel.term_valid[1].any()
+
+
+def test_nonzero_requests_defaults():
+    vocab = Vocab()
+    pb = pack_pod_batch([Pod(name="p")], vocab, k_cap=8)
+    assert pb.nonzero_req[0, 0] == 100  # default 100m
+    assert pb.nonzero_req[0, 1] == 200 * 1024  # default 200Mi in KiB
