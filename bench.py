@@ -1,0 +1,154 @@
+"""Benchmark: batched device scheduling throughput (pods/s).
+
+Shape mirrors the reference's scheduler_perf SchedulingBasic workload
+(5000 nodes / 10000 pods; CI floor 270 pods/s, BASELINE.md) — nodes are
+API objects only, pods carry plain resource requests, and the measured
+quantity is end-to-end scheduling decisions per second including host→device
+batch packing.
+
+Prints exactly one JSON line:
+  {"metric": "...", "value": N, "unit": "pods/s", "vs_baseline": N}
+"""
+
+import json
+import os
+import random
+import sys
+import time
+
+import jax
+
+try:
+    # jax is preloaded at interpreter start here; config.update still works
+    # until the backend is first used.
+    jax.config.update("jax_enable_x64", True)
+except Exception:
+    pass
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+N_NODES = int(os.environ.get("BENCH_NODES", "5000"))
+N_PODS = int(os.environ.get("BENCH_PODS", "10000"))
+BATCH = int(os.environ.get("BENCH_BATCH", "512"))
+BASELINE_PODS_PER_S = 270.0  # performance-config.yaml:51 floor
+
+
+def make_basic_pod(rng: random.Random, i: int):
+    from kubernetes_tpu.api.types import Container, Pod
+
+    return Pod(
+        name=f"pod-{i}",
+        namespace="default",
+        labels={"app": f"app-{i % 10}"},
+        containers=[
+            Container(
+                name="c",
+                requests={
+                    "cpu": f"{rng.choice([100, 250, 500])}m",
+                    "memory": f"{rng.choice([128, 256, 512])}Mi",
+                },
+            )
+        ],
+    )
+
+
+def main():
+    import numpy as np
+
+    from kubernetes_tpu.api.resource import Resource
+    from kubernetes_tpu.api.types import Node
+    from kubernetes_tpu.oracle.scores import HOSTNAME_LABEL
+    from kubernetes_tpu.oracle.state import OracleState
+    from kubernetes_tpu.ops.common import DeviceBatch, DeviceCluster
+    from kubernetes_tpu.ops.pipeline import _pipeline
+    from kubernetes_tpu.snapshot.cluster import pack_cluster
+    from kubernetes_tpu.snapshot.interner import Vocab
+    from kubernetes_tpu.snapshot.schema import ResourceLanes, bucket_cap, pack_pod_batch
+
+    import jax
+    import jax.numpy as jnp
+
+    rng = random.Random(42)
+    nodes = [
+        Node(
+            name=f"node-{i}",
+            labels={
+                "topology.kubernetes.io/zone": f"zone-{i % 3}",
+                HOSTNAME_LABEL: f"node-{i}",
+            },
+            capacity=Resource.from_map(
+                {"cpu": "8", "memory": "32Gi", "pods": 110}
+            ),
+        )
+        for i in range(N_NODES)
+    ]
+    state = OracleState.build(nodes)
+    pods = [make_basic_pod(rng, i) for i in range(N_PODS)]
+
+    vocab = Vocab()
+    pc = pack_cluster(state, vocab, pending_pods=pods[:BATCH])
+    v_cap = bucket_cap(len(vocab.label_vals))
+    hostname_key = jnp.asarray(vocab.label_keys.lookup(HOSTNAME_LABEL), jnp.int32)
+    lanes = ResourceLanes(vocab)
+
+    dc = DeviceCluster.from_host(pc.nodes, pc.existing, vocab)
+
+    # Warm up the compile cache with the steady-state shapes.
+    pb0 = pack_pod_batch(pods[:BATCH], vocab, k_cap=pc.nodes.k_cap, p_cap=BATCH)
+    db0 = DeviceBatch.from_host(pb0)
+    res = _pipeline(dc, db0, hostname_key, v_cap)
+    res.chosen.block_until_ready()
+
+    # Timed run: schedule every pod, committing capacity between batches
+    # (host-side requested update emulating the assume step).
+    requested = np.array(pc.nodes.requested)
+    num_pods = np.array(pc.nodes.num_pods)
+    scheduled = 0
+    t_pack = t_dev = 0.0
+    t0 = time.perf_counter()
+    for start in range(0, N_PODS, BATCH):
+        chunk = pods[start : start + BATCH]
+        tp = time.perf_counter()
+        pb = pack_pod_batch(chunk, vocab, k_cap=pc.nodes.k_cap, p_cap=BATCH)
+        db = DeviceBatch.from_host(pb)
+        dc = dc.__class__(
+            **{
+                **dc.__dict__,
+                "requested": jnp.asarray(requested),
+                "num_pods": jnp.asarray(num_pods),
+            }
+        )
+        td = time.perf_counter()
+        t_pack += td - tp
+        # Fetch only the [P] decisions — never the [P, N] working set.
+        res = _pipeline(dc, db, hostname_key, v_cap)
+        chosen = jax.device_get(res.chosen)
+        t_dev += time.perf_counter() - td
+        for i, pod in enumerate(chunk):
+            j = int(chosen[i])
+            if j < 0:
+                continue
+            requested[j] += pb.requests[i]
+            num_pods[j] += 1
+            scheduled += 1
+    dt = time.perf_counter() - t0
+    print(
+        f"# pack={t_pack:.2f}s device+fetch={t_dev:.2f}s total={dt:.2f}s",
+        file=sys.stderr,
+    )
+
+    pods_per_s = scheduled / dt
+    print(
+        json.dumps(
+            {
+                "metric": f"scheduling_throughput_{N_NODES}nodes_{N_PODS}pods",
+                "value": round(pods_per_s, 1),
+                "unit": "pods/s",
+                "vs_baseline": round(pods_per_s / BASELINE_PODS_PER_S, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

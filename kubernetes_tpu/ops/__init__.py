@@ -1,0 +1,15 @@
+"""Batched device kernels.
+
+Every kernel evaluates one scheduler plugin's Filter/Score semantics for a
+whole ``(pods × nodes)`` batch at once — the TPU-native replacement for the
+reference's per-node Parallelizer loops (pkg/scheduler/schedule_one.go:588,
+framework/runtime/framework.go:1101).  Inputs are the packed int32 tensors
+from kubernetes_tpu.snapshot; outputs are ``[P, N]`` boolean feasibility
+masks and integer scores, bit-matched against kubernetes_tpu.oracle.
+"""
+
+from kubernetes_tpu.ops.common import (  # noqa: F401
+    DeviceBatch,
+    DeviceCluster,
+    eval_table,
+)

@@ -1,0 +1,403 @@
+"""Shared kernel machinery: device containers and primitive evaluators.
+
+The conjunction-table evaluator here is the device analogue of
+labels.Selector.Matches / nodeaffinity.RequiredNodeAffinity.Match in the
+reference (staging/src/k8s.io/apimachinery/pkg/labels/selector.go,
+component-helpers/scheduling/corev1/nodeaffinity) — one vectorized pass
+instead of per-object interpreter loops.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, fields
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubernetes_tpu.snapshot.interner import ABSENT, INT_INVALID, PAD
+from kubernetes_tpu.snapshot.schema import (
+    ConjunctionTable,
+    ExistingPodTensors,
+    NodeTensors,
+    PodBatch,
+)
+from kubernetes_tpu.snapshot.selectors import (
+    OP_DOES_NOT_EXIST,
+    OP_EXISTS,
+    OP_GT,
+    OP_IN,
+    OP_LT,
+    OP_NOT_IN,
+)
+
+I32 = jnp.int32
+I64 = jnp.int64
+
+
+def _register_pytree(cls):
+    """Register a plain dataclass of arrays as a JAX pytree."""
+    names = [f.name for f in fields(cls)]
+
+    def flatten(x):
+        return tuple(getattr(x, n) for n in names), None
+
+    def unflatten(_, children):
+        return cls(*children)
+
+    jax.tree_util.register_pytree_node(cls, flatten, unflatten)
+    return cls
+
+
+@_register_pytree
+@dataclass
+class DTable:
+    """Device copy of a ConjunctionTable."""
+
+    req_key: Any  # i32 [..., R]
+    req_op: Any  # i32 [..., R]
+    req_vals: Any  # i32 [..., R, V]
+    req_rhs: Any  # i32 [..., R]
+    term_valid: Any  # bool [...]
+
+    @classmethod
+    def from_host(cls, t: ConjunctionTable) -> "DTable":
+        return cls(
+            req_key=jnp.asarray(t.req_key, I32),
+            req_op=jnp.asarray(t.req_op, I32),
+            req_vals=jnp.asarray(t.req_vals, I32),
+            req_rhs=jnp.asarray(t.req_rhs, I32),
+            term_valid=jnp.asarray(t.term_valid, bool),
+        )
+
+
+@_register_pytree
+@dataclass
+class DeviceCluster:
+    """HBM-resident cluster snapshot (nodes + placed pods + their terms)."""
+
+    # nodes
+    allocatable: Any  # i32 [N, R]
+    requested: Any  # i32 [N, R]
+    nonzero_req: Any  # i32 [N, 2]
+    num_pods: Any  # i32 [N]
+    allowed_pods: Any  # i32 [N]
+    node_labels: Any  # i32 [N, K]
+    val_ints: Any  # i32 [V]
+    taint_key: Any  # i32 [N, T]
+    taint_val: Any  # i32 [N, T]
+    taint_effect: Any  # i32 [N, T]
+    unschedulable: Any  # bool [N]
+    node_valid: Any  # bool [N]
+    used_ppk: Any  # i32 [N, U]
+    used_ip: Any  # i32 [N, U]
+    used_wild: Any  # bool [N, U]
+    img_sizes: Any  # i64 [N, IMG]
+    # placed pods
+    epod_node: Any  # i32 [E]
+    epod_ns: Any  # i32 [E]
+    epod_labels: Any  # i32 [E, K]
+    epod_valid: Any  # bool [E]
+    epod_deleting: Any  # bool [E]
+    # flattened (anti-)affinity terms of placed pods
+    term_pod: Any  # i32 [M]
+    term_kind: Any  # i32 [M]
+    term_topo: Any  # i32 [M]
+    term_weight: Any  # i32 [M]
+    term_table: DTable  # [M, 1, ...]
+    term_ns_all: Any  # bool [M]
+    term_ns_ids: Any  # i32 [M, NS]
+    # scalar ids resolved from the vocab (traced so vocab growth ≠ recompile)
+    name_key: Any  # i32  label-key id of metadata.name
+    unsched_key: Any  # i32  label-key id of node.kubernetes.io/unschedulable
+    empty_val: Any  # i32  label-val id of ""
+    n_valid_nodes: Any  # i32  number of real nodes
+    log_tab: Any  # i64 [N+2]  fixed-point round(log(i+2)·2^32) table
+
+    @classmethod
+    def from_host(cls, nt: NodeTensors, ep: ExistingPodTensors, vocab) -> "DeviceCluster":
+        from kubernetes_tpu.snapshot.selectors import METADATA_NAME_KEY
+
+        n = int(nt.valid.sum())
+        log_tab = np.round(
+            np.log(np.arange(nt.n_cap + 2, dtype=np.float64) + 2.0) * (1 << 32)
+        ).astype(np.int64)
+        return cls(
+            allocatable=jnp.asarray(nt.allocatable, I32),
+            requested=jnp.asarray(nt.requested, I32),
+            nonzero_req=jnp.asarray(nt.nonzero_req, I32),
+            num_pods=jnp.asarray(nt.num_pods, I32),
+            allowed_pods=jnp.asarray(nt.allowed_pods, I32),
+            node_labels=jnp.asarray(nt.label_vals, I32),
+            val_ints=jnp.asarray(nt.val_ints, I32),
+            taint_key=jnp.asarray(nt.taint_key, I32),
+            taint_val=jnp.asarray(nt.taint_val, I32),
+            taint_effect=jnp.asarray(nt.taint_effect, I32),
+            unschedulable=jnp.asarray(nt.unschedulable, bool),
+            node_valid=jnp.asarray(nt.valid, bool),
+            used_ppk=jnp.asarray(nt.used_ppk, I32),
+            used_ip=jnp.asarray(nt.used_ip, I32),
+            used_wild=jnp.asarray(nt.used_wild, bool),
+            img_sizes=jnp.asarray(nt.img_sizes, I64),
+            epod_node=jnp.asarray(ep.node_idx, I32),
+            epod_ns=jnp.asarray(ep.ns_id, I32),
+            epod_labels=jnp.asarray(ep.label_vals, I32),
+            epod_valid=jnp.asarray(ep.valid, bool),
+            epod_deleting=jnp.asarray(ep.deleting, bool),
+            term_pod=jnp.asarray(ep.term_pod, I32),
+            term_kind=jnp.asarray(ep.term_kind, I32),
+            term_topo=jnp.asarray(ep.term_topo_key, I32),
+            term_weight=jnp.asarray(ep.term_weight, I32),
+            term_table=DTable.from_host(ep.term_table),
+            term_ns_all=jnp.asarray(ep.term_ns_all, bool),
+            term_ns_ids=jnp.asarray(ep.term_ns_ids, I32),
+            name_key=jnp.asarray(vocab.label_keys.lookup(METADATA_NAME_KEY), I32),
+            unsched_key=jnp.asarray(
+                vocab.label_keys.lookup("node.kubernetes.io/unschedulable"), I32
+            ),
+            empty_val=jnp.asarray(vocab.label_vals.lookup(""), I32),
+            n_valid_nodes=jnp.asarray(n, I32),
+            log_tab=jnp.asarray(log_tab),
+        )
+
+
+@_register_pytree
+@dataclass
+class DeviceBatch:
+    """Pending-pod batch on device."""
+
+    requests: Any  # i32 [P, R]
+    nonzero_req: Any  # i32 [P, 2]
+    ns_id: Any  # i32 [P]
+    priority: Any  # i32 [P]
+    labels: Any  # i32 [P, K]
+    valid: Any  # bool [P]
+    node_sel: DTable  # [P, T, ...]
+    pref_node: DTable  # [P, PT, ...]
+    pref_weight: Any  # i32 [P, PT]
+    tol_key: Any  # i32 [P, TL]
+    tol_op: Any  # i32 [P, TL]
+    tol_val: Any  # i32 [P, TL]
+    tol_effect: Any  # i32 [P, TL]
+    tsc_table: DTable  # [P, C, ...]
+    tsc_topo: Any  # i32 [P, C]
+    tsc_max_skew: Any  # i32 [P, C]
+    tsc_hard: Any  # bool [P, C]
+    tsc_min_domains: Any  # i32 [P, C]
+    tsc_honor_affinity: Any  # bool [P, C]
+    tsc_honor_taints: Any  # bool [P, C]
+    aff_table: DTable  # [P, AT, ...]
+    aff_kind: Any  # i32 [P, AT]
+    aff_topo: Any  # i32 [P, AT]
+    aff_weight: Any  # i32 [P, AT]
+    aff_ns_all: Any  # bool [P, AT]
+    aff_ns_ids: Any  # i32 [P, AT, NS]
+    target_name_val: Any  # i32 [P]
+    want_ppk: Any  # i32 [P, W]
+    want_ip: Any  # i32 [P, W]
+    want_wild: Any  # bool [P, W]
+    img_ids: Any  # i32 [P, I]
+    n_containers: Any  # i32 [P]
+
+    @classmethod
+    def from_host(cls, pb: PodBatch) -> "DeviceBatch":
+        return cls(
+            requests=jnp.asarray(pb.requests, I32),
+            nonzero_req=jnp.asarray(pb.nonzero_req, I32),
+            ns_id=jnp.asarray(pb.ns_id, I32),
+            priority=jnp.asarray(pb.priority, I32),
+            labels=jnp.asarray(pb.label_vals, I32),
+            valid=jnp.asarray(pb.valid, bool),
+            node_sel=DTable.from_host(pb.node_sel),
+            pref_node=DTable.from_host(pb.pref_node),
+            pref_weight=jnp.asarray(pb.pref_weight, I32),
+            tol_key=jnp.asarray(pb.tol_key, I32),
+            tol_op=jnp.asarray(pb.tol_op, I32),
+            tol_val=jnp.asarray(pb.tol_val, I32),
+            tol_effect=jnp.asarray(pb.tol_effect, I32),
+            tsc_table=DTable.from_host(pb.tsc_table),
+            tsc_topo=jnp.asarray(pb.tsc_topo_key, I32),
+            tsc_max_skew=jnp.asarray(pb.tsc_max_skew, I32),
+            tsc_hard=jnp.asarray(pb.tsc_hard, bool),
+            tsc_min_domains=jnp.asarray(pb.tsc_min_domains, I32),
+            tsc_honor_affinity=jnp.asarray(pb.tsc_honor_affinity, bool),
+            tsc_honor_taints=jnp.asarray(pb.tsc_honor_taints, bool),
+            aff_table=DTable.from_host(pb.aff_table),
+            aff_kind=jnp.asarray(pb.aff_kind, I32),
+            aff_topo=jnp.asarray(pb.aff_topo_key, I32),
+            aff_weight=jnp.asarray(pb.aff_weight, I32),
+            aff_ns_all=jnp.asarray(pb.aff_ns_all, bool),
+            aff_ns_ids=jnp.asarray(pb.aff_ns_ids, I32),
+            target_name_val=jnp.asarray(pb.target_name_val, I32),
+            want_ppk=jnp.asarray(pb.want_ppk, I32),
+            want_ip=jnp.asarray(pb.want_ip, I32),
+            want_wild=jnp.asarray(pb.want_wild, bool),
+            img_ids=jnp.asarray(pb.img_ids, I32),
+            n_containers=jnp.asarray(pb.n_containers, I32),
+        )
+
+
+# ---------------------------------------------------------------------------
+# Conjunction evaluation
+# ---------------------------------------------------------------------------
+
+
+def eval_table(table: DTable, label_vals, val_ints):
+    """Evaluate every conjunction against every label row.
+
+    table arrays have shape ``lead + (R,)`` / ``lead + (R, V)``; ``label_vals``
+    is ``[N, K]``.  Returns matches ``lead + (N,)`` — term_valid is already
+    folded in (invalid/padding terms match nothing).
+
+    Requirement semantics mirror labels.Requirement.Matches (selector.go):
+    NotIn also matches absent keys; Gt/Lt need integer-parsing both sides.
+    The static R/V loops keep peak memory at one ``lead+(N,)`` buffer per op.
+    """
+    R = table.req_key.shape[-1]
+    V = table.req_vals.shape[-1]
+    N, K = label_vals.shape
+    cols = label_vals.T  # [K, N]
+
+    ok = None
+    for r in range(R):
+        key = table.req_key[..., r]  # lead
+        op = table.req_op[..., r]
+        rhs = table.req_rhs[..., r]
+        key_known = (key >= 0) & (key < K)
+        safe_key = jnp.clip(key, 0, K - 1)
+        val = jnp.where(key_known[..., None], cols[safe_key], ABSENT)  # lead+(N,)
+        present = val >= 0
+
+        in_any = jnp.zeros_like(present)
+        for v in range(V):
+            rv = table.req_vals[..., r, v]
+            in_any = in_any | (present & (val == rv[..., None]) & (rv >= 0)[..., None])
+
+        iv = jnp.where(
+            present,
+            val_ints[jnp.clip(val, 0, val_ints.shape[0] - 1)],
+            INT_INVALID,
+        )
+        int_ok = (iv != INT_INVALID) & (rhs != INT_INVALID)[..., None]
+
+        opb = op[..., None]
+        res = jnp.where(
+            opb == OP_IN,
+            in_any,
+            jnp.where(
+                opb == OP_NOT_IN,
+                ~in_any,
+                jnp.where(
+                    opb == OP_EXISTS,
+                    present,
+                    jnp.where(
+                        opb == OP_DOES_NOT_EXIST,
+                        ~present,
+                        jnp.where(
+                            opb == OP_GT,
+                            int_ok & (iv > rhs[..., None]),
+                            int_ok & (iv < rhs[..., None]),  # OP_LT
+                        ),
+                    ),
+                ),
+            ),
+        )
+        res = jnp.where(opb == PAD, True, res)  # padded requirement slot
+        ok = res if ok is None else (ok & res)
+    if ok is None:
+        ok = jnp.ones(table.req_key.shape[:-1] + (N,), bool)
+    return ok & table.term_valid[..., None]
+
+
+def dnf_any(term_matches):
+    """OR over the term axis (second-to-last): ``lead+(T, N)`` → ``lead+(N,)``."""
+    return jnp.any(term_matches, axis=-2)
+
+
+def ns_member(ns_all, ns_ids, target_ns):
+    """Namespace-set membership: ``lead`` bools / ``lead+(S,)`` ids vs ``[E]``
+    namespaces → ``lead+(E,)``."""
+    S = ns_ids.shape[-1]
+    ok = jnp.broadcast_to(
+        ns_all[..., None], ns_all.shape + (target_ns.shape[0],)
+    )
+    for s in range(S):
+        nid = ns_ids[..., s]
+        ok = ok | ((nid >= 0)[..., None] & (nid[..., None] == target_ns))
+    return ok
+
+
+# ---------------------------------------------------------------------------
+# Segment helpers (per-node and per-domain aggregation)
+# ---------------------------------------------------------------------------
+
+
+def per_node_counts(values_e, node_idx, n_nodes: int):
+    """Sum values over placed pods grouped by their node:
+    ``lead+(E,)`` → ``lead+(N,)``.  Invalid node_idx rows are dropped."""
+    lead = values_e.shape[:-1]
+    E = values_e.shape[-1]
+    seg = jnp.where((node_idx >= 0) & (node_idx < n_nodes), node_idx, n_nodes)
+    flat = values_e.reshape((-1, E))
+    out = jax.vmap(
+        lambda d: jax.ops.segment_sum(d, seg, num_segments=n_nodes + 1)
+    )(flat)
+    return out[:, :n_nodes].reshape(lead + (n_nodes,))
+
+
+def domain_stats(count_n, present_n, dv, v_cap: int):
+    """Aggregate per-node values by topology-domain id and read them back
+    per node.
+
+    count_n:   lead+(N,) int — per-node quantity to sum per domain
+    present_n: lead+(N,) bool — nodes whose domain "exists" (pair tracked)
+    dv:        lead+(N,) int — domain id per node (label-value id; <0 absent)
+    v_cap:     static domain-id bound (label-value vocab capacity)
+
+    Returns (per_node_total, per_node_domain_present, min_over_present,
+    n_domains): the first two gathered back at each node's domain, the last
+    two reduced over present domains (min is INT32_MAX when none present).
+    """
+    lead = count_n.shape[:-1]
+    N = count_n.shape[-1]
+    seg = jnp.where((dv >= 0) & (dv < v_cap), dv, v_cap)
+    flat_cnt = count_n.reshape((-1, N))
+    flat_pres = present_n.reshape((-1, N)).astype(I32)
+    flat_seg = seg.reshape((-1, N))
+
+    def one(cnt, pres, s):
+        tot = jax.ops.segment_sum(cnt, s, num_segments=v_cap + 1)
+        dpres = jax.ops.segment_max(pres, s, num_segments=v_cap + 1) > 0
+        dpres = dpres.at[v_cap].set(False)
+        per_node_tot = tot[s]
+        per_node_pres = dpres[s]
+        big = jnp.iinfo(jnp.int32).max
+        mn = jnp.min(jnp.where(dpres, tot, big))
+        ndom = jnp.sum(dpres.astype(I32))
+        return per_node_tot, per_node_pres, mn, ndom
+
+    tot, pres, mn, ndom = jax.vmap(one)(flat_cnt, flat_pres, flat_seg)
+    return (
+        tot.reshape(lead + (N,)),
+        pres.reshape(lead + (N,)),
+        mn.reshape(lead),
+        ndom.reshape(lead),
+    )
+
+
+def gather_rows(matrix, idx):
+    """``matrix[idx]`` with negative indices masked to a sentinel row of
+    ABSENT values: [N, K] gathered by lead-shaped idx → lead+(K,)."""
+    safe = jnp.clip(idx, 0, matrix.shape[0] - 1)
+    out = matrix[safe]
+    return jnp.where((idx >= 0)[..., None], out, ABSENT)
+
+
+def gather_at(cols_t, key):
+    """cols_t: [K, N]; key: lead → lead+(N,) of label values (ABSENT when the
+    key id is out of range/padding)."""
+    K = cols_t.shape[0]
+    known = (key >= 0) & (key < K)
+    safe = jnp.clip(key, 0, K - 1)
+    return jnp.where(known[..., None], cols_t[safe], ABSENT)

@@ -1,0 +1,424 @@
+"""Batched Filter kernels → ``[P, N]`` feasibility masks.
+
+Each function reproduces one in-tree Filter plugin's semantics
+(SURVEY.md §2.3) for every (pending pod, node) pair at once.  Reference
+citations point at the Go implementation being matched; the scalar golden
+model is kubernetes_tpu.oracle.filters.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from kubernetes_tpu.ops.common import (
+    DeviceBatch,
+    DeviceCluster,
+    I32,
+    dnf_any,
+    domain_stats,
+    eval_table,
+    gather_at,
+    ns_member,
+    per_node_counts,
+)
+from kubernetes_tpu.snapshot.interner import ABSENT, PAD
+from kubernetes_tpu.snapshot.schema import (
+    EFFECT_ALL,
+    EFFECT_NO_EXECUTE,
+    EFFECT_NO_SCHEDULE,
+    EFFECT_PREFER_NO_SCHEDULE,
+    TERM_PREFERRED_AFFINITY,
+    TERM_PREFERRED_ANTI,
+    TERM_REQUIRED_AFFINITY,
+    TERM_REQUIRED_ANTI,
+    TOL_OP_EXISTS,
+)
+
+
+# ---------------------------------------------------------------------------
+# NodeName (plugins/nodename/node_name.go)
+# ---------------------------------------------------------------------------
+
+
+def mask_node_name(dc: DeviceCluster, db: DeviceBatch):
+    node_name_val = gather_at(dc.node_labels.T, dc.name_key)  # [N]
+    tgt = db.target_name_val  # [P]
+    return (tgt == ABSENT)[:, None] | (node_name_val[None, :] == tgt[:, None])
+
+
+# ---------------------------------------------------------------------------
+# Taints / tolerations (plugins/tainttoleration/taint_toleration.go:103)
+# ---------------------------------------------------------------------------
+
+
+def any_tolerates(db: DeviceBatch, taint_key, taint_val, taint_effect, slot_use=None):
+    """[P, N, T] — does any toleration of pod p tolerate taint slot t of node
+    n (api/core/v1/toleration.go ToleratesTaint).
+
+    taint_* are [N, T] arrays; ``slot_use`` optionally restricts which
+    toleration slots participate ([P, TL] bool — e.g. the PreferNoSchedule
+    effect filter of the TaintToleration score).  The single source of truth
+    for toleration matching on device.
+    """
+    P, TL = db.tol_key.shape
+    N, T = taint_key.shape
+    out = jnp.zeros((P, N, T), bool)
+    for l in range(TL):
+        tk = db.tol_key[:, l][:, None, None]
+        to = db.tol_op[:, l][:, None, None]
+        tv = db.tol_val[:, l][:, None, None]
+        te = db.tol_effect[:, l][:, None, None]
+        use = db.tol_op[:, l] != PAD
+        if slot_use is not None:
+            use = use & slot_use[:, l]
+        effect_ok = (te == EFFECT_ALL) | (te == taint_effect[None])
+        wildcard = (tk == ABSENT) & (to == TOL_OP_EXISTS)
+        key_eq = tk == taint_key[None]
+        val_ok = (to == TOL_OP_EXISTS) | (tv == taint_val[None])
+        out = out | (
+            use[:, None, None] & effect_ok & (wildcard | (key_eq & val_ok))
+        )
+    return out
+
+
+def _tolerated(dc: DeviceCluster, db: DeviceBatch):
+    return any_tolerates(db, dc.taint_key, dc.taint_val, dc.taint_effect)
+
+
+def mask_taints(dc: DeviceCluster, db: DeviceBatch, tolerated=None):
+    if tolerated is None:
+        tolerated = _tolerated(dc, db)
+    hard = (dc.taint_effect == EFFECT_NO_SCHEDULE) | (
+        dc.taint_effect == EFFECT_NO_EXECUTE
+    )
+    taint_real = dc.taint_key != PAD
+    untol = jnp.any((hard & taint_real)[None] & ~tolerated, axis=-1)
+    return ~untol
+
+
+# ---------------------------------------------------------------------------
+# NodeUnschedulable (plugins/nodeunschedulable/node_unschedulable.go)
+# ---------------------------------------------------------------------------
+
+
+def mask_unschedulable(dc: DeviceCluster, db: DeviceBatch):
+    """Unschedulable nodes pass only if the pod tolerates the synthetic
+    node.kubernetes.io/unschedulable:NoSchedule taint."""
+    synth_key = jnp.full((1, 1), 0, I32) + dc.unsched_key
+    synth_val = jnp.full((1, 1), 0, I32) + dc.empty_val
+    synth_eff = jnp.full((1, 1), EFFECT_NO_SCHEDULE, I32)
+    tol = any_tolerates(db, synth_key, synth_val, synth_eff)[:, 0, 0]  # [P]
+    return (~dc.unschedulable)[None, :] | tol[:, None]
+
+
+# ---------------------------------------------------------------------------
+# NodeResourcesFit (plugins/noderesources/fit.go:423-503)
+# ---------------------------------------------------------------------------
+
+
+def mask_resources(dc: DeviceCluster, db: DeviceBatch, requested=None, num_pods=None):
+    """requested/num_pods default to the snapshot's but can be overridden by
+    the gang-commit scan's running totals.
+
+    Semantics from fit.go:460 fitsRequest: a pod with an all-zero request
+    vector always fits (early return); cpu/mem/ephemeral are compared
+    unconditionally after that (a zero request CAN fail on an overcommitted
+    node); extended-resource lanes are only compared when the pod requests
+    them.  The pod batch may carry more lanes than the snapshot (a pending
+    pod requesting a never-seen extended resource) — those lanes have zero
+    allocatable everywhere.
+    """
+    from kubernetes_tpu.snapshot.schema import N_FIXED_LANES
+
+    requested = dc.requested if requested is None else requested
+    num_pods = dc.num_pods if num_pods is None else num_pods
+    Rn = dc.allocatable.shape[1]
+    Rp = db.requests.shape[1]
+    fits = (num_pods + 1 <= dc.allowed_pods)[None, :]
+    all_zero = jnp.all(db.requests == 0, axis=1)  # [P]
+    lane_ok = None
+    for r in range(Rp):
+        req = db.requests[:, r][:, None]  # [P, 1]
+        if r < Rn:
+            avail = (dc.allocatable[:, r] - requested[:, r])[None, :]  # [1, N]
+        else:
+            avail = jnp.zeros((1, dc.allocatable.shape[0]), I32)
+        conflict = req > avail
+        if r >= N_FIXED_LANES:
+            conflict = conflict & (req > 0)  # unrequested scalars are skipped
+        lane_ok = ~conflict if lane_ok is None else (lane_ok & ~conflict)
+    return fits & (all_zero[:, None] | lane_ok)
+
+
+# ---------------------------------------------------------------------------
+# NodeAffinity (plugins/nodeaffinity/node_affinity.go:182-203)
+# ---------------------------------------------------------------------------
+
+
+def mask_node_affinity(dc: DeviceCluster, db: DeviceBatch):
+    terms = eval_table(db.node_sel, dc.node_labels, dc.val_ints)  # [P, T, N]
+    return dnf_any(terms)
+
+
+# ---------------------------------------------------------------------------
+# NodePorts (plugins/nodeports/node_ports.go)
+# ---------------------------------------------------------------------------
+
+
+def mask_ports(dc: DeviceCluster, db: DeviceBatch):
+    W = db.want_ppk.shape[1]
+    U = dc.used_ppk.shape[1]
+    P = db.want_ppk.shape[0]
+    N = dc.used_ppk.shape[0]
+    conflict = jnp.zeros((P, N), bool)
+    for w in range(W):
+        wk = db.want_ppk[:, w][:, None]
+        wi = db.want_ip[:, w][:, None]
+        ww = db.want_wild[:, w][:, None]
+        w_valid = wk != PAD
+        for u in range(U):
+            uk = dc.used_ppk[:, u][None, :]
+            ui = dc.used_ip[:, u][None, :]
+            uw = dc.used_wild[:, u][None, :]
+            u_valid = uk != PAD
+            conflict = conflict | (
+                w_valid
+                & u_valid
+                & (wk == uk)
+                & ((wi == ui) | ww | uw)
+            )
+    return ~conflict
+
+
+# ---------------------------------------------------------------------------
+# InterPodAffinity (plugins/interpodaffinity/filtering.go:306-365)
+# ---------------------------------------------------------------------------
+
+
+class InterPodPre(NamedTuple):
+    """Precomputed inter-pod state shared by the filter and score kernels."""
+
+    # existing pods' term rows vs incoming pods
+    ext_match: jnp.ndarray  # bool [M, P] term matches incoming pod
+    ext_topo_eq: jnp.ndarray  # bool [M, N] node shares term's topology value
+    # incoming pods' term rows vs existing pods
+    inc_match: jnp.ndarray  # bool [P, AT, E]
+    inc_dv: jnp.ndarray  # i32 [P, AT, N] node's domain id per incoming term
+    inc_cnt: jnp.ndarray  # i32 [P, AT, N] matching placed pods per node
+
+
+def interpod_precompute(dc: DeviceCluster, db: DeviceBatch) -> InterPodPre:
+    # Existing terms vs incoming pods (selector evaluated on pod labels,
+    # incoming namespace in term's namespace set).
+    ext_sel = eval_table(dc.term_table, db.labels, dc.val_ints)[:, 0, :]  # [M, P]
+    ext_ns = ns_member(dc.term_ns_all, dc.term_ns_ids, db.ns_id)  # [M, P]
+    src_valid = (
+        (dc.term_pod >= 0)
+        & jnp.take(
+            dc.epod_valid, jnp.clip(dc.term_pod, 0, dc.epod_valid.shape[0] - 1)
+        )
+    )
+    ext_match = ext_sel & ext_ns & src_valid[:, None]
+
+    # The term's topology value at its own pod's node, compared to all nodes.
+    node_of = jnp.where(
+        dc.term_pod >= 0,
+        jnp.take(dc.epod_node, jnp.clip(dc.term_pod, 0, dc.epod_node.shape[0] - 1)),
+        ABSENT,
+    )
+    cols = dc.node_labels.T  # [K, N]
+    nv = gather_at(cols, dc.term_topo)  # [M, N]
+    ev = jnp.take_along_axis(
+        nv, jnp.clip(node_of, 0, nv.shape[1] - 1)[:, None], axis=1
+    )[:, 0]
+    ev = jnp.where(node_of >= 0, ev, ABSENT)
+    ext_topo_eq = (ev >= 0)[:, None] & (nv == ev[:, None])
+
+    # Incoming terms vs existing pods.
+    inc_sel = eval_table(db.aff_table, dc.epod_labels, dc.val_ints)  # [P, AT, E]
+    inc_ns = ns_member(db.aff_ns_all, db.aff_ns_ids, dc.epod_ns)  # [P, AT, E]
+    inc_match = inc_sel & inc_ns & dc.epod_valid[None, None, :]
+    inc_cnt = per_node_counts(
+        inc_match.astype(I32), dc.epod_node, dc.node_labels.shape[0]
+    )
+    inc_dv = gather_at(cols, db.aff_topo)  # [P, AT, N]
+    return InterPodPre(
+        ext_match=ext_match,
+        ext_topo_eq=ext_topo_eq,
+        inc_match=inc_match,
+        inc_dv=inc_dv,
+        inc_cnt=inc_cnt,
+    )
+
+
+def mask_interpod(
+    dc: DeviceCluster, db: DeviceBatch, pre: InterPodPre, v_cap: int
+):
+    P, AT, N = pre.inc_dv.shape
+
+    # 1. Existing pods' required anti-affinity forbids same-domain nodes.
+    anti_row = (dc.term_kind == TERM_REQUIRED_ANTI).astype(I32)
+    m = (pre.ext_match.astype(I32) * anti_row[:, None]).T  # [P, M]
+    viol1 = (
+        jax.lax.dot_general(
+            m,
+            pre.ext_topo_eq.astype(I32),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=I32,
+        )
+        > 0
+    )  # [P, N]
+
+    # Domain totals of matching placed pods per incoming term.
+    dom_tot, _, _, _ = domain_stats(
+        pre.inc_cnt, jnp.zeros_like(pre.inc_cnt, bool), pre.inc_dv, v_cap
+    )
+    topo_present = pre.inc_dv >= 0  # [P, AT, N]
+
+    # 2. Incoming required anti-affinity: any matching placed pod in the
+    #    node's domain ⇒ reject (missing topology label ⇒ pass).
+    is_anti = db.aff_kind == TERM_REQUIRED_ANTI  # [P, AT]
+    viol2 = jnp.any(
+        is_anti[:, :, None] & topo_present & (dom_tot > 0), axis=1
+    )
+
+    # 3. Incoming required affinity: every term satisfied in-domain, with the
+    #    first-pod-in-series escape hatch (filtering.go:336-363).
+    is_aff = db.aff_kind == TERM_REQUIRED_AFFINITY
+    term_ok = topo_present & (dom_tot > 0)
+    aff_ok = jnp.all(~is_aff[:, :, None] | term_ok, axis=1)  # [P, N]
+
+    any_match_anywhere = jnp.any(
+        is_aff[:, :, None] & pre.inc_match, axis=(1, 2)
+    )  # [P]
+    # Self-match: term's selector against the pod's own labels + namespace.
+    self_sel = jax.vmap(
+        lambda tbl, lbl: eval_table(tbl, lbl[None, :], dc.val_ints)[..., 0]
+    )(db.aff_table, db.labels)  # [P, AT]
+    self_ns = jax.vmap(
+        lambda a, ids, ns: ns_member(a, ids, ns[None])[..., 0]
+    )(db.aff_ns_all, db.aff_ns_ids, db.ns_id)  # [P, AT]
+    self_all = jnp.all(~is_aff | (self_sel & self_ns), axis=1)
+    has_aff = jnp.any(is_aff, axis=1)
+    escape = has_aff & ~any_match_anywhere & self_all  # [P]
+
+    # A node missing any required-affinity topology label is rejected before
+    # the escape hatch is ever consulted (filtering.go: early return).
+    topo_all = jnp.all(~is_aff[:, :, None] | topo_present, axis=1)  # [P, N]
+    ok3 = aff_ok | (escape[:, None] & topo_all)
+    return ~viol1 & ~viol2 & ok3
+
+
+# ---------------------------------------------------------------------------
+# PodTopologySpread (plugins/podtopologyspread/filtering.go)
+# ---------------------------------------------------------------------------
+
+
+class SpreadPre(NamedTuple):
+    """Shared spread-filter state (also reused by the gang scan)."""
+
+    exists: jnp.ndarray  # bool [P, C] constraint slot holds a constraint
+    sel_match: jnp.ndarray  # bool [P, C, E] selector matches placed pod
+    self_match: jnp.ndarray  # bool [P, C] selector matches the pod itself
+    dv: jnp.ndarray  # i32 [P, C, N] domain id per node
+    eligible: jnp.ndarray  # bool [P, C, N] inclusion-policy eligibility
+    tracked: jnp.ndarray  # bool [P, N] node has all hard topo keys
+
+
+def spread_precompute(
+    dc: DeviceCluster,
+    db: DeviceBatch,
+    node_affinity_mask,
+    taint_mask,
+) -> SpreadPre:
+    exists = db.tsc_topo != PAD  # [P, C]
+    cols = dc.node_labels.T
+    dv = gather_at(cols, db.tsc_topo)  # [P, C, N]
+    topo_present = dv >= 0
+
+    hard = exists & db.tsc_hard
+    tracked = jnp.all(~hard[:, :, None] | topo_present, axis=1)  # [P, N]
+
+    eligible = jnp.where(
+        db.tsc_honor_affinity[:, :, None], node_affinity_mask[:, None, :], True
+    ) & jnp.where(db.tsc_honor_taints[:, :, None], taint_mask[:, None, :], True)
+
+    sel = eval_table(db.tsc_table, dc.epod_labels, dc.val_ints)  # [P, C, E]
+    same_ns = db.ns_id[:, None] == dc.epod_ns[None, :]  # [P, E]
+    sel_match = (
+        sel
+        & same_ns[:, None, :]
+        & dc.epod_valid[None, None, :]
+        & ~dc.epod_deleting[None, None, :]
+    )
+
+    self_match = jax.vmap(
+        lambda tbl, lbl: eval_table(tbl, lbl[None, :], dc.val_ints)[..., 0]
+    )(db.tsc_table, db.labels)  # [P, C]
+    return SpreadPre(exists, sel_match, self_match, dv, eligible, tracked)
+
+
+def mask_spread(
+    dc: DeviceCluster, db: DeviceBatch, pre: SpreadPre, v_cap: int
+):
+    """DoNotSchedule constraints: matchNum + selfMatch − minMatch > maxSkew
+    ⇒ Unschedulable (filtering.go:313-362)."""
+    hard = pre.exists & db.tsc_hard  # [P, C]
+    N = pre.dv.shape[2]
+
+    cnt_n = per_node_counts(pre.sel_match.astype(I32), dc.epod_node, N)
+    counted = pre.tracked[:, None, :] & pre.eligible
+    cnt_n = jnp.where(counted, cnt_n, 0)
+
+    dom_tot, dom_pres, dom_min, n_dom = domain_stats(
+        cnt_n, counted, pre.dv, v_cap
+    )
+    min_match = jnp.where(
+        (db.tsc_min_domains > 0) & (n_dom < db.tsc_min_domains), 0, dom_min
+    )  # [P, C]
+
+    topo_present = pre.dv >= 0
+    selfm = pre.self_match.astype(I32)[:, :, None]
+    skew = dom_tot + selfm - min_match[:, :, None]
+    c_ok = topo_present & (
+        ~dom_pres | (skew <= db.tsc_max_skew[:, :, None])
+    )
+    return jnp.all(~hard[:, :, None] | c_ok, axis=1)
+
+
+# ---------------------------------------------------------------------------
+# Combined
+# ---------------------------------------------------------------------------
+
+
+def all_masks(dc: DeviceCluster, db: DeviceBatch, v_cap: int) -> Dict[str, jnp.ndarray]:
+    """Run every Filter kernel; returns per-plugin masks plus the AND.
+
+    The combined mask also excludes invalid node slots and invalid pod rows
+    (padding in the bucketed batch).
+    """
+    tolerated = _tolerated(dc, db)
+    node_affinity = mask_node_affinity(dc, db)
+    taints = mask_taints(dc, db, tolerated)
+    ipre = interpod_precompute(dc, db)
+    spre = spread_precompute(dc, db, node_affinity, taints)
+    masks = {
+        "NodeName": mask_node_name(dc, db),
+        "NodeUnschedulable": mask_unschedulable(dc, db),
+        "TaintToleration": taints,
+        "NodeAffinity": node_affinity,
+        "NodePorts": mask_ports(dc, db),
+        "NodeResourcesFit": mask_resources(dc, db),
+        "InterPodAffinity": mask_interpod(dc, db, ipre, v_cap),
+        "PodTopologySpread": mask_spread(dc, db, spre, v_cap),
+    }
+    combined = dc.node_valid[None, :] & db.valid[:, None]
+    for m in masks.values():
+        combined = combined & m
+    masks["_combined"] = combined
+    masks["_interpod_pre"] = ipre
+    masks["_spread_pre"] = spre
+    return masks

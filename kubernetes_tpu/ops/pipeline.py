@@ -1,0 +1,74 @@
+"""Device scheduling pipeline: masks → scores → host selection.
+
+The batched replacement for schedulePod (reference schedule_one.go:408-456):
+one fused dispatch evaluates every (pending pod, node) pair.  Selection is
+argmax with first-max tie-breaking — the deterministic policy of the oracle
+(selectHost's reservoir sampling, schedule_one.go:870, is reproduced host-side
+when bit-compat with a recorded run is required).
+
+``schedule_independent`` treats each pod against the same snapshot (no
+intra-batch conflicts) — the building block validated against the oracle.
+The sequential-equivalent gang commit lives in kubernetes_tpu.ops.gang.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from kubernetes_tpu.ops import filters as F
+from kubernetes_tpu.ops import scores as S
+from kubernetes_tpu.ops.common import DeviceBatch, DeviceCluster, I32, I64
+from kubernetes_tpu.snapshot.cluster import PackedCluster
+from kubernetes_tpu.snapshot.schema import PodBatch, bucket_cap
+
+
+class PipelineResult(NamedTuple):
+    chosen: jnp.ndarray  # i32 [P] node index or -1
+    feasible: jnp.ndarray  # bool [P, N]
+    totals: jnp.ndarray  # i64 [P, N] weighted scores (0 where infeasible)
+    n_feasible: jnp.ndarray  # i32 [P]
+
+
+@functools.partial(jax.jit, static_argnames=("v_cap",))
+def _pipeline(dc: DeviceCluster, db: DeviceBatch, hostname_key, v_cap: int):
+    masks = F.all_masks(dc, db, v_cap)
+    feasible = masks["_combined"]
+    totals, _ = S.all_scores(
+        dc,
+        db,
+        feasible,
+        masks["_interpod_pre"],
+        masks["_spread_pre"],
+        v_cap,
+        hostname_key,
+    )
+    big = jnp.iinfo(jnp.int64).min
+    ranked = jnp.where(feasible, totals, big)
+    chosen = jnp.argmax(ranked, axis=1).astype(I32)
+    any_ok = jnp.any(feasible, axis=1)
+    chosen = jnp.where(any_ok, chosen, -1)
+    return PipelineResult(
+        chosen=chosen,
+        feasible=feasible,
+        totals=jnp.where(feasible, totals, 0),
+        n_feasible=jnp.sum(feasible.astype(I32), axis=1),
+    )
+
+
+def schedule_independent(
+    pc: PackedCluster, pb: PodBatch
+) -> PipelineResult:
+    """Schedule each pod of the batch against the unmodified snapshot."""
+    from kubernetes_tpu.oracle.scores import HOSTNAME_LABEL
+
+    dc = DeviceCluster.from_host(pc.nodes, pc.existing, pc.vocab)
+    db = DeviceBatch.from_host(pb)
+    v_cap = bucket_cap(len(pc.vocab.label_vals))
+    hostname_key = jnp.asarray(
+        pc.vocab.label_keys.lookup(HOSTNAME_LABEL), I32
+    )
+    return jax.device_get(_pipeline(dc, db, hostname_key, v_cap))

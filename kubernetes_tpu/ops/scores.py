@@ -1,0 +1,398 @@
+"""Batched Score kernels → ``[P, N]`` integer scores + normalization.
+
+Reproduces the default-profile scoring plugins (SURVEY.md §2.3) with the
+reference's exact integer arithmetic wherever it is integer in Go, and
+fixed-point int64 arithmetic where Go uses float64 (documented per kernel) —
+float64 is unavailable on TPU, and float32 would drift from the golden model.
+Scalar golden model: kubernetes_tpu.oracle.scores.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from kubernetes_tpu.ops.common import (
+    DeviceBatch,
+    DeviceCluster,
+    I32,
+    I64,
+    eval_table,
+    gather_at,
+    per_node_counts,
+)
+from kubernetes_tpu.ops.filters import InterPodPre, SpreadPre
+from kubernetes_tpu.snapshot.interner import ABSENT, PAD
+from kubernetes_tpu.snapshot.schema import (
+    EFFECT_ALL,
+    EFFECT_PREFER_NO_SCHEDULE,
+    LANE_CPU,
+    LANE_MEM,
+    TERM_PREFERRED_AFFINITY,
+    TERM_PREFERRED_ANTI,
+    TERM_REQUIRED_AFFINITY,
+    TOL_OP_EXISTS,
+)
+
+MAX_NODE_SCORE = 100
+_FX = 32  # fixed-point fractional bits for the spread log weights
+
+
+def default_normalize(raw, feasible, reverse: bool = False):
+    """plugins/helper/normalize_score.go DefaultNormalizeScore over the
+    feasible set of each pod: score = 100·s/max (optionally reversed)."""
+    raw = raw.astype(I64)
+    mx = jnp.max(jnp.where(feasible, raw, 0), axis=1, keepdims=True)
+    scaled = jnp.where(mx > 0, MAX_NODE_SCORE * raw // jnp.maximum(mx, 1), raw)
+    if reverse:
+        scaled = jnp.where(
+            mx > 0, MAX_NODE_SCORE - scaled, MAX_NODE_SCORE
+        )
+    return scaled
+
+
+# ---------------------------------------------------------------------------
+# NodeResourcesFit — LeastAllocated (noderesources/least_allocated.go:29-60)
+# ---------------------------------------------------------------------------
+
+
+def score_least_allocated(dc: DeviceCluster, db: DeviceBatch, nonzero_req=None):
+    """(alloc−req)·100/alloc averaged over cpu+memory, on the *non-zero
+    defaulted* requests (resource_allocation.go:37-115)."""
+    nonzero_req = dc.nonzero_req if nonzero_req is None else nonzero_req
+    alloc = jnp.stack(
+        [dc.allocatable[:, LANE_CPU], dc.allocatable[:, LANE_MEM]], axis=1
+    ).astype(I64)  # [N, 2]
+    req = (
+        nonzero_req[None, :, :].astype(I64)
+        + db.nonzero_req[:, None, :].astype(I64)
+    )  # [P, N, 2]
+    frac = jnp.where(
+        req > alloc[None],
+        0,
+        (alloc[None] - req) * MAX_NODE_SCORE // jnp.maximum(alloc[None], 1),
+    )
+    lane_ok = (alloc > 0)[None]  # [1, N, 2]
+    total = jnp.sum(jnp.where(lane_ok, frac, 0), axis=2)
+    wsum = jnp.sum(lane_ok.astype(I64), axis=2)
+    return jnp.where(wsum > 0, total // jnp.maximum(wsum, 1), 0)
+
+
+# ---------------------------------------------------------------------------
+# NodeResourcesBalancedAllocation (balanced_allocation.go:138-160)
+# ---------------------------------------------------------------------------
+
+
+def score_balanced_allocation(dc: DeviceCluster, db: DeviceBatch, requested=None):
+    """1 − |cpu_frac − mem_frac|/2, scaled to 100.  Computed exactly in
+    int64 rationals: score = 100 − ceil(50·|r0·a1 − r1·a0| / (a0·a1))
+    (matches Go's float64 path for all realistic quantities)."""
+    requested = dc.requested if requested is None else requested
+    a0 = dc.allocatable[:, LANE_CPU].astype(I64)
+    a1 = dc.allocatable[:, LANE_MEM].astype(I64)
+    r0 = requested[:, LANE_CPU].astype(I64)[None] + db.requests[:, LANE_CPU].astype(
+        I64
+    )[:, None]
+    r1 = requested[:, LANE_MEM].astype(I64)[None] + db.requests[:, LANE_MEM].astype(
+        I64
+    )[:, None]
+    r0 = jnp.minimum(r0, a0[None])  # min(fraction, 1)
+    r1 = jnp.minimum(r1, a1[None])
+    d = jnp.abs(r0 * a1[None] - r1 * a0[None])
+    den = jnp.maximum(a0 * a1, 1)[None]
+    both = ((a0 > 0) & (a1 > 0))[None]
+    score = MAX_NODE_SCORE - (50 * d + den - 1) // den
+    return jnp.where(both, score, MAX_NODE_SCORE)
+
+
+# ---------------------------------------------------------------------------
+# NodeAffinity preferred terms (nodeaffinity/node_affinity.go:239)
+# ---------------------------------------------------------------------------
+
+
+def score_node_affinity(dc: DeviceCluster, db: DeviceBatch):
+    terms = eval_table(db.pref_node, dc.node_labels, dc.val_ints)  # [P, PT, N]
+    w = db.pref_weight.astype(I64)[:, :, None]
+    return jnp.sum(jnp.where(terms, w, 0), axis=1)
+
+
+# ---------------------------------------------------------------------------
+# TaintToleration (tainttoleration/taint_toleration.go:164-196)
+# ---------------------------------------------------------------------------
+
+
+def score_taint_toleration(dc: DeviceCluster, db: DeviceBatch):
+    """Count of PreferNoSchedule taints not tolerated (tolerations filtered
+    to effect ∈ {"", PreferNoSchedule}); lower is better (reversed in
+    normalize)."""
+    from kubernetes_tpu.ops.filters import any_tolerates
+
+    slot_use = (db.tol_effect == EFFECT_ALL) | (
+        db.tol_effect == EFFECT_PREFER_NO_SCHEDULE
+    )  # [P, TL]
+    tol = any_tolerates(
+        db, dc.taint_key, dc.taint_val, dc.taint_effect, slot_use=slot_use
+    )
+    pns = (dc.taint_effect == EFFECT_PREFER_NO_SCHEDULE) & (dc.taint_key != PAD)
+    return jnp.sum((pns[None] & ~tol).astype(I64), axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# InterPodAffinity (interpodaffinity/scoring.go:50-265)
+# ---------------------------------------------------------------------------
+
+
+def score_interpod(
+    dc: DeviceCluster,
+    db: DeviceBatch,
+    pre: InterPodPre,
+    v_cap: int,
+    hard_pod_affinity_weight: int = 1,
+):
+    """topo_score aggregation: incoming preferred terms (±w per matching
+    placed pod in-domain) + symmetric existing-term contributions."""
+    from kubernetes_tpu.ops.common import domain_stats
+
+    # Incoming preferred terms: w · (# matching placed pods in node's domain).
+    kind = db.aff_kind
+    w = jnp.where(
+        kind == TERM_PREFERRED_AFFINITY,
+        db.aff_weight,
+        jnp.where(kind == TERM_PREFERRED_ANTI, -db.aff_weight, 0),
+    ).astype(I64)  # [P, AT]
+    dom_tot, _, _, _ = domain_stats(
+        pre.inc_cnt, jnp.zeros_like(pre.inc_cnt, bool), pre.inc_dv, v_cap
+    )  # [P, AT, N]
+    topo_present = pre.inc_dv >= 0
+    incoming = jnp.sum(
+        jnp.where(topo_present, dom_tot.astype(I64) * w[:, :, None], 0), axis=1
+    )  # [P, N]
+
+    # Symmetric: existing terms matching the incoming pod, credited to nodes
+    # sharing the term's topology value.
+    ew = jnp.where(
+        dc.term_kind == TERM_REQUIRED_AFFINITY,
+        hard_pod_affinity_weight,
+        jnp.where(
+            dc.term_kind == TERM_PREFERRED_AFFINITY,
+            dc.term_weight,
+            jnp.where(dc.term_kind == TERM_PREFERRED_ANTI, -dc.term_weight, 0),
+        ),
+    ).astype(I32)  # [M]
+    m = pre.ext_match.astype(I32) * ew[:, None]  # [M, P]
+    sym = jax.lax.dot_general(
+        m.T,
+        pre.ext_topo_eq.astype(I32),
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=I32,
+    ).astype(I64)  # [P, N]
+    return incoming + sym
+
+
+def normalize_interpod(raw, feasible):
+    """scoring.go:265: map [min,max] over feasible → [0,100]."""
+    raw = raw.astype(I64)
+    big = jnp.iinfo(jnp.int64).max
+    mn = jnp.min(jnp.where(feasible, raw, big), axis=1, keepdims=True)
+    mx = jnp.max(jnp.where(feasible, raw, -big), axis=1, keepdims=True)
+    diff = mx - mn
+    return jnp.where(
+        diff > 0, MAX_NODE_SCORE * (raw - mn) // jnp.maximum(diff, 1), 0
+    )
+
+
+# ---------------------------------------------------------------------------
+# PodTopologySpread (podtopologyspread/scoring.go)
+# ---------------------------------------------------------------------------
+
+
+def score_spread(
+    dc: DeviceCluster,
+    db: DeviceBatch,
+    pre: SpreadPre,
+    feasible,
+    v_cap: int,
+    hostname_val_key,
+):
+    """ScheduleAnyway constraints: Σ_c count·log(topoSize+2) + (maxSkew−1),
+    computed in 32.32 fixed point from a host-precomputed log table so the
+    result matches float64 round() bit-for-bit.
+
+    Returns (raw [P,N] i64 fixed-point-rounded ints, valid [P,N] bool) —
+    valid=False marks "ignored" nodes (missing topo labels ⇒ score 0 after
+    normalize).
+    """
+    soft = pre.exists & ~db.tsc_hard  # [P, C]
+    has_soft = jnp.any(soft, axis=1)  # [P]
+    P, C, N = pre.dv.shape
+
+    topo_present = pre.dv >= 0
+    ignored = feasible & ~jnp.all(~soft[:, :, None] | topo_present, axis=1)
+    counted_node = feasible & ~ignored  # filtered, non-ignored
+
+    is_hostname = db.tsc_topo == hostname_val_key  # [P, C]
+
+    # topoSize: distinct domains among counted nodes (non-hostname keys).
+    from kubernetes_tpu.ops.common import domain_stats
+
+    _, _, _, n_dom = domain_stats(
+        jnp.zeros((P, C, N), I32),
+        counted_node[:, None, :] & jnp.broadcast_to(soft[:, :, None], (P, C, N)),
+        pre.dv,
+        v_cap,
+    )
+    n_counted = jnp.sum(counted_node.astype(I32), axis=1)  # [P]
+    size = jnp.where(is_hostname, n_counted[:, None], n_dom)  # [P, C]
+    w_fx = dc.log_tab[jnp.clip(size, 0, dc.log_tab.shape[0] - 1)]  # [P, C] i64
+
+    # Matching-pod counts: all nodes with all soft topo keys, eligible per
+    # inclusion policy; only domains seen among counted nodes accumulate.
+    all_keys = jnp.all(~soft[:, :, None] | topo_present, axis=1)  # [P, N]
+    cnt_n = per_node_counts(pre.sel_match.astype(I32), dc.epod_node, N)
+    pair_init = counted_node[:, None, :] & jnp.broadcast_to(
+        soft[:, :, None], (P, C, N)
+    ) & ~is_hostname[:, :, None]
+    counting = all_keys[:, None, :] & pre.eligible
+    dom_tot, dom_pres, _, _ = domain_stats(
+        jnp.where(counting, cnt_n, 0), pair_init, pre.dv, v_cap
+    )
+    # hostname key: per-node count, not per-domain
+    cnt = jnp.where(is_hostname[:, :, None], cnt_n, jnp.where(dom_pres, dom_tot, 0))
+
+    contrib = cnt.astype(I64) * w_fx[:, :, None] + (
+        (db.tsc_max_skew.astype(I64) - 1)[:, :, None] << _FX
+    )
+    total_fx = jnp.sum(jnp.where(soft[:, :, None], contrib, 0), axis=1)  # [P, N]
+
+    # round-half-even of total_fx / 2^32
+    k = total_fx >> _FX
+    frac = total_fx & ((1 << _FX) - 1)
+    half = 1 << (_FX - 1)
+    up = (frac > half) | ((frac == half) & ((k & 1) == 1))
+    raw = k + up.astype(I64)
+    raw = jnp.where(has_soft[:, None], raw, 0)
+    valid = jnp.where(has_soft[:, None], ~ignored, feasible)
+    return raw, valid
+
+
+def normalize_spread(raw, valid, feasible):
+    """scoring.go:227: 100·(max+min−s)/max over valid nodes; invalid → 0."""
+    raw = raw.astype(I64)
+    big = jnp.iinfo(jnp.int64).max
+    use = valid & feasible
+    mn = jnp.min(jnp.where(use, raw, big), axis=1, keepdims=True)
+    mx = jnp.max(jnp.where(use, raw, -big), axis=1, keepdims=True)
+    any_valid = jnp.any(use, axis=1, keepdims=True)
+    out = jnp.where(
+        mx == 0,
+        MAX_NODE_SCORE,
+        MAX_NODE_SCORE * (mx + mn - raw) // jnp.maximum(mx, 1),
+    )
+    return jnp.where(use & any_valid, out, 0)
+
+
+# ---------------------------------------------------------------------------
+# ImageLocality (imagelocality/image_locality.go:54-96)
+# ---------------------------------------------------------------------------
+
+_MB = 1024 * 1024
+_MIN_THRESHOLD = 23 * _MB
+_MAX_CONTAINER_THRESHOLD = 1000 * _MB
+
+
+def score_image_locality(dc: DeviceCluster, db: DeviceBatch):
+    IMG = dc.img_sizes.shape[1]
+    spread = jnp.sum(
+        ((dc.img_sizes > 0) & dc.node_valid[:, None]).astype(I64), axis=0
+    )  # [IMG]
+    total = jnp.maximum(dc.n_valid_nodes.astype(I64), 1)
+
+    I = db.img_ids.shape[1]
+    sum_scores = jnp.zeros((db.img_ids.shape[0], dc.img_sizes.shape[0]), I64)
+    for i in range(I):
+        ii = db.img_ids[:, i]
+        known = (ii >= 0) & (ii < IMG)
+        safe = jnp.clip(ii, 0, IMG - 1)
+        size = dc.img_sizes[:, safe].T  # [P, N]
+        sp = spread[safe]  # [P]
+        contrib = size * sp[:, None] // total
+        sum_scores = sum_scores + jnp.where(known[:, None], contrib, 0)
+
+    nc = db.n_containers.astype(I64)[:, None]
+    min_th = _MIN_THRESHOLD * nc
+    max_th = _MAX_CONTAINER_THRESHOLD * nc
+    clamped = jnp.clip(sum_scores, min_th, max_th)
+    score = MAX_NODE_SCORE * (clamped - min_th) // jnp.maximum(max_th - min_th, 1)
+    has_imgs = jnp.any(db.img_ids >= 0, axis=1)
+    return jnp.where(has_imgs[:, None], score, 0)
+
+
+# ---------------------------------------------------------------------------
+# Weighted total (runtime/framework.go:1177-1201)
+# ---------------------------------------------------------------------------
+
+DEFAULT_SCORE_WEIGHTS = {
+    "TaintToleration": 3,
+    "NodeAffinity": 2,
+    "PodTopologySpread": 2,
+    "InterPodAffinity": 2,
+    "NodeResourcesFit": 1,
+    "NodeResourcesBalancedAllocation": 1,
+    "ImageLocality": 1,
+}
+
+
+def all_scores(
+    dc: DeviceCluster,
+    db: DeviceBatch,
+    feasible,
+    ipre: InterPodPre,
+    spre: SpreadPre,
+    v_cap: int,
+    hostname_val_key,
+    weights: Dict[str, int] = None,
+    requested=None,
+    nonzero_req=None,
+):
+    """Weighted sum of normalized plugin scores over the feasible set."""
+    w = DEFAULT_SCORE_WEIGHTS if weights is None else weights
+    total = jnp.zeros(feasible.shape, I64)
+    per_plugin = {}
+
+    def acc(name, scores):
+        per_plugin[name] = scores
+        nonlocal total
+        total = total + scores.astype(I64) * w.get(name, 0)
+
+    if w.get("TaintToleration"):
+        acc(
+            "TaintToleration",
+            default_normalize(
+                score_taint_toleration(dc, db), feasible, reverse=True
+            ),
+        )
+    if w.get("NodeAffinity"):
+        acc(
+            "NodeAffinity",
+            default_normalize(score_node_affinity(dc, db), feasible),
+        )
+    if w.get("PodTopologySpread"):
+        raw, valid = score_spread(dc, db, spre, feasible, v_cap, hostname_val_key)
+        acc("PodTopologySpread", normalize_spread(raw, valid, feasible))
+    if w.get("InterPodAffinity"):
+        acc(
+            "InterPodAffinity",
+            normalize_interpod(score_interpod(dc, db, ipre, v_cap), feasible),
+        )
+    if w.get("NodeResourcesFit"):
+        acc("NodeResourcesFit", score_least_allocated(dc, db, nonzero_req))
+    if w.get("NodeResourcesBalancedAllocation"):
+        acc(
+            "NodeResourcesBalancedAllocation",
+            score_balanced_allocation(dc, db, requested),
+        )
+    if w.get("ImageLocality"):
+        acc("ImageLocality", score_image_locality(dc, db))
+    return total, per_plugin

@@ -411,7 +411,8 @@ def score_image_locality(pod: Pod, ns: NodeState, state: OracleState) -> int:
                 1 for e in state.nodes.values() if image in e.node.images
             )
             sum_scores += int(ns.node.images[image] * spread / total_nodes)
-    num_containers = max(len(pod.containers), 1)
+    # image_locality.go: init containers count toward the thresholds too.
+    num_containers = max(len(pod.containers) + len(pod.init_containers), 1)
     max_threshold = _MAX_CONTAINER_THRESHOLD * num_containers
     min_threshold = _MIN_THRESHOLD * num_containers
     if sum_scores < min_threshold:

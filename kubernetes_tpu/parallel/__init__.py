@@ -1,0 +1,21 @@
+"""Multi-chip parallelism: mesh construction + sharding specs.
+
+The reference scales per-cycle work with a 16-way chunked parallel-for over
+nodes (pkg/scheduler/framework/parallelize/parallelism.go:28) and runs
+replicas active/passive behind leader election.  Here the same two axes
+become a 2-D ``jax.sharding.Mesh``:
+
+  * ``pods``  — the batch axis (the reference's strictly-serial pod loop,
+    SURVEY.md §2.2 item 1, turned into data parallelism);
+  * ``nodes`` — the cluster axis (the reference's Parallelizer.Until axis,
+    turned into sharded tensor columns).
+
+XLA inserts the collectives (all-gathers for cross-node reductions like
+normalize/argmax) — there is no hand-written NCCL/MPI equivalent, by design.
+"""
+
+from kubernetes_tpu.parallel.mesh import (  # noqa: F401
+    batch_shardings,
+    cluster_shardings,
+    make_mesh,
+)

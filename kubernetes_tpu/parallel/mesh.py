@@ -1,0 +1,81 @@
+"""Device mesh + sharding specs for the scheduling pipeline.
+
+Sharding layout (SURVEY.md §2.4 "TPU-native equivalent"):
+
+  * every ``[P, ...]`` pod-batch tensor is sharded over the ``pods`` axis;
+  * node-major snapshot tensors (``[N, ...]``) are replicated by default —
+    the snapshot is the shared working set, and the per-pod pipeline reduces
+    over all nodes; with a ``nodes`` axis >1 they are sharded on dim 0 and
+    XLA all-gathers where a full-width reduction (normalize, argmax) needs
+    them;
+  * interned vocab side-tables are replicated.
+
+This mirrors how the reference shares one Snapshot across its 16 worker
+goroutines while splitting the pod stream — except both axes here scale
+across chips over ICI instead of OS threads.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kubernetes_tpu.ops.common import DeviceBatch, DeviceCluster, DTable
+
+
+def make_mesh(
+    n_devices: Optional[int] = None, pods_axis: Optional[int] = None
+) -> Mesh:
+    """Mesh over available devices: ('pods', 'nodes').
+
+    Default: all devices on the pods axis (batch parallel), nodes axis 1 —
+    the layout that needs zero collectives in the hot path.
+    """
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    devs = devs[:n]
+    # Default pods axis: the largest power of two dividing n, so bucketed
+    # (power-of-two) batch dims always shard evenly.
+    pa = pods_axis or (n & -n)
+    na = n // pa
+    arr = np.array(devs).reshape(pa, na)
+    return Mesh(arr, ("pods", "nodes"))
+
+
+def _shard(mesh: Mesh, spec: P) -> NamedSharding:
+    return NamedSharding(mesh, spec)
+
+
+def batch_shardings(mesh: Mesh, db: DeviceBatch) -> DeviceBatch:
+    """Sharding pytree for a DeviceBatch: dim 0 (pods) sharded."""
+
+    def spec_for(x):
+        return _shard(mesh, P("pods", *([None] * (x.ndim - 1))))
+
+    return jax.tree_util.tree_map(spec_for, db)
+
+
+def cluster_shardings(mesh: Mesh, dc: DeviceCluster) -> DeviceCluster:
+    """Sharding pytree for a DeviceCluster: replicated (nodes axis of the
+    mesh shards node-major tensors when sized >1)."""
+    n_nodes_axis = mesh.shape["nodes"]
+
+    def spec_for(x):
+        if n_nodes_axis > 1 and getattr(x, "ndim", 0) >= 1:
+            return _shard(mesh, P(None))
+        return _shard(mesh, P())
+
+    return jax.tree_util.tree_map(spec_for, dc)
+
+
+def place_batch(mesh: Mesh, db: DeviceBatch) -> DeviceBatch:
+    shardings = batch_shardings(mesh, db)
+    return jax.tree_util.tree_map(jax.device_put, db, shardings)
+
+
+def place_cluster(mesh: Mesh, dc: DeviceCluster) -> DeviceCluster:
+    shardings = cluster_shardings(mesh, dc)
+    return jax.tree_util.tree_map(jax.device_put, dc, shardings)

@@ -1,0 +1,107 @@
+"""Bridge: host cluster state → one packed, device-ready snapshot.
+
+Mirrors what the reference's Cache.UpdateSnapshot produces (a consistent
+NodeInfo list with per-node accounting, pkg/scheduler/backend/cache/cache.go:185)
+as a single batch pack.  The incremental generation-based variant lives in
+kubernetes_tpu.cache; this module is the from-scratch path used by tests,
+bench setup, and cache re-sync.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+from kubernetes_tpu.api.types import Node, Pod
+from kubernetes_tpu.oracle.state import OracleState
+from kubernetes_tpu.snapshot.interner import ABSENT, PAD, Vocab
+from kubernetes_tpu.snapshot.schema import (
+    MEM_UNIT,
+    ExistingPodTensors,
+    NodeTensors,
+    ResourceLanes,
+    bucket_cap,
+    encode_port,
+    pack_existing_pods,
+    pack_nodes,
+)
+
+
+@dataclass
+class PackedCluster:
+    nodes: NodeTensors
+    existing: ExistingPodTensors
+    vocab: Vocab
+
+
+def accumulate_node_usage(
+    nt: NodeTensors,
+    placed_pods: Sequence[Pod],
+    vocab: Vocab,
+) -> None:
+    """Fold placed pods into per-node requested/non-zero/pod-count/port
+    accounting (NodeInfo.AddPodInfo, framework/types.go:829)."""
+    lanes = ResourceLanes(vocab)
+    R = nt.allocatable.shape[1]
+    nt.requested[:] = 0
+    nt.nonzero_req[:] = 0
+    nt.num_pods[:] = 0
+
+    port_rows: Dict[int, list] = {}
+    for pod in placed_pods:
+        i = nt.name_to_idx.get(pod.node_name)
+        if i is None:
+            continue
+        req = pod.compute_requests()
+        nt.requested[i] += lanes.request_row(req, R)
+        nz = req.non_zero_defaulted()
+        nt.nonzero_req[i, 0] += nz.milli_cpu
+        nt.nonzero_req[i, 1] += -(-nz.memory // MEM_UNIT)
+        nt.num_pods[i] += 1
+        for p in pod.host_ports():
+            port_rows.setdefault(i, []).append(encode_port(vocab, p))
+
+    U = bucket_cap(max((len(r) for r in port_rows.values()), default=1), 1)
+    N = nt.n_cap
+    nt.used_ppk = np.full((N, U), PAD, dtype=np.int32)
+    nt.used_ip = np.full((N, U), PAD, dtype=np.int32)
+    nt.used_wild = np.zeros((N, U), dtype=bool)
+    for i, rows in port_rows.items():
+        for j, (ppk, ip, wild) in enumerate(rows[:U]):
+            nt.used_ppk[i, j] = ppk
+            nt.used_ip[i, j] = ip
+            nt.used_wild[i, j] = wild
+
+
+def pack_cluster(
+    state: OracleState,
+    vocab: Optional[Vocab] = None,
+    n_cap: Optional[int] = None,
+    e_cap: Optional[int] = None,
+    pending_pods: Sequence[Pod] = (),
+) -> PackedCluster:
+    """``pending_pods`` pre-interns the label keys of pods that will later be
+    packed with pack_pod_batch against this snapshot, so the label-matrix
+    width K covers every key carried by a real object.  (Selector-only keys
+    need no column: an out-of-range key id reads as "label absent", which is
+    exactly the right semantics.)"""
+    vocab = vocab or Vocab()
+    nodes = [ns.node for ns in state.nodes.values()]
+    placed = state.all_pods()
+    for p in list(placed) + list(pending_pods):
+        for k, v in p.labels.items():
+            vocab.intern_label(k, v)
+        vocab.namespaces.intern(p.namespace)
+    nt = pack_nodes(nodes, vocab, n_cap=n_cap)
+    accumulate_node_usage(nt, placed, vocab)
+    ep = pack_existing_pods(
+        placed,
+        nt.name_to_idx,
+        vocab,
+        e_cap=e_cap,
+        k_cap=nt.k_cap,
+        namespace_labels=state.namespace_labels,
+    )
+    return PackedCluster(nodes=nt, existing=ep, vocab=vocab)

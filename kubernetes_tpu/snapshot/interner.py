@@ -74,6 +74,8 @@ class Vocab:
     namespaces: Interner = field(default_factory=Interner)
     resources: Interner = field(default_factory=Interner)  # extended resources
     node_names: Interner = field(default_factory=Interner)
+    ports: Interner = field(default_factory=Interner)  # "proto:port" and host IPs
+    images: Interner = field(default_factory=Interner)  # container image names
 
     # Parsed-integer view of label_vals (same indexing), grown lazily.
     _val_ints: List[int] = field(default_factory=list)

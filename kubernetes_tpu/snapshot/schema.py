@@ -11,10 +11,12 @@ and ready for HBM:
 
 Conventions:
   - int32 everywhere; ABSENT = -1 (missing label), PAD = -2 (unused slot).
-  - resource lanes: 0=cpu millicores, 1=memory KiB, 2=ephemeral KiB, then one
+  - resource lanes: 0=cpu millicores, 1=memory MiB, 2=ephemeral MiB, then one
     lane per extended resource (vocab.resources).  Requests round *up*,
     allocatable rounds *down* — feasibility on device is conservative within
-    1KiB (real workloads are Mi-aligned so decisions match the reference).
+    1MiB (real workloads are Mi-aligned so decisions match the reference);
+    MiB units keep multi-TiB hosts inside int32 (up to 2048 TiB).  Extended
+    resource counts are clamped into int32.
   - capacities are bucketed to powers of two so recurring pack calls hit the
     same XLA program (static shapes; SURVEY.md §7 "dynamic shapes").
 """
@@ -53,6 +55,13 @@ LANE_MEM = 1
 LANE_EPH = 2
 N_FIXED_LANES = 3
 
+MEM_UNIT = 1 << 20  # memory/ephemeral lane granularity: 1 MiB
+_I32_MAX = 2**31 - 1
+
+
+def _i32(v: int) -> int:
+    return min(v, _I32_MAX)
+
 # Taint effects
 EFFECT_NO_SCHEDULE = 0
 EFFECT_PREFER_NO_SCHEDULE = 1
@@ -76,9 +85,13 @@ TERM_PREFERRED_ANTI = 3
 
 
 def bucket_cap(n: int, minimum: int = 8) -> int:
-    """Round up to a power of two (≥ minimum) to stabilize shapes."""
+    """Round up to a stable bucket: powers of two up to 2048, then multiples
+    of 1024 (pure pow2 wastes up to 2× at cluster scale — 5000 nodes would
+    pad to 8192; this pads to 5120)."""
     n = max(n, minimum, 1)
-    return 1 << math.ceil(math.log2(n))
+    if n <= 2048:
+        return 1 << math.ceil(math.log2(n))
+    return -(-n // 1024) * 1024
 
 
 # ---------------------------------------------------------------------------
@@ -98,24 +111,24 @@ class ResourceLanes:
 
     def request_row(self, r: Resource, n_lanes: Optional[int] = None) -> np.ndarray:
         row = np.zeros(n_lanes or self.n_lanes, dtype=np.int32)
-        row[LANE_CPU] = r.milli_cpu
-        row[LANE_MEM] = -(-r.memory // 1024)  # ceil KiB
-        row[LANE_EPH] = -(-r.ephemeral_storage // 1024)
+        row[LANE_CPU] = _i32(r.milli_cpu)
+        row[LANE_MEM] = _i32(-(-r.memory // MEM_UNIT))  # ceil MiB
+        row[LANE_EPH] = _i32(-(-r.ephemeral_storage // MEM_UNIT))
         for name, v in r.scalars.items():
             lane = N_FIXED_LANES + self.vocab.resources.intern(name)
             if lane < len(row):
-                row[lane] = v
+                row[lane] = _i32(v)
         return row
 
     def allocatable_row(self, r: Resource, n_lanes: Optional[int] = None) -> np.ndarray:
         row = np.zeros(n_lanes or self.n_lanes, dtype=np.int32)
-        row[LANE_CPU] = r.milli_cpu
-        row[LANE_MEM] = r.memory // 1024  # floor KiB
-        row[LANE_EPH] = r.ephemeral_storage // 1024
+        row[LANE_CPU] = _i32(r.milli_cpu)
+        row[LANE_MEM] = _i32(r.memory // MEM_UNIT)  # floor MiB
+        row[LANE_EPH] = _i32(r.ephemeral_storage // MEM_UNIT)
         for name, v in r.scalars.items():
             lane = N_FIXED_LANES + self.vocab.resources.intern(name)
             if lane < len(row):
-                row[lane] = v
+                row[lane] = _i32(v)
         return row
 
 
@@ -198,6 +211,13 @@ class NodeTensors:
     taint_effect: np.ndarray  # i32 [N, T]
     unschedulable: np.ndarray  # bool [N]
     valid: np.ndarray  # bool [N]
+    # host-port usage by placed pods: interned (proto:port) id, host-ip id,
+    # and whether the ip is the 0.0.0.0 wildcard (NodeInfo.UsedPorts)
+    used_ppk: np.ndarray = None  # i32 [N, U]
+    used_ip: np.ndarray = None  # i32 [N, U]
+    used_wild: np.ndarray = None  # bool [N, U]
+    # image id → size bytes present on node (NodeInfo.ImageStates)
+    img_sizes: np.ndarray = None  # i64 [N, IMG]
     names: List[str] = field(default_factory=list)
     name_to_idx: Dict[str, int] = field(default_factory=dict)
 
@@ -239,6 +259,8 @@ def pack_nodes(
             vocab.intern_val(t.value)
         for name in node.allocatable.scalars:
             vocab.resources.intern(name)
+        for img in node.images:
+            vocab.images.intern(img)
 
     N = n_cap or bucket_cap(len(nodes))
     K = k_cap or bucket_cap(len(vocab.label_keys))
@@ -259,6 +281,10 @@ def pack_nodes(
         taint_effect=np.full((N, T), PAD, dtype=np.int32),
         unschedulable=np.zeros(N, dtype=bool),
         valid=np.zeros(N, dtype=bool),
+        used_ppk=np.full((N, 1), PAD, dtype=np.int32),
+        used_ip=np.full((N, 1), PAD, dtype=np.int32),
+        used_wild=np.zeros((N, 1), dtype=bool),
+        img_sizes=np.zeros((N, bucket_cap(len(vocab.images), 1)), dtype=np.int64),
     )
     for i, node in enumerate(nodes[:N]):
         write_node_row(nt, i, node, vocab)
@@ -281,6 +307,12 @@ def write_node_row(nt: NodeTensors, i: int, node: Node, vocab: Vocab) -> None:
         nt.taint_effect[i, j] = _EFFECT_CODE.get(t.effect, EFFECT_NO_SCHEDULE)
     nt.unschedulable[i] = node.unschedulable
     nt.valid[i] = True
+    IMG = nt.img_sizes.shape[1]
+    nt.img_sizes[i] = 0
+    for img, size in node.images.items():
+        ii = vocab.images.intern(img)
+        if ii < IMG:
+            nt.img_sizes[i, ii] = size
     if i < len(nt.names):
         old = nt.names[i]
         if old in nt.name_to_idx and old != node.name:
@@ -306,13 +338,19 @@ class ExistingPodTensors:
     ns_id: np.ndarray  # i32 [E]
     label_vals: np.ndarray  # i32 [E, K]
     valid: np.ndarray  # bool [E]
-    # Required anti-affinity terms of existing pods, flattened to rows
-    # (mirrors HavePodsWithRequiredAntiAffinityList, snapshot.go:34).
-    anti_term_pod: np.ndarray  # i32 [M]  → index into E
-    anti_topo_key: np.ndarray  # i32 [M]
-    anti_table: ConjunctionTable  # [M, 1, R, V] label-selector conjunction
-    anti_ns_all: np.ndarray  # bool [M]  (empty namespaceSelector ⇒ all)
-    anti_ns_ids: np.ndarray  # i32 [M, NS]
+    deleting: np.ndarray  # bool [E]  (deletionTimestamp set)
+    # All (anti-)affinity terms of existing pods, flattened to rows — the
+    # generalization of HavePodsWithAffinityList /
+    # HavePodsWithRequiredAntiAffinityList (snapshot.go:34).  kind is TERM_*;
+    # weight is nonzero for preferred terms (and the hard-pod-affinity weight
+    # for required affinity, applied by the score kernel).
+    term_pod: np.ndarray  # i32 [M]  → index into E (ABSENT = padding)
+    term_kind: np.ndarray  # i32 [M]  TERM_* or PAD
+    term_topo_key: np.ndarray  # i32 [M]
+    term_weight: np.ndarray  # i32 [M]
+    term_table: ConjunctionTable  # [M, 1, R, V] label-selector conjunction
+    term_ns_all: np.ndarray  # bool [M]  (empty namespaceSelector ⇒ all)
+    term_ns_ids: np.ndarray  # i32 [M, NS]
     keys: List[str] = field(default_factory=list)
 
     @property
@@ -354,6 +392,45 @@ def resolve_term_namespaces(
     return False, sorted(set(ns_ids))
 
 
+def iter_pod_affinity_terms(pod: Pod, vocab: Vocab, namespace_labels):
+    """Every (anti-)affinity term of a pod, flattened and compiled:
+    yields (compiled_selector, kind, topo_key_id, weight, ns_all, ns_ids).
+
+    The single source of truth for term flattening — used for both placed
+    pods (pack_existing_pods) and pending batches (pack_pod_batch), mirroring
+    the reference's shared AffinityTerm pre-parsing (framework/types.go:350).
+    """
+    if not pod.affinity:
+        return
+    groups = []
+    if pod.affinity.pod_affinity:
+        pa = pod.affinity.pod_affinity
+        groups.append(
+            (pa.required_during_scheduling_ignored_during_execution, TERM_REQUIRED_AFFINITY, False)
+        )
+        groups.append(
+            (pa.preferred_during_scheduling_ignored_during_execution, TERM_PREFERRED_AFFINITY, True)
+        )
+    if pod.affinity.pod_anti_affinity:
+        pa = pod.affinity.pod_anti_affinity
+        groups.append(
+            (pa.required_during_scheduling_ignored_during_execution, TERM_REQUIRED_ANTI, False)
+        )
+        groups.append(
+            (pa.preferred_during_scheduling_ignored_during_execution, TERM_PREFERRED_ANTI, True)
+        )
+    for terms, kind, weighted in groups:
+        for t in terms:
+            term = t.pod_affinity_term if weighted else t
+            compiled = compile_label_selector(term.label_selector, vocab)
+            topo = vocab.label_keys.intern(term.topology_key)
+            weight = t.weight if weighted else 0
+            ns_all, ns_ids = resolve_term_namespaces(
+                term, pod, vocab, namespace_labels
+            )
+            yield compiled, kind, topo, weight, ns_all, ns_ids
+
+
 def pack_existing_pods(
     pods: Sequence[Pod],
     node_name_to_idx: Dict[str, int],
@@ -374,47 +451,53 @@ def pack_existing_pods(
     ns_id = np.full(E, ABSENT, dtype=np.int32)
     label_vals = np.full((E, K), ABSENT, dtype=np.int32)
     valid = np.zeros(E, dtype=bool)
+    deleting = np.zeros(E, dtype=bool)
     keys: List[str] = []
 
-    anti_rows: List[CompiledRequirements] = []
-    anti_pod: List[int] = []
-    anti_topo: List[int] = []
-    anti_all: List[bool] = []
-    anti_ns: List[List[int]] = []
+    rows: List[CompiledRequirements] = []
+    r_pod: List[int] = []
+    r_kind: List[int] = []
+    r_topo: List[int] = []
+    r_weight: List[int] = []
+    r_all: List[bool] = []
+    r_ns: List[List[int]] = []
 
     for i, pod in enumerate(pods[:E]):
         node_idx[i] = node_name_to_idx.get(pod.node_name, ABSENT)
         ns_id[i] = vocab.namespaces.intern(pod.namespace)
         label_vals[i] = _pod_label_row(pod, vocab, K)
         valid[i] = node_idx[i] != ABSENT
+        deleting[i] = pod.deletion_timestamp is not None
         keys.append(pod.key)
-        if pod.affinity and pod.affinity.pod_anti_affinity:
-            for term in (
-                pod.affinity.pod_anti_affinity.required_during_scheduling_ignored_during_execution
-            ):
-                anti_rows.append(compile_label_selector(term.label_selector, vocab))
-                anti_pod.append(i)
-                anti_topo.append(vocab.label_keys.intern(term.topology_key))
-                all_ns, ids = resolve_term_namespaces(
-                    term, pod, vocab, namespace_labels
-                )
-                anti_all.append(all_ns)
-                anti_ns.append(ids)
+        for compiled, kind, topo, weight, ns_all, ns_ids_ in iter_pod_affinity_terms(
+            pod, vocab, namespace_labels
+        ):
+            rows.append(compiled)
+            r_pod.append(i)
+            r_kind.append(kind)
+            r_topo.append(topo)
+            r_weight.append(weight)
+            r_all.append(ns_all)
+            r_ns.append(ns_ids_)
 
-    M = bucket_cap(len(anti_rows), 1)
-    NS = bucket_cap(max((len(x) for x in anti_ns), default=1), 1)
-    anti_term_pod = np.full(M, ABSENT, dtype=np.int32)
-    anti_topo_key = np.full(M, PAD, dtype=np.int32)
-    anti_ns_all = np.zeros(M, dtype=bool)
-    anti_ns_ids = np.full((M, NS), PAD, dtype=np.int32)
-    for j in range(len(anti_rows)):
-        anti_term_pod[j] = anti_pod[j]
-        anti_topo_key[j] = anti_topo[j]
-        anti_ns_all[j] = anti_all[j]
-        for m, nsid in enumerate(anti_ns[j][:NS]):
-            anti_ns_ids[j, m] = nsid
+    M = bucket_cap(len(rows), 1)
+    NS = bucket_cap(max((len(x) for x in r_ns), default=1), 1)
+    term_pod = np.full(M, ABSENT, dtype=np.int32)
+    term_kind = np.full(M, PAD, dtype=np.int32)
+    term_topo_key = np.full(M, PAD, dtype=np.int32)
+    term_weight = np.zeros(M, dtype=np.int32)
+    term_ns_all = np.zeros(M, dtype=bool)
+    term_ns_ids = np.full((M, NS), PAD, dtype=np.int32)
+    for j in range(len(rows)):
+        term_pod[j] = r_pod[j]
+        term_kind[j] = r_kind[j]
+        term_topo_key[j] = r_topo[j]
+        term_weight[j] = r_weight[j]
+        term_ns_all[j] = r_all[j]
+        for m, nsid in enumerate(r_ns[j][:NS]):
+            term_ns_ids[j, m] = nsid
     table = pack_conjunction_table(
-        [[c] for c in anti_rows] + [[] for _ in range(M - len(anti_rows))],
+        [[c] for c in rows] + [[] for _ in range(M - len(rows))],
         t_cap=1,
     )
 
@@ -423,11 +506,14 @@ def pack_existing_pods(
         ns_id=ns_id,
         label_vals=label_vals,
         valid=valid,
-        anti_term_pod=anti_term_pod,
-        anti_topo_key=anti_topo_key,
-        anti_table=table,
-        anti_ns_all=anti_ns_all,
-        anti_ns_ids=anti_ns_ids,
+        deleting=deleting,
+        term_pod=term_pod,
+        term_kind=term_kind,
+        term_topo_key=term_topo_key,
+        term_weight=term_weight,
+        term_table=table,
+        term_ns_all=term_ns_all,
+        term_ns_ids=term_ns_ids,
         keys=keys,
     )
 
@@ -472,11 +558,28 @@ class PodBatch:
     aff_weight: np.ndarray  # i32 [P, AT]
     aff_ns_all: np.ndarray  # bool [P, AT]
     aff_ns_ids: np.ndarray  # i32 [P, AT, NS]
+    # spec.nodeName as an interned label-value id (matched against the
+    # metadata.name pseudo-label; ABSENT = unset)
+    target_name_val: np.ndarray = None  # i32 [P]
+    # requested host ports (same encoding as NodeTensors.used_*)
+    want_ppk: np.ndarray = None  # i32 [P, W]
+    want_ip: np.ndarray = None  # i32 [P, W]
+    want_wild: np.ndarray = None  # bool [P, W]
+    # container images for ImageLocality
+    img_ids: np.ndarray = None  # i32 [P, I]
+    n_containers: np.ndarray = None  # i32 [P]
     pods: List[Pod] = field(default_factory=list)
 
     @property
     def p_cap(self) -> int:
         return self.requests.shape[0]
+
+
+def encode_port(vocab: Vocab, p) -> Tuple[int, int, bool]:
+    """ContainerPort → (proto:port id, host-ip id, ip-is-wildcard)."""
+    ppk = vocab.ports.intern(f"{p.protocol}:{p.host_port}")
+    ip = p.host_ip or "0.0.0.0"
+    return ppk, vocab.ports.intern(ip), ip == "0.0.0.0"
 
 
 def _merged_node_dnf(pod: Pod, vocab: Vocab) -> List[CompiledRequirements]:
@@ -541,6 +644,9 @@ def pack_pod_batch(
     priority = np.zeros(P, dtype=np.int32)
     label_vals = np.full((P, k_cap), ABSENT, dtype=np.int32)
 
+    target_name_val = np.full(P, ABSENT, dtype=np.int32)
+    n_containers = np.zeros(P, dtype=np.int32)
+
     node_dnfs: List[List[CompiledRequirements]] = []
     pref_terms: List[List[CompiledRequirements]] = []
     pref_weights: List[List[int]] = []
@@ -549,15 +655,23 @@ def pack_pod_batch(
     tsc_sels: List[List[CompiledRequirements]] = []
     aff_terms: List[List[CompiledRequirements]] = []
     aff_meta: List[List[Tuple[int, int, int, bool, List[int]]]] = []
+    port_rows: List[List[Tuple[int, int, bool]]] = []
+    img_rows: List[List[int]] = []
 
     for i, pod in enumerate(pods[:P]):
         req = pod.compute_requests()
         requests[i] = lanes.request_row(req, R)
         nz = req.non_zero_defaulted()
-        nonzero[i] = (nz.milli_cpu, -(-nz.memory // 1024))
+        nonzero[i] = (_i32(nz.milli_cpu), _i32(-(-nz.memory // MEM_UNIT)))
         ns_id[i] = vocab.namespaces.intern(pod.namespace)
         priority[i] = pod.priority
         label_vals[i] = _pod_label_row(pod, vocab, k_cap)
+        if pod.node_name:
+            target_name_val[i] = vocab.intern_val(pod.node_name)
+        # image_locality.go: len(initContainers) + len(containers)
+        n_containers[i] = max(len(pod.containers) + len(pod.init_containers), 1)
+        port_rows.append([encode_port(vocab, p) for p in pod.host_ports()])
+        img_rows.append([vocab.images.intern(img) for img in pod.images])
 
         node_dnfs.append(_merged_node_dnf(pod, vocab))
 
@@ -580,7 +694,8 @@ def pack_pod_batch(
         for tol in pod.tolerations:
             key = vocab.label_keys.intern(tol.key) if tol.key else ABSENT
             op = TOL_OP_EXISTS if tol.operator == TOLERATION_OP_EXISTS else TOL_OP_EQUAL
-            val = vocab.intern_val(tol.value) if tol.value else ABSENT
+            # "" is interned like any other value so Equal("") == taint("").
+            val = vocab.intern_val(tol.value)
             eff = _EFFECT_CODE.get(tol.effect, EFFECT_ALL) if tol.effect else EFFECT_ALL
             trow.append((key, op, val, eff))
         tols.append(trow)
@@ -596,43 +711,11 @@ def pack_pod_batch(
 
         arow: List[CompiledRequirements] = []
         ameta: List[Tuple[int, int, int, bool, List[int]]] = []
-
-        def _add_terms(terms, kind, weighted):
-            for t in terms:
-                term = t.pod_affinity_term if weighted else t
-                w = t.weight if weighted else 0
-                arow.append(compile_label_selector(term.label_selector, vocab))
-                all_ns, ids = resolve_term_namespaces(
-                    term, pod, vocab, namespace_labels
-                )
-                ameta.append(
-                    (kind, vocab.label_keys.intern(term.topology_key), w, all_ns, ids)
-                )
-
-        if pod.affinity and pod.affinity.pod_affinity:
-            pa = pod.affinity.pod_affinity
-            _add_terms(
-                pa.required_during_scheduling_ignored_during_execution,
-                TERM_REQUIRED_AFFINITY,
-                False,
-            )
-            _add_terms(
-                pa.preferred_during_scheduling_ignored_during_execution,
-                TERM_PREFERRED_AFFINITY,
-                True,
-            )
-        if pod.affinity and pod.affinity.pod_anti_affinity:
-            pa = pod.affinity.pod_anti_affinity
-            _add_terms(
-                pa.required_during_scheduling_ignored_during_execution,
-                TERM_REQUIRED_ANTI,
-                False,
-            )
-            _add_terms(
-                pa.preferred_during_scheduling_ignored_during_execution,
-                TERM_PREFERRED_ANTI,
-                True,
-            )
+        for compiled, kind, topo, w, all_ns, ids in iter_pod_affinity_terms(
+            pod, vocab, namespace_labels
+        ):
+            arow.append(compiled)
+            ameta.append((kind, topo, w, all_ns, ids))
         aff_terms.append(arow)
         aff_meta.append(ameta)
 
@@ -645,6 +728,24 @@ def pack_pod_batch(
         tsc_sels.append([])
         aff_terms.append([])
         aff_meta.append([])
+        port_rows.append([])
+        img_rows.append([])
+
+    W = bucket_cap(max((len(r) for r in port_rows), default=1), 1)
+    want_ppk = np.full((P, W), PAD, dtype=np.int32)
+    want_ip = np.full((P, W), PAD, dtype=np.int32)
+    want_wild = np.zeros((P, W), dtype=bool)
+    for i, prow in enumerate(port_rows):
+        for j, (ppk, ip, wild) in enumerate(prow[:W]):
+            want_ppk[i, j] = ppk
+            want_ip[i, j] = ip
+            want_wild[i, j] = wild
+
+    I = bucket_cap(max((len(r) for r in img_rows), default=1), 1)
+    img_ids = np.full((P, I), PAD, dtype=np.int32)
+    for i, irow in enumerate(img_rows):
+        for j, ii in enumerate(irow[:I]):
+            img_ids[i, j] = ii
 
     node_sel = pack_conjunction_table(node_dnfs)
     pref_node = pack_conjunction_table(pref_terms)
@@ -732,5 +833,11 @@ def pack_pod_batch(
         aff_weight=aff_weight,
         aff_ns_all=aff_ns_all,
         aff_ns_ids=aff_ns_ids,
+        target_name_val=target_name_val,
+        want_ppk=want_ppk,
+        want_ip=want_ip,
+        want_wild=want_wild,
+        img_ids=img_ids,
+        n_containers=n_containers,
         pods=list(pods),
     )

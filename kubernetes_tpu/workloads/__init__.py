@@ -1,0 +1,8 @@
+"""Synthetic workload generation (the analogue of scheduler_perf's YAML op
+DSL workload templates, test/integration/scheduler_perf/scheduler_perf.go:447)."""
+
+from kubernetes_tpu.workloads.synthetic import (  # noqa: F401
+    make_cluster,
+    make_node,
+    make_pod,
+)

@@ -1,0 +1,276 @@
+"""Random cluster/pod generators for property tests.
+
+Plays the role of the reference's testing/wrappers.go fluent builders plus
+scheduler_perf's workload templates: quantities are Mi-aligned (matching the
+packed snapshot's KiB-lane exactness contract, snapshot/schema.py docstring).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Dict, List, Optional, Tuple
+
+from kubernetes_tpu.api.resource import Resource
+from kubernetes_tpu.api.types import (
+    Affinity,
+    Container,
+    ContainerPort,
+    LabelSelector,
+    LabelSelectorRequirement,
+    Node,
+    NodeAffinity,
+    NodeSelector,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    Pod,
+    PodAffinity,
+    PodAffinityTerm,
+    PodAntiAffinity,
+    PreferredSchedulingTerm,
+    Taint,
+    Toleration,
+    TopologySpreadConstraint,
+    WeightedPodAffinityTerm,
+)
+
+ZONES = ["zone-a", "zone-b", "zone-c"]
+REGIONS = ["region-1", "region-2"]
+DISKS = ["ssd", "hdd", "nvme"]
+APPS = ["web", "db", "cache", "batch"]
+NAMESPACES = ["default", "prod", "dev"]
+TAINT_KEYS = ["dedicated", "gpu", "spot"]
+IMAGES = ["img/web:1", "img/db:2", "img/cache:3"]
+HOSTNAME = "kubernetes.io/hostname"
+
+
+def make_node(rng: random.Random, i: int) -> Node:
+    labels = {
+        "topology.kubernetes.io/zone": rng.choice(ZONES),
+        "topology.kubernetes.io/region": rng.choice(REGIONS),
+        HOSTNAME: f"node-{i}",
+    }
+    if rng.random() < 0.5:
+        labels["disk"] = rng.choice(DISKS)
+    if rng.random() < 0.3:
+        labels["tier"] = str(rng.randrange(1, 5))
+    taints: List[Taint] = []
+    if rng.random() < 0.2:
+        taints.append(
+            Taint(
+                key=rng.choice(TAINT_KEYS),
+                value=rng.choice(["", "true", "team-a"]),
+                effect=rng.choice(["NoSchedule", "PreferNoSchedule", "NoExecute"]),
+            )
+        )
+    images = {}
+    for img in IMAGES:
+        if rng.random() < 0.4:
+            images[img] = rng.randrange(50, 900) * 1024 * 1024
+    return Node(
+        name=f"node-{i}",
+        labels=labels,
+        capacity=Resource.from_map(
+            {
+                "cpu": f"{rng.choice([2, 4, 8, 16])}",
+                "memory": f"{rng.choice([4, 8, 16, 32])}Gi",
+                "pods": rng.choice([16, 32, 110]),
+            }
+        ),
+        taints=tuple(taints),
+        unschedulable=rng.random() < 0.05,
+        images=images,
+    )
+
+
+def _label_selector(rng: random.Random) -> Optional[LabelSelector]:
+    r = rng.random()
+    if r < 0.5:
+        return LabelSelector(match_labels={"app": rng.choice(APPS)})
+    if r < 0.8:
+        return LabelSelector(
+            match_expressions=(
+                LabelSelectorRequirement(
+                    "app",
+                    rng.choice(["In", "NotIn", "Exists", "DoesNotExist"]),
+                    tuple(rng.sample(APPS, rng.randrange(1, 3))),
+                ),
+            )
+        )
+    return LabelSelector()  # empty ⇒ matches everything
+
+
+def _affinity_term(rng: random.Random) -> PodAffinityTerm:
+    topo = rng.choice(["topology.kubernetes.io/zone", HOSTNAME])
+    kwargs = dict(topology_key=topo, label_selector=_label_selector(rng))
+    r = rng.random()
+    if r < 0.2:
+        kwargs["namespaces"] = tuple(rng.sample(NAMESPACES, rng.randrange(1, 3)))
+    elif r < 0.3:
+        kwargs["namespace_selector"] = LabelSelector()  # all namespaces
+    return PodAffinityTerm(**kwargs)
+
+
+def make_pod(
+    rng: random.Random,
+    name: str,
+    node_name: str = "",
+    hard: bool = False,
+) -> Pod:
+    labels = {"app": rng.choice(APPS)}
+    if rng.random() < 0.3:
+        labels["tier"] = str(rng.randrange(1, 5))
+    containers = [
+        Container(
+            name="c0",
+            requests={
+                "cpu": f"{rng.choice([0, 100, 250, 500, 1000])}m",
+                "memory": f"{rng.choice([0, 128, 256, 512, 1024])}Mi",
+            },
+        )
+    ]
+    kwargs = dict(
+        name=name,
+        namespace=rng.choice(NAMESPACES),
+        labels=labels,
+        node_name=node_name,
+        containers=containers,
+        priority=rng.randrange(0, 3) * 100,
+        images=tuple(rng.sample(IMAGES, rng.randrange(0, 3))),
+    )
+
+    if rng.random() < 0.35:
+        kwargs["node_selector"] = (
+            {"disk": rng.choice(DISKS)}
+            if rng.random() < 0.7
+            else {"topology.kubernetes.io/zone": rng.choice(ZONES)}
+        )
+    if rng.random() < 0.35:
+        req = None
+        if rng.random() < 0.7:
+            op = rng.choice(["In", "NotIn", "Exists", "Gt", "Lt"])
+            vals: Tuple[str, ...]
+            if op in ("Gt", "Lt"):
+                key, vals = "tier", (str(rng.randrange(1, 5)),)
+            else:
+                key, vals = "disk", tuple(rng.sample(DISKS, rng.randrange(1, 3)))
+            req = NodeSelector(
+                (
+                    NodeSelectorTerm(
+                        match_expressions=(NodeSelectorRequirement(key, op, vals),)
+                    ),
+                )
+            )
+        pref = ()
+        if rng.random() < 0.5:
+            pref = (
+                PreferredSchedulingTerm(
+                    weight=rng.randrange(1, 100),
+                    preference=NodeSelectorTerm(
+                        match_expressions=(
+                            NodeSelectorRequirement(
+                                "disk", "In", (rng.choice(DISKS),)
+                            ),
+                        )
+                    ),
+                ),
+            )
+        kwargs["affinity"] = Affinity(
+            node_affinity=NodeAffinity(
+                required_during_scheduling_ignored_during_execution=req,
+                preferred_during_scheduling_ignored_during_execution=pref,
+            )
+        )
+    if rng.random() < 0.3:
+        kwargs["tolerations"] = (
+            Toleration(
+                key=rng.choice(TAINT_KEYS + [""]),
+                operator=rng.choice(["Exists", "Equal"]),
+                value=rng.choice(["", "true"]),
+                effect=rng.choice(["", "NoSchedule", "PreferNoSchedule"]),
+            ),
+        )
+    if rng.random() < 0.3:
+        aff = kwargs.get("affinity") or Affinity()
+        pa = None
+        paa = None
+        if rng.random() < 0.6:
+            req_terms = (_affinity_term(rng),) if rng.random() < 0.6 else ()
+            pref_terms = (
+                (
+                    WeightedPodAffinityTerm(
+                        weight=rng.randrange(1, 100),
+                        pod_affinity_term=_affinity_term(rng),
+                    ),
+                )
+                if rng.random() < 0.6
+                else ()
+            )
+            if req_terms or pref_terms:
+                pa = PodAffinity(
+                    required_during_scheduling_ignored_during_execution=req_terms,
+                    preferred_during_scheduling_ignored_during_execution=pref_terms,
+                )
+        if rng.random() < 0.6:
+            req_terms = (_affinity_term(rng),) if rng.random() < 0.5 else ()
+            pref_terms = (
+                (
+                    WeightedPodAffinityTerm(
+                        weight=rng.randrange(1, 100),
+                        pod_affinity_term=_affinity_term(rng),
+                    ),
+                )
+                if rng.random() < 0.6
+                else ()
+            )
+            if req_terms or pref_terms:
+                paa = PodAntiAffinity(
+                    required_during_scheduling_ignored_during_execution=req_terms,
+                    preferred_during_scheduling_ignored_during_execution=pref_terms,
+                )
+        if pa or paa:
+            kwargs["affinity"] = Affinity(
+                node_affinity=aff.node_affinity,
+                pod_affinity=pa,
+                pod_anti_affinity=paa,
+            )
+    if rng.random() < 0.25:
+        kwargs["topology_spread_constraints"] = (
+            TopologySpreadConstraint(
+                max_skew=rng.randrange(1, 3),
+                topology_key=rng.choice(
+                    ["topology.kubernetes.io/zone", HOSTNAME]
+                ),
+                when_unsatisfiable=rng.choice(["DoNotSchedule", "ScheduleAnyway"]),
+                label_selector=_label_selector(rng),
+                min_domains=rng.choice([None, 2]),
+                node_affinity_policy=rng.choice(["Honor", "Ignore"]),
+                node_taints_policy=rng.choice(["Honor", "Ignore"]),
+            ),
+        )
+    if rng.random() < 0.15:
+        kwargs["containers"] = containers + [
+            Container(
+                name="c1",
+                ports=(
+                    ContainerPort(
+                        container_port=8080,
+                        host_port=rng.choice([8080, 9090]),
+                        protocol="TCP",
+                    ),
+                ),
+            )
+        ]
+    if hard and rng.random() < 0.2:
+        kwargs["node_name"] = f"node-{rng.randrange(0, 4)}"
+    return Pod(**kwargs)
+
+
+def make_cluster(
+    rng: random.Random, n_nodes: int, n_placed: int
+) -> Tuple[List[Node], List[Pod]]:
+    nodes = [make_node(rng, i) for i in range(n_nodes)]
+    placed = []
+    for j in range(n_placed):
+        node = rng.choice(nodes)
+        placed.append(make_pod(rng, f"placed-{j}", node_name=node.name))
+    return nodes, placed

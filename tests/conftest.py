@@ -1,15 +1,21 @@
 """Test configuration: force JAX onto a virtual 8-device CPU mesh.
 
-Must set env vars before jax is imported anywhere (JAX reads XLA_FLAGS at
-backend init).  Real-TPU benchmarking happens in bench.py, not under pytest.
+jax is preloaded at interpreter startup in this environment (sitecustomize),
+so env vars alone are too late — use jax.config.update before any backend
+initialization.  Real-TPU benchmarking happens in bench.py, not under pytest.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+# int64 is required by the score kernels' exact-integer arithmetic (it is
+# emulated on TPU; float64 is never used so TPU compatibility is preserved).
+jax.config.update("jax_enable_x64", True)
+
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
-os.environ.setdefault("JAX_ENABLE_X64", "0")

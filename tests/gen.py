@@ -1,0 +1,15 @@
+"""Shim: generators moved into the package (kubernetes_tpu.workloads)."""
+
+from kubernetes_tpu.workloads.synthetic import (  # noqa: F401
+    APPS,
+    DISKS,
+    HOSTNAME,
+    IMAGES,
+    NAMESPACES,
+    REGIONS,
+    TAINT_KEYS,
+    ZONES,
+    make_cluster,
+    make_node,
+    make_pod,
+)

@@ -1,0 +1,60 @@
+"""Debug helper: explain an InterPodAffinity kernel/oracle mismatch."""
+
+import os
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np
+
+from kubernetes_tpu.oracle import filters as OF
+from kubernetes_tpu.ops import filters as KF
+from kubernetes_tpu.ops.common import DeviceBatch, DeviceCluster
+from kubernetes_tpu.snapshot.schema import TERM_REQUIRED_AFFINITY, bucket_cap
+
+from tests.test_kernels import build
+
+state, pending, pc, pb = build(1)
+dc = DeviceCluster.from_host(pc.nodes, pc.existing, pc.vocab)
+db = DeviceBatch.from_host(pb)
+v_cap = bucket_cap(len(pc.vocab.label_vals))
+ipre = KF.interpod_precompute(dc, db)
+got = np.asarray(KF.mask_interpod(dc, db, ipre, v_cap))
+
+node_names = list(state.nodes)
+found = False
+for i, pod in enumerate(pending):
+    for j, name in enumerate(node_names):
+        want = OF.filter_interpod_affinity(pod, state.nodes[name], state) is None
+        if got[i, j] != want:
+            found = True
+            print(f"MISMATCH pod={i} ({pod.key}) node={j} ({name})")
+            print(f"  device={got[i, j]} oracle={want}")
+            print(f"  reason={OF.filter_interpod_affinity(pod, state.nodes[name], state)}")
+            kinds = np.asarray(db.aff_kind[i])
+            print(f"  aff_kind={kinds}")
+            inc_match = np.asarray(ipre.inc_match[i])  # [AT, E]
+            print(f"  inc_match rows: {[list(np.nonzero(inc_match[t])[0]) for t in range(inc_match.shape[0])]}")
+            print(f"  epods at those indices:")
+            for t in range(inc_match.shape[0]):
+                for e in np.nonzero(inc_match[t])[0]:
+                    key = pc.existing.keys[e] if e < len(pc.existing.keys) else "?"
+                    print(f"    term {t}: e={e} {key} node_idx={pc.existing.node_idx[e]}")
+            inc_cnt = np.asarray(ipre.inc_cnt[i])  # [AT, N]
+            print(f"  inc_cnt[:, :{len(node_names)}]={inc_cnt[:, :len(node_names)]}")
+            dv = np.asarray(ipre.inc_dv[i])
+            print(f"  inc_dv[:, :{len(node_names)}]={dv[:, :len(node_names)]}")
+            # which existing pods SHOULD match per oracle
+            from kubernetes_tpu.oracle.filters import _term_matches_pod, _required_terms
+            for term in _required_terms(pod, anti=False):
+                for ens in state.nodes.values():
+                    for ep in ens.pods:
+                        if _term_matches_pod(term, ep, pod, state):
+                            print(f"  oracle-match: {ep.key} on {ep.node_name} zone={ens.node.labels.get('topology.kubernetes.io/zone')}")
+            break
+    if found:
+        break
+if not found:
+    print("no mismatch")

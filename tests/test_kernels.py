@@ -1,0 +1,160 @@
+"""Property tests: device kernels vs the scalar oracle.
+
+The analogue of the reference's per-plugin unit suites (e.g.
+plugins/noderesources/fit_test.go): random clusters + random pods, asserting
+that every [P,N] mask/score the kernels produce equals the oracle's
+per-(pod,node) answer, and that end-to-end decisions match.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.oracle import filters as OF
+from kubernetes_tpu.oracle import pipeline as OP
+from kubernetes_tpu.oracle import scores as OS
+from kubernetes_tpu.oracle.state import OracleState
+from kubernetes_tpu.ops import filters as KF
+from kubernetes_tpu.ops import scores as KS
+from kubernetes_tpu.ops.common import DeviceBatch, DeviceCluster
+from kubernetes_tpu.ops.pipeline import schedule_independent
+from kubernetes_tpu.snapshot.cluster import pack_cluster
+from kubernetes_tpu.snapshot.schema import bucket_cap, pack_pod_batch
+from kubernetes_tpu.snapshot.interner import Vocab
+
+from tests.gen import make_cluster, make_pod
+
+NS_LABELS = {
+    "default": {"team": "core"},
+    "prod": {"team": "core", "env": "prod"},
+    "dev": {"env": "dev"},
+}
+
+
+def build(seed: int, n_nodes=12, n_placed=24, n_pending=16):
+    rng = random.Random(seed)
+    nodes, placed = make_cluster(rng, n_nodes, n_placed)
+    state = OracleState.build(nodes, placed, namespace_labels=NS_LABELS)
+    pending = [make_pod(rng, f"pend-{i}", hard=True) for i in range(n_pending)]
+    vocab = Vocab()
+    pc = pack_cluster(state, vocab, pending_pods=pending)
+    pb = pack_pod_batch(
+        pending,
+        vocab,
+        k_cap=pc.nodes.k_cap,
+        namespace_labels=state.namespace_labels,
+    )
+    return state, pending, pc, pb
+
+
+def oracle_filter_table(state, pending, filter_fn, *extra):
+    """[P, N] bool mask from a single oracle filter."""
+    node_names = list(state.nodes)
+    out = np.zeros((len(pending), len(node_names)), dtype=bool)
+    for i, pod in enumerate(pending):
+        for j, name in enumerate(node_names):
+            out[i, j] = filter_fn(pod, state.nodes[name], *extra) is None
+    return out
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_filter_masks_match_oracle(seed):
+    state, pending, pc, pb = build(seed)
+    dc = DeviceCluster.from_host(pc.nodes, pc.existing, pc.vocab)
+    db = DeviceBatch.from_host(pb)
+    v_cap = bucket_cap(len(pc.vocab.label_vals))
+    masks = KF.all_masks(dc, db, v_cap)
+    P, N = len(pending), len(state.nodes)
+    node_names = list(state.nodes)
+
+    def dev(name):
+        return np.asarray(masks[name])[:P, :N]
+
+    np.testing.assert_array_equal(
+        dev("NodeName"),
+        oracle_filter_table(state, pending, OF.filter_node_name),
+        err_msg="NodeName",
+    )
+    np.testing.assert_array_equal(
+        dev("NodeUnschedulable"),
+        oracle_filter_table(state, pending, OF.filter_node_unschedulable),
+        err_msg="NodeUnschedulable",
+    )
+    np.testing.assert_array_equal(
+        dev("TaintToleration"),
+        oracle_filter_table(state, pending, OF.filter_taints),
+        err_msg="TaintToleration",
+    )
+    np.testing.assert_array_equal(
+        dev("NodeAffinity"),
+        oracle_filter_table(state, pending, OF.filter_node_affinity),
+        err_msg="NodeAffinity",
+    )
+    np.testing.assert_array_equal(
+        dev("NodePorts"),
+        oracle_filter_table(state, pending, OF.filter_node_ports),
+        err_msg="NodePorts",
+    )
+    want_res = np.zeros((P, N), dtype=bool)
+    for i, pod in enumerate(pending):
+        for j, name in enumerate(node_names):
+            want_res[i, j] = not OF.filter_node_resources(pod, state.nodes[name])
+    np.testing.assert_array_equal(dev("NodeResourcesFit"), want_res, err_msg="Fit")
+
+    want_ipa = np.zeros((P, N), dtype=bool)
+    for i, pod in enumerate(pending):
+        for j, name in enumerate(node_names):
+            want_ipa[i, j] = (
+                OF.filter_interpod_affinity(pod, state.nodes[name], state) is None
+            )
+    np.testing.assert_array_equal(
+        dev("InterPodAffinity"), want_ipa, err_msg="InterPodAffinity"
+    )
+
+    want_sp = np.zeros((P, N), dtype=bool)
+    for i, pod in enumerate(pending):
+        counts = OF.spread_pair_counts(pod, state)
+        for j, name in enumerate(node_names):
+            want_sp[i, j] = (
+                OF.filter_topology_spread(pod, state.nodes[name], state, counts)
+                is None
+            )
+    np.testing.assert_array_equal(
+        dev("PodTopologySpread"), want_sp, err_msg="PodTopologySpread"
+    )
+
+
+@pytest.mark.parametrize("seed", [11, 12, 13])
+def test_scores_match_oracle(seed):
+    state, pending, pc, pb = build(seed)
+    res = schedule_independent(pc, pb)
+    P, N = len(pending), len(state.nodes)
+    node_names = list(state.nodes)
+
+    for i, pod in enumerate(pending):
+        fit = OP.feasible_nodes(pod, state)
+        got_feasible = {
+            node_names[j] for j in range(N) if res.feasible[i, j]
+        }
+        assert got_feasible == set(fit.feasible), f"pod {i} feasible set"
+        if len(fit.feasible) <= 1:
+            continue
+        totals = OP.prioritize(pod, state, fit.feasible)
+        for name, want in totals.items():
+            j = node_names.index(name)
+            assert int(res.totals[i, j]) == want, (
+                f"pod {i} node {name}: device {int(res.totals[i, j])} "
+                f"!= oracle {want}"
+            )
+
+
+@pytest.mark.parametrize("seed", [21, 22, 23, 24])
+def test_decisions_match_oracle(seed):
+    state, pending, pc, pb = build(seed, n_nodes=16, n_placed=40, n_pending=24)
+    res = schedule_independent(pc, pb)
+    node_names = list(state.nodes)
+    for i, pod in enumerate(pending):
+        want = OP.schedule_one(pod, state).node
+        got = node_names[res.chosen[i]] if res.chosen[i] >= 0 else None
+        assert got == want, f"pod {i}: device {got} != oracle {want}"

@@ -1,0 +1,79 @@
+"""Edge cases from review: extended-resource lanes, overcommit, huge nodes."""
+
+import numpy as np
+
+from kubernetes_tpu.api.resource import Resource
+from kubernetes_tpu.api.types import Container, Node, Pod
+from kubernetes_tpu.oracle.state import OracleState
+from kubernetes_tpu.ops.pipeline import schedule_independent
+from kubernetes_tpu.snapshot.cluster import pack_cluster
+from kubernetes_tpu.snapshot.interner import Vocab
+from kubernetes_tpu.snapshot.schema import pack_pod_batch
+
+
+def _run(nodes, pending, placed=()):
+    state = OracleState.build(nodes, placed)
+    vocab = Vocab()
+    pc = pack_cluster(state, vocab, pending_pods=pending)
+    pb = pack_pod_batch(pending, vocab, k_cap=pc.nodes.k_cap)
+    return state, schedule_independent(pc, pb)
+
+
+def test_unknown_extended_resource_rejected_everywhere():
+    """A pod requesting an extended resource no node advertises must be
+    unschedulable (fit.go scalar loop), even though the snapshot has no lane
+    for it."""
+    nodes = [
+        Node(
+            name="n0",
+            capacity=Resource.from_map(
+                {"cpu": "4", "memory": "8Gi", "example.com/gpu": 2}
+            ),
+        )
+    ]
+    pod = Pod(
+        name="p",
+        containers=[
+            Container(requests={"cpu": "1", "vendor.com/fpga": 1})
+        ],
+    )
+    _, res = _run(nodes, [pod])
+    assert res.chosen[0] == -1
+
+    # ...but the advertised one is schedulable.
+    pod2 = Pod(
+        name="p2",
+        containers=[Container(requests={"cpu": "1", "example.com/gpu": 1})],
+    )
+    _, res2 = _run(nodes, [pod2])
+    assert res2.chosen[0] == 0
+
+
+def test_zero_request_pod_fits_overcommitted_node():
+    """All-zero requests early-return as fitting (fit.go:460) even when the
+    node is overcommitted on cpu/memory by existing pods."""
+    nodes = [
+        Node(name="n0", capacity=Resource.from_map({"cpu": "1", "memory": "1Gi"}))
+    ]
+    hog = Pod(
+        name="hog",
+        node_name="n0",
+        containers=[Container(requests={"cpu": "1", "memory": "1Gi"})],
+    )
+    empty = Pod(name="empty")
+    nonzero = Pod(name="nz", containers=[Container(requests={"cpu": "100m"})])
+    state, res = _run(nodes, [empty, nonzero], placed=[hog])
+    assert res.chosen[0] == 0, "zero-request pod must fit"
+    assert res.chosen[1] == -1, "cpu-requesting pod must not fit"
+
+
+def test_multi_tib_node_packs_and_schedules():
+    """≥2 TiB memory no longer overflows the int32 lanes (MiB units)."""
+    nodes = [
+        Node(name="big", capacity=Resource.from_map({"cpu": "64", "memory": "4Ti"}))
+    ]
+    pod = Pod(
+        name="p", containers=[Container(requests={"cpu": "1", "memory": "1Ti"})]
+    )
+    _, res = _run(nodes, [pod])
+    assert res.chosen[0] == 0

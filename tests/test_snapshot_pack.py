@@ -39,7 +39,7 @@ def test_pack_nodes_basic():
     nt = pack_nodes(nodes, vocab)
     assert nt.valid[:2].all() and not nt.valid[2:].any()
     assert nt.allocatable[0, LANE_CPU] == 4000
-    assert nt.allocatable[1, LANE_MEM] == 4 * 1024 * 1024  # KiB
+    assert nt.allocatable[1, LANE_MEM] == 4 * 1024  # MiB
     zone_key = vocab.label_keys.lookup("zone")
     assert nt.label_vals[0, zone_key] == vocab.label_vals.lookup("a")
     assert nt.label_vals[1, zone_key] == vocab.label_vals.lookup("b")
@@ -100,9 +100,12 @@ def test_pack_existing_pods_and_anti_terms():
     app = vocab.label_keys.lookup("app")
     assert ep.label_vals[0, app] == vocab.label_vals.lookup("db")
     # one anti term row, attached to pod 1
-    assert ep.anti_term_pod[0] == 1
-    assert ep.anti_topo_key[0] == vocab.label_keys.lookup("zone")
-    assert ep.anti_table.term_valid[0, 0]
+    from kubernetes_tpu.snapshot.schema import TERM_REQUIRED_ANTI
+
+    assert ep.term_pod[0] == 1
+    assert ep.term_kind[0] == TERM_REQUIRED_ANTI
+    assert ep.term_topo_key[0] == vocab.label_keys.lookup("zone")
+    assert ep.term_table.term_valid[0, 0]
 
 
 def test_pack_pod_batch_selectors_and_tolerations():
@@ -131,7 +134,7 @@ def test_pack_pod_batch_selectors_and_tolerations():
     pb = pack_pod_batch([pod], vocab, k_cap=nt.k_cap, p_cap=4)
     assert pb.valid[0] and not pb.valid[1:].any()
     assert pb.requests[0, LANE_CPU] == 500
-    assert pb.requests[0, LANE_MEM] == 256 * 1024
+    assert pb.requests[0, LANE_MEM] == 256  # MiB
     # merged DNF: one term with zone req AND disk req
     assert pb.node_sel.term_valid[0, 0]
     assert not pb.node_sel.term_valid[0, 1:].any()
@@ -147,4 +150,4 @@ def test_nonzero_requests_defaults():
     vocab = Vocab()
     pb = pack_pod_batch([Pod(name="p")], vocab, k_cap=8)
     assert pb.nonzero_req[0, 0] == 100  # default 100m
-    assert pb.nonzero_req[0, 1] == 200 * 1024  # default 200Mi in KiB
+    assert pb.nonzero_req[0, 1] == 200  # default 200Mi in MiB
