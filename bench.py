@@ -53,17 +53,16 @@ def make_basic_pod(rng: random.Random, i: int):
 
 
 def main():
-    import numpy as np
+    import dataclasses
 
     from kubernetes_tpu.api.resource import Resource
     from kubernetes_tpu.api.types import Node
     from kubernetes_tpu.oracle.scores import HOSTNAME_LABEL
     from kubernetes_tpu.oracle.state import OracleState
     from kubernetes_tpu.ops.common import DeviceBatch, DeviceCluster
-    from kubernetes_tpu.ops.pipeline import _pipeline
     from kubernetes_tpu.snapshot.cluster import pack_cluster
     from kubernetes_tpu.snapshot.interner import Vocab
-    from kubernetes_tpu.snapshot.schema import ResourceLanes, bucket_cap, pack_pod_batch
+    from kubernetes_tpu.snapshot.schema import bucket_cap, pack_pod_batch
 
     import jax
     import jax.numpy as jnp
@@ -89,20 +88,51 @@ def main():
     pc = pack_cluster(state, vocab, pending_pods=pods[:BATCH])
     v_cap = bucket_cap(len(vocab.label_vals))
     hostname_key = jnp.asarray(vocab.label_keys.lookup(HOSTNAME_LABEL), jnp.int32)
-    lanes = ResourceLanes(vocab)
 
     dc = DeviceCluster.from_host(pc.nodes, pc.existing, vocab)
 
-    # Warm up the compile cache with the steady-state shapes.
-    pb0 = pack_pod_batch(pods[:BATCH], vocab, k_cap=pc.nodes.k_cap, p_cap=BATCH)
-    db0 = DeviceBatch.from_host(pb0)
-    res = _pipeline(dc, db0, hostname_key, v_cap)
-    res.chosen.block_until_ready()
+    from kubernetes_tpu.ops import gang
+    from kubernetes_tpu.ops.pipeline import batch_feature_flags
 
-    # Timed run: schedule every pod, committing capacity between batches
-    # (host-side requested update emulating the assume step).
-    requested = np.array(pc.nodes.requested)
-    num_pods = np.array(pc.nodes.num_pods)
+    # Warm up the compile cache with the steady-state shapes.  Flags are
+    # OR-ed over ALL chunks so a compile-time kernel skip can never disagree
+    # with later data.
+    pb0 = pack_pod_batch(pods[:BATCH], vocab, k_cap=pc.nodes.k_cap, p_cap=BATCH)
+    has_interpod = has_spread = has_images = has_ports = False
+    for start in range(0, N_PODS, BATCH):
+        pbx = (
+            pb0
+            if start == 0
+            else pack_pod_batch(
+                pods[start : start + BATCH],
+                vocab,
+                k_cap=pc.nodes.k_cap,
+                p_cap=BATCH,
+            )
+        )
+        hi, hs, hm, hp = batch_feature_flags(pc, pbx)
+        has_interpod |= hi
+        has_spread |= hs
+        has_images |= hm
+        has_ports |= hp
+    db0 = DeviceBatch.from_host(pb0)
+
+    def run_batch(dc, db):
+        return gang.gang_run(
+            dc,
+            db,
+            hostname_key,
+            v_cap,
+            has_interpod=has_interpod,
+            has_spread=has_spread,
+            has_ports=has_ports,
+            has_images=has_images,
+        )
+
+    run_batch(dc, db0)[0].block_until_ready()
+
+    # Timed run: gang-scheduled batches, sequential-equivalent within a
+    # batch; node tallies chain across batches device-side.
     scheduled = 0
     t_pack = t_dev = 0.0
     t0 = time.perf_counter()
@@ -111,26 +141,19 @@ def main():
         tp = time.perf_counter()
         pb = pack_pod_batch(chunk, vocab, k_cap=pc.nodes.k_cap, p_cap=BATCH)
         db = DeviceBatch.from_host(pb)
-        dc = dc.__class__(
-            **{
-                **dc.__dict__,
-                "requested": jnp.asarray(requested),
-                "num_pods": jnp.asarray(num_pods),
-            }
-        )
         td = time.perf_counter()
         t_pack += td - tp
-        # Fetch only the [P] decisions — never the [P, N] working set.
-        res = _pipeline(dc, db, hostname_key, v_cap)
-        chosen = jax.device_get(res.chosen)
+        chosen, _, final = run_batch(dc, db)
+        # Fetch only the [P] decisions — never any [P, N] working set.
+        chosen = jax.device_get(chosen)
+        dc = dataclasses.replace(
+            dc,
+            requested=final["requested"],
+            nonzero_req=final["nonzero"],
+            num_pods=final["num_pods"],
+        )
         t_dev += time.perf_counter() - td
-        for i, pod in enumerate(chunk):
-            j = int(chosen[i])
-            if j < 0:
-                continue
-            requested[j] += pb.requests[i]
-            num_pods[j] += 1
-            scheduled += 1
+        scheduled += int((chosen[: len(chunk)] >= 0).sum())
     dt = time.perf_counter() - t0
     print(
         f"# pack={t_pack:.2f}s device+fetch={t_dev:.2f}s total={dt:.2f}s",

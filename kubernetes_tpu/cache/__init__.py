@@ -1,0 +1,11 @@
+"""Scheduler cache: authoritative in-memory cluster state + device mirror.
+
+The host side mirrors pkg/scheduler/backend/cache (assume/forget/
+finish-binding protocol, informer reconciliation, per-node generations);
+the device side replaces the reference's Snapshot struct copy
+(cache.go:185 UpdateSnapshot) with generation-gated repacking of only the
+dirty node rows into the HBM tensors.
+"""
+
+from kubernetes_tpu.cache.cache import Cache  # noqa: F401
+from kubernetes_tpu.cache.mirror import SnapshotMirror  # noqa: F401

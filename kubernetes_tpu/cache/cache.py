@@ -1,0 +1,230 @@
+"""Host scheduler cache (pkg/scheduler/backend/cache/cache.go).
+
+Holds the authoritative view of nodes and pods between the informer stream
+and the scheduling loop:
+
+  * ``assume_pod``/``forget_pod``/``finish_binding`` implement the
+    optimistic-binding protocol (cache.go:360-422): a scheduled pod is
+    charged to its node immediately so the next cycle sees it, before the
+    API write round-trips.
+  * informer Add/Update/RemovePod reconcile against assumed state,
+    including the assumed-vs-informer races (cache.go:484-568).
+  * every mutation bumps the node's ``generation``; the device mirror
+    repacks only nodes newer than its own generation (cache.go:185-279's
+    incremental UpdateSnapshot, reproduced for HBM).
+  * assumed pods that never confirm expire after a TTL (cache.go:721-752;
+    the reference default is "never", kept configurable here).
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from kubernetes_tpu.api.resource import Resource
+from kubernetes_tpu.api.types import Node, Pod
+
+_generation = itertools.count(1)
+
+
+def next_generation() -> int:
+    return next(_generation)
+
+
+@dataclass
+class CachedNode:
+    """NodeInfo analogue (framework/types.go:585): node + accounting."""
+
+    node: Optional[Node]  # None for a "ghost" node that only hosts pods
+    pods: Dict[str, Pod] = field(default_factory=dict)  # uid → pod
+    requested: Resource = field(default_factory=Resource)
+    non_zero_requested: Resource = field(default_factory=Resource)
+    generation: int = 0
+
+    def add_pod(self, pod: Pod) -> None:
+        req = pod.compute_requests()
+        self.requested.add(req)
+        self.non_zero_requested.add(req.non_zero_defaulted())
+        self.pods[pod.uid] = pod
+        self.generation = next_generation()
+
+    def remove_pod(self, pod: Pod) -> bool:
+        if pod.uid not in self.pods:
+            return False
+        old = self.pods.pop(pod.uid)
+        req = old.compute_requests()
+        self.requested.sub(req)
+        self.non_zero_requested.sub(req.non_zero_defaulted())
+        self.generation = next_generation()
+        return True
+
+
+@dataclass
+class _PodState:
+    pod: Pod
+    binding_finished: bool = False
+    deadline: Optional[float] = None
+
+
+class CacheError(RuntimeError):
+    """Cache invariant violation — the reference fatals on these
+    (cache.go:537-541); we raise and let the caller decide."""
+
+
+class Cache:
+    def __init__(self, assumed_pod_ttl_s: Optional[float] = None):
+        # ttl None reproduces durationToExpireAssumedPod=0 (never expire,
+        # scheduler.go:57)
+        self.ttl = assumed_pod_ttl_s
+        self.nodes: Dict[str, CachedNode] = {}
+        self.pod_states: Dict[str, _PodState] = {}
+        self.assumed: set[str] = set()
+
+    # ----- nodes (informer) -----------------------------------------------
+
+    def add_node(self, node: Node) -> None:
+        cn = self.nodes.get(node.name)
+        if cn is None:
+            self.nodes[node.name] = CachedNode(
+                node=node, generation=next_generation()
+            )
+        else:
+            cn.node = node
+            cn.generation = next_generation()
+
+    def update_node(self, node: Node) -> None:
+        self.add_node(node)
+
+    def remove_node(self, name: str) -> None:
+        cn = self.nodes.get(name)
+        if cn is None:
+            return
+        if cn.pods:
+            # Ghost node: keep accounting until its pods are deleted
+            # (cache.go:601-668).
+            cn.node = None
+            cn.generation = next_generation()
+        else:
+            del self.nodes[name]
+
+    # ----- assume protocol (scheduler) ------------------------------------
+
+    def assume_pod(self, pod: Pod, node_name: str) -> None:
+        if pod.uid in self.pod_states:
+            raise CacheError(f"pod {pod.key} already assumed/added")
+        pod.node_name = node_name
+        cn = self.nodes.setdefault(node_name, CachedNode(node=None))
+        cn.add_pod(pod)
+        self.pod_states[pod.uid] = _PodState(pod)
+        self.assumed.add(pod.uid)
+
+    def finish_binding(self, pod: Pod, now: Optional[float] = None) -> None:
+        ps = self.pod_states.get(pod.uid)
+        if ps is None or pod.uid not in self.assumed:
+            return
+        ps.binding_finished = True
+        if self.ttl is not None:
+            ps.deadline = (now or time.monotonic()) + self.ttl
+
+    def forget_pod(self, pod: Pod) -> None:
+        ps = self.pod_states.get(pod.uid)
+        if ps is None:
+            return
+        if pod.uid not in self.assumed:
+            raise CacheError(f"pod {pod.key} was added, not assumed; cannot forget")
+        self._remove_pod_internal(ps.pod)
+        del self.pod_states[pod.uid]
+        self.assumed.discard(pod.uid)
+
+    def cleanup_expired_assumed(self, now: Optional[float] = None) -> List[Pod]:
+        """TTL janitor (cache.go:729 cleanupAssumedPods)."""
+        now = now or time.monotonic()
+        expired = []
+        for uid in list(self.assumed):
+            ps = self.pod_states[uid]
+            if ps.binding_finished and ps.deadline is not None and now >= ps.deadline:
+                expired.append(ps.pod)
+                self._remove_pod_internal(ps.pod)
+                del self.pod_states[uid]
+                self.assumed.discard(uid)
+        return expired
+
+    # ----- pods (informer) -------------------------------------------------
+
+    def add_pod(self, pod: Pod) -> None:
+        """Informer confirmation of a (possibly assumed) pod
+        (cache.go:484)."""
+        ps = self.pod_states.get(pod.uid)
+        if ps is not None and pod.uid in self.assumed:
+            if ps.pod.node_name != pod.node_name:
+                # Assumed to another node than the API says: trust the API
+                # (the race in cache.go:498-516).
+                self._remove_pod_internal(ps.pod)
+                self._add_pod_internal(pod)
+            else:
+                # Same node: adopt the API object (it is the truth).
+                self.nodes[pod.node_name].pods[pod.uid] = pod
+            # Confirmed: no longer assumed.
+            self.assumed.discard(pod.uid)
+            ps.pod = pod
+            ps.deadline = None
+        elif ps is None:
+            self._add_pod_internal(pod)
+            self.pod_states[pod.uid] = _PodState(pod)
+        else:
+            raise CacheError(f"pod {pod.key} added twice")
+
+    def update_pod(self, old: Pod, new: Pod) -> None:
+        ps = self.pod_states.get(old.uid)
+        if ps is None:
+            raise CacheError(f"updating unknown pod {old.key}")
+        if old.uid in self.assumed:
+            raise CacheError(f"updating assumed pod {old.key}")
+        self._remove_pod_internal(ps.pod)
+        self._add_pod_internal(new)
+        ps.pod = new
+
+    def remove_pod(self, pod: Pod) -> None:
+        ps = self.pod_states.get(pod.uid)
+        if ps is None:
+            return
+        self._remove_pod_internal(ps.pod)
+        del self.pod_states[pod.uid]
+        self.assumed.discard(pod.uid)
+        # Drop ghost nodes whose last pod left.
+        cn = self.nodes.get(ps.pod.node_name)
+        if cn is not None and cn.node is None and not cn.pods:
+            del self.nodes[ps.pod.node_name]
+
+    def _add_pod_internal(self, pod: Pod) -> None:
+        cn = self.nodes.setdefault(pod.node_name, CachedNode(node=None))
+        cn.add_pod(pod)
+
+    def _remove_pod_internal(self, pod: Pod) -> None:
+        cn = self.nodes.get(pod.node_name)
+        if cn is None or not cn.remove_pod(pod):
+            raise CacheError(f"pod {pod.key} not found on node {pod.node_name!r}")
+
+    # ----- introspection ----------------------------------------------------
+
+    def is_assumed(self, uid: str) -> bool:
+        return uid in self.assumed
+
+    def real_nodes(self) -> List[CachedNode]:
+        return [cn for cn in self.nodes.values() if cn.node is not None]
+
+    def placed_pods(self) -> List[Pod]:
+        return [
+            p
+            for cn in self.nodes.values()
+            for p in cn.pods.values()
+        ]
+
+    def stats(self) -> Dict[str, int]:
+        return {
+            "nodes": len(self.real_nodes()),
+            "pods": sum(len(cn.pods) for cn in self.nodes.values()),
+            "assumed": len(self.assumed),
+        }

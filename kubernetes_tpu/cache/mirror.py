@@ -1,0 +1,149 @@
+"""Incremental device mirror of the cache (UpdateSnapshot, cache.go:185).
+
+The reference walks its generation-ordered node list head-first and copies
+only NodeInfos newer than the snapshot's generation.  Here the same delta
+discipline drives HBM tensor maintenance:
+
+  * node rows with ``generation > mirror.generation`` are repacked in place
+    (write_node_row + usage rows);
+  * the placed-pod tensors are rebuilt only when the pod population changed
+    (their rows are append-only between full repacks);
+  * capacity growth (more nodes/pods/labels than the buckets hold) forces a
+    full repack at the next bucket size — amortized O(1) by doubling.
+
+Returns numpy tensors; the scheduler converts to DeviceCluster (upload).
+Uploading only dirty rows via device-side dynamic_update_slice is a planned
+optimization; the delta protocol here is the prerequisite.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from kubernetes_tpu.cache.cache import Cache
+from kubernetes_tpu.snapshot.cluster import accumulate_node_usage
+from kubernetes_tpu.snapshot.interner import Vocab
+from kubernetes_tpu.snapshot.schema import (
+    MEM_UNIT,
+    NodeTensors,
+    ResourceLanes,
+    bucket_cap,
+    pack_existing_pods,
+    pack_nodes,
+    write_node_row,
+)
+
+
+class SnapshotMirror:
+    def __init__(self, vocab: Optional[Vocab] = None):
+        self.vocab = vocab or Vocab()
+        self.generation = 0
+        self.nodes: Optional[NodeTensors] = None
+        self.existing = None
+        self._pod_population: tuple = ()
+        self._full_packs = 0
+        self._row_updates = 0
+        self._force_full = False
+
+    def update(self, cache: Cache, namespace_labels=None) -> None:
+        """Bring the mirror up to date with the cache (incremental)."""
+        real = cache.real_nodes()
+        names = [cn.node.name for cn in real]
+        placed = cache.placed_pods()
+
+        need_full = (
+            self._force_full
+            or self.nodes is None
+            or len(real) > self.nodes.n_cap
+            or bucket_cap(len(self.vocab.label_keys)) > self.nodes.k_cap
+            or set(names) != set(self.nodes.name_to_idx)
+        )
+        if need_full:
+            self._force_full = False
+            self._full_pack(cache, namespace_labels)
+            return
+
+        lanes = ResourceLanes(self.vocab)
+        dirty = 0
+        for cn in real:
+            if cn.generation <= self.generation:
+                continue
+            i = self.nodes.name_to_idx[cn.node.name]
+            write_node_row(self.nodes, i, cn.node, self.vocab)
+            self._write_usage_row(cn, i, lanes)
+            dirty += 1
+        self._row_updates += dirty
+
+        # id() is part of the key: update_pod replaces the stored object, so
+        # label-only changes still trigger a placed-pod tensor rebuild.
+        population = tuple(sorted((p.uid, id(p)) for p in placed))
+        if population != self._pod_population:
+            # Pod set changed: rebuild placed-pod tensors (+ per-node usage
+            # accounting rows were already updated above via generations).
+            self.existing = pack_existing_pods(
+                placed,
+                self.nodes.name_to_idx,
+                self.vocab,
+                k_cap=self.nodes.k_cap,
+                namespace_labels=namespace_labels,
+            )
+            self._pod_population = population
+
+        self.generation = max(
+            (cn.generation for cn in real), default=self.generation
+        )
+
+    def _write_usage_row(self, cn, i: int, lanes: ResourceLanes) -> None:
+        nt = self.nodes
+        R = nt.allocatable.shape[1]
+        nt.requested[i] = lanes.request_row(cn.requested, R)
+        nt.nonzero_req[i, 0] = cn.non_zero_requested.milli_cpu
+        nt.nonzero_req[i, 1] = -(-cn.non_zero_requested.memory // MEM_UNIT)
+        nt.num_pods[i] = len(cn.pods)
+        U = nt.used_ppk.shape[1]
+        nt.used_ppk[i] = -2
+        nt.used_ip[i] = -2
+        nt.used_wild[i] = False
+        from kubernetes_tpu.snapshot.schema import encode_port
+
+        rows = [
+            encode_port(self.vocab, hp)
+            for pod in cn.pods.values()
+            for hp in pod.host_ports()
+        ]
+        if len(rows) > U:
+            # port slots overflow → grow on next full pack
+            self._force_full = True
+        for j, (ppk, ip, wild) in enumerate(rows[:U]):
+            nt.used_ppk[i, j] = ppk
+            nt.used_ip[i, j] = ip
+            nt.used_wild[i, j] = wild
+
+    def _full_pack(self, cache: Cache, namespace_labels) -> None:
+        real = cache.real_nodes()
+        placed = cache.placed_pods()
+        for p in placed:
+            for k, v in p.labels.items():
+                self.vocab.intern_label(k, v)
+            self.vocab.namespaces.intern(p.namespace)
+        self.nodes = pack_nodes([cn.node for cn in real], self.vocab)
+        accumulate_node_usage(self.nodes, placed, self.vocab)
+        self.existing = pack_existing_pods(
+            placed,
+            self.nodes.name_to_idx,
+            self.vocab,
+            k_cap=self.nodes.k_cap,
+            namespace_labels=namespace_labels,
+        )
+        self._pod_population = tuple(sorted((p.uid, id(p)) for p in placed))
+        self.generation = max((cn.generation for cn in real), default=0)
+        self._full_packs += 1
+
+    def stats(self) -> Dict[str, int]:
+        return {
+            "full_packs": self._full_packs,
+            "row_updates": self._row_updates,
+            "generation": self.generation,
+        }

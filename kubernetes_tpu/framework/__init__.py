@@ -1,0 +1,18 @@
+"""Scheduling framework: plugin API, runtime, registry, profiles, config.
+
+The Python mirror of pkg/scheduler/framework — same 12 extension points,
+Status codes, and CycleState semantics (framework/interface.go), with one
+structural change: a plugin may be *device-backed* (contributes a batched
+[P, N] mask/score kernel to the fused dispatch) or *host-backed* (scalar
+per-(pod, node) callbacks, used for stateful plugins like volume binding
+until they grow kernels).
+"""
+
+from kubernetes_tpu.framework.interface import (  # noqa: F401
+    Code,
+    CycleState,
+    Plugin,
+    Status,
+)
+from kubernetes_tpu.framework.registry import Registry, default_registry  # noqa: F401
+from kubernetes_tpu.framework.runtime import Framework  # noqa: F401

@@ -1,0 +1,326 @@
+"""Scheduler configuration API.
+
+KubeSchedulerConfiguration-shaped (pkg/scheduler/apis/config/types.go:37-198)
+with versioned defaulting and validation: profiles, per-extension-point
+plugin enable/disable, MultiPoint expansion
+(apis/config/v1/default_plugins.go:30-52, runtime/framework.go:511), plugin
+args, extenders, and the scheduler-wide knobs (parallelism,
+percentageOfNodesToScore, backoff bounds).  Loadable from YAML.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+EXTENSION_POINTS = (
+    "preEnqueue",
+    "queueSort",
+    "preFilter",
+    "filter",
+    "postFilter",
+    "preScore",
+    "score",
+    "reserve",
+    "permit",
+    "preBind",
+    "bind",
+    "postBind",
+)
+
+# Default MultiPoint plugin list with score weights
+# (apis/config/v1/default_plugins.go:30-52).
+DEFAULT_MULTI_POINT: List[Tuple[str, int]] = [
+    ("SchedulingGates", 0),
+    ("PrioritySort", 0),
+    ("NodeUnschedulable", 0),
+    ("NodeName", 0),
+    ("TaintToleration", 3),
+    ("NodeAffinity", 2),
+    ("NodePorts", 0),
+    ("NodeResourcesFit", 1),
+    ("VolumeRestrictions", 0),
+    ("NodeVolumeLimits", 0),
+    ("VolumeBinding", 0),
+    ("VolumeZone", 0),
+    ("PodTopologySpread", 2),
+    ("InterPodAffinity", 2),
+    ("DefaultPreemption", 0),
+    ("NodeResourcesBalancedAllocation", 1),
+    ("ImageLocality", 1),
+    ("DefaultBinder", 0),
+]
+
+DEFAULT_SCHEDULER_NAME = "default-scheduler"
+
+
+@dataclass
+class PluginRef:
+    name: str
+    weight: int = 0
+
+
+@dataclass
+class PluginSet:
+    enabled: List[PluginRef] = field(default_factory=list)
+    disabled: List[PluginRef] = field(default_factory=list)
+
+
+@dataclass
+class Plugins:
+    """Per-extension-point sets + multiPoint (apis/config/types.go)."""
+
+    multi_point: PluginSet = field(default_factory=PluginSet)
+    pre_enqueue: PluginSet = field(default_factory=PluginSet)
+    queue_sort: PluginSet = field(default_factory=PluginSet)
+    pre_filter: PluginSet = field(default_factory=PluginSet)
+    filter: PluginSet = field(default_factory=PluginSet)
+    post_filter: PluginSet = field(default_factory=PluginSet)
+    pre_score: PluginSet = field(default_factory=PluginSet)
+    score: PluginSet = field(default_factory=PluginSet)
+    reserve: PluginSet = field(default_factory=PluginSet)
+    permit: PluginSet = field(default_factory=PluginSet)
+    pre_bind: PluginSet = field(default_factory=PluginSet)
+    bind: PluginSet = field(default_factory=PluginSet)
+    post_bind: PluginSet = field(default_factory=PluginSet)
+
+
+@dataclass
+class Extender:
+    """HTTP extender config (apis/config/types.go Extender)."""
+
+    url_prefix: str = ""
+    filter_verb: str = ""
+    prioritize_verb: str = ""
+    bind_verb: str = ""
+    preempt_verb: str = ""
+    weight: int = 1
+    enable_https: bool = False
+    http_timeout_s: float = 30.0
+    node_cache_capable: bool = False
+    ignorable: bool = False
+    managed_resources: List[str] = field(default_factory=list)
+
+
+@dataclass
+class Profile:
+    scheduler_name: str = DEFAULT_SCHEDULER_NAME
+    plugins: Plugins = field(default_factory=Plugins)
+    plugin_config: Dict[str, dict] = field(default_factory=dict)
+    percentage_of_nodes_to_score: Optional[int] = None
+
+
+@dataclass
+class SchedulerConfiguration:
+    """KubeSchedulerConfiguration (types.go:37)."""
+
+    parallelism: int = 16
+    profiles: List[Profile] = field(default_factory=lambda: [Profile()])
+    extenders: List[Extender] = field(default_factory=list)
+    percentage_of_nodes_to_score: int = 0  # 0 = adaptive
+    pod_initial_backoff_seconds: float = 1.0
+    pod_max_backoff_seconds: float = 10.0
+    batch_size: int = 256  # TPU extension: gang batch width
+
+    def validate(self) -> None:
+        names = [p.scheduler_name for p in self.profiles]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate profile names: {names}")
+        if not self.profiles:
+            raise ValueError("at least one profile required")
+        if self.pod_initial_backoff_seconds <= 0:
+            raise ValueError("podInitialBackoffSeconds must be positive")
+        if self.pod_max_backoff_seconds < self.pod_initial_backoff_seconds:
+            raise ValueError("podMaxBackoffSeconds < podInitialBackoffSeconds")
+        if not 0 <= self.percentage_of_nodes_to_score <= 100:
+            raise ValueError("percentageOfNodesToScore must be in [0, 100]")
+
+
+# ---------------------------------------------------------------------------
+# Defaulting + MultiPoint expansion (runtime/framework.go:511 expandMultiPoint)
+# ---------------------------------------------------------------------------
+
+# Which extension points each in-tree plugin actually implements.
+PLUGIN_POINTS: Dict[str, Tuple[str, ...]] = {
+    "SchedulingGates": ("preEnqueue",),
+    "PrioritySort": ("queueSort",),
+    "NodeUnschedulable": ("filter",),
+    "NodeName": ("filter",),
+    "TaintToleration": ("filter", "preScore", "score"),
+    "NodeAffinity": ("preFilter", "filter", "preScore", "score"),
+    "NodePorts": ("preFilter", "filter"),
+    "NodeResourcesFit": ("preFilter", "filter", "preScore", "score"),
+    "VolumeRestrictions": ("preFilter", "filter"),
+    "NodeVolumeLimits": ("filter",),
+    "VolumeBinding": ("preFilter", "filter", "reserve", "preBind", "score"),
+    "VolumeZone": ("filter",),
+    "PodTopologySpread": ("preFilter", "filter", "preScore", "score"),
+    "InterPodAffinity": ("preFilter", "filter", "preScore", "score"),
+    "DefaultPreemption": ("postFilter",),
+    "NodeResourcesBalancedAllocation": ("preScore", "score"),
+    "ImageLocality": ("score",),
+    "DefaultBinder": ("bind",),
+}
+
+_SNAKE = {
+    "preEnqueue": "pre_enqueue",
+    "queueSort": "queue_sort",
+    "preFilter": "pre_filter",
+    "filter": "filter",
+    "postFilter": "post_filter",
+    "preScore": "pre_score",
+    "score": "score",
+    "reserve": "reserve",
+    "permit": "permit",
+    "preBind": "pre_bind",
+    "bind": "bind",
+    "postBind": "post_bind",
+}
+
+
+def default_plugins() -> Plugins:
+    p = Plugins()
+    p.multi_point.enabled = [PluginRef(n, w) for n, w in DEFAULT_MULTI_POINT]
+    return p
+
+
+def expand_profile(profile: Profile) -> Dict[str, List[PluginRef]]:
+    """MultiPoint expansion + per-point enable/disable merge.
+
+    Returns extensionPoint → ordered [PluginRef] with effective weights.
+    Rules (runtime/framework.go:511-600): per-point Enabled appends after
+    multipoint expansion; per-point Disabled removes multipoint entries for
+    that point only; '*' disables all; per-point weight overrides multipoint
+    weight.
+    """
+    plugins = profile.plugins
+    mp = plugins.multi_point
+    if not mp.enabled and not mp.disabled:
+        mp = default_plugins().multi_point
+    mp_disabled = {d.name for d in mp.disabled}
+    mp_all_disabled = "*" in mp_disabled
+
+    out: Dict[str, List[PluginRef]] = {ep: [] for ep in EXTENSION_POINTS}
+    for ep in EXTENSION_POINTS:
+        point_set: PluginSet = getattr(plugins, _SNAKE[ep])
+        point_disabled = {d.name for d in point_set.disabled}
+        point_all_disabled = "*" in point_disabled
+        seen = set()
+
+        if not mp_all_disabled:
+            for ref in mp.enabled:
+                if ref.name in mp_disabled or ref.name in seen:
+                    continue
+                if ep not in PLUGIN_POINTS.get(ref.name, ()):
+                    continue
+                if point_all_disabled or ref.name in point_disabled:
+                    continue
+                # per-point weight overrides multipoint weight
+                override = next(
+                    (e for e in point_set.enabled if e.name == ref.name), None
+                )
+                weight = override.weight if override and override.weight else ref.weight
+                out[ep].append(PluginRef(ref.name, weight or _default_weight(ref.name, ep)))
+                seen.add(ref.name)
+
+        for ref in point_set.enabled:
+            if ref.name in seen:
+                continue
+            out[ep].append(PluginRef(ref.name, ref.weight or _default_weight(ref.name, ep)))
+            seen.add(ref.name)
+    return out
+
+
+def _default_weight(name: str, ep: str) -> int:
+    if ep != "score":
+        return 0
+    return dict(DEFAULT_MULTI_POINT).get(name, 1) or 1
+
+
+# ---------------------------------------------------------------------------
+# YAML loading (cmd/kube-scheduler/app/options/configfile.go analogue)
+# ---------------------------------------------------------------------------
+
+
+def _plugin_set_from(d: Optional[dict]) -> PluginSet:
+    d = d or {}
+    return PluginSet(
+        enabled=[
+            PluginRef(e["name"], e.get("weight", 0)) for e in d.get("enabled", [])
+        ],
+        disabled=[
+            PluginRef(e["name"], e.get("weight", 0)) for e in d.get("disabled", [])
+        ],
+    )
+
+
+def _plugins_from(d: Optional[dict]) -> Plugins:
+    d = d or {}
+    p = Plugins()
+    p.multi_point = _plugin_set_from(d.get("multiPoint"))
+    for ep in EXTENSION_POINTS:
+        setattr(p, _SNAKE[ep], _plugin_set_from(d.get(ep)))
+    return p
+
+
+def load_config(source) -> SchedulerConfiguration:
+    """Load from a YAML string / path / dict."""
+    import os
+
+    if isinstance(source, dict):
+        d = source
+    else:
+        import yaml
+
+        if isinstance(source, str) and os.path.exists(source):
+            with open(source) as f:
+                d = yaml.safe_load(f)
+        else:
+            d = yaml.safe_load(source)
+    d = d or {}
+    kind = d.get("kind", "KubeSchedulerConfiguration")
+    if kind != "KubeSchedulerConfiguration":
+        raise ValueError(f"unexpected kind {kind!r}")
+
+    profiles = []
+    for pd in d.get("profiles", [{}]):
+        plugin_config = {
+            e["name"]: e.get("args", {}) for e in pd.get("pluginConfig", [])
+        }
+        profiles.append(
+            Profile(
+                scheduler_name=pd.get("schedulerName", DEFAULT_SCHEDULER_NAME),
+                plugins=_plugins_from(pd.get("plugins")),
+                plugin_config=plugin_config,
+                percentage_of_nodes_to_score=pd.get("percentageOfNodesToScore"),
+            )
+        )
+    extenders = [
+        Extender(
+            url_prefix=e.get("urlPrefix", ""),
+            filter_verb=e.get("filterVerb", ""),
+            prioritize_verb=e.get("prioritizeVerb", ""),
+            bind_verb=e.get("bindVerb", ""),
+            preempt_verb=e.get("preemptVerb", ""),
+            weight=e.get("weight", 1),
+            enable_https=e.get("enableHTTPS", False),
+            http_timeout_s=e.get("httpTimeout", 30.0),
+            node_cache_capable=e.get("nodeCacheCapable", False),
+            ignorable=e.get("ignorable", False),
+            managed_resources=[
+                r.get("name") for r in e.get("managedResources", [])
+            ],
+        )
+        for e in d.get("extenders", [])
+    ]
+    cfg = SchedulerConfiguration(
+        parallelism=d.get("parallelism", 16),
+        profiles=profiles or [Profile()],
+        extenders=extenders,
+        percentage_of_nodes_to_score=d.get("percentageOfNodesToScore", 0),
+        pod_initial_backoff_seconds=d.get("podInitialBackoffSeconds", 1.0),
+        pod_max_backoff_seconds=d.get("podMaxBackoffSeconds", 10.0),
+        batch_size=d.get("batchSize", 256),
+    )
+    cfg.validate()
+    return cfg

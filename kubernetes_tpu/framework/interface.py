@@ -1,0 +1,307 @@
+"""Plugin API: extension points, Status codes, CycleState.
+
+Mirrors pkg/scheduler/framework/interface.go — the 12 extension points
+(PreEnqueue, QueueSort, PreFilter, Filter, PostFilter, PreScore, Score,
+Reserve, Permit, PreBind, Bind, PostBind) and the Status code lattice
+(:190-244).  Two deliberate differences for the TPU execution model:
+
+  * Filter/Score have BATCH variants (``filter_batch``/``score_batch``)
+    returning [P, N] device arrays — a device-backed plugin implements
+    those; the scalar variants remain for host-backed plugins and parity
+    testing.
+  * PreFilter's node-narrowing result (PreFilterResult.NodeNames,
+    interface.go:837) is expressed as a [P, N] mask contribution instead of
+    a name set.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from kubernetes_tpu.api.types import Node, Pod
+
+
+class Code(enum.IntEnum):
+    """Status codes (interface.go:190)."""
+
+    SUCCESS = 0
+    ERROR = 1
+    UNSCHEDULABLE = 2
+    UNSCHEDULABLE_AND_UNRESOLVABLE = 3
+    WAIT = 4
+    SKIP = 5
+    PENDING = 6
+
+
+@dataclass
+class Status:
+    code: Code = Code.SUCCESS
+    reasons: Tuple[str, ...] = ()
+    plugin: str = ""
+
+    @classmethod
+    def success(cls) -> "Status":
+        return cls()
+
+    @classmethod
+    def unschedulable(cls, *reasons: str, plugin: str = "") -> "Status":
+        return cls(Code.UNSCHEDULABLE, tuple(reasons), plugin)
+
+    @classmethod
+    def unresolvable(cls, *reasons: str, plugin: str = "") -> "Status":
+        return cls(Code.UNSCHEDULABLE_AND_UNRESOLVABLE, tuple(reasons), plugin)
+
+    @classmethod
+    def error(cls, msg: str, plugin: str = "") -> "Status":
+        return cls(Code.ERROR, (msg,), plugin)
+
+    @classmethod
+    def skip(cls) -> "Status":
+        return cls(Code.SKIP)
+
+    @classmethod
+    def wait(cls, plugin: str = "") -> "Status":
+        return cls(Code.WAIT, plugin=plugin)
+
+    @property
+    def ok(self) -> bool:
+        return self.code == Code.SUCCESS
+
+    @property
+    def rejected(self) -> bool:
+        return self.code in (
+            Code.UNSCHEDULABLE,
+            Code.UNSCHEDULABLE_AND_UNRESOLVABLE,
+        )
+
+    def merge_reason(self) -> str:
+        return "; ".join(self.reasons)
+
+
+class CycleState:
+    """Per-scheduling-cycle scratch space (framework/cycle_state.go:44).
+
+    Keyed read/write plus the Skip sets PreFilter/PreScore populate.  One
+    CycleState serves a whole BATCH here; per-pod data is stored under
+    (key, pod_uid) to keep host plugins independent.
+    """
+
+    def __init__(self) -> None:
+        self._data: Dict[Any, Any] = {}
+        self.skip_filter_plugins: set[str] = set()
+        self.skip_score_plugins: set[str] = set()
+
+    def write(self, key: Any, value: Any) -> None:
+        self._data[key] = value
+
+    def read(self, key: Any) -> Any:
+        return self._data.get(key)
+
+    def delete(self, key: Any) -> None:
+        self._data.pop(key, None)
+
+    def clone(self) -> "CycleState":
+        cs = CycleState()
+        cs._data = dict(self._data)
+        cs.skip_filter_plugins = set(self.skip_filter_plugins)
+        cs.skip_score_plugins = set(self.skip_score_plugins)
+        return cs
+
+
+# ---------------------------------------------------------------------------
+# Plugin base classes (one per extension point, interface.go:443-682)
+# ---------------------------------------------------------------------------
+
+
+class Plugin:
+    """Base: every plugin has a name (interface.go:443)."""
+
+    name: str = ""
+
+    def __init__(self, args: Optional[dict] = None, handle=None):
+        self.args = args or {}
+        self.handle = handle
+
+
+class PreEnqueuePlugin(Plugin):
+    def pre_enqueue(self, pod: Pod) -> Status:
+        raise NotImplementedError
+
+
+class QueueSortPlugin(Plugin):
+    def less(self, a, b) -> bool:
+        """a, b are QueuedPodInfo-shaped objects."""
+        raise NotImplementedError
+
+
+class PreFilterPlugin(Plugin):
+    def pre_filter(self, state: CycleState, pods: Sequence[Pod]) -> Status:
+        """Batched PreFilter; may return Status.skip() to disable the
+        coupled Filter for this cycle."""
+        return Status.success()
+
+
+class FilterPlugin(Plugin):
+    """Host-backed per-(pod, node) filter."""
+
+    def filter(self, state: CycleState, pod: Pod, node_state) -> Status:
+        raise NotImplementedError
+
+
+class DeviceFilterPlugin(Plugin):
+    """Device-backed filter: contributes a [P, N] feasibility mask.
+
+    ``mask_fn(dc, db, ctx) -> jnp.ndarray`` is invoked inside the fused jit
+    dispatch; ctx carries v_cap and shared precomputes.
+    """
+
+    def device_mask(self, dc, db, ctx) -> Any:
+        raise NotImplementedError
+
+
+class PostFilterPlugin(Plugin):
+    def post_filter(self, state: CycleState, pod: Pod, filtered_node_status) -> Tuple[Optional[str], Status]:
+        """Returns (nominated_node_name, status) — the preemption hook."""
+        raise NotImplementedError
+
+
+class PreScorePlugin(Plugin):
+    def pre_score(self, state: CycleState, pods: Sequence[Pod], nodes) -> Status:
+        return Status.success()
+
+
+class ScorePlugin(Plugin):
+    """Host-backed per-(pod, node) score with optional normalize."""
+
+    def score(self, state: CycleState, pod: Pod, node_state) -> int:
+        raise NotImplementedError
+
+    def normalize(self, state: CycleState, pod: Pod, scores: List[int]) -> List[int]:
+        return scores
+
+
+class DeviceScorePlugin(Plugin):
+    """Device-backed score: contributes a normalized [P, N] int score."""
+
+    def device_score(self, dc, db, feasible, ctx) -> Any:
+        raise NotImplementedError
+
+
+class ReservePlugin(Plugin):
+    def reserve(self, state: CycleState, pod: Pod, node_name: str) -> Status:
+        return Status.success()
+
+    def unreserve(self, state: CycleState, pod: Pod, node_name: str) -> None:
+        pass
+
+
+class PermitPlugin(Plugin):
+    def permit(self, state: CycleState, pod: Pod, node_name: str) -> Tuple[Status, float]:
+        """Returns (status, timeout_seconds); Wait parks the pod
+        (waiting_pods_map semantics)."""
+        return Status.success(), 0.0
+
+
+class PreBindPlugin(Plugin):
+    def pre_bind(self, state: CycleState, pod: Pod, node_name: str) -> Status:
+        return Status.success()
+
+
+class BindPlugin(Plugin):
+    def bind(self, state: CycleState, pod: Pod, node_name: str) -> Status:
+        """Status.skip() passes to the next bind plugin (interface.go)."""
+        raise NotImplementedError
+
+
+class PostBindPlugin(Plugin):
+    def post_bind(self, state: CycleState, pod: Pod, node_name: str) -> None:
+        pass
+
+
+class EnqueueExtensions(Plugin):
+    """EventsToRegister (interface.go): which cluster events can make a pod
+    rejected by this plugin schedulable again."""
+
+    def events_to_register(self) -> List["ClusterEventWithHint"]:
+        return []
+
+
+# ---------------------------------------------------------------------------
+# Cluster events (framework/types.go:48-187)
+# ---------------------------------------------------------------------------
+
+
+class ActionType(enum.IntFlag):
+    ADD = 1
+    DELETE = 2
+    UPDATE_NODE_ALLOCATABLE = 4
+    UPDATE_NODE_LABEL = 8
+    UPDATE_NODE_TAINT = 16
+    UPDATE_NODE_CONDITION = 32
+    UPDATE_NODE_ANNOTATION = 64
+    UPDATE_POD_LABEL = 128
+    UPDATE_POD_SCALE_DOWN = 256
+    UPDATE_POD_TOLERATIONS = 512
+    UPDATE_POD_SCHEDULING_GATES = 1024
+    UPDATE = (
+        UPDATE_NODE_ALLOCATABLE
+        | UPDATE_NODE_LABEL
+        | UPDATE_NODE_TAINT
+        | UPDATE_NODE_CONDITION
+        | UPDATE_NODE_ANNOTATION
+        | UPDATE_POD_LABEL
+        | UPDATE_POD_SCALE_DOWN
+        | UPDATE_POD_TOLERATIONS
+        | UPDATE_POD_SCHEDULING_GATES
+    )
+    ALL = ADD | DELETE | UPDATE
+
+
+class EventResource(str, enum.Enum):
+    POD = "Pod"
+    ASSIGNED_POD = "AssignedPod"
+    UNSCHEDULED_POD = "UnscheduledPod"
+    NODE = "Node"
+    PVC = "PersistentVolumeClaim"
+    PV = "PersistentVolume"
+    STORAGE_CLASS = "StorageClass"
+    CSI_NODE = "CSINode"
+    RESOURCE_CLAIM = "ResourceClaim"
+    WILDCARD = "*"
+
+
+@dataclass(frozen=True)
+class ClusterEvent:
+    resource: EventResource
+    action: ActionType
+    label: str = ""
+
+    def match(self, other: "ClusterEvent") -> bool:
+        res_ok = (
+            self.resource == EventResource.WILDCARD
+            or other.resource == EventResource.WILDCARD
+            or self.resource == other.resource
+        )
+        return res_ok and bool(self.action & other.action)
+
+
+class QueueingHint(enum.IntEnum):
+    """QueueingHintFn result (types.go:145)."""
+
+    SKIP = 0
+    QUEUE = 1
+
+
+# hint_fn(pod, old_obj, new_obj) -> QueueingHint
+QueueingHintFn = Callable[[Pod, Any, Any], QueueingHint]
+
+
+@dataclass
+class ClusterEventWithHint:
+    event: ClusterEvent
+    hint_fn: Optional[QueueingHintFn] = None
+
+
+WILDCARD_EVENT = ClusterEvent(EventResource.WILDCARD, ActionType.ALL)

@@ -1,0 +1,314 @@
+"""In-tree plugins as framework plugin classes.
+
+Each default plugin (SURVEY.md §2.3) exists here with:
+  * its extension points and EventsToRegister (queueing hints),
+  * a scalar host fallback delegating to the oracle (golden semantics),
+  * for device-backed plugins, the name of the fused-kernel component it
+    enables (the actual math lives in kubernetes_tpu.ops and runs as one
+    dispatch — plugins toggle and weight it, mirroring how the reference's
+    profile config enables plugins without changing their code).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from kubernetes_tpu.api.types import Pod
+from kubernetes_tpu.framework.interface import (
+    ActionType,
+    BindPlugin,
+    ClusterEvent,
+    ClusterEventWithHint,
+    Code,
+    CycleState,
+    EnqueueExtensions,
+    EventResource,
+    FilterPlugin,
+    Plugin,
+    PreEnqueuePlugin,
+    PreFilterPlugin,
+    QueueingHint,
+    QueueSortPlugin,
+    ScorePlugin,
+    Status,
+)
+from kubernetes_tpu.oracle import filters as OF
+from kubernetes_tpu.oracle import scores as OS
+
+
+class DevicePluginMixin:
+    """Marks a plugin whose Filter/Score runs inside the fused device
+    dispatch.  ``kernel`` is the component name the ops layer recognizes."""
+
+    kernel: str = ""
+
+
+# ---------------------------------------------------------------------------
+# QueueSort / PreEnqueue / Bind
+# ---------------------------------------------------------------------------
+
+
+class PrioritySort(QueueSortPlugin):
+    """queuesort/priority_sort.go:43 — priority desc, then enqueue time."""
+
+    name = "PrioritySort"
+
+    def less(self, a, b) -> bool:
+        pa, pb = a.pod.priority, b.pod.priority
+        if pa != pb:
+            return pa > pb
+        return a.timestamp < b.timestamp
+
+
+class SchedulingGates(PreEnqueuePlugin, EnqueueExtensions):
+    """schedulinggates/scheduling_gates.go:48 — gated pods never enqueue."""
+
+    name = "SchedulingGates"
+
+    def pre_enqueue(self, pod: Pod) -> Status:
+        if pod.scheduling_gates:
+            return Status.unresolvable(
+                f"waiting for scheduling gates: {list(pod.scheduling_gates)}",
+                plugin=self.name,
+            )
+        return Status.success()
+
+    def events_to_register(self):
+        def hint(pod: Pod, old, new) -> QueueingHint:
+            # Pod update removing the last gate makes it schedulable.
+            if isinstance(new, Pod) and new.uid == pod.uid and not new.scheduling_gates:
+                return QueueingHint.QUEUE
+            return QueueingHint.SKIP
+
+        return [
+            ClusterEventWithHint(
+                ClusterEvent(
+                    EventResource.UNSCHEDULED_POD,
+                    ActionType.UPDATE_POD_SCHEDULING_GATES,
+                ),
+                hint,
+            )
+        ]
+
+
+class DefaultBinder(BindPlugin):
+    """defaultbinder/default_binder.go — POST the binding via the handle's
+    binding sink (the API-write boundary)."""
+
+    name = "DefaultBinder"
+
+    def bind(self, state: CycleState, pod: Pod, node_name: str) -> Status:
+        try:
+            self.handle.bind(pod, node_name)
+        except Exception as e:  # noqa: BLE001 — surfaced as Status
+            return Status.error(str(e), plugin=self.name)
+        return Status.success()
+
+
+# ---------------------------------------------------------------------------
+# Device-backed Filter/Score plugins (fused kernels)
+# ---------------------------------------------------------------------------
+
+
+def _node_event(action: ActionType) -> ClusterEventWithHint:
+    return ClusterEventWithHint(ClusterEvent(EventResource.NODE, action))
+
+
+class NodeName(DevicePluginMixin, FilterPlugin, EnqueueExtensions):
+    name = "NodeName"
+    kernel = "NodeName"
+
+    def filter(self, state, pod, ns) -> Status:
+        r = OF.filter_node_name(pod, ns)
+        return Status.unresolvable(r, plugin=self.name) if r else Status.success()
+
+    def events_to_register(self):
+        return [_node_event(ActionType.ADD)]
+
+
+class NodeUnschedulable(DevicePluginMixin, FilterPlugin, EnqueueExtensions):
+    name = "NodeUnschedulable"
+    kernel = "NodeUnschedulable"
+
+    def filter(self, state, pod, ns) -> Status:
+        r = OF.filter_node_unschedulable(pod, ns)
+        return Status.unresolvable(r, plugin=self.name) if r else Status.success()
+
+    def events_to_register(self):
+        return [_node_event(ActionType.ADD | ActionType.UPDATE_NODE_TAINT)]
+
+
+class TaintToleration(DevicePluginMixin, FilterPlugin, ScorePlugin, EnqueueExtensions):
+    name = "TaintToleration"
+    kernel = "TaintToleration"
+
+    def filter(self, state, pod, ns) -> Status:
+        r = OF.filter_taints(pod, ns)
+        return Status.unresolvable(r, plugin=self.name) if r else Status.success()
+
+    def score(self, state, pod, ns) -> int:
+        return OS.score_taint_toleration(pod, ns)
+
+    def normalize(self, state, pod, scores):
+        return OS.normalize_taint_toleration(scores)
+
+    def events_to_register(self):
+        return [_node_event(ActionType.ADD | ActionType.UPDATE_NODE_TAINT)]
+
+
+class NodeAffinity(DevicePluginMixin, FilterPlugin, ScorePlugin, EnqueueExtensions):
+    name = "NodeAffinity"
+    kernel = "NodeAffinity"
+
+    def filter(self, state, pod, ns) -> Status:
+        r = OF.filter_node_affinity(pod, ns)
+        return Status.unschedulable(r, plugin=self.name) if r else Status.success()
+
+    def score(self, state, pod, ns) -> int:
+        return OS.score_node_affinity(pod, ns)
+
+    def normalize(self, state, pod, scores):
+        return OS.normalize_node_affinity(scores)
+
+    def events_to_register(self):
+        return [_node_event(ActionType.ADD | ActionType.UPDATE_NODE_LABEL)]
+
+
+class NodePorts(DevicePluginMixin, FilterPlugin, EnqueueExtensions):
+    name = "NodePorts"
+    kernel = "NodePorts"
+
+    def filter(self, state, pod, ns) -> Status:
+        r = OF.filter_node_ports(pod, ns)
+        return Status.unschedulable(r, plugin=self.name) if r else Status.success()
+
+    def events_to_register(self):
+        def pod_deleted_hint(pod: Pod, old, new) -> QueueingHint:
+            # A deleted pod frees host ports only if it used one we want.
+            if isinstance(old, Pod):
+                used = {(p.protocol, p.host_port) for p in old.host_ports()}
+                want = {(p.protocol, p.host_port) for p in pod.host_ports()}
+                return (
+                    QueueingHint.QUEUE if used & want else QueueingHint.SKIP
+                )
+            return QueueingHint.QUEUE
+
+        return [
+            ClusterEventWithHint(
+                ClusterEvent(EventResource.ASSIGNED_POD, ActionType.DELETE),
+                pod_deleted_hint,
+            ),
+            _node_event(ActionType.ADD),
+        ]
+
+
+class NodeResourcesFit(DevicePluginMixin, FilterPlugin, ScorePlugin, EnqueueExtensions):
+    name = "NodeResourcesFit"
+    kernel = "NodeResourcesFit"
+
+    def filter(self, state, pod, ns) -> Status:
+        rs = OF.filter_node_resources(pod, ns)
+        return (
+            Status.unschedulable(*rs, plugin=self.name) if rs else Status.success()
+        )
+
+    def score(self, state, pod, ns) -> int:
+        strategy = self.args.get("scoringStrategy", {}).get("type", "LeastAllocated")
+        if strategy == "MostAllocated":
+            return OS.score_most_allocated(pod, ns)
+        return OS.score_least_allocated(pod, ns)
+
+    def events_to_register(self):
+        def pod_hint(pod: Pod, old, new) -> QueueingHint:
+            # Deleted/scaled-down pods free resources (fit.go:250-365).
+            return QueueingHint.QUEUE
+
+        return [
+            ClusterEventWithHint(
+                ClusterEvent(
+                    EventResource.ASSIGNED_POD,
+                    ActionType.DELETE | ActionType.UPDATE_POD_SCALE_DOWN,
+                ),
+                pod_hint,
+            ),
+            _node_event(ActionType.ADD | ActionType.UPDATE_NODE_ALLOCATABLE),
+        ]
+
+
+class NodeResourcesBalancedAllocation(DevicePluginMixin, ScorePlugin):
+    name = "NodeResourcesBalancedAllocation"
+    kernel = "NodeResourcesBalancedAllocation"
+
+    def score(self, state, pod, ns) -> int:
+        return OS.score_balanced_allocation(pod, ns)
+
+
+class ImageLocality(DevicePluginMixin, ScorePlugin):
+    name = "ImageLocality"
+    kernel = "ImageLocality"
+
+    def score(self, state, pod, ns) -> int:
+        # needs cluster state; host fallback resolved through handle
+        return OS.score_image_locality(pod, ns, self.handle.oracle_state())
+
+
+class InterPodAffinity(DevicePluginMixin, FilterPlugin, ScorePlugin, EnqueueExtensions):
+    name = "InterPodAffinity"
+    kernel = "InterPodAffinity"
+
+    def filter(self, state, pod, ns) -> Status:
+        r = OF.filter_interpod_affinity(pod, ns, self.handle.oracle_state())
+        return Status.unschedulable(r, plugin=self.name) if r else Status.success()
+
+    def events_to_register(self):
+        return [
+            ClusterEventWithHint(
+                ClusterEvent(
+                    EventResource.ASSIGNED_POD,
+                    ActionType.ADD | ActionType.DELETE | ActionType.UPDATE_POD_LABEL,
+                )
+            ),
+            _node_event(ActionType.ADD | ActionType.UPDATE_NODE_LABEL),
+        ]
+
+
+class PodTopologySpread(DevicePluginMixin, FilterPlugin, ScorePlugin, EnqueueExtensions):
+    name = "PodTopologySpread"
+    kernel = "PodTopologySpread"
+
+    def filter(self, state, pod, ns) -> Status:
+        r = OF.filter_topology_spread(pod, ns, self.handle.oracle_state())
+        return Status.unschedulable(r, plugin=self.name) if r else Status.success()
+
+    def events_to_register(self):
+        return [
+            ClusterEventWithHint(
+                ClusterEvent(
+                    EventResource.ASSIGNED_POD,
+                    ActionType.ADD | ActionType.DELETE | ActionType.UPDATE_POD_LABEL,
+                )
+            ),
+            _node_event(
+                ActionType.ADD
+                | ActionType.DELETE
+                | ActionType.UPDATE_NODE_LABEL
+                | ActionType.UPDATE_NODE_TAINT
+            ),
+        ]
+
+
+DEFAULT_PLUGINS = [
+    PrioritySort,
+    SchedulingGates,
+    NodeName,
+    NodeUnschedulable,
+    TaintToleration,
+    NodeAffinity,
+    NodePorts,
+    NodeResourcesFit,
+    NodeResourcesBalancedAllocation,
+    ImageLocality,
+    InterPodAffinity,
+    PodTopologySpread,
+    DefaultBinder,
+]

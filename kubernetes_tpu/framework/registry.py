@@ -1,0 +1,38 @@
+"""Plugin registry: name → factory (framework/runtime/registry.go).
+
+In-tree plugins register at import; out-of-tree plugins merge the same way
+the reference merges frameworkruntime.Registry (scheduler.go:278-280).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Type
+
+from kubernetes_tpu.framework.interface import Plugin
+from kubernetes_tpu.framework.plugins import DEFAULT_PLUGINS
+
+PluginFactory = Callable[[Optional[dict], object], Plugin]
+
+
+class Registry(Dict[str, PluginFactory]):
+    def register(self, name: str, factory: PluginFactory) -> None:
+        if name in self:
+            raise ValueError(f"plugin {name!r} already registered")
+        self[name] = factory
+
+    def merge(self, other: "Registry") -> "Registry":
+        for name, factory in other.items():
+            self.register(name, factory)
+        return self
+
+
+def _factory_of(cls: Type[Plugin]) -> PluginFactory:
+    return lambda args, handle: cls(args=args, handle=handle)
+
+
+def default_registry() -> Registry:
+    """The in-tree set (framework/plugins/registry.go:47)."""
+    r = Registry()
+    for cls in DEFAULT_PLUGINS:
+        r.register(cls.name, _factory_of(cls))
+    return r

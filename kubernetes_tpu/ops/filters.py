@@ -254,23 +254,34 @@ def interpod_precompute(dc: DeviceCluster, db: DeviceBatch) -> InterPodPre:
     )
 
 
+def interpod_weighted_ext(dc: DeviceCluster, pre: InterPodPre, row_weight):
+    """Σ over existing-term rows of row_weight · [term matches pod] ·
+    [node shares the term's topology value] — the shared masked-matmul core
+    of the existing-anti-affinity filter and the symmetric score.
+
+    row_weight: i32 [M]; returns i32 [P, N]."""
+    m = (pre.ext_match.astype(I32) * row_weight[:, None]).T  # [P, M]
+    return jax.lax.dot_general(
+        m,
+        pre.ext_topo_eq.astype(I32),
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=I32,
+    )
+
+
+def interpod_existing_violation(dc: DeviceCluster, pre: InterPodPre):
+    """[P, N]: forbidden by some existing pod's required anti-affinity."""
+    anti_row = (dc.term_kind == TERM_REQUIRED_ANTI).astype(I32)
+    return interpod_weighted_ext(dc, pre, anti_row) > 0
+
+
 def mask_interpod(
     dc: DeviceCluster, db: DeviceBatch, pre: InterPodPre, v_cap: int
 ):
     P, AT, N = pre.inc_dv.shape
 
     # 1. Existing pods' required anti-affinity forbids same-domain nodes.
-    anti_row = (dc.term_kind == TERM_REQUIRED_ANTI).astype(I32)
-    m = (pre.ext_match.astype(I32) * anti_row[:, None]).T  # [P, M]
-    viol1 = (
-        jax.lax.dot_general(
-            m,
-            pre.ext_topo_eq.astype(I32),
-            (((1,), (0,)), ((), ())),
-            preferred_element_type=I32,
-        )
-        > 0
-    )  # [P, N]
+    viol1 = interpod_existing_violation(dc, pre)  # [P, N]
 
     # Domain totals of matching placed pods per incoming term.
     dom_tot, _, _, _ = domain_stats(
@@ -394,8 +405,35 @@ def mask_spread(
 # ---------------------------------------------------------------------------
 
 
-def all_masks(dc: DeviceCluster, db: DeviceBatch, v_cap: int) -> Dict[str, jnp.ndarray]:
+ALL_FILTER_KERNELS = frozenset(
+    {
+        "NodeName",
+        "NodeUnschedulable",
+        "TaintToleration",
+        "NodeAffinity",
+        "NodePorts",
+        "NodeResourcesFit",
+        "InterPodAffinity",
+        "PodTopologySpread",
+    }
+)
+
+
+def all_masks(
+    dc: DeviceCluster,
+    db: DeviceBatch,
+    v_cap: int,
+    has_interpod: bool = True,
+    has_spread: bool = True,
+    enabled: frozenset = ALL_FILTER_KERNELS,
+) -> Dict[str, jnp.ndarray]:
     """Run every Filter kernel; returns per-plugin masks plus the AND.
+
+    ``has_interpod``/``has_spread`` are STATIC flags computed host-side from
+    the batch + snapshot: when a batch carries no such constraints the
+    corresponding kernels (the segment-sum-heavy ones) compile away entirely
+    — the analogue of the reference's PreFilter Skip status
+    (framework/interface.go:443).
 
     The combined mask also excludes invalid node slots and invalid pod rows
     (padding in the bucketed batch).
@@ -403,18 +441,26 @@ def all_masks(dc: DeviceCluster, db: DeviceBatch, v_cap: int) -> Dict[str, jnp.n
     tolerated = _tolerated(dc, db)
     node_affinity = mask_node_affinity(dc, db)
     taints = mask_taints(dc, db, tolerated)
-    ipre = interpod_precompute(dc, db)
-    spre = spread_precompute(dc, db, node_affinity, taints)
-    masks = {
-        "NodeName": mask_node_name(dc, db),
-        "NodeUnschedulable": mask_unschedulable(dc, db),
-        "TaintToleration": taints,
-        "NodeAffinity": node_affinity,
-        "NodePorts": mask_ports(dc, db),
-        "NodeResourcesFit": mask_resources(dc, db),
-        "InterPodAffinity": mask_interpod(dc, db, ipre, v_cap),
-        "PodTopologySpread": mask_spread(dc, db, spre, v_cap),
-    }
+    masks = {}
+    if "NodeName" in enabled:
+        masks["NodeName"] = mask_node_name(dc, db)
+    if "NodeUnschedulable" in enabled:
+        masks["NodeUnschedulable"] = mask_unschedulable(dc, db)
+    if "TaintToleration" in enabled:
+        masks["TaintToleration"] = taints
+    if "NodeAffinity" in enabled:
+        masks["NodeAffinity"] = node_affinity
+    if "NodePorts" in enabled:
+        masks["NodePorts"] = mask_ports(dc, db)
+    if "NodeResourcesFit" in enabled:
+        masks["NodeResourcesFit"] = mask_resources(dc, db)
+    ipre = spre = None
+    if has_interpod and "InterPodAffinity" in enabled:
+        ipre = interpod_precompute(dc, db)
+        masks["InterPodAffinity"] = mask_interpod(dc, db, ipre, v_cap)
+    if has_spread and "PodTopologySpread" in enabled:
+        spre = spread_precompute(dc, db, node_affinity, taints)
+        masks["PodTopologySpread"] = mask_spread(dc, db, spre, v_cap)
     combined = dc.node_valid[None, :] & db.valid[:, None]
     for m in masks.values():
         combined = combined & m

@@ -1,0 +1,703 @@
+"""Sequential-equivalent gang scheduling: one lax.scan step per pod.
+
+The reference schedules strictly one pod at a time, each cycle seeing all
+previous placements through the assume-cache (schedule_one.go:65,
+cache.go:360).  Batch evaluation must reproduce those semantics or decisions
+diverge (SURVEY.md §7 "intra-batch conflicts").  The design:
+
+  * everything state-INdependent is computed batched up front — all
+    selector/term matching, the pod×existing quadratic terms, and the
+    pod×pod batch-cross match matrices (the expensive MXU work);
+  * a lax.scan walks the batch in queue order; each step is an [N]-wide
+    vectorized re-evaluation of only the state-DEPENDENT pieces (resource
+    tallies, spread/inter-pod counts contributed by batch placements, score
+    normalization over the current feasible set) followed by argmax commit.
+
+The scan step mirrors, piece by piece, what the serial oracle recomputes
+between pods, so gang results are identical to scheduling the pods one by
+one — property-tested against the serial oracle in tests/test_gang.py.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from kubernetes_tpu.ops import filters as F
+from kubernetes_tpu.ops import scores as S
+from kubernetes_tpu.ops.common import (
+    DeviceBatch,
+    DeviceCluster,
+    I32,
+    I64,
+    domain_stats,
+    eval_table,
+    ns_member,
+    per_node_counts,
+)
+from kubernetes_tpu.snapshot.interner import ABSENT, PAD
+from kubernetes_tpu.snapshot.schema import (
+    LANE_CPU,
+    LANE_MEM,
+    N_FIXED_LANES,
+    TERM_PREFERRED_AFFINITY,
+    TERM_PREFERRED_ANTI,
+    TERM_REQUIRED_AFFINITY,
+    TERM_REQUIRED_ANTI,
+)
+
+MAX = S.MAX_NODE_SCORE
+_FX = S._FX
+
+
+class GangStatics(NamedTuple):
+    """State-independent precompute for one (cluster, batch) pair."""
+
+    static_mask: jnp.ndarray  # bool [P, N]
+    # spread filter (hard constraints, filtering.go:236-362)
+    sp_hard: jnp.ndarray  # bool [P, C]
+    sp_soft: jnp.ndarray  # bool [P, C]
+    sp_dv: jnp.ndarray  # i32 [P, C, N]
+    sp_te: jnp.ndarray  # bool [P, C, N] tracked & eligible (filter counting)
+    sp_dom_cnt: jnp.ndarray  # i32 [P, C, N] per-domain counts (existing pods)
+    sp_dom_pres: jnp.ndarray  # bool [P, C, N]
+    sp_ndom: jnp.ndarray  # i32 [P, C]
+    sp_self: jnp.ndarray  # bool [P, C]
+    sp_bmatch: jnp.ndarray  # bool [P, C, J]
+    # spread score (scoring.go)
+    sp_is_host: jnp.ndarray  # bool [P, C]
+    sp_counting: jnp.ndarray  # bool [P, C, N] all-keys ∧ eligible (score gate)
+    sp_node_cnt: jnp.ndarray  # i32 [P, C, N] raw per-node matching counts
+    sp_sc_dom: jnp.ndarray  # i32 [P, C, N] score-gated per-domain counts
+    sp_all_keys: jnp.ndarray  # bool [P, N] node has every soft topo key
+    # inter-pod
+    ip_dv: jnp.ndarray  # i32 [P, AT, N]
+    ip_dom_cnt: jnp.ndarray  # i32 [P, AT, N] matching existing in node's domain
+    ip_viol_existing: jnp.ndarray  # bool [P, N]
+    ip_sym: jnp.ndarray  # i64 [P, N] symmetric score from existing terms
+    ip_any_static: jnp.ndarray  # bool [P]
+    ip_self_all: jnp.ndarray  # bool [P]
+    ip_bmatch: jnp.ndarray  # bool [P, AT, J]  (read [j,u,p]: p matches j's term u)
+    ip_is_aff: jnp.ndarray  # bool [P, AT]
+    ip_is_anti: jnp.ndarray  # bool [P, AT]
+    ip_pref_w: jnp.ndarray  # i64 [P, AT]
+    ip_sym_w: jnp.ndarray  # i64 [P, AT] weight of p's terms once p is placed
+    # static raw scores
+    sc_taint: jnp.ndarray  # i64 [P, N]
+    sc_nodeaff: jnp.ndarray  # i64 [P, N]
+    sc_image: jnp.ndarray  # i64 [P, N]
+    # batch port conflicts
+    port_b: jnp.ndarray  # bool [P, J]
+
+
+def precompute(
+    dc: DeviceCluster,
+    db: DeviceBatch,
+    hostname_key,
+    v_cap: int,
+    hard_pod_affinity_weight: int = 1,
+    has_interpod: bool = True,
+    has_spread: bool = True,
+    has_ports: bool = True,
+    has_images: bool = True,
+    enabled: frozenset = F.ALL_FILTER_KERNELS,
+) -> GangStatics:
+    """When a has_* flag is False the corresponding statics are built with a
+    ZERO-width constraint axis; the scan step's reductions over that axis
+    vanish at compile time (the PreFilter-Skip of the gang path — shape-
+    driven rather than flag-plumbed).  ``enabled`` reflects the profile's
+    Filter plugin set."""
+    P = db.valid.shape[0]
+    N = dc.node_valid.shape[0]
+    tolerated = F._tolerated(dc, db)
+    node_affinity = F.mask_node_affinity(dc, db)
+    taints = F.mask_taints(dc, db, tolerated)
+    static_mask = dc.node_valid[None, :] & db.valid[:, None]
+    if "NodeName" in enabled:
+        static_mask = static_mask & F.mask_node_name(dc, db)
+    if "NodeUnschedulable" in enabled:
+        static_mask = static_mask & F.mask_unschedulable(dc, db)
+    if "TaintToleration" in enabled:
+        static_mask = static_mask & taints
+    if "NodeAffinity" in enabled:
+        static_mask = static_mask & node_affinity
+    if "NodePorts" in enabled:
+        static_mask = static_mask & F.mask_ports(dc, db)
+    has_interpod = has_interpod and "InterPodAffinity" in enabled
+    has_spread = has_spread and "PodTopologySpread" in enabled
+
+    # ---- spread ----
+    if has_spread:
+        spre = F.spread_precompute(dc, db, node_affinity, taints)
+        _, C, _ = spre.dv.shape
+        cnt_n = per_node_counts(spre.sel_match.astype(I32), dc.epod_node, N)
+        te = spre.tracked[:, None, :] & spre.eligible
+        dom_tot, dom_pres, _, n_dom = domain_stats(
+            jnp.where(te, cnt_n, 0), te, spre.dv, v_cap
+        )
+        soft = spre.exists & ~db.tsc_hard
+        topo_present = spre.dv >= 0
+        all_keys = jnp.all(~soft[:, :, None] | topo_present, axis=1)  # [P, N]
+        counting = all_keys[:, None, :] & spre.eligible
+        sc_dom, _, _, _ = domain_stats(
+            jnp.where(counting, cnt_n, 0), counting, spre.dv, v_cap
+        )
+        b_sel = eval_table(db.tsc_table, db.labels, dc.val_ints)  # [P, C, J]
+        same_ns = db.ns_id[:, None] == db.ns_id[None, :]
+        sp_bmatch = b_sel & same_ns[:, None, :] & db.valid[None, None, :]
+        sp = dict(
+            sp_hard=spre.exists & db.tsc_hard,
+            sp_soft=soft,
+            sp_dv=spre.dv,
+            sp_te=te,
+            sp_dom_cnt=jnp.where(dom_pres, dom_tot, 0),
+            sp_dom_pres=dom_pres,
+            sp_ndom=n_dom,
+            sp_self=spre.self_match,
+            sp_bmatch=sp_bmatch,
+            sp_is_host=db.tsc_topo == hostname_key,
+            sp_counting=counting,
+            sp_node_cnt=cnt_n,
+            sp_sc_dom=jnp.where(spre.dv >= 0, sc_dom, 0),
+            sp_all_keys=all_keys,
+        )
+    else:
+        z2 = jnp.zeros((P, 0), bool)
+        z3b = jnp.zeros((P, 0, N), bool)
+        z3i = jnp.zeros((P, 0, N), I32)
+        sp = dict(
+            sp_hard=z2,
+            sp_soft=z2,
+            sp_dv=z3i,
+            sp_te=z3b,
+            sp_dom_cnt=z3i,
+            sp_dom_pres=z3b,
+            sp_ndom=jnp.zeros((P, 0), I32),
+            sp_self=z2,
+            sp_bmatch=jnp.zeros((P, 0, P), bool),
+            sp_is_host=z2,
+            sp_counting=z3b,
+            sp_node_cnt=z3i,
+            sp_sc_dom=z3i,
+            sp_all_keys=jnp.ones((P, N), bool),
+        )
+
+    # ---- inter-pod ----
+    if has_interpod:
+        ipre = F.interpod_precompute(dc, db)
+        viol_existing = F.interpod_existing_violation(dc, ipre)
+        sym = S.interpod_symmetric_score(dc, ipre, hard_pod_affinity_weight)
+        ip_dom_cnt, _, _, _ = domain_stats(
+            ipre.inc_cnt, jnp.zeros_like(ipre.inc_cnt, bool), ipre.inc_dv, v_cap
+        )
+        ip_dom_cnt = jnp.where(ipre.inc_dv >= 0, ip_dom_cnt, 0)
+        is_aff = db.aff_kind == TERM_REQUIRED_AFFINITY
+        is_anti = db.aff_kind == TERM_REQUIRED_ANTI
+        any_static = jnp.any(is_aff[:, :, None] & ipre.inc_match, axis=(1, 2))
+        self_sel = jax.vmap(
+            lambda tbl, lbl: eval_table(tbl, lbl[None, :], dc.val_ints)[..., 0]
+        )(db.aff_table, db.labels)
+        self_ns = jax.vmap(
+            lambda a, ids, ns: ns_member(a, ids, ns[None])[..., 0]
+        )(db.aff_ns_all, db.aff_ns_ids, db.ns_id)
+        self_all = jnp.all(~is_aff | (self_sel & self_ns), axis=1)
+        b_aff_sel = eval_table(db.aff_table, db.labels, dc.val_ints)
+        b_aff_ns = ns_member(db.aff_ns_all, db.aff_ns_ids, db.ns_id)
+        ip_bmatch = b_aff_sel & b_aff_ns & db.valid[None, None, :]
+        pref_w = jnp.where(
+            db.aff_kind == TERM_PREFERRED_AFFINITY,
+            db.aff_weight,
+            jnp.where(db.aff_kind == TERM_PREFERRED_ANTI, -db.aff_weight, 0),
+        ).astype(I64)
+        sym_w = jnp.where(
+            db.aff_kind == TERM_REQUIRED_AFFINITY,
+            hard_pod_affinity_weight,
+            pref_w.astype(I32),
+        ).astype(I64)
+        ip = dict(
+            ip_dv=ipre.inc_dv,
+            ip_dom_cnt=ip_dom_cnt,
+            ip_viol_existing=viol_existing,
+            ip_sym=sym,
+            ip_any_static=any_static,
+            ip_self_all=self_all,
+            ip_bmatch=ip_bmatch,
+            ip_is_aff=is_aff,
+            ip_is_anti=is_anti,
+            ip_pref_w=pref_w,
+            ip_sym_w=sym_w,
+        )
+    else:
+        ip = dict(
+            ip_dv=jnp.zeros((P, 0, N), I32),
+            ip_dom_cnt=jnp.zeros((P, 0, N), I32),
+            ip_viol_existing=jnp.zeros((P, N), bool),
+            ip_sym=jnp.zeros((P, N), I64),
+            ip_any_static=jnp.zeros((P,), bool),
+            ip_self_all=jnp.ones((P,), bool),
+            ip_bmatch=jnp.zeros((P, 0, P), bool),
+            ip_is_aff=jnp.zeros((P, 0), bool),
+            ip_is_anti=jnp.zeros((P, 0), bool),
+            ip_pref_w=jnp.zeros((P, 0), I64),
+            ip_sym_w=jnp.zeros((P, 0), I64),
+        )
+
+    # ---- batch port conflicts (node_ports.go semantics, pod×pod) ----
+    if has_ports:
+        W = db.want_ppk.shape[1]
+        port_b = jnp.zeros((P, P), bool)
+        for w in range(W):
+            wk = db.want_ppk[:, w][:, None]
+            wi = db.want_ip[:, w][:, None]
+            ww = db.want_wild[:, w][:, None]
+            wv = wk != PAD
+            for u in range(W):
+                uk = db.want_ppk[:, u][None, :]
+                ui = db.want_ip[:, u][None, :]
+                uw = db.want_wild[:, u][None, :]
+                uv = uk != PAD
+                port_b = port_b | (
+                    wv & uv & (wk == uk) & ((wi == ui) | ww | uw)
+                )
+    else:
+        port_b = jnp.zeros((P, 0), bool)
+
+    if has_images:
+        sc_image = S.score_image_locality(dc, db)
+    else:
+        sc_image = jnp.zeros((P, N), I64)
+
+    return GangStatics(
+        static_mask=static_mask,
+        **sp,
+        **ip,
+        sc_taint=S.score_taint_toleration(dc, db),
+        sc_nodeaff=S.score_node_affinity(dc, db),
+        sc_image=sc_image,
+        port_b=port_b,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Per-step helpers (single pod, [N]-wide)
+# ---------------------------------------------------------------------------
+
+
+def _norm_default(raw, feas, reverse=False):
+    raw = raw.astype(I64)
+    mx = jnp.max(jnp.where(feas, raw, 0))
+    out = jnp.where(mx > 0, MAX * raw // jnp.maximum(mx, 1), raw)
+    if reverse:
+        out = jnp.where(mx > 0, MAX - out, MAX)
+    return out
+
+
+def _norm_minmax(raw, feas):
+    raw = raw.astype(I64)
+    big = jnp.iinfo(jnp.int64).max
+    mn = jnp.min(jnp.where(feas, raw, big))
+    mx = jnp.max(jnp.where(feas, raw, -big))
+    diff = mx - mn
+    return jnp.where(diff > 0, MAX * (raw - mn) // jnp.maximum(diff, 1), 0)
+
+
+def _norm_spread(raw, valid, feas):
+    raw = raw.astype(I64)
+    use = valid & feas
+    big = jnp.iinfo(jnp.int64).max
+    mn = jnp.min(jnp.where(use, raw, big))
+    mx = jnp.max(jnp.where(use, raw, -big))
+    any_valid = jnp.any(use)
+    out = jnp.where(
+        mx == 0, MAX, MAX * (mx + mn - raw) // jnp.maximum(mx, 1)
+    )
+    return jnp.where(use & any_valid, out, 0)
+
+
+def _scatter_by_domain(values_j, dom_j, v_cap: int):
+    """Σ values grouped by domain id: [.., J] ints + [.., J] ids →
+    [.., v_cap+1] (invalid ids land in the dump slot v_cap)."""
+    seg = jnp.where((dom_j >= 0) & (dom_j < v_cap), dom_j, v_cap)
+    lead = values_j.shape[:-1]
+    J = values_j.shape[-1]
+    flat_v = values_j.reshape((-1, J))
+    flat_s = seg.reshape((-1, J))
+    out = jax.vmap(
+        lambda v, s: jax.ops.segment_sum(v, s, num_segments=v_cap + 1)
+    )(flat_v, flat_s)
+    return out.reshape(lead + (v_cap + 1,))
+
+
+# Positional weight order for the gang scan's static `weights` tuple — the
+# single source of truth is scores.DEFAULT_SCORE_WEIGHTS.
+WEIGHT_ORDER = (
+    "TaintToleration",
+    "NodeAffinity",
+    "PodTopologySpread",
+    "InterPodAffinity",
+    "NodeResourcesFit",
+    "NodeResourcesBalancedAllocation",
+    "ImageLocality",
+)
+DEFAULT_WEIGHTS = tuple(S.DEFAULT_SCORE_WEIGHTS[n] for n in WEIGHT_ORDER)
+
+
+@functools.partial(jax.jit, static_argnames=("v_cap", "weights", "check_fit"))
+def gang_schedule(
+    dc: DeviceCluster,
+    db: DeviceBatch,
+    g: GangStatics,
+    v_cap: int,
+    weights: tuple = DEFAULT_WEIGHTS,
+    check_fit: bool = True,
+):
+    """Scan the batch in order; each pod sees all prior in-batch placements.
+
+    Returns (chosen [P] i32 node index or -1, n_feasible [P] i32).
+    """
+    P, N = g.static_mask.shape
+    Rn = dc.requested.shape[1]
+    Rp = db.requests.shape[1]
+
+    init = dict(
+        requested=dc.requested,
+        nonzero=dc.nonzero_req,
+        num_pods=dc.num_pods,
+        assigned=jnp.full((P,), ABSENT, I32),
+        onehot=jnp.zeros((P, N), bool),
+    )
+
+    def step(state, p):
+        assigned_valid = state["assigned"] >= 0  # [J]
+        a_clip = jnp.clip(state["assigned"], 0, N - 1)
+
+        # ---------------- dynamic filters ----------------
+        req = db.requests[p]  # [Rp]
+        mask = g.static_mask[p]
+        if check_fit:
+            fits = state["num_pods"] + 1 <= dc.allowed_pods
+            all_zero = jnp.all(req == 0)
+            avail = dc.allocatable - state["requested"]  # [N, Rn]
+            if Rp > Rn:
+                avail = jnp.concatenate(
+                    [avail, jnp.zeros((N, Rp - Rn), I32)], axis=1
+                )
+            conflict = req[None, :] > avail  # [N, Rp]
+            # extended-resource lanes only count when actually requested
+            scalar_lane = jnp.arange(Rp) >= N_FIXED_LANES
+            conflict = conflict & (~scalar_lane | (req > 0))[None, :]
+            lane_ok = ~jnp.any(conflict, axis=1)
+            mask = mask & fits & (all_zero | lane_ok)
+
+        av = assigned_valid[None, :]
+        if g.port_b.shape[1]:
+            port_conf = jnp.any(g.port_b[p][:, None] & state["onehot"], axis=0)
+            mask = mask & ~port_conf
+
+        # ---------------- spread (hard) ----------------
+        dv = g.sp_dv[p]  # [C, N]
+        dv_at = None
+        if g.sp_dv.shape[1]:
+            te_at = jnp.take_along_axis(g.sp_te[p], a_clip[None, :], axis=1)
+            dv_at = jnp.take_along_axis(dv, a_clip[None, :], axis=1)  # [C, J]
+            contrib = (g.sp_bmatch[p] & av & te_at).astype(I32)
+            dom_add = _scatter_by_domain(
+                contrib, jnp.where(av, dv_at, -1), v_cap
+            )  # [C, V+1]
+            dyn = jnp.take_along_axis(dom_add, jnp.clip(dv, 0, v_cap), axis=1)
+            dyn = jnp.where(dv >= 0, dyn, 0)
+            total = g.sp_dom_cnt[p] + dyn  # [C, N]
+            big32 = jnp.iinfo(jnp.int32).max
+            min_match = jnp.min(jnp.where(g.sp_te[p], total, big32), axis=1)
+            min_match = jnp.where(
+                (db.tsc_min_domains[p] > 0)
+                & (g.sp_ndom[p] < db.tsc_min_domains[p]),
+                0,
+                min_match,
+            )
+            skew = (
+                total + g.sp_self[p].astype(I32)[:, None] - min_match[:, None]
+            )
+            c_ok = (dv >= 0) & (
+                ~g.sp_dom_pres[p] | (skew <= db.tsc_max_skew[p][:, None])
+            )
+            mask = mask & jnp.all(~g.sp_hard[p][:, None] | c_ok, axis=0)
+
+        # ---------------- inter-pod (hard) ----------------
+        if g.ip_dv.shape[1]:
+            ip_dv = g.ip_dv[p]  # [AT, N]
+            ip_dv_at = jnp.take_along_axis(ip_dv, a_clip[None, :], axis=1)
+            ip_contrib = (g.ip_bmatch[p] & av).astype(I32)
+            ip_add = _scatter_by_domain(
+                ip_contrib, jnp.where(av, ip_dv_at, -1), v_cap
+            )
+            ip_dyn = jnp.take_along_axis(
+                ip_add, jnp.clip(ip_dv, 0, v_cap), axis=1
+            )
+            ip_dyn = jnp.where(ip_dv >= 0, ip_dyn, 0)
+            ip_total = g.ip_dom_cnt[p] + ip_dyn  # [AT, N]
+
+            topo_present = ip_dv >= 0
+            viol2 = jnp.any(
+                g.ip_is_anti[p][:, None] & topo_present & (ip_total > 0), axis=0
+            )
+            aff_ok = jnp.all(
+                ~g.ip_is_aff[p][:, None] | (topo_present & (ip_total > 0)),
+                axis=0,
+            )
+            any_dyn = jnp.any(g.ip_is_aff[p][:, None] & g.ip_bmatch[p] & av)
+            any_match = g.ip_any_static[p] | any_dyn
+            topo_all = jnp.all(
+                ~g.ip_is_aff[p][:, None] | topo_present, axis=0
+            )
+            escape = jnp.any(g.ip_is_aff[p]) & ~any_match & g.ip_self_all[p]
+            ok3 = aff_ok | (escape & topo_all)
+
+            # batch-assigned pods' terms vs p: p matches j's term u
+            #   ⇔ ip_bmatch[j, u, p]
+            m_jp = g.ip_bmatch[:, :, p] & assigned_valid[:, None]  # [J, AT]
+            dv_ju = jnp.take_along_axis(
+                g.ip_dv, a_clip[:, None, None], axis=2
+            )[:, :, 0]  # [J, AT]
+            eq = (dv_ju >= 0)[:, :, None] & (
+                g.ip_dv == dv_ju[:, :, None]
+            )  # [J, AT, N]
+            viol_b = jnp.any(
+                (m_jp & g.ip_is_anti)[:, :, None] & eq, axis=(0, 1)
+            )
+            mask = mask & ~g.ip_viol_existing[p] & ~viol2 & ok3 & ~viol_b
+        else:
+            ip_total = g.ip_dom_cnt[p]
+            topo_present = g.ip_dv[p] >= 0
+            m_jp = g.ip_bmatch[:, :, p] & assigned_valid[:, None]
+            eq = jnp.zeros((P, 0, N), bool)
+        feas = mask
+        n_feas = jnp.sum(feas.astype(I32))
+
+        # ---------------- scores ----------------
+        # LeastAllocated on non-zero-defaulted requests
+        nz = (
+            state["nonzero"].astype(I64)
+            + db.nonzero_req[p][None, :].astype(I64)
+        )  # [N, 2]
+        alloc2 = jnp.stack(
+            [dc.allocatable[:, LANE_CPU], dc.allocatable[:, LANE_MEM]], axis=1
+        ).astype(I64)
+        frac = jnp.where(
+            nz > alloc2, 0, (alloc2 - nz) * MAX // jnp.maximum(alloc2, 1)
+        )
+        lane_has = alloc2 > 0
+        wsum = jnp.sum(lane_has.astype(I64), axis=1)
+        least = jnp.where(
+            wsum > 0,
+            jnp.sum(jnp.where(lane_has, frac, 0), axis=1) // jnp.maximum(wsum, 1),
+            0,
+        )
+
+        # BalancedAllocation on real requests
+        a0 = dc.allocatable[:, LANE_CPU].astype(I64)
+        a1 = dc.allocatable[:, LANE_MEM].astype(I64)
+        r0 = jnp.minimum(
+            state["requested"][:, LANE_CPU].astype(I64)
+            + db.requests[p, LANE_CPU].astype(I64),
+            a0,
+        )
+        r1 = jnp.minimum(
+            state["requested"][:, LANE_MEM].astype(I64)
+            + db.requests[p, LANE_MEM].astype(I64),
+            a1,
+        )
+        d = jnp.abs(r0 * a1 - r1 * a0)
+        den = jnp.maximum(a0 * a1, 1)
+        balanced = jnp.where(
+            (a0 > 0) & (a1 > 0), MAX - (50 * d + den - 1) // den, MAX
+        )
+
+        # InterPodAffinity: static symmetric + incoming preferred (with batch
+        # contributions) + symmetric from batch-assigned pods' terms.
+        if g.ip_dv.shape[1]:
+            pref = jnp.sum(
+                jnp.where(
+                    topo_present,
+                    ip_total.astype(I64) * g.ip_pref_w[p][:, None],
+                    0,
+                ),
+                axis=0,
+            )
+            w_jp = jnp.where(m_jp, g.ip_sym_w, 0)  # [J, AT] i64
+            sym_b = jnp.sum(w_jp[:, :, None] * eq.astype(I64), axis=(0, 1))
+            ip_raw = g.ip_sym[p] + pref + sym_b
+        else:
+            ip_raw = g.ip_sym[p]
+
+        # PodTopologySpread score
+        if g.sp_dv.shape[1]:
+            sp_raw, sp_valid = _spread_score(
+                dc, db, g, state, p, feas, dv_at, v_cap
+            )
+        else:
+            sp_raw = jnp.zeros((N,), I64)
+            sp_valid = feas
+
+        w_taint, w_naff, w_spread, w_ip, w_fit, w_bal, w_img = weights
+        total_score = jnp.zeros((N,), I64)
+        if w_taint:
+            total_score += w_taint * _norm_default(
+                g.sc_taint[p], feas, reverse=True
+            )
+        if w_naff:
+            total_score += w_naff * _norm_default(g.sc_nodeaff[p], feas)
+        if w_spread:
+            total_score += w_spread * _norm_spread(sp_raw, sp_valid, feas)
+        if w_ip:
+            total_score += w_ip * _norm_minmax(ip_raw, feas)
+        if w_fit:
+            total_score += w_fit * least
+        if w_bal:
+            total_score += w_bal * balanced
+        if w_img:
+            total_score += w_img * g.sc_image[p]
+
+        neg = jnp.iinfo(jnp.int64).min
+        ranked = jnp.where(feas, total_score, neg)
+        choice = jnp.argmax(ranked).astype(I32)
+        choice = jnp.where(n_feas > 0, choice, ABSENT)
+
+        # ---------------- commit ----------------
+        commit = choice >= 0
+        onehot_n = (jnp.arange(N, dtype=I32) == choice) & commit
+        state = dict(
+            requested=state["requested"]
+            + onehot_n[:, None].astype(I32) * db.requests[p][None, :Rn],
+            nonzero=state["nonzero"]
+            + onehot_n[:, None].astype(I32) * db.nonzero_req[p][None, :],
+            num_pods=state["num_pods"] + onehot_n.astype(I32),
+            assigned=state["assigned"].at[p].set(choice),
+            onehot=state["onehot"].at[p].set(onehot_n),
+        )
+        return state, (choice, n_feas)
+
+    state, (chosen, n_feas) = jax.lax.scan(step, init, jnp.arange(P, dtype=I32))
+    # Final node tallies let the caller chain batches without a host round
+    # trip: feed them back as the next DeviceCluster's requested/nonzero/
+    # num_pods (the across-batch analogue of the assume cache).
+    return chosen, n_feas, {
+        "requested": state["requested"],
+        "nonzero": state["nonzero"],
+        "num_pods": state["num_pods"],
+    }
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "v_cap",
+        "hard_pod_affinity_weight",
+        "has_interpod",
+        "has_spread",
+        "has_ports",
+        "has_images",
+        "enabled",
+        "weights",
+    ),
+)
+def gang_run(
+    dc: DeviceCluster,
+    db: DeviceBatch,
+    hostname_key,
+    v_cap: int,
+    hard_pod_affinity_weight: int = 1,
+    has_interpod: bool = True,
+    has_spread: bool = True,
+    has_ports: bool = True,
+    has_images: bool = True,
+    enabled: frozenset = F.ALL_FILTER_KERNELS,
+    weights: tuple = DEFAULT_WEIGHTS,
+):
+    """Fused precompute + scan: ONE device dispatch per batch."""
+    g = precompute(
+        dc,
+        db,
+        hostname_key,
+        v_cap,
+        hard_pod_affinity_weight,
+        has_interpod=has_interpod,
+        has_spread=has_spread,
+        has_ports=has_ports,
+        has_images=has_images,
+        enabled=enabled,
+    )
+    return gang_schedule(
+        dc,
+        db,
+        g,
+        v_cap,
+        weights=weights,
+        check_fit="NodeResourcesFit" in enabled,
+    )
+
+
+def _spread_score(dc, db, g, state, p, feas, dv_at, v_cap):
+    """ScheduleAnyway scoring for one pod given current batch placements
+    (podtopologyspread/scoring.go, fixed-point log weights)."""
+    soft = g.sp_soft[p]  # [C]
+    has_soft = jnp.any(soft)
+    dv = g.sp_dv[p]  # [C, N]
+    C, N = dv.shape
+    av = (state["assigned"] >= 0)[None, :]
+
+    ignored = feas & ~g.sp_all_keys[p]
+    counted = feas & g.sp_all_keys[p]  # filtered, non-ignored
+
+    # pair-init presence + topoSize over counted nodes (dynamic: depends on
+    # current feasibility)
+    pres_add = _scatter_by_domain(
+        jnp.broadcast_to(counted[None, :], (C, N)).astype(I32),
+        jnp.where(counted[None, :], dv, -1),
+        v_cap,
+    )  # [C, V+1]
+    pair_pres = (
+        jnp.take_along_axis(pres_add, jnp.clip(dv, 0, v_cap), axis=1) > 0
+    )
+    pair_pres = pair_pres & (dv >= 0)
+    n_dom = jnp.sum((pres_add[:, :v_cap] > 0).astype(I32), axis=1)  # [C]
+    n_counted = jnp.sum(counted.astype(I32))
+    size = jnp.where(g.sp_is_host[p], n_counted, n_dom)  # [C]
+    w_fx = dc.log_tab[jnp.clip(size, 0, dc.log_tab.shape[0] - 1)]  # [C] i64
+
+    # batch contributions to score counts (gated by the score counting mask
+    # at the assigned node)
+    cg_at = jnp.take_along_axis(g.sp_counting[p], jnp.clip(
+        state["assigned"], 0, N - 1)[None, :], axis=1)  # [C, J]
+    contrib = (g.sp_bmatch[p] & av & cg_at).astype(I32)
+    dom_add = _scatter_by_domain(contrib, jnp.where(av, dv_at, -1), v_cap)
+    dyn_dom = jnp.take_along_axis(dom_add, jnp.clip(dv, 0, v_cap), axis=1)
+    dyn_dom = jnp.where(dv >= 0, dyn_dom, 0)
+
+    # hostname constraints count per node directly
+    dyn_host = jnp.sum(
+        (g.sp_bmatch[p][:, :, None] & state["onehot"][None, :, :]).astype(I32),
+        axis=1,
+    )  # [C, N]
+
+    cnt = jnp.where(
+        g.sp_is_host[p][:, None],
+        g.sp_node_cnt[p] + dyn_host,
+        jnp.where(pair_pres, g.sp_sc_dom[p] + dyn_dom, 0),
+    )  # [C, N]
+
+    contrib_fx = cnt.astype(I64) * w_fx[:, None] + (
+        (db.tsc_max_skew[p].astype(I64) - 1)[:, None] << _FX
+    )
+    total_fx = jnp.sum(jnp.where(soft[:, None], contrib_fx, 0), axis=0)  # [N]
+    k = total_fx >> _FX
+    frac = total_fx & ((1 << _FX) - 1)
+    half = 1 << (_FX - 1)
+    up = (frac > half) | ((frac == half) & ((k & 1) == 1))
+    raw = k + up.astype(I64)
+    raw = jnp.where(has_soft, raw, 0)
+    valid = jnp.where(has_soft, ~ignored, feas)
+    return raw, valid

@@ -23,6 +23,7 @@ from kubernetes_tpu.ops import filters as F
 from kubernetes_tpu.ops import scores as S
 from kubernetes_tpu.ops.common import DeviceBatch, DeviceCluster, I32, I64
 from kubernetes_tpu.snapshot.cluster import PackedCluster
+from kubernetes_tpu.snapshot.interner import PAD as PAD_
 from kubernetes_tpu.snapshot.schema import PodBatch, bucket_cap
 
 
@@ -33,9 +34,22 @@ class PipelineResult(NamedTuple):
     n_feasible: jnp.ndarray  # i32 [P]
 
 
-@functools.partial(jax.jit, static_argnames=("v_cap",))
-def _pipeline(dc: DeviceCluster, db: DeviceBatch, hostname_key, v_cap: int):
-    masks = F.all_masks(dc, db, v_cap)
+@functools.partial(
+    jax.jit,
+    static_argnames=("v_cap", "has_interpod", "has_spread", "has_images"),
+)
+def _pipeline(
+    dc: DeviceCluster,
+    db: DeviceBatch,
+    hostname_key,
+    v_cap: int,
+    has_interpod: bool = True,
+    has_spread: bool = True,
+    has_images: bool = True,
+):
+    masks = F.all_masks(
+        dc, db, v_cap, has_interpod=has_interpod, has_spread=has_spread
+    )
     feasible = masks["_combined"]
     totals, _ = S.all_scores(
         dc,
@@ -45,6 +59,7 @@ def _pipeline(dc: DeviceCluster, db: DeviceBatch, hostname_key, v_cap: int):
         masks["_spread_pre"],
         v_cap,
         hostname_key,
+        has_images=has_images,
     )
     big = jnp.iinfo(jnp.int64).min
     ranked = jnp.where(feasible, totals, big)
@@ -59,6 +74,23 @@ def _pipeline(dc: DeviceCluster, db: DeviceBatch, hostname_key, v_cap: int):
     )
 
 
+def batch_feature_flags(pc: PackedCluster, pb: PodBatch):
+    """Host-side static flags: which constraint families does this
+    (snapshot, batch) pair actually use?  Lets the jit drop whole kernels
+    (the reference's PreFilter-Skip, made a compile-time decision).
+
+    Returns (has_interpod, has_spread, has_images, has_ports)."""
+    has_interpod = bool(
+        (pb.aff_kind != PAD_).any() or (pc.existing.term_kind != PAD_).any()
+    )
+    has_spread = bool((pb.tsc_topo_key != PAD_).any())
+    has_images = bool((pb.img_ids >= 0).any())
+    has_ports = bool(
+        (pb.want_ppk != PAD_).any() or (pc.nodes.used_ppk != PAD_).any()
+    )
+    return has_interpod, has_spread, has_images, has_ports
+
+
 def schedule_independent(
     pc: PackedCluster, pb: PodBatch
 ) -> PipelineResult:
@@ -71,4 +103,15 @@ def schedule_independent(
     hostname_key = jnp.asarray(
         pc.vocab.label_keys.lookup(HOSTNAME_LABEL), I32
     )
-    return jax.device_get(_pipeline(dc, db, hostname_key, v_cap))
+    has_interpod, has_spread, has_images, _ = batch_feature_flags(pc, pb)
+    return jax.device_get(
+        _pipeline(
+            dc,
+            db,
+            hostname_key,
+            v_cap,
+            has_interpod=has_interpod,
+            has_spread=has_spread,
+            has_images=has_images,
+        )
+    )

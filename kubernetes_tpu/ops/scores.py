@@ -170,8 +170,18 @@ def score_interpod(
         jnp.where(topo_present, dom_tot.astype(I64) * w[:, :, None], 0), axis=1
     )  # [P, N]
 
-    # Symmetric: existing terms matching the incoming pod, credited to nodes
-    # sharing the term's topology value.
+    sym = interpod_symmetric_score(dc, pre, hard_pod_affinity_weight)
+    return incoming + sym
+
+
+def interpod_symmetric_score(
+    dc: DeviceCluster, pre: InterPodPre, hard_pod_affinity_weight: int = 1
+):
+    """[P, N] i64: existing pods' terms matching the incoming pod, credited
+    to nodes sharing the term's topology value (scoring.go processExistingPod
+    symmetric paths)."""
+    from kubernetes_tpu.ops.filters import interpod_weighted_ext
+
     ew = jnp.where(
         dc.term_kind == TERM_REQUIRED_AFFINITY,
         hard_pod_affinity_weight,
@@ -181,14 +191,7 @@ def score_interpod(
             jnp.where(dc.term_kind == TERM_PREFERRED_ANTI, -dc.term_weight, 0),
         ),
     ).astype(I32)  # [M]
-    m = pre.ext_match.astype(I32) * ew[:, None]  # [M, P]
-    sym = jax.lax.dot_general(
-        m.T,
-        pre.ext_topo_eq.astype(I32),
-        (((1,), (0,)), ((), ())),
-        preferred_element_type=I32,
-    ).astype(I64)  # [P, N]
-    return incoming + sym
+    return interpod_weighted_ext(dc, pre, ew).astype(I64)
 
 
 def normalize_interpod(raw, feasible):
@@ -355,8 +358,14 @@ def all_scores(
     weights: Dict[str, int] = None,
     requested=None,
     nonzero_req=None,
+    has_images: bool = True,
 ):
-    """Weighted sum of normalized plugin scores over the feasible set."""
+    """Weighted sum of normalized plugin scores over the feasible set.
+
+    ``ipre``/``spre`` may be None (batch statically known to carry no such
+    constraints); the oracle-equivalent constant then applies — spread
+    normalizes to 100 everywhere (normalize_topology_spread with all-zero
+    raw), inter-pod normalizes to 0 (diff == 0)."""
     w = DEFAULT_SCORE_WEIGHTS if weights is None else weights
     total = jnp.zeros(feasible.shape, I64)
     per_plugin = {}
@@ -379,13 +388,24 @@ def all_scores(
             default_normalize(score_node_affinity(dc, db), feasible),
         )
     if w.get("PodTopologySpread"):
-        raw, valid = score_spread(dc, db, spre, feasible, v_cap, hostname_val_key)
-        acc("PodTopologySpread", normalize_spread(raw, valid, feasible))
+        if spre is not None:
+            raw, valid = score_spread(
+                dc, db, spre, feasible, v_cap, hostname_val_key
+            )
+            acc("PodTopologySpread", normalize_spread(raw, valid, feasible))
+        else:
+            acc(
+                "PodTopologySpread",
+                jnp.where(feasible, MAX_NODE_SCORE, 0).astype(I64),
+            )
     if w.get("InterPodAffinity"):
-        acc(
-            "InterPodAffinity",
-            normalize_interpod(score_interpod(dc, db, ipre, v_cap), feasible),
-        )
+        if ipre is not None:
+            acc(
+                "InterPodAffinity",
+                normalize_interpod(score_interpod(dc, db, ipre, v_cap), feasible),
+            )
+        else:
+            acc("InterPodAffinity", jnp.zeros(feasible.shape, I64))
     if w.get("NodeResourcesFit"):
         acc("NodeResourcesFit", score_least_allocated(dc, db, nonzero_req))
     if w.get("NodeResourcesBalancedAllocation"):
@@ -394,5 +414,8 @@ def all_scores(
             score_balanced_allocation(dc, db, requested),
         )
     if w.get("ImageLocality"):
-        acc("ImageLocality", score_image_locality(dc, db))
+        if has_images:
+            acc("ImageLocality", score_image_locality(dc, db))
+        else:
+            acc("ImageLocality", jnp.zeros(feasible.shape, I64))
     return total, per_plugin

@@ -1,0 +1,6 @@
+"""Scheduling queue (pkg/scheduler/backend/queue)."""
+
+from kubernetes_tpu.queue.scheduling_queue import (  # noqa: F401
+    QueuedPodInfo,
+    SchedulingQueue,
+)

@@ -1,0 +1,338 @@
+"""3-tier scheduling queue with queueing hints and batch pop.
+
+Mirrors pkg/scheduler/backend/queue/scheduling_queue.go:
+
+  * activeQ       — heap ordered by the profile's QueueSort (priority desc,
+                    then enqueue time);
+  * podBackoffQ   — heap by backoff expiry; exponential 1s→10s per attempt
+                    (:1230-1266);
+  * unschedulablePods — map, flushed to active/backoff after 5 min (:63).
+
+Requeue is driven by ClusterEvent → QueueingHintFn maps built from the
+plugins' EventsToRegister (isPodWorthRequeuing :401-475): an event requeues
+an unschedulable pod only if one of the plugins that rejected it registered
+a matching hint that returns QUEUE.  The in-flight ledger reproduces
+active_queue.go:74-126 — events arriving while a pod is being scheduled are
+replayed when the pod is marked done, so nothing is lost to the race.
+
+The TPU-native extension is ``pop_batch(k)``: up to k pods in exact
+QueueSort order, feeding one gang dispatch instead of one pod per cycle.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from kubernetes_tpu.api.types import Pod
+from kubernetes_tpu.framework.interface import (
+    ClusterEvent,
+    ClusterEventWithHint,
+    QueueingHint,
+)
+
+DEFAULT_POD_INITIAL_BACKOFF = 1.0
+DEFAULT_POD_MAX_BACKOFF = 10.0
+DEFAULT_UNSCHEDULABLE_TIMEOUT = 5 * 60.0
+
+_seq = itertools.count()
+
+
+@dataclass
+class QueuedPodInfo:
+    """framework.QueuedPodInfo (types.go:234)."""
+
+    pod: Pod
+    timestamp: float = 0.0  # first enqueue time
+    attempts: int = 0
+    unschedulable_plugins: set = field(default_factory=set)
+    pending_plugins: set = field(default_factory=set)
+    gated: bool = False
+    # bookkeeping
+    last_failure_time: float = 0.0
+
+    @property
+    def uid(self) -> str:
+        return self.pod.uid
+
+
+class SchedulingQueue:
+    def __init__(
+        self,
+        less_fn: Optional[Callable[[QueuedPodInfo, QueuedPodInfo], bool]] = None,
+        queueing_hints: Optional[
+            Dict[str, List[ClusterEventWithHint]]
+        ] = None,
+        pre_enqueue_check: Optional[Callable[[Pod], Any]] = None,
+        initial_backoff_s: float = DEFAULT_POD_INITIAL_BACKOFF,
+        max_backoff_s: float = DEFAULT_POD_MAX_BACKOFF,
+        unschedulable_timeout_s: float = DEFAULT_UNSCHEDULABLE_TIMEOUT,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.less = less_fn or self._default_less
+        self.hints = queueing_hints or {}
+        self.pre_enqueue_check = pre_enqueue_check
+        self.initial_backoff = initial_backoff_s
+        self.max_backoff = max_backoff_s
+        self.unschedulable_timeout = unschedulable_timeout_s
+        self.clock = clock
+
+        self._active: List[Tuple[Any, int, QueuedPodInfo]] = []  # heap
+        self._backoff: List[Tuple[float, int, QueuedPodInfo]] = []  # heap
+        self._unschedulable: Dict[str, QueuedPodInfo] = {}
+        self._gated: Dict[str, QueuedPodInfo] = {}
+        self._in_queue: Dict[str, str] = {}  # uid → which structure
+        # in-flight pods + events ledger (active_queue.go:74-126)
+        self._in_flight: Dict[str, List[Tuple[ClusterEvent, Any, Any]]] = {}
+
+    # ----- ordering --------------------------------------------------------
+
+    @staticmethod
+    def _default_less(a: QueuedPodInfo, b: QueuedPodInfo) -> bool:
+        """PrioritySort semantics (queuesort/priority_sort.go:43)."""
+        if a.pod.priority != b.pod.priority:
+            return a.pod.priority > b.pod.priority
+        return a.timestamp < b.timestamp
+
+    def _active_key(self, qp: QueuedPodInfo):
+        return (-qp.pod.priority, qp.timestamp)
+
+    def _push_active(self, qp: QueuedPodInfo) -> None:
+        heapq.heappush(self._active, (self._active_key(qp), next(_seq), qp))
+        self._in_queue[qp.uid] = "active"
+
+    def _push_backoff(self, qp: QueuedPodInfo) -> None:
+        heapq.heappush(
+            self._backoff, (self._backoff_expiry(qp), next(_seq), qp)
+        )
+        self._in_queue[qp.uid] = "backoff"
+
+    def _backoff_expiry(self, qp: QueuedPodInfo) -> float:
+        """Exponential: initial·2^(attempts-1), capped (scheduling_queue.go:1230)."""
+        d = self.initial_backoff * (2 ** max(qp.attempts - 1, 0))
+        return qp.last_failure_time + min(d, self.max_backoff)
+
+    # ----- add paths --------------------------------------------------------
+
+    def add(self, pod: Pod) -> None:
+        """New unscheduled pod from the informer (PreEnqueue gating,
+        scheduling_queue.go:499-538)."""
+        if pod.uid in self._in_queue or pod.uid in self._in_flight:
+            return
+        qp = QueuedPodInfo(pod=pod, timestamp=self.clock())
+        if self.pre_enqueue_check is not None:
+            status = self.pre_enqueue_check(pod)
+            if status is not None and not getattr(status, "ok", True):
+                qp.gated = True
+                qp.unschedulable_plugins.add(getattr(status, "plugin", ""))
+                self._gated[pod.uid] = qp
+                self._in_queue[pod.uid] = "gated"
+                return
+        self._push_active(qp)
+
+    def update(self, old: Optional[Pod], new: Pod) -> None:
+        where = self._in_queue.get(new.uid)
+        if where is None:
+            if new.uid in self._in_flight:
+                self._record_in_flight_event(
+                    ClusterEvent_from_pod_update(), old, new
+                )
+                return
+            self.add(new)
+            return
+        qp = self._find(new.uid)
+        if qp is None:
+            return
+        qp.pod = new
+        if where == "gated":
+            # Re-run gating: removing the last gate activates the pod.
+            if self.pre_enqueue_check is not None:
+                status = self.pre_enqueue_check(new)
+                if status is None or getattr(status, "ok", True):
+                    del self._gated[new.uid]
+                    qp.gated = False
+                    self._push_active(qp)
+        elif where == "unschedulable":
+            # Spec updates may make it schedulable (scheduling_queue.go update path).
+            del self._unschedulable[new.uid]
+            self._requeue(qp, immediately=False)
+
+    def delete(self, pod: Pod) -> None:
+        where = self._in_queue.pop(pod.uid, None)
+        if where == "unschedulable":
+            self._unschedulable.pop(pod.uid, None)
+        elif where == "gated":
+            self._gated.pop(pod.uid, None)
+        elif where in ("active", "backoff"):
+            # lazy deletion: heap entries are skipped when their uid is
+            # no longer registered
+            pass
+        self._in_flight.pop(pod.uid, None)
+
+    # ----- pop --------------------------------------------------------------
+
+    def _flush_backoff(self) -> None:
+        now = self.clock()
+        while self._backoff:
+            expiry, _, qp = self._backoff[0]
+            if self._in_queue.get(qp.uid) != "backoff":
+                heapq.heappop(self._backoff)
+                continue
+            if expiry > now:
+                break
+            heapq.heappop(self._backoff)
+            self._push_active(qp)
+
+    def flush_unschedulable_leftover(self) -> None:
+        """Pods stuck unschedulable > timeout move back
+        (flushUnschedulablePodsLeftover, :802)."""
+        now = self.clock()
+        for uid in list(self._unschedulable):
+            qp = self._unschedulable[uid]
+            if now - qp.last_failure_time >= self.unschedulable_timeout:
+                del self._unschedulable[uid]
+                self._requeue(qp, immediately=False)
+
+    def pop_batch(self, k: int) -> List[QueuedPodInfo]:
+        """Up to k pods in QueueSort order — the gang dispatch feed.
+
+        Each popped pod enters the in-flight ledger; call done(uid) after
+        its scheduling attempt concludes.
+        """
+        self._flush_backoff()
+        out: List[QueuedPodInfo] = []
+        while len(out) < k and self._active:
+            _, _, qp = heapq.heappop(self._active)
+            if self._in_queue.get(qp.uid) != "active":
+                continue  # lazily-deleted entry
+            del self._in_queue[qp.uid]
+            qp.attempts += 1
+            self._in_flight[qp.uid] = []
+            out.append(qp)
+        return out
+
+    def pop(self) -> Optional[QueuedPodInfo]:
+        batch = self.pop_batch(1)
+        return batch[0] if batch else None
+
+    # ----- scheduling-attempt outcomes --------------------------------------
+
+    def add_unschedulable(self, qp: QueuedPodInfo, unschedulable_plugins) -> None:
+        """AddUnschedulableIfNotPresent (:723): failed pod parks in the
+        unschedulable map with the plugins that rejected it; events recorded
+        while it was in flight are replayed first (done() semantics)."""
+        qp.unschedulable_plugins = set(unschedulable_plugins or ())
+        qp.last_failure_time = self.clock()
+        events = self._in_flight.pop(qp.uid, [])
+        for ev, old, new in events:
+            if self._is_worth_requeuing(qp, ev, old, new):
+                self._requeue(qp, immediately=False)
+                return
+        self._unschedulable[qp.uid] = qp
+        self._in_queue[qp.uid] = "unschedulable"
+
+    def done(self, uid: str) -> None:
+        """Pod's scheduling attempt fully concluded (bound or failed)."""
+        self._in_flight.pop(uid, None)
+
+    def activate(self, pods: Sequence[Pod]) -> None:
+        """Plugins may force-activate specific pods (:589)."""
+        for pod in pods:
+            qp = self._find(pod.uid)
+            if qp is None:
+                continue
+            where = self._in_queue.get(pod.uid)
+            if where in ("unschedulable", "backoff"):
+                if where == "unschedulable":
+                    self._unschedulable.pop(pod.uid, None)
+                self._push_active(qp)
+
+    # ----- cluster events → requeue (the reactive path) ---------------------
+
+    def move_all_on_event(
+        self, event: ClusterEvent, old: Any = None, new: Any = None
+    ) -> int:
+        """MoveAllToActiveOrBackoffQueue (:1014).  Returns #requeued."""
+        # record for in-flight pods first (replayed at done)
+        for uid in self._in_flight:
+            self._in_flight[uid].append((event, old, new))
+
+        moved = 0
+        for uid in list(self._unschedulable):
+            qp = self._unschedulable[uid]
+            if self._is_worth_requeuing(qp, event, old, new):
+                del self._unschedulable[uid]
+                self._requeue(qp, immediately=False)
+                moved += 1
+        return moved
+
+    def _is_worth_requeuing(
+        self, qp: QueuedPodInfo, event: ClusterEvent, old: Any, new: Any
+    ) -> bool:
+        """isPodWorthRequeuing (:401): only hints of the plugins that
+        rejected the pod run, for matching events."""
+        plugins = qp.unschedulable_plugins | qp.pending_plugins
+        if not plugins:
+            return True  # rejected by no plugin (e.g. error) → always retry
+        for name in plugins:
+            for ewh in self.hints.get(name, []):
+                if not ewh.event.match(event):
+                    continue
+                if ewh.hint_fn is None:
+                    return True
+                try:
+                    if ewh.hint_fn(qp.pod, old, new) == QueueingHint.QUEUE:
+                        return True
+                except Exception:
+                    return True  # hint error → requeue (fail open, :447)
+        return False
+
+    def _requeue(self, qp: QueuedPodInfo, immediately: bool) -> None:
+        if immediately or self._backoff_expiry(qp) <= self.clock():
+            self._push_active(qp)
+        else:
+            self._push_backoff(qp)
+
+    # ----- introspection ----------------------------------------------------
+
+    def _find(self, uid: str) -> Optional[QueuedPodInfo]:
+        if uid in self._unschedulable:
+            return self._unschedulable[uid]
+        if uid in self._gated:
+            return self._gated[uid]
+        for _, _, qp in itertools.chain(self._active, self._backoff):
+            if qp.uid == uid and self._in_queue.get(uid) in ("active", "backoff"):
+                return qp
+        return None
+
+    def pending_pods(self) -> Dict[str, List[Pod]]:
+        """PendingPods introspection (:1146)."""
+        active = [
+            qp.pod
+            for _, _, qp in self._active
+            if self._in_queue.get(qp.uid) == "active"
+        ]
+        backoff = [
+            qp.pod
+            for _, _, qp in self._backoff
+            if self._in_queue.get(qp.uid) == "backoff"
+        ]
+        return {
+            "active": active,
+            "backoff": backoff,
+            "unschedulable": [qp.pod for qp in self._unschedulable.values()],
+            "gated": [qp.pod for qp in self._gated.values()],
+        }
+
+    def __len__(self) -> int:
+        p = self.pending_pods()
+        return sum(len(v) for v in p.values())
+
+
+def ClusterEvent_from_pod_update():
+    from kubernetes_tpu.framework.interface import ActionType, EventResource
+
+    return ClusterEvent(EventResource.UNSCHEDULED_POD, ActionType.UPDATE)

@@ -1,0 +1,10 @@
+"""Test fixtures: fake cluster (in-process API + watch stream).
+
+The analogue of the reference's fake clientset + StartTestServer pattern
+(SURVEY.md §4 tiers 1-2): nodes and pods are plain objects in an in-memory
+store; mutations fan out to registered handlers exactly like the informer
+delivery path; binding loops back as an assigned-pod Add event the way
+apiserver → etcd → watch → informer does (SURVEY.md §3.5).
+"""
+
+from kubernetes_tpu.testing.fake_cluster import FakeCluster  # noqa: F401

@@ -1,0 +1,111 @@
+"""In-process cluster: object store + watch fan-out + binding subresource."""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import replace
+from typing import Callable, Dict, List, Optional
+
+from kubernetes_tpu.api.types import Node, Pod
+
+
+class FakeCluster:
+    """A miniature apiserver: CRUD on nodes/pods, watch handler fan-out, and
+    the pods/binding subresource (registry/core/pod/storage/storage.go:169
+    assignPod semantics — sets spec.nodeName via the store, then notifies
+    watchers)."""
+
+    def __init__(self) -> None:
+        self.nodes: Dict[str, Node] = {}
+        self.pods: Dict[str, Pod] = {}
+        self._node_handlers: List[tuple] = []  # (add, update, delete)
+        self._pod_handlers: List[tuple] = []
+        self.bindings: Dict[str, str] = {}  # pod uid → node name
+
+    # ----- watch registration ----------------------------------------------
+
+    def watch_nodes(self, on_add, on_update, on_delete) -> None:
+        self._node_handlers.append((on_add, on_update, on_delete))
+        for node in self.nodes.values():
+            on_add(node)
+
+    def watch_pods(self, on_add, on_update, on_delete) -> None:
+        self._pod_handlers.append((on_add, on_update, on_delete))
+        for pod in self.pods.values():
+            on_add(pod)
+
+    # ----- nodes ------------------------------------------------------------
+
+    def create_node(self, node: Node) -> None:
+        self.nodes[node.name] = node
+        for add, _, _ in self._node_handlers:
+            add(node)
+
+    def update_node(self, node: Node) -> None:
+        old = self.nodes.get(node.name)
+        self.nodes[node.name] = node
+        for _, update, _ in self._node_handlers:
+            update(old, node)
+
+    def delete_node(self, name: str) -> None:
+        node = self.nodes.pop(name, None)
+        if node is None:
+            return
+        for _, _, delete in self._node_handlers:
+            delete(node)
+
+    # ----- pods -------------------------------------------------------------
+
+    def create_pod(self, pod: Pod) -> None:
+        # The store owns its copy and every delivered event carries a fresh
+        # copy — callers keep mutating theirs (assume sets nodeName on the
+        # scheduler's object) without ever aliasing the "API" state.
+        pod = copy.deepcopy(pod)
+        self.pods[pod.uid] = pod
+        for add, _, _ in self._pod_handlers:
+            add(copy.deepcopy(pod))
+
+    def update_pod(self, pod: Pod) -> None:
+        pod = copy.deepcopy(pod)
+        old = self.pods.get(pod.uid)
+        self.pods[pod.uid] = pod
+        for _, update, _ in self._pod_handlers:
+            update(copy.deepcopy(old), copy.deepcopy(pod))
+
+    def delete_pod(self, uid: str) -> None:
+        pod = self.pods.pop(uid, None)
+        if pod is None:
+            return
+        for _, _, delete in self._pod_handlers:
+            delete(pod)
+
+    # ----- binding subresource ----------------------------------------------
+
+    def bind(self, pod: Pod, node_name: str) -> None:
+        """POST pods/{name}/binding: CAS-sets nodeName, rejects doubles."""
+        stored = self.pods.get(pod.uid)
+        if stored is None:
+            raise KeyError(f"binding unknown pod {pod.key}")
+        if stored.node_name and stored.node_name != node_name:
+            raise RuntimeError(
+                f"pod {pod.key} already bound to {stored.node_name}"
+            )
+        if node_name not in self.nodes:
+            raise KeyError(f"binding to unknown node {node_name}")
+        old = copy.deepcopy(stored)
+        stored.node_name = node_name
+        self.bindings[pod.uid] = node_name
+        for _, update, _ in self._pod_handlers:
+            update(old, copy.deepcopy(stored))
+
+    # ----- wiring -----------------------------------------------------------
+
+    def connect(self, scheduler) -> None:
+        """Attach a Scheduler's event handlers (addAllEventHandlers)."""
+        self.watch_nodes(
+            scheduler.on_node_add, scheduler.on_node_update, scheduler.on_node_delete
+        )
+        self.watch_pods(
+            scheduler.on_pod_add, scheduler.on_pod_update, scheduler.on_pod_delete
+        )
+        scheduler.binding_sink = self.bind

@@ -1,0 +1,99 @@
+"""Config API: defaulting, MultiPoint expansion, YAML loading, validation."""
+
+import pytest
+
+from kubernetes_tpu.framework import config as cfg
+from kubernetes_tpu.framework.registry import default_registry
+from kubernetes_tpu.framework.runtime import Framework
+
+
+def test_default_profile_expansion():
+    prof = cfg.Profile()
+    pts = cfg.expand_profile(prof)
+    score_names = {r.name: r.weight for r in pts["score"]}
+    # default weights (apis/config/v1/default_plugins.go:30-52)
+    assert score_names["TaintToleration"] == 3
+    assert score_names["NodeAffinity"] == 2
+    assert score_names["PodTopologySpread"] == 2
+    assert score_names["InterPodAffinity"] == 2
+    assert score_names["NodeResourcesFit"] == 1
+    assert score_names["NodeResourcesBalancedAllocation"] == 1
+    assert score_names["ImageLocality"] == 1
+    filter_names = [r.name for r in pts["filter"]]
+    assert "NodeResourcesFit" in filter_names
+    assert "PodTopologySpread" in filter_names
+    assert [r.name for r in pts["queueSort"]] == ["PrioritySort"]
+    assert [r.name for r in pts["bind"]] == ["DefaultBinder"]
+    assert [r.name for r in pts["preEnqueue"]] == ["SchedulingGates"]
+
+
+def test_multipoint_disable_and_weight_override():
+    prof = cfg.Profile()
+    prof.plugins.multi_point.disabled = [cfg.PluginRef("ImageLocality")]
+    prof.plugins.score.enabled = [cfg.PluginRef("NodeAffinity", weight=7)]
+    pts = cfg.expand_profile(prof)
+    score = {r.name: r.weight for r in pts["score"]}
+    assert "ImageLocality" not in score
+    assert score["NodeAffinity"] == 7
+
+
+def test_point_disable_star():
+    prof = cfg.Profile()
+    prof.plugins.score.disabled = [cfg.PluginRef("*")]
+    pts = cfg.expand_profile(prof)
+    assert pts["score"] == []
+    assert [r.name for r in pts["bind"]] == ["DefaultBinder"]
+
+
+def test_yaml_load_and_framework():
+    y = """
+apiVersion: kubescheduler.config.k8s.io/v1
+kind: KubeSchedulerConfiguration
+parallelism: 8
+podInitialBackoffSeconds: 2
+podMaxBackoffSeconds: 20
+profiles:
+  - schedulerName: tpu-scheduler
+    plugins:
+      multiPoint:
+        disabled:
+          - name: ImageLocality
+      score:
+        enabled:
+          - name: NodeResourcesFit
+            weight: 5
+    pluginConfig:
+      - name: NodeResourcesFit
+        args:
+          scoringStrategy:
+            type: MostAllocated
+"""
+    c = cfg.load_config(y)
+    assert c.parallelism == 8
+    assert c.pod_initial_backoff_seconds == 2
+    fwk = Framework(c.profiles[0], default_registry())
+    assert fwk.profile_name == "tpu-scheduler"
+    assert fwk.score_weights["NodeResourcesFit"] == 5
+    assert "ImageLocality" not in fwk.score_weights
+    assert "NodeResourcesFit" in fwk.device_enabled()
+    inst = fwk._instances["NodeResourcesFit"]
+    assert inst.args["scoringStrategy"]["type"] == "MostAllocated"
+
+
+def test_validation_rejects_bad_config():
+    with pytest.raises(ValueError):
+        cfg.load_config({"kind": "Wrong"})
+    c = cfg.SchedulerConfiguration(pod_initial_backoff_seconds=-1)
+    with pytest.raises(ValueError):
+        c.validate()
+    c = cfg.SchedulerConfiguration()
+    c.profiles = [cfg.Profile(), cfg.Profile()]
+    with pytest.raises(ValueError):
+        c.validate()
+
+
+def test_events_to_register_surface():
+    fwk = Framework(cfg.Profile(), default_registry())
+    evs = fwk.events_to_register()
+    assert "NodeResourcesFit" in evs
+    assert "SchedulingGates" in evs

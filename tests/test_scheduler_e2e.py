@@ -1,0 +1,176 @@
+"""End-to-end: fake API → scheduler → bindings, decisions vs serial oracle.
+
+The tier-2 analogue of test/integration/scheduler (SURVEY.md §4): a real
+scheduler against an in-process API, pods never run, outcomes observed as
+bindings.
+"""
+
+import random
+
+import pytest
+
+from kubernetes_tpu.api.resource import Resource
+from kubernetes_tpu.api.types import Container, Node, Pod
+from kubernetes_tpu.framework.config import SchedulerConfiguration
+from kubernetes_tpu.oracle.pipeline import schedule_one
+from kubernetes_tpu.oracle.state import OracleState
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.testing import FakeCluster
+
+from tests.gen import make_cluster, make_pod
+
+NS_LABELS = {
+    "default": {"team": "core"},
+    "prod": {"team": "core", "env": "prod"},
+    "dev": {"env": "dev"},
+}
+
+
+class FakeClock:
+    """Injected clock (the reference's clock.Clock test pattern,
+    scheduling_queue.go:224)."""
+
+    def __init__(self) -> None:
+        self.now = 1000.0
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, s: float) -> None:
+        self.now += s
+
+
+def build_env(batch_size=8):
+    api = FakeCluster()
+    clock = FakeClock()
+    sched = Scheduler(
+        configuration=SchedulerConfiguration(batch_size=batch_size),
+        namespace_labels=NS_LABELS,
+        clock=clock,
+    )
+    api.connect(sched)
+    return api, sched, clock
+
+
+@pytest.mark.parametrize("seed", [41, 42])
+def test_e2e_decisions_match_serial_oracle(seed):
+    rng = random.Random(seed)
+    nodes, placed = make_cluster(rng, 10, 16)
+    pending = [make_pod(rng, f"pend-{i}") for i in range(16)]
+
+    api, sched, clock = build_env(batch_size=16)
+    for n in nodes:
+        api.create_node(n)
+    for p in placed:
+        api.create_pod(p)
+    for p in pending:
+        api.create_pod(p)
+
+    outcomes = sched.schedule_pending(max_batches=1)
+
+    state = OracleState.build(
+        [api.nodes[n.name] for n in nodes],
+        [p for p in placed],
+        namespace_labels=NS_LABELS,
+    )
+    # The queue pops priority-desc then enqueue-order (PrioritySort); the
+    # serial oracle must be replayed in the same order.
+    queue_order = sorted(
+        enumerate(pending), key=lambda iv: (-iv[1].priority, iv[0])
+    )
+    for _, pod in queue_order:
+        want = schedule_one(pod, state).node
+        got = api.bindings.get(pod.uid)
+        assert got == want, f"{pod.name}: bound {got}, oracle says {want}"
+        if want is not None:
+            pod.node_name = want
+            state.place(pod)
+
+    # failed pods are parked unschedulable, not lost
+    pend = sched.queue.pending_pods()
+    lost = {p.uid for p in pending} - set(api.bindings) - {
+        p.uid for p in pend["unschedulable"]
+    } - {p.uid for p in pend["backoff"]} - {p.uid for p in pend["active"]}
+    assert not lost
+
+
+def test_e2e_unschedulable_then_node_added_requeues():
+    """A pod rejected for unsatisfiable resources becomes schedulable when a
+    fitting node appears (the reactive path, SURVEY.md §3.3)."""
+    api, sched, clock = build_env()
+    api.create_node(
+        Node(name="small", capacity=Resource.from_map({"cpu": "1", "memory": "1Gi"}))
+    )
+    big_pod = Pod(
+        name="big",
+        containers=[Container(requests={"cpu": "4", "memory": "4Gi"})],
+    )
+    api.create_pod(big_pod)
+
+    out = sched.schedule_pending()
+    assert out[0].node is None
+    assert len(sched.queue.pending_pods()["unschedulable"]) == 1
+
+    api.create_node(
+        Node(name="huge", capacity=Resource.from_map({"cpu": "16", "memory": "32Gi"}))
+    )
+    # The requeued pod backs off first (afterBackoff strategy, 1s initial).
+    assert len(sched.queue.pending_pods()["backoff"]) == 1
+    clock.advance(2.0)
+    out = sched.schedule_pending()
+    assert [o.node for o in out] == ["huge"]
+    assert api.bindings[big_pod.uid] == "huge"
+
+
+def test_e2e_binding_confirms_assumed_pod():
+    api, sched, clock = build_env()
+    api.create_node(
+        Node(name="n1", capacity=Resource.from_map({"cpu": "4", "memory": "8Gi"}))
+    )
+    pod = Pod(name="p", containers=[Container(requests={"cpu": "1"})])
+    api.create_pod(pod)
+    sched.schedule_pending()
+    assert api.bindings[pod.uid] == "n1"
+    # informer loop-back confirmed the assumed pod
+    assert not sched.cache.assumed
+    assert sched.cache.stats()["pods"] == 1
+
+
+def test_e2e_scheduling_gates():
+    """Gated pods never reach the queue; ungating activates them."""
+    api, sched, clock = build_env()
+    api.create_node(
+        Node(name="n1", capacity=Resource.from_map({"cpu": "4", "memory": "8Gi"}))
+    )
+    pod = Pod(name="gated", scheduling_gates=("wait-for-me",))
+    api.create_pod(pod)
+    assert sched.schedule_pending() == []
+    assert len(sched.queue.pending_pods()["gated"]) == 1
+
+    ungated = Pod(
+        name="gated", uid=pod.uid, scheduling_gates=()
+    )
+    api.update_pod(ungated)
+    clock.advance(2.0)
+    out = sched.schedule_pending()
+    assert [o.node for o in out] == ["n1"]
+
+
+def test_e2e_incremental_mirror_reuses_rows():
+    """Consecutive batches must NOT full-repack the node tensors."""
+    api, sched, clock = build_env(batch_size=4)
+    for i in range(6):
+        api.create_node(
+            Node(
+                name=f"n{i}",
+                capacity=Resource.from_map({"cpu": "8", "memory": "16Gi"}),
+            )
+        )
+    for i in range(12):
+        api.create_pod(
+            Pod(name=f"p{i}", containers=[Container(requests={"cpu": "500m"})])
+        )
+    sched.schedule_pending()
+    stats = sched.mirror.stats()
+    assert stats["full_packs"] == 1, stats
+    assert len(api.bindings) == 12
