@@ -18,6 +18,7 @@ and the scheduling loop:
 
 from __future__ import annotations
 
+import copy
 import itertools
 import time
 from dataclasses import dataclass, field
@@ -112,12 +113,17 @@ class Cache:
     # ----- assume protocol (scheduler) ------------------------------------
 
     def assume_pod(self, pod: Pod, node_name: str) -> None:
+        """Assumes a COPY of the pod (schedule_one.go:943 assumes
+        podInfo.DeepCopy()): the queued object stays pristine, so a failed
+        reserve/permit/bind never leaves a stale node_name pinning the pod
+        to the node it just failed on."""
         if pod.uid in self.pod_states:
             raise CacheError(f"pod {pod.key} already assumed/added")
-        pod.node_name = node_name
+        assumed = copy.copy(pod)
+        assumed.node_name = node_name
         cn = self.nodes.setdefault(node_name, CachedNode(node=None))
-        cn.add_pod(pod)
-        self.pod_states[pod.uid] = _PodState(pod)
+        cn.add_pod(assumed)
+        self.pod_states[pod.uid] = _PodState(assumed)
         self.assumed.add(pod.uid)
 
     def finish_binding(self, pod: Pod, now: Optional[float] = None) -> None:

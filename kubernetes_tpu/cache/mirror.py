@@ -71,10 +71,21 @@ class SnapshotMirror:
             if cn.generation <= self.generation:
                 continue
             i = self.nodes.name_to_idx[cn.node.name]
-            write_node_row(self.nodes, i, cn.node, self.vocab)
+            if not write_node_row(self.nodes, i, cn.node, self.vocab):
+                self._force_full = True  # slot axis truncated (taints/labels/…)
             self._write_usage_row(cn, i, lanes)
+            if self._force_full:
+                break  # overflow: everything below is repacked anyway
             dirty += 1
         self._row_updates += dirty
+
+        if self._force_full:
+            # A row write overflowed its slot capacity (e.g. host-port rows
+            # > U): the snapshot is missing entries RIGHT NOW, so repack at
+            # grown bucket sizes before this batch schedules against it.
+            self._force_full = False
+            self._full_pack(cache, namespace_labels)
+            return
 
         # id() is part of the key: update_pod replaces the stored object, so
         # label-only changes still trigger a placed-pod tensor rebuild.

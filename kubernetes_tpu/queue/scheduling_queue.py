@@ -29,14 +29,17 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from kubernetes_tpu.api.types import Pod
 from kubernetes_tpu.framework.interface import (
+    ActionType,
     ClusterEvent,
     ClusterEventWithHint,
+    EventResource,
     QueueingHint,
 )
 
 DEFAULT_POD_INITIAL_BACKOFF = 1.0
 DEFAULT_POD_MAX_BACKOFF = 10.0
 DEFAULT_UNSCHEDULABLE_TIMEOUT = 5 * 60.0
+DEFAULT_UNSCHEDULABLE_FLUSH_INTERVAL = 30.0  # scheduling_queue.go:356
 
 _seq = itertools.count()
 
@@ -85,8 +88,14 @@ class SchedulingQueue:
         self._unschedulable: Dict[str, QueuedPodInfo] = {}
         self._gated: Dict[str, QueuedPodInfo] = {}
         self._in_queue: Dict[str, str] = {}  # uid → which structure
+        # uid → the LIVE heap entry's sequence id.  Lazy heap deletion keys
+        # liveness on (location, entry id) so a pod re-entering the same heap
+        # never resurrects a stale earlier entry.
+        self._live: Dict[str, int] = {}
+        self._items: Dict[str, QueuedPodInfo] = {}  # uid → qp (O(1) lookup)
         # in-flight pods + events ledger (active_queue.go:74-126)
         self._in_flight: Dict[str, List[Tuple[ClusterEvent, Any, Any]]] = {}
+        self._last_unsched_flush = self.clock()
 
     # ----- ordering --------------------------------------------------------
 
@@ -101,14 +110,24 @@ class SchedulingQueue:
         return (-qp.pod.priority, qp.timestamp)
 
     def _push_active(self, qp: QueuedPodInfo) -> None:
-        heapq.heappush(self._active, (self._active_key(qp), next(_seq), qp))
+        eid = next(_seq)
+        heapq.heappush(self._active, (self._active_key(qp), eid, qp))
         self._in_queue[qp.uid] = "active"
+        self._live[qp.uid] = eid
+        self._items[qp.uid] = qp
 
     def _push_backoff(self, qp: QueuedPodInfo) -> None:
-        heapq.heappush(
-            self._backoff, (self._backoff_expiry(qp), next(_seq), qp)
-        )
+        eid = next(_seq)
+        heapq.heappush(self._backoff, (self._backoff_expiry(qp), eid, qp))
         self._in_queue[qp.uid] = "backoff"
+        self._live[qp.uid] = eid
+        self._items[qp.uid] = qp
+
+    def _entry_live(self, qp: QueuedPodInfo, eid: int, location: str) -> bool:
+        return (
+            self._in_queue.get(qp.uid) == location
+            and self._live.get(qp.uid) == eid
+        )
 
     def _backoff_expiry(self, qp: QueuedPodInfo) -> float:
         """Exponential: initial·2^(attempts-1), capped (scheduling_queue.go:1230)."""
@@ -130,6 +149,7 @@ class SchedulingQueue:
                 qp.unschedulable_plugins.add(getattr(status, "plugin", ""))
                 self._gated[pod.uid] = qp
                 self._in_queue[pod.uid] = "gated"
+                self._items[pod.uid] = qp
                 return
         self._push_active(qp)
 
@@ -137,8 +157,11 @@ class SchedulingQueue:
         where = self._in_queue.get(new.uid)
         if where is None:
             if new.uid in self._in_flight:
-                self._record_in_flight_event(
-                    ClusterEvent_from_pod_update(), old, new
+                # Record for replay at add_unschedulable; the live attempt
+                # keeps running on the spec the kernel evaluated — the new
+                # spec is adopted only at requeue time.
+                self._in_flight[new.uid].append(
+                    (ClusterEvent_from_pod_update(), old, new)
                 )
                 return
             self.add(new)
@@ -146,6 +169,7 @@ class SchedulingQueue:
         qp = self._find(new.uid)
         if qp is None:
             return
+        old_key = self._active_key(qp) if where == "active" else None
         qp.pod = new
         if where == "gated":
             # Re-run gating: removing the last gate activates the pod.
@@ -159,6 +183,13 @@ class SchedulingQueue:
             # Spec updates may make it schedulable (scheduling_queue.go update path).
             del self._unschedulable[new.uid]
             self._requeue(qp, immediately=False)
+        elif where == "active" and self._active_key(qp) != old_key:
+            # Re-push so a priority change reorders the heap; the old entry
+            # goes stale through its entry id.  Key-neutral updates skip the
+            # re-push so informer churn doesn't grow the heap.
+            self._push_active(qp)
+        # backoff ordering is by expiry, which no pod field affects — the
+        # in-place qp.pod update above suffices.
 
     def delete(self, pod: Pod) -> None:
         where = self._in_queue.pop(pod.uid, None)
@@ -170,6 +201,8 @@ class SchedulingQueue:
             # lazy deletion: heap entries are skipped when their uid is
             # no longer registered
             pass
+        self._live.pop(pod.uid, None)
+        self._items.pop(pod.uid, None)
         self._in_flight.pop(pod.uid, None)
 
     # ----- pop --------------------------------------------------------------
@@ -177,8 +210,8 @@ class SchedulingQueue:
     def _flush_backoff(self) -> None:
         now = self.clock()
         while self._backoff:
-            expiry, _, qp = self._backoff[0]
-            if self._in_queue.get(qp.uid) != "backoff":
+            expiry, eid, qp = self._backoff[0]
+            if not self._entry_live(qp, eid, "backoff"):
                 heapq.heappop(self._backoff)
                 continue
             if expiry > now:
@@ -202,13 +235,19 @@ class SchedulingQueue:
         Each popped pod enters the in-flight ledger; call done(uid) after
         its scheduling attempt concludes.
         """
+        now = self.clock()
+        if now - self._last_unsched_flush >= DEFAULT_UNSCHEDULABLE_FLUSH_INTERVAL:
+            self._last_unsched_flush = now
+            self.flush_unschedulable_leftover()
         self._flush_backoff()
         out: List[QueuedPodInfo] = []
         while len(out) < k and self._active:
-            _, _, qp = heapq.heappop(self._active)
-            if self._in_queue.get(qp.uid) != "active":
-                continue  # lazily-deleted entry
+            _, eid, qp = heapq.heappop(self._active)
+            if not self._entry_live(qp, eid, "active"):
+                continue  # lazily-deleted or superseded entry
             del self._in_queue[qp.uid]
+            self._live.pop(qp.uid, None)
+            self._items.pop(qp.uid, None)
             qp.attempts += 1
             self._in_flight[qp.uid] = []
             out.append(qp)
@@ -224,15 +263,31 @@ class SchedulingQueue:
         """AddUnschedulableIfNotPresent (:723): failed pod parks in the
         unschedulable map with the plugins that rejected it; events recorded
         while it was in flight are replayed first (done() semantics)."""
+        if qp.uid not in self._in_flight:
+            # The pod was deleted (or otherwise concluded) mid-attempt —
+            # re-parking it would resurrect a ghost no delete event will
+            # ever clear.
+            return
         qp.unschedulable_plugins = set(unschedulable_plugins or ())
         qp.last_failure_time = self.clock()
-        events = self._in_flight.pop(qp.uid, [])
+        events = self._in_flight.pop(qp.uid)
+        # Adopt the newest spec delivered mid-attempt (reference: the
+        # informer update lands in the queue's copy before requeue).
+        for ev, old, new in events:
+            if (
+                ev.resource == EventResource.UNSCHEDULED_POD
+                and ev.action & ActionType.UPDATE
+                and isinstance(new, Pod)
+                and new.uid == qp.uid
+            ):
+                qp.pod = new
         for ev, old, new in events:
             if self._is_worth_requeuing(qp, ev, old, new):
                 self._requeue(qp, immediately=False)
                 return
         self._unschedulable[qp.uid] = qp
         self._in_queue[qp.uid] = "unschedulable"
+        self._items[qp.uid] = qp
 
     def done(self, uid: str) -> None:
         """Pod's scheduling attempt fully concluded (bound or failed)."""
@@ -299,26 +354,21 @@ class SchedulingQueue:
     # ----- introspection ----------------------------------------------------
 
     def _find(self, uid: str) -> Optional[QueuedPodInfo]:
-        if uid in self._unschedulable:
-            return self._unschedulable[uid]
-        if uid in self._gated:
-            return self._gated[uid]
-        for _, _, qp in itertools.chain(self._active, self._backoff):
-            if qp.uid == uid and self._in_queue.get(uid) in ("active", "backoff"):
-                return qp
-        return None
+        if self._in_queue.get(uid) is None:
+            return None
+        return self._items.get(uid)
 
     def pending_pods(self) -> Dict[str, List[Pod]]:
         """PendingPods introspection (:1146)."""
         active = [
             qp.pod
-            for _, _, qp in self._active
-            if self._in_queue.get(qp.uid) == "active"
+            for _, eid, qp in self._active
+            if self._entry_live(qp, eid, "active")
         ]
         backoff = [
             qp.pod
-            for _, _, qp in self._backoff
-            if self._in_queue.get(qp.uid) == "backoff"
+            for _, eid, qp in self._backoff
+            if self._entry_live(qp, eid, "backoff")
         ]
         return {
             "active": active,

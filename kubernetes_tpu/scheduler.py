@@ -341,13 +341,15 @@ class Scheduler:
             self._handle_failure(qp, s)
             return ScheduleOutcome(pod, None, s, n_feas)
 
-        self.queue.done(pod.uid)
         s = fwk.run_bind(state, pod, node_name)
         if not s.ok:
+            # The in-flight ledger is still intact here, so events that
+            # arrived during the attempt replay through add_unschedulable.
             fwk.run_unreserve(state, pod, node_name)
             self.cache.forget_pod(pod)
             self._handle_failure(qp, s)
             return ScheduleOutcome(pod, None, s, n_feas)
+        self.queue.done(pod.uid)
         fwk.run_post_bind(state, pod, node_name)
         self.cache.finish_binding(pod)
         self.nominator.delete(pod)

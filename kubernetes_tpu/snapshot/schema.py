@@ -291,13 +291,32 @@ def pack_nodes(
     return nt
 
 
-def write_node_row(nt: NodeTensors, i: int, node: Node, vocab: Vocab) -> None:
-    """(Re)pack one node into row i — the incremental-update primitive."""
+def write_node_row(nt: NodeTensors, i: int, node: Node, vocab: Vocab) -> bool:
+    """(Re)pack one node into row i — the incremental-update primitive.
+
+    Returns False when any slot axis (labels, resource lanes, taints,
+    images) truncated the node's content: the caller must force a full
+    repack at grown bucket sizes before scheduling against the snapshot.
+    """
+    fits = True
     lanes = ResourceLanes(vocab)
-    nt.allocatable[i] = lanes.allocatable_row(node.allocatable, nt.allocatable.shape[1])
+    R = nt.allocatable.shape[1]
+    nt.allocatable[i] = lanes.allocatable_row(node.allocatable, R)
+    if lanes.n_lanes > R:  # after allocatable_row interned new scalars
+        fits = False
     nt.allowed_pods[i] = node.allocatable.allowed_pod_number or 110
     nt.label_vals[i] = _node_label_row(node, vocab, nt.k_cap)
+    if any(
+        vocab.intern_label(k, v)[0] >= nt.k_cap for k, v in node.labels.items()
+    ):
+        fits = False
+    if len(vocab.label_vals) > nt.val_ints.shape[0]:
+        # new label VALUE ids outrun the packed parsed-int table — Gt/Lt
+        # selector evaluation would read stale entries
+        fits = False
     T = nt.taint_key.shape[1]
+    if len(node.taints) > T:
+        fits = False
     nt.taint_key[i] = PAD
     nt.taint_val[i] = PAD
     nt.taint_effect[i] = PAD
@@ -313,6 +332,8 @@ def write_node_row(nt: NodeTensors, i: int, node: Node, vocab: Vocab) -> None:
         ii = vocab.images.intern(img)
         if ii < IMG:
             nt.img_sizes[i, ii] = size
+        else:
+            fits = False
     if i < len(nt.names):
         old = nt.names[i]
         if old in nt.name_to_idx and old != node.name:
@@ -323,6 +344,7 @@ def write_node_row(nt: NodeTensors, i: int, node: Node, vocab: Vocab) -> None:
             nt.names.append("")
         nt.names.append(node.name)
     nt.name_to_idx[node.name] = i
+    return fits
 
 
 # ---------------------------------------------------------------------------

@@ -131,3 +131,86 @@ def test_delete_removes_everywhere():
     q.delete(pod)
     assert q.pop() is None
     assert len(q) == 0
+
+
+def test_update_reorders_active_heap():
+    """A priority bump while active must reorder the heap (VERDICT weak #7)."""
+    q, _ = make_queue()
+    a = Pod(name="a", priority=0)
+    b = Pod(name="b", priority=10)
+    q.add(a)
+    q.add(b)
+    a2 = Pod(name="a", priority=100, uid=a.uid)
+    q.update(a, a2)
+    got = [qp.pod.name for qp in q.pop_batch(10)]
+    assert got == ["a", "b"]
+
+
+def test_stale_backoff_entry_not_resurrected():
+    """backoff → activate → fail → backoff again must honor the NEW backoff
+    window, not a stale earlier heap entry (ADVICE low #2)."""
+    q, clock = make_queue()
+    pod = Pod(name="p")
+    q.add(pod)
+    qp = q.pop()
+    qp.last_failure_time = clock.now
+    q._requeue(qp, immediately=False)  # attempt 1 → backoff expires at t=1
+    assert q.pending_pods()["backoff"]
+    q.activate([pod])  # force-activate: old backoff entry now stale
+    qp = q.pop()
+    assert qp is not None and qp.attempts == 2
+    qp.last_failure_time = clock.now
+    q._requeue(qp, immediately=False)  # attempt 2 → expires at t=2
+    clock.now = 1.5  # stale attempt-1 entry would have expired by now
+    assert q.pop() is None, "stale backoff entry resurrected the pod early"
+    clock.now = 2.1
+    assert q.pop() is not None
+
+
+def test_unschedulable_flush_driven_by_pop():
+    """pop_batch drives the 5-minute leftover flush without external timers."""
+    q, clock = make_queue()
+    q.add(Pod(name="p"))
+    qp = q.pop()
+    q.add_unschedulable(qp, {"X"})
+    clock.now += 301  # past unschedulable timeout AND flush interval
+    got = q.pop_batch(10)
+    assert [g.pod.name for g in got] == ["p"]
+
+
+def test_find_after_many_adds_is_indexed():
+    q, _ = make_queue()
+    pods = [Pod(name=f"p{i}") for i in range(100)]
+    for p in pods:
+        q.add(p)
+    assert q._find(pods[50].uid).pod is pods[50]
+    q.delete(pods[50])
+    assert q._find(pods[50].uid) is None
+
+
+def test_in_flight_update_recorded_and_adopted():
+    """A pod update arriving mid-attempt records a replayable event; the
+    LIVE attempt keeps the evaluated spec, and the requeue adopts the new
+    one."""
+    q, _ = make_queue()
+    pod = Pod(name="p")
+    q.add(pod)
+    qp = q.pop()  # in flight
+    new = Pod(name="p", uid=pod.uid, priority=7)
+    q.update(pod, new)
+    assert qp.pod is pod, "live attempt must keep the evaluated spec"
+    q.add_unschedulable(qp, set())
+    assert qp.pod is new, "requeue must adopt the newest spec"
+    # the UnscheduledPod/UPDATE event replays → requeued, not parked
+    assert not q.pending_pods()["unschedulable"]
+
+
+def test_deleted_in_flight_pod_not_resurrected():
+    """delete() during an attempt must win over a later add_unschedulable."""
+    q, _ = make_queue()
+    pod = Pod(name="p")
+    q.add(pod)
+    qp = q.pop()  # in flight
+    q.delete(pod)  # informer delete mid-attempt
+    q.add_unschedulable(qp, {"X"})  # attempt concludes with failure
+    assert len(q) == 0, "deleted pod resurrected as a ghost"
