@@ -143,7 +143,7 @@ def main():
         db = DeviceBatch.from_host(pb)
         td = time.perf_counter()
         t_pack += td - tp
-        chosen, _, final = run_batch(dc, db)
+        chosen, _, _, final = run_batch(dc, db)
         # Fetch only the [P] decisions — never any [P, N] working set.
         chosen = jax.device_get(chosen)
         dc = dataclasses.replace(

@@ -273,6 +273,7 @@ class Pod:
     phase: str = "Pending"
     nominated_node_name: str = ""
     deletion_timestamp: Optional[float] = None
+    start_time: Optional[float] = None  # status.startTime (preemption tie-break)
 
     def __post_init__(self):
         if not self.uid:
@@ -327,6 +328,28 @@ class Pod:
     @property
     def key(self) -> str:
         return f"{self.namespace}/{self.name}"
+
+
+# ---------------------------------------------------------------------------
+# PodDisruptionBudget (policy/v1; the scheduler only reads selector +
+# disruptionsAllowed — preemption.go filterPodsWithPDBViolation)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class PodDisruptionBudget:
+    name: str
+    namespace: str = "default"
+    selector: Optional[LabelSelector] = None
+    # status.disruptionsAllowed — how many more voluntary evictions the
+    # budget tolerates right now
+    disruptions_allowed: int = 0
+
+    def matches(self, pod: "Pod") -> bool:
+        if pod.namespace != self.namespace or self.selector is None:
+            return False
+        sel = k8slabels.selector_from_label_selector(self.selector)
+        return sel.matches(pod.labels)
 
 
 # ---------------------------------------------------------------------------

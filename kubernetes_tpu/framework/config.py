@@ -184,6 +184,31 @@ def default_plugins() -> Plugins:
     return p
 
 
+def _merge_plugin_set(default: PluginSet, custom: PluginSet) -> PluginSet:
+    """mergePluginSet (apis/config/v1/default_plugins.go:107): defaults
+    minus custom-disabled, with same-named custom entries replacing the
+    default IN PLACE (order preserved), then remaining custom appended."""
+    disabled_names = {d.name for d in custom.disabled}
+    custom_by_name = {e.name: (i, e) for i, e in enumerate(custom.enabled)}
+    replaced = set()
+    enabled: List[PluginRef] = []
+    if "*" not in disabled_names:
+        for d in default.enabled:
+            if d.name in disabled_names:
+                continue
+            hit = custom_by_name.get(d.name)
+            if hit is not None:
+                i, e = hit
+                enabled.append(e)
+                replaced.add(i)
+            else:
+                enabled.append(d)
+    enabled.extend(
+        e for i, e in enumerate(custom.enabled) if i not in replaced
+    )
+    return PluginSet(enabled=enabled, disabled=list(custom.disabled))
+
+
 def expand_profile(profile: Profile) -> Dict[str, List[PluginRef]]:
     """MultiPoint expansion + per-point enable/disable merge.
 
@@ -194,9 +219,11 @@ def expand_profile(profile: Profile) -> Dict[str, List[PluginRef]]:
     weight.
     """
     plugins = profile.plugins
-    mp = plugins.multi_point
-    if not mp.enabled and not mp.disabled:
-        mp = default_plugins().multi_point
+    # Defaults are merged before expansion (apis/config/v1
+    # default_plugins.go:107 mergePluginSet): user-enabled plugins override
+    # same-named defaults in place or append; disabled names (or '*') drop
+    # defaults.
+    mp = _merge_plugin_set(default_plugins().multi_point, plugins.multi_point)
     mp_disabled = {d.name for d in mp.disabled}
     mp_all_disabled = "*" in mp_disabled
 

@@ -90,8 +90,12 @@ class CycleState:
 
     def __init__(self) -> None:
         self._data: Dict[Any, Any] = {}
-        self.skip_filter_plugins: set[str] = set()
-        self.skip_score_plugins: set[str] = set()
+        # Per-pod skip sets: (pod_uid, plugin_name).  The reference's
+        # SkipFilterPlugins/SkipScorePlugins are per-cycle (= per-pod); one
+        # CycleState here serves a whole batch, so the pod uid is part of
+        # the key.
+        self.skip_filter_plugins: set[tuple[str, str]] = set()
+        self.skip_score_plugins: set[tuple[str, str]] = set()
 
     def write(self, key: Any, value: Any) -> None:
         self._data[key] = value
@@ -101,6 +105,12 @@ class CycleState:
 
     def delete(self, key: Any) -> None:
         self._data.pop(key, None)
+
+    def mark_skip_filter(self, pod_uid: str, plugin: str) -> None:
+        self.skip_filter_plugins.add((pod_uid, plugin))
+
+    def is_filter_skipped(self, pod_uid: str, plugin: str) -> bool:
+        return (pod_uid, plugin) in self.skip_filter_plugins
 
     def clone(self) -> "CycleState":
         cs = CycleState()
@@ -137,9 +147,10 @@ class QueueSortPlugin(Plugin):
 
 
 class PreFilterPlugin(Plugin):
-    def pre_filter(self, state: CycleState, pods: Sequence[Pod]) -> Status:
-        """Batched PreFilter; may return Status.skip() to disable the
-        coupled Filter for this cycle."""
+    def pre_filter(self, state: CycleState, pod: Pod) -> Status:
+        """Per-pod PreFilter (interface.go RunPreFilterPlugins semantics):
+        Status.skip() disables the coupled Filter for this pod;
+        unschedulable/unresolvable rejects the pod for the whole cycle."""
         return Status.success()
 
 

@@ -25,6 +25,7 @@ from kubernetes_tpu.framework.interface import (
     EventResource,
     FilterPlugin,
     Plugin,
+    PostFilterPlugin,
     PreEnqueuePlugin,
     PreFilterPlugin,
     QueueingHint,
@@ -32,6 +33,7 @@ from kubernetes_tpu.framework.interface import (
     ScorePlugin,
     Status,
 )
+from kubernetes_tpu.framework.preemption import Evaluator
 from kubernetes_tpu.oracle import filters as OF
 from kubernetes_tpu.oracle import scores as OS
 
@@ -297,9 +299,40 @@ class PodTopologySpread(DevicePluginMixin, FilterPlugin, ScorePlugin, EnqueueExt
         ]
 
 
+class DefaultPreemption(PostFilterPlugin, EnqueueExtensions):
+    """defaultpreemption/default_preemption.go — the PostFilter shim over
+    the preemption evaluator (framework/preemption.py)."""
+
+    name = "DefaultPreemption"
+
+    def __init__(self, args: Optional[dict] = None, handle=None):
+        super().__init__(args, handle)
+        a = self.args or {}
+        self.evaluator = Evaluator(
+            self.name,
+            handle,
+            percentage=a.get("minCandidateNodesPercentage", 10),
+            min_candidates=a.get("minCandidateNodesAbsolute", 100),
+        )
+
+    def post_filter(self, state, pod, filtered_node_status):
+        # filtered_node_status (the per-node Diagnosis) narrows candidates
+        # when available; the evaluator re-derives them otherwise.
+        return self.evaluator.preempt(pod)
+
+    def events_to_register(self):
+        # Victim deletion is what unblocks the nominated preemptor.
+        return [
+            ClusterEventWithHint(
+                ClusterEvent(EventResource.ASSIGNED_POD, ActionType.DELETE)
+            )
+        ]
+
+
 DEFAULT_PLUGINS = [
     PrioritySort,
     SchedulingGates,
+    DefaultPreemption,
     NodeName,
     NodeUnschedulable,
     TaintToleration,

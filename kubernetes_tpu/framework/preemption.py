@@ -1,0 +1,342 @@
+"""Preemption evaluator — the PostFilter dry-run machinery.
+
+Reimplements the reference's generic evaluator
+(/root/reference/pkg/scheduler/framework/preemption/preemption.go:148-212
+Preempt, :216 findCandidates, :431 pickOneNodeForPreemption) and the
+DefaultPreemption victim-selection semantics
+(plugins/defaultpreemption/default_preemption.go:140-229
+SelectVictimsOnNode, :239 PodEligibleToPreemptOthers):
+
+  * eligibility (preemptionPolicy=Never, terminating victim on the
+    nominated node);
+  * candidate discovery by dry-running victim removal per node —
+    remove ALL lower-priority pods, check fit, then reprieve victims
+    highest-priority-first (PDB-violating victims reprieved first);
+  * lexicographic candidate selection (fewest PDB violations → lowest
+    max victim priority → lowest priority sum → fewest victims →
+    latest earliest start time → first);
+  * preparation: evict victims (reject waiting pods, delete the rest)
+    and clear lower-priority nominations on the chosen node.
+
+The dry-run re-filter runs against the host OracleState (the golden
+semantics); the batched device path narrows candidates up front via
+kubernetes_tpu.ops.preemption so only plausibly-feasible nodes reach the
+scalar reprieve loop.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from kubernetes_tpu.api.types import Pod, PodDisruptionBudget
+from kubernetes_tpu.framework.interface import Status
+from kubernetes_tpu.oracle import filters as OF
+from kubernetes_tpu.oracle.state import NodeState, OracleState
+
+
+@dataclass
+class Victims:
+    """extenderv1.Victims analogue: pods ordered most-important-first."""
+
+    pods: List[Pod] = field(default_factory=list)
+    num_pdb_violations: int = 0
+
+
+@dataclass
+class Candidate:
+    name: str
+    victims: Victims
+
+
+def more_important(a: Pod, b: Pod) -> bool:
+    """util.MoreImportantPod: higher priority first; ties → earlier start."""
+    if a.priority != b.priority:
+        return a.priority > b.priority
+    sa = a.start_time if a.start_time is not None else float("inf")
+    sb = b.start_time if b.start_time is not None else float("inf")
+    return sa < sb
+
+
+def _importance_key(p: Pod):
+    return (-p.priority, p.start_time if p.start_time is not None else float("inf"))
+
+
+class Evaluator:
+    """framework/preemption.Evaluator. The handle provides oracle_state(),
+    nominator, delete_pod, list_pdbs, get_waiting_pod, activate."""
+
+    def __init__(
+        self,
+        plugin_name: str,
+        handle,
+        percentage: int = 10,
+        min_candidates: int = 100,
+    ):
+        self.plugin_name = plugin_name
+        self.handle = handle
+        self.percentage = percentage
+        self.min_candidates = min_candidates
+
+    # ----- entry point ------------------------------------------------------
+
+    def preempt(self, pod: Pod, potential_nodes: Optional[Sequence[str]] = None) -> Tuple[Optional[str], Status]:
+        """Returns (nominated_node_name, status).  nominated "" with an
+        unschedulable status means "clear any existing nomination"."""
+        state = self.handle.oracle_state()
+
+        ok, msg = self.pod_eligible(pod, state)
+        if not ok:
+            return None, Status.unschedulable(msg, plugin=self.plugin_name)
+
+        if potential_nodes is None:
+            potential_nodes = self.potential_nodes(pod, state)
+        if not potential_nodes:
+            # Preemption can't help anywhere: clear stale nomination.
+            return "", Status.unschedulable(
+                "preemption is not helpful for scheduling",
+                plugin=self.plugin_name,
+            )
+
+        offset, num = self.offset_and_num_candidates(len(potential_nodes))
+        pdbs = self.handle.list_pdbs()
+        candidates = self.dry_run(
+            pod, state, list(potential_nodes)[offset:], num, pdbs
+        )
+        if not candidates:
+            return "", Status.unschedulable(
+                "no preemption victims found for incoming pod",
+                plugin=self.plugin_name,
+            )
+
+        best = self.select_candidate(candidates)
+        self.prepare_candidate(pod, best)
+        return best.name, Status.success()
+
+    # ----- eligibility (default_preemption.go:239) --------------------------
+
+    def pod_eligible(self, pod: Pod, state: OracleState) -> Tuple[bool, str]:
+        if pod.preemption_policy == "Never":
+            return False, "not eligible due to preemptionPolicy=Never"
+        nom = pod.nominated_node_name
+        if nom:
+            ns = state.nodes.get(nom)
+            if ns is not None:
+                for p in ns.pods:
+                    if p.priority < pod.priority and p.deletion_timestamp is not None:
+                        return (
+                            False,
+                            "not eligible due to a terminating pod on the nominated node",
+                        )
+        return True, ""
+
+    # ----- candidate discovery ---------------------------------------------
+
+    def offset_and_num_candidates(self, n: int) -> Tuple[int, int]:
+        """GetOffsetAndNumCandidates (default_preemption.go): candidates =
+        max(n·percentage/100, minCandidates), capped at n.  Offset is 0 for
+        deterministic decisions (the reference randomizes to spread load)."""
+        num = max(n * self.percentage // 100, self.min_candidates)
+        return 0, min(num, n)
+
+    def potential_nodes(self, pod: Pod, state: OracleState) -> List[str]:
+        """Nodes where removing lower-priority pods COULD make the pod
+        schedulable: has victims, and passes every filter no pod removal can
+        fix (NodesForStatusCode(Unschedulable), preemption.go:216-230)."""
+        out = []
+        for name, ns in state.nodes.items():
+            if not any(p.priority < pod.priority for p in ns.pods):
+                continue
+            if OF.filter_node_name(pod, ns):
+                continue
+            if OF.filter_node_unschedulable(pod, ns):
+                continue
+            if OF.filter_taints(pod, ns):
+                continue
+            if OF.filter_node_affinity(pod, ns):
+                continue
+            out.append(name)
+        return out
+
+    def dry_run(
+        self,
+        pod: Pod,
+        state: OracleState,
+        nodes: Sequence[str],
+        num_candidates: int,
+        pdbs: Sequence[PodDisruptionBudget],
+    ) -> List[Candidate]:
+        """DryRunPreemption (preemption.go:548): stop once enough candidates
+        are found (the reference splits violating/non-violating pools; we
+        collect up to num_candidates in node order — deterministic)."""
+        candidates: List[Candidate] = []
+        for name in nodes:
+            victims = self.select_victims_on_node(pod, state, name, pdbs)
+            if victims is not None:
+                candidates.append(Candidate(name=name, victims=victims))
+                if len(candidates) >= num_candidates:
+                    break
+        return candidates
+
+    def select_victims_on_node(
+        self,
+        pod: Pod,
+        state: OracleState,
+        node_name: str,
+        pdbs: Sequence[PodDisruptionBudget],
+    ) -> Optional[Victims]:
+        """default_preemption.go:140 SelectVictimsOnNode on a working copy of
+        the node: remove all lower-priority pods, check fit, reprieve
+        highest-priority-first (violating victims first)."""
+        orig = state.nodes[node_name]
+        work = NodeState(node=orig.node)
+        potential: List[Pod] = []
+        for p in orig.pods:
+            if p.priority < pod.priority:
+                potential.append(p)
+            else:
+                work.add_pod(p)
+        if not potential:
+            return None
+
+        state.nodes[node_name] = work
+        try:
+            if not self._fits(pod, work, state):
+                return None
+            potential.sort(key=_importance_key)
+            violating, non_violating = self._split_pdb_violations(potential, pdbs)
+            victims: List[Pod] = []
+            num_violating = 0
+
+            def reprieve(v: Pod) -> bool:
+                work.add_pod(v)
+                if self._fits(pod, work, state):
+                    return True
+                work.remove_pod(v)
+                victims.append(v)
+                return False
+
+            for v in violating:
+                if not reprieve(v):
+                    num_violating += 1
+            for v in non_violating:
+                reprieve(v)
+            if not victims:
+                # Everyone reprieved — nothing to preempt here.
+                return None
+            victims.sort(key=_importance_key)
+            return Victims(pods=victims, num_pdb_violations=num_violating)
+        finally:
+            state.nodes[node_name] = orig
+
+    def _fits(self, pod: Pod, ns: NodeState, state: OracleState) -> bool:
+        """RunFilterPluginsWithNominatedPods for one node: all default
+        filters, with nominated pods of >= priority on this node counted
+        (runtime/framework.go:973)."""
+        nominated = [
+            np
+            for np in self.handle.nominator.pods_for_node(ns.node.name)
+            if np.priority >= pod.priority and np.uid != pod.uid
+        ]
+        for np in nominated:
+            ns.add_pod(np)
+        try:
+            if OF.filter_node_name(pod, ns):
+                return False
+            if OF.filter_node_unschedulable(pod, ns):
+                return False
+            if OF.filter_taints(pod, ns):
+                return False
+            if OF.filter_node_affinity(pod, ns):
+                return False
+            if OF.filter_node_ports(pod, ns):
+                return False
+            if OF.filter_node_resources(pod, ns):
+                return False
+            if OF.filter_interpod_affinity(pod, ns, state):
+                return False
+            counts = OF.spread_pair_counts(pod, state)
+            if OF.filter_topology_spread(pod, ns, state, counts):
+                return False
+            return True
+        finally:
+            for np in nominated:
+                ns.remove_pod(np)
+
+    def _split_pdb_violations(
+        self, victims: Sequence[Pod], pdbs: Sequence[PodDisruptionBudget]
+    ) -> Tuple[List[Pod], List[Pod]]:
+        """filterPodsWithPDBViolation (default_preemption.go:290): EVERY
+        matching PDB's budget is decremented per victim — violating victims
+        consume budgets too — and a victim violates when any matched budget
+        goes negative.  (status.disruptedPods dedup is not modeled.)"""
+        allowed = [p.disruptions_allowed for p in pdbs]
+        violating: List[Pod] = []
+        non_violating: List[Pod] = []
+        for v in victims:
+            is_violating = False
+            if v.labels:
+                for i, p in enumerate(pdbs):
+                    if not p.matches(v):
+                        continue
+                    allowed[i] -= 1
+                    if allowed[i] < 0:
+                        is_violating = True
+            (violating if is_violating else non_violating).append(v)
+        return violating, non_violating
+
+    # ----- candidate selection (preemption.go:431) --------------------------
+
+    def select_candidate(self, candidates: List[Candidate]) -> Candidate:
+        if len(candidates) == 1:
+            return candidates[0]
+
+        def highest_priority(c: Candidate) -> int:
+            return c.victims.pods[0].priority if c.victims.pods else -(2**31)
+
+        def sum_priorities(c: Candidate) -> int:
+            return sum(p.priority + 2**31 + 1 for p in c.victims.pods)
+
+        def earliest_start(c: Candidate) -> float:
+            starts = [
+                p.start_time if p.start_time is not None else float("-inf")
+                for p in c.victims.pods
+            ]
+            return min(starts) if starts else float("-inf")
+
+        pool = candidates
+        for key, reverse in (
+            (lambda c: c.victims.num_pdb_violations, False),
+            (highest_priority, False),
+            (sum_priorities, False),
+            (lambda c: len(c.victims.pods), False),
+            (earliest_start, True),  # LATEST earliest start wins
+        ):
+            vals = [key(c) for c in pool]
+            best = max(vals) if reverse else min(vals)
+            pool = [c for c, v in zip(pool, vals) if v == best]
+            if len(pool) == 1:
+                return pool[0]
+        return pool[0]
+
+    # ----- preparation (preemption.go:349 prepareCandidate) -----------------
+
+    def prepare_candidate(self, pod: Pod, c: Candidate) -> None:
+        for victim in c.victims.pods:
+            wp = self.handle.get_waiting_pod(victim.uid)
+            if wp is not None:
+                wp.reject("preempted")
+            else:
+                self.handle.delete_pod(victim)
+        # Lower-priority pods nominated here may no longer fit: clear their
+        # nominations and reactivate them.
+        demoted = [
+            np
+            for np in self.handle.nominator.pods_for_node(c.name)
+            if np.priority < pod.priority
+        ]
+        for np in demoted:
+            np.nominated_node_name = ""
+            self.handle.nominator.delete(np)
+        if demoted:
+            self.handle.activate(demoted)

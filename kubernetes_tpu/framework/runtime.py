@@ -130,24 +130,50 @@ class Framework:
                     return s
         return Status.success()
 
-    def run_pre_filter(self, state: CycleState, pods: Sequence[Pod]) -> Status:
-        for p in self._by_point.get("preFilter", []):
-            if isinstance(p, PreFilterPlugin):
-                s = p.pre_filter(state, pods)
+    def run_pre_filter(
+        self, state: CycleState, pods: Sequence[Pod]
+    ) -> Dict[str, Status]:
+        """RunPreFilterPlugins per pod (runtime/framework.go:698): returns
+        uid → rejecting Status for pods that must not reach Filter; Skip
+        marks the plugin's coupled Filter skipped for that pod only."""
+        failures: Dict[str, Status] = {}
+        plugins = [
+            p
+            for p in self._by_point.get("preFilter", [])
+            if isinstance(p, PreFilterPlugin)
+        ]
+        if not plugins:
+            return failures
+        for pod in pods:
+            for p in plugins:
+                s = p.pre_filter(state, pod)
                 if s.code == Code.SKIP:
-                    state.skip_filter_plugins.add(p.name)
+                    state.mark_skip_filter(pod.uid, p.name)
                 elif not s.ok:
-                    return s
-        return Status.success()
+                    if not s.plugin:
+                        s.plugin = p.name
+                    failures[pod.uid] = s
+                    break
+        return failures
 
     def run_host_filters(self, state: CycleState, pod: Pod, node_state) -> Status:
+        """Host-backed Filter plugins as a per-(pod, node) veto — the path
+        device kernels can't take (stateful plugins, runtime:861)."""
         for p in self.host_filter_plugins():
-            if p.name in state.skip_filter_plugins:
+            if state.is_filter_skipped(pod.uid, p.name):
                 continue
             s = p.filter(state, pod, node_state)
             if not s.ok:
+                if not s.plugin:
+                    s.plugin = p.name
                 return s
         return Status.success()
+
+    def has_host_filters(self) -> bool:
+        return bool(self.host_filter_plugins())
+
+    def has_post_filter(self) -> bool:
+        return bool(self._by_point.get("postFilter"))
 
     def run_reserve(self, state: CycleState, pod: Pod, node_name: str) -> Status:
         for p in self._by_point.get("reserve", []):
@@ -221,11 +247,19 @@ class Framework:
     def run_post_filter(
         self, state: CycleState, pod: Pod, filtered_node_status
     ) -> Tuple[Optional[str], Status]:
+        """RunPostFilterPlugins (runtime:908).  A plugin returning "" as the
+        nominated node signals "clear any stale nomination" even when the
+        status stays unschedulable (PostFilterResult.NominatingMode)."""
+        clear_seen = False
         for p in self._by_point.get("postFilter", []):
             if isinstance(p, PostFilterPlugin):
                 nominated, s = p.post_filter(state, pod, filtered_node_status)
                 if s.ok or s.code == Code.ERROR:
                     return nominated, s
+                if nominated == "":
+                    clear_seen = True
+        if clear_seen:
+            return "", Status.unschedulable("preemption is not helpful")
         return None, Status.unschedulable("no postFilter plugin made the pod schedulable")
 
     # ----- queueing-hint registration (eventhandlers.go:431) ---------------

@@ -91,6 +91,15 @@ class GangStatics(NamedTuple):
     sc_image: jnp.ndarray  # i64 [P, N]
     # batch port conflicts
     port_b: jnp.ndarray  # bool [P, J]
+    # per-kernel masks kept separate for failure diagnosis (FitError reason
+    # counts, framework/types.go:367-465).  All-True when a kernel is
+    # disabled so it is never blamed.
+    d_nodename: jnp.ndarray  # bool [P, N]
+    d_unsched: jnp.ndarray  # bool [P, N]
+    d_taints: jnp.ndarray  # bool [P, N]
+    d_nodeaff: jnp.ndarray  # bool [P, N]
+    d_ports: jnp.ndarray  # bool [P, N]
+    d_extra: jnp.ndarray  # bool [P, N] (host-filter veto mask)
 
 
 def precompute(
@@ -104,6 +113,7 @@ def precompute(
     has_ports: bool = True,
     has_images: bool = True,
     enabled: frozenset = F.ALL_FILTER_KERNELS,
+    extra_mask=None,
 ) -> GangStatics:
     """When a has_* flag is False the corresponding statics are built with a
     ZERO-width constraint axis; the scan step's reductions over that axis
@@ -115,17 +125,21 @@ def precompute(
     tolerated = F._tolerated(dc, db)
     node_affinity = F.mask_node_affinity(dc, db)
     taints = F.mask_taints(dc, db, tolerated)
-    static_mask = dc.node_valid[None, :] & db.valid[:, None]
-    if "NodeName" in enabled:
-        static_mask = static_mask & F.mask_node_name(dc, db)
-    if "NodeUnschedulable" in enabled:
-        static_mask = static_mask & F.mask_unschedulable(dc, db)
-    if "TaintToleration" in enabled:
-        static_mask = static_mask & taints
-    if "NodeAffinity" in enabled:
-        static_mask = static_mask & node_affinity
-    if "NodePorts" in enabled:
-        static_mask = static_mask & F.mask_ports(dc, db)
+    base = dc.node_valid[None, :] & db.valid[:, None]
+    true_pn = jnp.ones((P, N), bool)
+    # host-plugin vetoes (run_host_filters) fold in as a static [P, N]
+    # feasibility contribution
+    d_extra = extra_mask if extra_mask is not None else true_pn
+    d_nodename = F.mask_node_name(dc, db) if "NodeName" in enabled else true_pn
+    d_unsched = (
+        F.mask_unschedulable(dc, db) if "NodeUnschedulable" in enabled else true_pn
+    )
+    d_taints = taints if "TaintToleration" in enabled else true_pn
+    d_nodeaff = node_affinity if "NodeAffinity" in enabled else true_pn
+    d_ports = F.mask_ports(dc, db) if "NodePorts" in enabled else true_pn
+    static_mask = (
+        base & d_extra & d_nodename & d_unsched & d_taints & d_nodeaff & d_ports
+    )
     has_interpod = has_interpod and "InterPodAffinity" in enabled
     has_spread = has_spread and "PodTopologySpread" in enabled
 
@@ -278,6 +292,12 @@ def precompute(
         sc_nodeaff=S.score_node_affinity(dc, db),
         sc_image=sc_image,
         port_b=port_b,
+        d_nodename=d_nodename,
+        d_unsched=d_unsched,
+        d_taints=d_taints,
+        d_nodeaff=d_nodeaff,
+        d_ports=d_ports,
+        d_extra=d_extra,
     )
 
 
@@ -331,6 +351,19 @@ def _scatter_by_domain(values_j, dom_j, v_cap: int):
     return out.reshape(lead + (v_cap + 1,))
 
 
+# Diagnosis rows of the [P, N_DIAG] reason-count output, in chain order.
+DIAG_KERNELS = (
+    "NodeUnschedulable",
+    "NodeName",
+    "TaintToleration",
+    "NodeAffinity",
+    "NodePorts",
+    "HostFilters",
+    "NodeResourcesFit",
+    "PodTopologySpread",
+    "InterPodAffinity",
+)
+
 # Positional weight order for the gang scan's static `weights` tuple — the
 # single source of truth is scores.DEFAULT_SCORE_WEIGHTS.
 WEIGHT_ORDER = (
@@ -353,8 +386,18 @@ def gang_schedule(
     v_cap: int,
     weights: tuple = DEFAULT_WEIGHTS,
     check_fit: bool = True,
+    nom_node=None,
+    nom_prio=None,
+    nom_req=None,
 ):
     """Scan the batch in order; each pod sees all prior in-batch placements.
+
+    nom_* (optional [G] / [G, Rn] arrays) carry NOMINATED pods — preemptors
+    whose victims are still terminating.  Their resources are charged to
+    their nominated node for every pod of lower-or-equal... strictly lower
+    priority than the nominee (RunFilterPluginsWithNominatedPods,
+    runtime/framework.go:973: nominated pods with priority >= the evaluated
+    pod count as present).
 
     Returns (chosen [P] i32 node index or -1, n_feasible [P] i32).
     """
@@ -377,10 +420,23 @@ def gang_schedule(
         # ---------------- dynamic filters ----------------
         req = db.requests[p]  # [Rp]
         mask = g.static_mask[p]
+        true_n = jnp.ones((N,), bool)
+        m_fit = true_n
         if check_fit:
-            fits = state["num_pods"] + 1 <= dc.allowed_pods
+            nom_cnt = 0
+            nom_delta = 0
+            if nom_node is not None:
+                gate = nom_prio >= db.priority[p]  # [G]
+                seg = jnp.clip(nom_node, 0, N - 1)
+                nom_delta = jax.ops.segment_sum(
+                    jnp.where(gate[:, None], nom_req, 0), seg, num_segments=N
+                )  # [N, Rn]
+                nom_cnt = jax.ops.segment_sum(
+                    gate.astype(I32), seg, num_segments=N
+                )
+            fits = state["num_pods"] + nom_cnt + 1 <= dc.allowed_pods
             all_zero = jnp.all(req == 0)
-            avail = dc.allocatable - state["requested"]  # [N, Rn]
+            avail = dc.allocatable - state["requested"] - nom_delta  # [N, Rn]
             if Rp > Rn:
                 avail = jnp.concatenate(
                     [avail, jnp.zeros((N, Rp - Rn), I32)], axis=1
@@ -390,12 +446,15 @@ def gang_schedule(
             scalar_lane = jnp.arange(Rp) >= N_FIXED_LANES
             conflict = conflict & (~scalar_lane | (req > 0))[None, :]
             lane_ok = ~jnp.any(conflict, axis=1)
-            mask = mask & fits & (all_zero | lane_ok)
+            m_fit = fits & (all_zero | lane_ok)
+            mask = mask & m_fit
 
         av = assigned_valid[None, :]
+        m_portb = true_n
         if g.port_b.shape[1]:
             port_conf = jnp.any(g.port_b[p][:, None] & state["onehot"], axis=0)
-            mask = mask & ~port_conf
+            m_portb = ~port_conf
+            mask = mask & m_portb
 
         # ---------------- spread (hard) ----------------
         dv = g.sp_dv[p]  # [C, N]
@@ -424,7 +483,10 @@ def gang_schedule(
             c_ok = (dv >= 0) & (
                 ~g.sp_dom_pres[p] | (skew <= db.tsc_max_skew[p][:, None])
             )
-            mask = mask & jnp.all(~g.sp_hard[p][:, None] | c_ok, axis=0)
+            m_spread = jnp.all(~g.sp_hard[p][:, None] | c_ok, axis=0)
+            mask = mask & m_spread
+        else:
+            m_spread = true_n
 
         # ---------------- inter-pod (hard) ----------------
         if g.ip_dv.shape[1]:
@@ -468,14 +530,38 @@ def gang_schedule(
             viol_b = jnp.any(
                 (m_jp & g.ip_is_anti)[:, :, None] & eq, axis=(0, 1)
             )
-            mask = mask & ~g.ip_viol_existing[p] & ~viol2 & ok3 & ~viol_b
+            m_interpod = ~g.ip_viol_existing[p] & ~viol2 & ok3 & ~viol_b
+            mask = mask & m_interpod
         else:
+            m_interpod = true_n
             ip_total = g.ip_dom_cnt[p]
             topo_present = g.ip_dv[p] >= 0
             m_jp = g.ip_bmatch[:, :, p] & assigned_valid[:, None]
             eq = jnp.zeros((P, 0, N), bool)
         feas = mask
         n_feas = jnp.sum(feas.astype(I32))
+
+        # ---------------- failure diagnosis ----------------
+        # Per-kernel rejected-node counts with first-failure attribution in
+        # the reference's filter chain order (findNodesThatPassFilters
+        # early-exits per node; FitError aggregates counts per reason).
+        remaining = dc.node_valid & db.valid[p]
+        reason_counts = []
+        for comp in (
+            g.d_unsched[p],
+            g.d_nodename[p],
+            g.d_taints[p],
+            g.d_nodeaff[p],
+            g.d_ports[p] & m_portb,
+            g.d_extra[p],
+            m_fit,
+            m_spread,
+            m_interpod,
+        ):
+            rejected = remaining & ~comp
+            reason_counts.append(jnp.sum(rejected.astype(I32)))
+            remaining = remaining & comp
+        reason_counts = jnp.stack(reason_counts)  # [N_DIAG]
 
         # ---------------- scores ----------------
         # LeastAllocated on non-zero-defaulted requests
@@ -578,13 +664,15 @@ def gang_schedule(
             assigned=state["assigned"].at[p].set(choice),
             onehot=state["onehot"].at[p].set(onehot_n),
         )
-        return state, (choice, n_feas)
+        return state, (choice, n_feas, reason_counts)
 
-    state, (chosen, n_feas) = jax.lax.scan(step, init, jnp.arange(P, dtype=I32))
+    state, (chosen, n_feas, reason_counts) = jax.lax.scan(
+        step, init, jnp.arange(P, dtype=I32)
+    )
     # Final node tallies let the caller chain batches without a host round
     # trip: feed them back as the next DeviceCluster's requested/nonzero/
     # num_pods (the across-batch analogue of the assume cache).
-    return chosen, n_feas, {
+    return chosen, n_feas, reason_counts, {
         "requested": state["requested"],
         "nonzero": state["nonzero"],
         "num_pods": state["num_pods"],
@@ -616,6 +704,10 @@ def gang_run(
     has_images: bool = True,
     enabled: frozenset = F.ALL_FILTER_KERNELS,
     weights: tuple = DEFAULT_WEIGHTS,
+    extra_mask=None,
+    nom_node=None,
+    nom_prio=None,
+    nom_req=None,
 ):
     """Fused precompute + scan: ONE device dispatch per batch."""
     g = precompute(
@@ -629,6 +721,7 @@ def gang_run(
         has_ports=has_ports,
         has_images=has_images,
         enabled=enabled,
+        extra_mask=extra_mask,
     )
     return gang_schedule(
         dc,
@@ -637,6 +730,9 @@ def gang_run(
         v_cap,
         weights=weights,
         check_fit="NodeResourcesFit" in enabled,
+        nom_node=nom_node,
+        nom_prio=nom_prio,
+        nom_req=nom_req,
     )
 
 

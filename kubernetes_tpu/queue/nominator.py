@@ -44,5 +44,17 @@ class Nominator:
     def pods_for_node(self, node_name: str) -> List[Pod]:
         return list(self._by_node.get(node_name, {}).values())
 
+    def entries(self) -> List[tuple]:
+        """All (node_name, pod) nominations — the gang dispatch charges
+        these to their nodes for lower-priority pods."""
+        return [
+            (node, pod)
+            for node, pods in self._by_node.items()
+            for pod in pods.values()
+        ]
+
+    def __len__(self) -> int:
+        return len(self._node_of)
+
     def nominated_node(self, uid: str) -> Optional[str]:
         return self._node_of.get(uid)

@@ -51,6 +51,35 @@ class ScheduleOutcome:
     node: Optional[str]
     status: Status
     n_feasible: int = 0
+    # plugin name → count of nodes it rejected (Diagnosis.NodeToStatus
+    # aggregate, framework/types.go:367)
+    diagnosis: Optional[Dict[str, int]] = None
+
+
+# FitError reason strings keyed by diagnosis kernel (types.go:420-465 /
+# the per-plugin ErrReason constants).
+_DIAG_REASONS = {
+    "NodeUnschedulable": "node(s) were unschedulable",
+    "NodeName": "node(s) didn't match the requested node name",
+    "TaintToleration": "node(s) had untolerated taints",
+    "NodeAffinity": "node(s) didn't match Pod's node affinity/selector",
+    "NodePorts": "node(s) didn't have free ports for the requested pod ports",
+    "HostFilters": "node(s) were rejected by host filter plugins",
+    "NodeResourcesFit": "node(s) had insufficient resources",
+    "PodTopologySpread": "node(s) didn't match pod topology spread constraints",
+    "InterPodAffinity": "node(s) didn't satisfy inter-pod affinity/anti-affinity rules",
+}
+
+
+def fit_error_message(num_nodes: int, diagnosis: Dict[str, int]) -> str:
+    """FitError.Error() shape: '0/N nodes are available: <reasons>.'"""
+    if not diagnosis:
+        return f"0/{num_nodes} nodes are available"
+    parts = [
+        f"{c} {_DIAG_REASONS.get(k, k)}"
+        for k, c in sorted(diagnosis.items(), key=lambda kv: -kv[1])
+    ]
+    return f"0/{num_nodes} nodes are available: " + ", ".join(parts)
 
 
 class Handle:
@@ -69,6 +98,23 @@ class Handle:
     def nominator(self) -> Nominator:
         return self._s.nominator
 
+    def delete_pod(self, pod: Pod) -> None:
+        """Victim eviction — the preemption API write (preemption.go:380)."""
+        self._s.pod_deleter(pod)
+
+    def list_pdbs(self):
+        return self._s.pdb_lister()
+
+    def get_waiting_pod(self, uid: str):
+        for fwk in self._s.profiles.values():
+            wp = fwk.waiting_pods.get(uid)
+            if wp is not None:
+                return wp
+        return None
+
+    def activate(self, pods) -> None:
+        self._s.queue.activate(pods)
+
 
 class Scheduler:
     def __init__(
@@ -82,6 +128,9 @@ class Scheduler:
         self.config = configuration or cfg.SchedulerConfiguration()
         self.config.validate()
         self.binding_sink = binding_sink or (lambda pod, node: None)
+        self.pod_deleter = lambda pod: None  # victim eviction sink
+        self.pdb_lister = lambda: []
+        self.status_patcher = lambda pod: None  # pod status writes (nomination)
         self.namespace_labels = namespace_labels or {}
         self.clock = clock
 
@@ -101,15 +150,21 @@ class Scheduler:
             for name, evs in fwk.events_to_register().items():
                 hints.setdefault(name, []).extend(evs)
 
-        default_fwk = next(iter(self.profiles.values()))
+        def pre_enqueue(pod: Pod):
+            # PreEnqueue runs under the pod's OWN profile
+            # (schedule_one.go:376 frameworkForPod).
+            fwk = self.profiles.get(pod.scheduler_name)
+            return fwk.run_pre_enqueue(pod) if fwk is not None else None
+
         self.queue = SchedulingQueue(
             queueing_hints=hints,
-            pre_enqueue_check=default_fwk.run_pre_enqueue,
+            pre_enqueue_check=pre_enqueue,
             initial_backoff_s=self.config.pod_initial_backoff_seconds,
             max_backoff_s=self.config.pod_max_backoff_seconds,
             clock=clock,
         )
         self._dirty_pending = False
+        self._oracle_cache: Optional[OracleState] = None
         self.metrics: Dict[str, float] = {
             "schedule_attempts": 0,
             "scheduled": 0,
@@ -120,12 +175,14 @@ class Scheduler:
     # ----- event handlers (eventhandlers.go:345-428) ------------------------
 
     def on_node_add(self, node: Node) -> None:
+        self._invalidate_view()
         self.cache.add_node(node)
         self.queue.move_all_on_event(
             ClusterEvent(EventResource.NODE, ActionType.ADD), None, node
         )
 
     def on_node_update(self, old: Node, new: Node) -> None:
+        self._invalidate_view()
         self.cache.update_node(new)
         action = ActionType(0)
         if old.labels != new.labels:
@@ -144,12 +201,14 @@ class Scheduler:
             )
 
     def on_node_delete(self, node: Node) -> None:
+        self._invalidate_view()
         self.cache.remove_node(node.name)
         self.queue.move_all_on_event(
             ClusterEvent(EventResource.NODE, ActionType.DELETE), node, None
         )
 
     def on_pod_add(self, pod: Pod) -> None:
+        self._invalidate_view()
         if pod.node_name:
             self.cache.add_pod(pod)
             self.queue.move_all_on_event(
@@ -161,6 +220,7 @@ class Scheduler:
             self.queue.add(pod)
 
     def on_pod_update(self, old: Pod, new: Pod) -> None:
+        self._invalidate_view()
         if new.node_name:
             if old.node_name:
                 self.cache.update_pod(old, new)
@@ -177,6 +237,7 @@ class Scheduler:
             self.queue.update(old, new)
 
     def on_pod_delete(self, pod: Pod) -> None:
+        self._invalidate_view()
         if pod.node_name:
             self.cache.remove_pod(pod)
             self.queue.move_all_on_event(
@@ -193,15 +254,22 @@ class Scheduler:
 
     # ----- views ------------------------------------------------------------
 
+    def _invalidate_view(self) -> None:
+        self._oracle_cache = None
+
     def oracle_view(self) -> OracleState:
-        """Host-object view of the cache for host-backed plugins/oracle."""
-        st = OracleState(namespace_labels=self.namespace_labels)
-        for cn in self.cache.real_nodes():
-            ns = NodeState(node=cn.node)
-            for p in cn.pods.values():
-                ns.add_pod(p)
-            st.nodes[cn.node.name] = ns
-        return st
+        """Host-object view of the cache for host-backed plugins/oracle.
+        Cached until any cache mutation (informer event, assume/forget) —
+        a batch's PostFilter calls share one build."""
+        if self._oracle_cache is None:
+            st = OracleState(namespace_labels=self.namespace_labels)
+            for cn in self.cache.real_nodes():
+                ns = NodeState(node=cn.node)
+                for p in cn.pods.values():
+                    ns.add_pod(p)
+                st.nodes[cn.node.name] = ns
+            self._oracle_cache = st
+        return self._oracle_cache
 
     # ----- the scheduling loop ---------------------------------------------
 
@@ -213,17 +281,40 @@ class Scheduler:
             batch = self.queue.pop_batch(self.config.batch_size)
             if not batch:
                 break
-            outcomes.extend(self._schedule_batch(batch))
+            # Segregate by profile (schedule_one.go:376-382): each group
+            # runs ONE gang dispatch under its own framework's plugin set.
+            groups: Dict[str, list] = {}
+            for qp in batch:
+                groups.setdefault(qp.pod.scheduler_name, []).append(qp)
+            for group in groups.values():
+                outcomes.extend(self._schedule_batch(group))
             batches += 1
             if max_batches is not None and batches >= max_batches:
                 break
         return outcomes
 
     def _schedule_batch(self, batch) -> List[ScheduleOutcome]:
-        pods = [qp.pod for qp in batch]
         fwk = self.profiles.get(
-            pods[0].scheduler_name, next(iter(self.profiles.values()))
+            batch[0].pod.scheduler_name, next(iter(self.profiles.values()))
         )
+        outcomes: List[ScheduleOutcome] = []
+        state = CycleState()
+
+        # 0. PreFilter (runtime:698): per-pod rejection + Skip bookkeeping
+        pf_failures = fwk.run_pre_filter(state, [qp.pod for qp in batch])
+        if pf_failures:
+            live = []
+            for qp in batch:
+                s = pf_failures.get(qp.pod.uid)
+                if s is None:
+                    live.append(qp)
+                    continue
+                self.metrics["schedule_attempts"] += 1
+                outcomes.append(self._post_filter_or_fail(fwk, state, qp, s, 0))
+            batch = live
+            if not batch:
+                return outcomes
+        pods = [qp.pod for qp in batch]
 
         # 1. snapshot: incremental host-side pack + device upload
         self.mirror.update(self.cache, self.namespace_labels)
@@ -271,8 +362,22 @@ class Scheduler:
             )
         )
 
+        # 1b. host-backed Filter plugins veto (pod, node) pairs the device
+        # kernels can't judge (stateful plugins — volumebinding class).
+        extra_mask = None
+        if fwk.has_host_filters():
+            extra_mask = self._host_filter_mask(fwk, state, pods, p_cap)
+
+        # 1c. nominated preemptors (victims still terminating) charge their
+        # nominated node for pods of lower priority (runtime:973).
+        nom_node = nom_prio = nom_req = None
+        if len(self.nominator):
+            nom_node, nom_prio, nom_req = self._nominated_arrays(
+                {qp.pod.uid for qp in batch}
+            )
+
         # 2. one fused device dispatch (the whole Filter→Score→Select loop)
-        chosen, n_feas, _ = gang.gang_run(
+        chosen, n_feas, reason_counts, _ = gang.gang_run(
             dc,
             db,
             hostname_key,
@@ -283,25 +388,41 @@ class Scheduler:
             has_images=has_images,
             enabled=enabled,
             weights=weights,
+            extra_mask=extra_mask,
+            nom_node=nom_node,
+            nom_prio=nom_prio,
+            nom_req=nom_req,
         )
         chosen = jax.device_get(chosen)
         n_feas = jax.device_get(n_feas)
+        counts = None  # fetched lazily — only failures read it
 
         # 3. per-pod commit: assume → reserve → permit → bind
         node_names = self.mirror.nodes.names
-        outcomes = []
-        state = CycleState()
+        n_nodes = len(self.cache.real_nodes())
         for i, qp in enumerate(batch):
             pod = qp.pod
             self.metrics["schedule_attempts"] += 1
             idx = int(chosen[i])
             if idx < 0:
+                if counts is None:
+                    counts = jax.device_get(reason_counts)
+                diag = {
+                    k: int(c)
+                    for k, c in zip(gang.DIAG_KERNELS, counts[i])
+                    if c > 0
+                }
                 status = Status.unschedulable(
-                    "no nodes available" if int(n_feas[i]) == 0 else "filtered out"
+                    fit_error_message(n_nodes, diag)
                 )
-                self._handle_failure(qp, status)
+                plugins = set(diag)
+                if "HostFilters" in plugins:
+                    plugins.discard("HostFilters")
+                    plugins |= {p.name for p in fwk.host_filter_plugins()}
                 outcomes.append(
-                    ScheduleOutcome(pod, None, status, int(n_feas[i]))
+                    self._post_filter_or_fail(
+                        fwk, state, qp, status, int(n_feas[i]), diag, plugins
+                    )
                 )
                 continue
             node_name = node_names[idx]
@@ -309,9 +430,90 @@ class Scheduler:
             outcomes.append(outcome)
         return outcomes
 
+    def _nominated_arrays(self, exclude_uids):
+        """Pack nominations (minus this batch's own pods) into the gang
+        dispatch's nom_* arrays."""
+        import numpy as np
+
+        from kubernetes_tpu.snapshot.schema import ResourceLanes
+
+        lanes = ResourceLanes(self.mirror.vocab)
+        R = self.mirror.nodes.allocatable.shape[1]
+        rows = []
+        for node, pod in self.nominator.entries():
+            if pod.uid in exclude_uids:
+                continue
+            idx = self.mirror.nodes.name_to_idx.get(node)
+            if idx is None:
+                continue
+            rows.append((idx, pod.priority, lanes.request_row(pod.compute_requests(), R)))
+        if not rows:
+            return None, None, None
+        nom_node = jnp.asarray(np.array([r[0] for r in rows], dtype=np.int32))
+        nom_prio = jnp.asarray(np.array([r[1] for r in rows], dtype=np.int32))
+        nom_req = jnp.asarray(np.stack([r[2] for r in rows]))
+        return nom_node, nom_prio, nom_req
+
+    def _host_filter_mask(self, fwk, state, pods, p_cap: int):
+        """[p_cap, N] bool: True where host Filter plugins allow the pair
+        (the post-device-veto path of runtime:861 for host-backed plugins)."""
+        import numpy as np
+
+        nt = self.mirror.nodes
+        n_cap = nt.valid.shape[0]
+        mask = np.ones((p_cap, n_cap), dtype=bool)
+        st = self.oracle_view()
+        node_states = [
+            st.nodes.get(nt.names[j]) if j < len(nt.names) else None
+            for j in range(n_cap)
+        ]
+        for i, pod in enumerate(pods):
+            for j, ns in enumerate(node_states):
+                if ns is None or not nt.valid[j]:
+                    continue
+                if not fwk.run_host_filters(state, pod, ns).ok:
+                    mask[i, j] = False
+        return jnp.asarray(mask)
+
+    def _post_filter_or_fail(
+        self,
+        fwk,
+        state,
+        qp,
+        status: Status,
+        n_feas: int,
+        diagnosis: Optional[Dict[str, int]] = None,
+        plugins: Optional[set] = None,
+    ) -> ScheduleOutcome:
+        """Route a filter failure into PostFilter (preemption) when the
+        profile has one (schedule_one.go:135-180)."""
+        pod = qp.pod
+        if fwk.has_post_filter() and status.code == Code.UNSCHEDULABLE:
+            nominated, pf_status = fwk.run_post_filter(state, pod, None)
+            if nominated:
+                pod.nominated_node_name = nominated
+                self.nominator.add(pod, nominated)
+                self.status_patcher(pod)  # schedule_one.go:1117 PatchPodStatus
+            elif nominated == "" and pod.nominated_node_name:
+                pod.nominated_node_name = ""
+                self.nominator.delete(pod)
+                self.status_patcher(pod)
+        elif (
+            status.code == Code.UNSCHEDULABLE_AND_UNRESOLVABLE
+            and pod.nominated_node_name
+        ):
+            # Preemption can't resolve this class of failure — clear the
+            # stale nomination so it stops reserving capacity.
+            pod.nominated_node_name = ""
+            self.nominator.delete(pod)
+            self.status_patcher(pod)
+        self._handle_failure(qp, status, plugins)
+        return ScheduleOutcome(pod, None, status, n_feas, diagnosis)
+
     def _commit(self, fwk, state, qp, node_name: str, n_feas: int) -> ScheduleOutcome:
         """assume → reserve → permit → bind (schedulingCycle/bindingCycle)."""
         pod = qp.pod
+        self._invalidate_view()
         self.cache.assume_pod(pod, node_name)
 
         s = fwk.run_reserve(state, pod, node_name)
@@ -356,11 +558,14 @@ class Scheduler:
         self.metrics["scheduled"] += 1
         return ScheduleOutcome(pod, node_name, Status.success(), n_feas)
 
-    def _handle_failure(self, qp, status: Status) -> None:
-        """handleSchedulingFailure (schedule_one.go:1020)."""
+    def _handle_failure(self, qp, status: Status, plugins: Optional[set] = None) -> None:
+        """handleSchedulingFailure (schedule_one.go:1020).  ``plugins`` is
+        the rejecting-plugin set driving queueing-hint requeue; it defaults
+        to the status's single plugin."""
         if status.code == Code.ERROR:
             self.metrics["errors"] += 1
         else:
             self.metrics["unschedulable"] += 1
-        plugins = {status.plugin} if status.plugin else set()
+        if plugins is None:
+            plugins = {status.plugin} if status.plugin else set()
         self.queue.add_unschedulable(qp, plugins)

@@ -18,9 +18,11 @@ class FakeCluster:
     def __init__(self) -> None:
         self.nodes: Dict[str, Node] = {}
         self.pods: Dict[str, Pod] = {}
+        self.pdbs: Dict[str, object] = {}  # name → PodDisruptionBudget
         self._node_handlers: List[tuple] = []  # (add, update, delete)
         self._pod_handlers: List[tuple] = []
         self.bindings: Dict[str, str] = {}  # pod uid → node name
+        self.evictions: List[str] = []  # uids deleted via preemption
 
     # ----- watch registration ----------------------------------------------
 
@@ -98,6 +100,25 @@ class FakeCluster:
         for _, update, _ in self._pod_handlers:
             update(old, copy.deepcopy(stored))
 
+    # ----- pod status subresource -------------------------------------------
+
+    def patch_pod_status(self, pod: Pod) -> None:
+        """PATCH pods/{name}/status: the scheduler's nomination/condition
+        writes (util.PatchPodStatus)."""
+        stored = self.pods.get(pod.uid)
+        if stored is None:
+            return
+        old = copy.deepcopy(stored)
+        stored.nominated_node_name = pod.nominated_node_name
+        stored.phase = pod.phase
+        for _, update, _ in self._pod_handlers:
+            update(old, copy.deepcopy(stored))
+
+    # ----- PDBs -------------------------------------------------------------
+
+    def create_pdb(self, pdb) -> None:
+        self.pdbs[pdb.name] = pdb
+
     # ----- wiring -----------------------------------------------------------
 
     def connect(self, scheduler) -> None:
@@ -109,3 +130,11 @@ class FakeCluster:
             scheduler.on_pod_add, scheduler.on_pod_update, scheduler.on_pod_delete
         )
         scheduler.binding_sink = self.bind
+
+        def evict(pod):
+            self.evictions.append(pod.uid)
+            self.delete_pod(pod.uid)
+
+        scheduler.pod_deleter = evict
+        scheduler.pdb_lister = lambda: list(self.pdbs.values())
+        scheduler.status_patcher = self.patch_pod_status

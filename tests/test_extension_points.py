@@ -1,0 +1,215 @@
+"""Full extension-point path: PreFilter, host Filter veto, multi-profile.
+
+Covers the wiring the reference exercises in runtime/framework.go:698
+(RunPreFilterPlugins), :861 (filter chain incl. host-backed plugins), and
+schedule_one.go:376-382 (frameworkForPod / per-profile dispatch).
+"""
+
+from kubernetes_tpu.api.resource import Resource
+from kubernetes_tpu.api.types import Container, Node, Pod, Taint
+from kubernetes_tpu.framework import config as cfg
+from kubernetes_tpu.framework.interface import (
+    Code,
+    FilterPlugin,
+    PreFilterPlugin,
+    Status,
+)
+from kubernetes_tpu.framework.registry import default_registry
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.testing.fake_cluster import FakeCluster
+
+
+def _node(name, cpu="4", taints=()):
+    return Node(
+        name=name,
+        labels={"kubernetes.io/hostname": name},
+        capacity=Resource.from_map({"cpu": cpu, "memory": "16Gi", "pods": 50}),
+        taints=tuple(taints),
+    )
+
+
+def _pod(name, cpu="100m", scheduler_name=cfg.DEFAULT_SCHEDULER_NAME, labels=None):
+    return Pod(
+        name=name,
+        labels=labels or {},
+        scheduler_name=scheduler_name,
+        containers=[Container(name="c", requests={"cpu": cpu, "memory": "64Mi"})],
+    )
+
+
+class VetoNode(FilterPlugin):
+    """Host-backed Filter (no device kernel): vetoes one node by name."""
+
+    name = "VetoNode"
+    calls = 0
+
+    def filter(self, state, pod, node_state) -> Status:
+        VetoNode.calls += 1
+        if node_state.node.name == self.args.get("banned"):
+            return Status.unschedulable("node banned", plugin=self.name)
+        return Status.success()
+
+
+class RejectLabeled(PreFilterPlugin):
+    """PreFilter rejecting pods labeled reject=yes."""
+
+    name = "RejectLabeled"
+
+    def pre_filter(self, state, pod) -> Status:
+        if pod.labels.get("reject") == "yes":
+            return Status.unresolvable("rejected at prefilter", plugin=self.name)
+        return Status.success()
+
+
+class SkipAlways(PreFilterPlugin, FilterPlugin):
+    """PreFilter returns Skip → its own Filter must never run."""
+
+    name = "SkipAlways"
+    filter_calls = 0
+
+    def pre_filter(self, state, pod) -> Status:
+        return Status.skip()
+
+    def filter(self, state, pod, node_state) -> Status:
+        SkipAlways.filter_calls += 1
+        return Status.unschedulable("should have been skipped", plugin=self.name)
+
+
+def _registry_with(*plugin_classes):
+    reg = default_registry()
+    for c in plugin_classes:
+        reg.register(c.name, lambda args, handle, c=c: c(args=args, handle=handle))
+    return reg
+
+
+def _profile_with_extra(name, extra, points, plugin_args=None):
+    p = cfg.Profile(scheduler_name=name)
+    for point in points:
+        snake = cfg._SNAKE.get(point, point)
+        getattr(p.plugins, snake).enabled.append(cfg.PluginRef(extra))
+    if plugin_args:
+        p.plugin_config[extra] = plugin_args
+    return p
+
+
+def test_host_filter_vetoes_device_decision():
+    """A host-backed Filter plugin must be able to veto the node the device
+    kernels would have chosen."""
+    cluster = FakeCluster()
+    conf = cfg.SchedulerConfiguration(
+        profiles=[
+            _profile_with_extra(
+                cfg.DEFAULT_SCHEDULER_NAME,
+                "VetoNode",
+                ["filter"],
+                {"banned": "big"},
+            )
+        ]
+    )
+    sched = Scheduler(conf, registry=_registry_with(VetoNode))
+    cluster.connect(sched)
+    # "big" has far more free capacity → LeastAllocated would pick it
+    cluster.create_node(_node("big", cpu="64"))
+    cluster.create_node(_node("small", cpu="2"))
+    cluster.create_pod(_pod("p"))
+    out = sched.schedule_pending()
+    assert len(out) == 1 and out[0].node == "small", out
+
+
+def test_prefilter_rejects_pod_before_device():
+    cluster = FakeCluster()
+    conf = cfg.SchedulerConfiguration(
+        profiles=[
+            _profile_with_extra(
+                cfg.DEFAULT_SCHEDULER_NAME, "RejectLabeled", ["preFilter"]
+            )
+        ]
+    )
+    sched = Scheduler(conf, registry=_registry_with(RejectLabeled))
+    cluster.connect(sched)
+    cluster.create_node(_node("n1"))
+    cluster.create_pod(_pod("ok"))
+    cluster.create_pod(_pod("bad", labels={"reject": "yes"}))
+    out = {o.pod.name: o for o in sched.schedule_pending()}
+    assert out["ok"].node == "n1"
+    assert out["bad"].node is None
+    assert out["bad"].status.plugin == "RejectLabeled"
+    assert out["bad"].status.code == Code.UNSCHEDULABLE_AND_UNRESOLVABLE
+
+
+def test_prefilter_skip_disables_coupled_filter():
+    SkipAlways.filter_calls = 0
+    cluster = FakeCluster()
+    conf = cfg.SchedulerConfiguration(
+        profiles=[
+            _profile_with_extra(
+                cfg.DEFAULT_SCHEDULER_NAME, "SkipAlways", ["preFilter", "filter"]
+            )
+        ]
+    )
+    sched = Scheduler(conf, registry=_registry_with(SkipAlways))
+    cluster.connect(sched)
+    cluster.create_node(_node("n1"))
+    cluster.create_pod(_pod("p"))
+    out = sched.schedule_pending()
+    assert out[0].node == "n1"
+    assert SkipAlways.filter_calls == 0, "skipped Filter still ran"
+
+
+def test_two_profiles_in_one_batch_use_own_plugin_sets():
+    """Pods of different profiles popped in ONE batch must each run under
+    their own framework (schedule_one.go:376-382)."""
+    cluster = FakeCluster()
+    tolerant = cfg.Profile(scheduler_name="tolerant-scheduler")
+    tolerant.plugins.multi_point.disabled.append(cfg.PluginRef("TaintToleration"))
+    conf = cfg.SchedulerConfiguration(
+        profiles=[cfg.Profile(), tolerant]
+    )
+    sched = Scheduler(conf)
+    cluster.connect(sched)
+    # Only a tainted node exists: default-profile pods must park, the
+    # taint-blind profile's pods must bind.
+    cluster.create_node(
+        _node("t1", taints=[Taint(key="dedicated", value="x")])
+    )
+    cluster.create_pod(_pod("default-pod"))
+    cluster.create_pod(_pod("tolerant-pod", scheduler_name="tolerant-scheduler"))
+    out = {o.pod.name: o for o in sched.schedule_pending()}
+    assert out["tolerant-pod"].node == "t1"
+    assert out["default-pod"].node is None
+
+
+def test_multipoint_disabled_only_profile_keeps_defaults():
+    prof = cfg.Profile()
+    prof.plugins.multi_point.disabled.append(cfg.PluginRef("ImageLocality"))
+    expanded = cfg.expand_profile(prof)
+    score_names = [r.name for r in expanded["score"]]
+    assert "ImageLocality" not in score_names
+    assert "NodeResourcesFit" in score_names  # defaults survived
+
+
+def test_failure_diagnosis_reason_counts():
+    """FitError-style diagnosis: per-kernel rejected-node counts and the
+    rejecting-plugin set driving queueing hints (types.go:367-465)."""
+    from kubernetes_tpu.api.types import Taint
+
+    cluster = FakeCluster()
+    sched = Scheduler()
+    cluster.connect(sched)
+    cluster.create_node(_node("full", cpu="1"))
+    cluster.create_node(_node("tainted", taints=[Taint(key="k", value="v")]))
+    cluster.create_pod(_pod("filler", cpu="1"))
+    out1 = sched.schedule_pending()
+    assert out1[0].node == "full"
+    # now a pod that fits nowhere: full is out of cpu, tainted is tainted
+    cluster.create_pod(_pod("p", cpu="800m"))
+    out = [o for o in sched.schedule_pending() if o.pod.name == "p"]
+    assert out and out[0].node is None
+    d = out[0].diagnosis
+    assert d == {"TaintToleration": 1, "NodeResourcesFit": 1}, d
+    assert "1 node(s) had untolerated taints" in out[0].status.reasons[0]
+    assert "1 node(s) had insufficient resources" in out[0].status.reasons[0]
+    assert "0/2 nodes are available" in out[0].status.reasons[0]
+    # the parked pod's hint set is the rejecting plugins
+    qp = sched.queue._unschedulable[out[0].pod.uid]
+    assert qp.unschedulable_plugins == {"TaintToleration", "NodeResourcesFit"}

@@ -44,7 +44,7 @@ def run_gang(state, pending):
     v_cap = bucket_cap(len(vocab.label_vals))
     hostname_key = jnp.asarray(vocab.label_keys.lookup(HOSTNAME_LABEL), I32)
     g = gang.precompute(dc, db, hostname_key, v_cap)
-    chosen, n_feas, _ = gang.gang_schedule(dc, db, g, v_cap)
+    chosen, n_feas, _, _ = gang.gang_schedule(dc, db, g, v_cap)
     names = list(state.nodes)
     return [
         names[int(c)] if int(c) >= 0 else None

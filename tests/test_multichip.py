@@ -47,7 +47,7 @@ def problem():
 @pytest.fixture(scope="module")
 def single_device_decisions(problem):
     dc, db, hostname_key, v_cap = problem
-    chosen, n_feas, _ = gang.gang_run(dc, db, hostname_key, v_cap)
+    chosen, n_feas, _, _ = gang.gang_run(dc, db, hostname_key, v_cap)
     return jax.device_get(chosen), jax.device_get(n_feas)
 
 
@@ -57,7 +57,7 @@ def _run_on_mesh(problem, pods_axis):
     assert mesh.shape["pods"] == pods_axis
     dcs = place_cluster(mesh, dc)
     dbs = place_batch(mesh, db)
-    chosen, n_feas, _ = gang.gang_run(dcs, dbs, hostname_key, v_cap)
+    chosen, n_feas, _, _ = gang.gang_run(dcs, dbs, hostname_key, v_cap)
     return jax.device_get(chosen), jax.device_get(n_feas)
 
 
