@@ -1,13 +1,15 @@
-"""Benchmark: batched device scheduling throughput (pods/s).
+"""Benchmark: end-to-end scheduler throughput (pods/s).
 
-Shape mirrors the reference's scheduler_perf SchedulingBasic workload
-(5000 nodes / 10000 pods; CI floor 270 pods/s, BASELINE.md) — nodes are
-API objects only, pods carry plain resource requests, and the measured
-quantity is end-to-end scheduling decisions per second including host→device
-batch packing.
+Drives the FULL scheduler — queue, snapshot mirror, device dispatch (fast
+signature path or gang scan), assume/bind commit — on the BASELINE.json
+configs.  The headline metric mirrors the reference's scheduler_perf
+SchedulingBasic workload (5000 nodes / 10000 pods; CI floor 270 pods/s,
+performance-config.yaml:51); configs 2-4 are reported in the same JSON
+line under "configs".
 
 Prints exactly one JSON line:
-  {"metric": "...", "value": N, "unit": "pods/s", "vs_baseline": N}
+  {"metric": "...", "value": N, "unit": "pods/s", "vs_baseline": N,
+   "configs": {...}}
 """
 
 import json
@@ -19,155 +21,255 @@ import time
 import jax
 
 try:
-    # jax is preloaded at interpreter start here; config.update still works
-    # until the backend is first used.
     jax.config.update("jax_enable_x64", True)
 except Exception:
     pass
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-N_NODES = int(os.environ.get("BENCH_NODES", "5000"))
-N_PODS = int(os.environ.get("BENCH_PODS", "10000"))
-BATCH = int(os.environ.get("BENCH_BATCH", "512"))
 BASELINE_PODS_PER_S = 270.0  # performance-config.yaml:51 floor
 
 
-def make_basic_pod(rng: random.Random, i: int):
-    from kubernetes_tpu.api.types import Container, Pod
+def _mk_sched():
+    from kubernetes_tpu.scheduler import Scheduler
 
-    return Pod(
-        name=f"pod-{i}",
-        namespace="default",
-        labels={"app": f"app-{i % 10}"},
-        containers=[
-            Container(
-                name="c",
-                requests={
-                    "cpu": f"{rng.choice([100, 250, 500])}m",
-                    "memory": f"{rng.choice([128, 256, 512])}Mi",
-                },
-            )
-        ],
-    )
+    sched = Scheduler()
+    bindings = {}
+    sched.binding_sink = lambda pod, node: bindings.__setitem__(pod.uid, node)
+    return sched, bindings
 
 
-def main():
-    import dataclasses
+def _drain(sched):
+    t0 = time.perf_counter()
+    out = sched.schedule_pending()
+    dt = time.perf_counter() - t0
+    ok = sum(1 for o in out if o.node)
+    return ok, dt
 
+
+def _run_workload(nodes, pods):
+    """Warm compile caches on the first 64 pods, then time the rest."""
+    sched, _ = _mk_sched()
+    for n in nodes:
+        sched.on_node_add(n)
+    for p in pods[:64]:
+        sched.on_pod_add(p)
+    _drain(sched)
+    for p in pods[64:]:
+        sched.on_pod_add(p)
+    ok, dt = _drain(sched)
+    return ok, dt, sched
+
+
+def _basic_nodes(n, zones=3):
     from kubernetes_tpu.api.resource import Resource
     from kubernetes_tpu.api.types import Node
-    from kubernetes_tpu.oracle.scores import HOSTNAME_LABEL
-    from kubernetes_tpu.oracle.state import OracleState
-    from kubernetes_tpu.ops.common import DeviceBatch, DeviceCluster
-    from kubernetes_tpu.snapshot.cluster import pack_cluster
-    from kubernetes_tpu.snapshot.interner import Vocab
-    from kubernetes_tpu.snapshot.schema import bucket_cap, pack_pod_batch
 
-    import jax
-    import jax.numpy as jnp
-
-    rng = random.Random(42)
-    nodes = [
+    return [
         Node(
             name=f"node-{i}",
             labels={
-                "topology.kubernetes.io/zone": f"zone-{i % 3}",
-                HOSTNAME_LABEL: f"node-{i}",
+                "topology.kubernetes.io/zone": f"zone-{i % zones}",
+                "kubernetes.io/hostname": f"node-{i}",
             },
             capacity=Resource.from_map(
                 {"cpu": "8", "memory": "32Gi", "pods": 110}
             ),
         )
-        for i in range(N_NODES)
+        for i in range(n)
     ]
-    state = OracleState.build(nodes)
-    pods = [make_basic_pod(rng, i) for i in range(N_PODS)]
 
-    vocab = Vocab()
-    pc = pack_cluster(state, vocab, pending_pods=pods[:BATCH])
-    v_cap = bucket_cap(len(vocab.label_vals))
-    hostname_key = jnp.asarray(vocab.label_keys.lookup(HOSTNAME_LABEL), jnp.int32)
 
-    dc = DeviceCluster.from_host(pc.nodes, pc.existing, vocab)
+def bench_basic(n_nodes, n_pods):
+    """Config 1: SchedulingBasic — resource requests only."""
+    from kubernetes_tpu.api.types import Container, Pod
 
-    from kubernetes_tpu.ops import gang
-    from kubernetes_tpu.ops.pipeline import batch_feature_flags
+    rng = random.Random(42)
+    pods = [
+        Pod(
+            name=f"pod-{i}",
+            labels={"app": f"app-{i % 10}"},
+            containers=[
+                Container(
+                    name="c",
+                    requests={
+                        "cpu": f"{rng.choice([100, 250, 500])}m",
+                        "memory": f"{rng.choice([128, 256, 512])}Mi",
+                    },
+                )
+            ],
+        )
+        for i in range(n_pods)
+    ]
+    return _run_workload(_basic_nodes(n_nodes), pods)
 
-    # Warm up the compile cache with the steady-state shapes.  Flags are
-    # OR-ed over ALL chunks so a compile-time kernel skip can never disagree
-    # with later data.
-    pb0 = pack_pod_batch(pods[:BATCH], vocab, k_cap=pc.nodes.k_cap, p_cap=BATCH)
-    has_interpod = has_spread = has_images = has_ports = False
-    for start in range(0, N_PODS, BATCH):
-        pbx = (
-            pb0
-            if start == 0
-            else pack_pod_batch(
-                pods[start : start + BATCH],
-                vocab,
-                k_cap=pc.nodes.k_cap,
-                p_cap=BATCH,
+
+def bench_affinity_taints(n_nodes, n_pods):
+    """Config 2: NodeAffinity + TaintToleration predicate tensors."""
+    from kubernetes_tpu.api.types import (
+        Affinity,
+        Container,
+        NodeAffinity,
+        NodeSelector,
+        NodeSelectorRequirement,
+        NodeSelectorTerm,
+        Pod,
+        Taint,
+        Toleration,
+    )
+
+    rng = random.Random(7)
+    nodes = _basic_nodes(n_nodes)
+    for i, n in enumerate(nodes):
+        n.labels["tier"] = f"t{i % 4}"
+        if i % 5 == 0:
+            n.taints = (Taint(key="dedicated", value="infra"),)
+    pods = []
+    for i in range(n_pods):
+        tol = (
+            (Toleration(key="dedicated", operator="Equal", value="infra"),)
+            if i % 3 == 0
+            else ()
+        )
+        aff = Affinity(
+            node_affinity=NodeAffinity(
+                required_during_scheduling_ignored_during_execution=NodeSelector(
+                    (
+                        NodeSelectorTerm(
+                            match_expressions=(
+                                NodeSelectorRequirement(
+                                    "tier", "In", (f"t{i % 4}", f"t{(i + 1) % 4}")
+                                ),
+                            )
+                        ),
+                    )
+                )
             )
         )
-        hi, hs, hm, hp = batch_feature_flags(pc, pbx)
-        has_interpod |= hi
-        has_spread |= hs
-        has_images |= hm
-        has_ports |= hp
-    db0 = DeviceBatch.from_host(pb0)
-
-    def run_batch(dc, db):
-        return gang.gang_run(
-            dc,
-            db,
-            hostname_key,
-            v_cap,
-            has_interpod=has_interpod,
-            has_spread=has_spread,
-            has_ports=has_ports,
-            has_images=has_images,
+        pods.append(
+            Pod(
+                name=f"pod-{i}",
+                affinity=aff,
+                tolerations=tol,
+                containers=[
+                    Container(
+                        name="c",
+                        requests={
+                            "cpu": f"{rng.choice([100, 250])}m",
+                            "memory": "128Mi",
+                        },
+                    )
+                ],
+            )
         )
+    return _run_workload(nodes, pods)
 
-    run_batch(dc, db0)[0].block_until_ready()
 
-    # Timed run: gang-scheduled batches, sequential-equivalent within a
-    # batch; node tallies chain across batches device-side.
-    scheduled = 0
-    t_pack = t_dev = 0.0
-    t0 = time.perf_counter()
-    for start in range(0, N_PODS, BATCH):
-        chunk = pods[start : start + BATCH]
-        tp = time.perf_counter()
-        pb = pack_pod_batch(chunk, vocab, k_cap=pc.nodes.k_cap, p_cap=BATCH)
-        db = DeviceBatch.from_host(pb)
-        td = time.perf_counter()
-        t_pack += td - tp
-        chosen, _, _, final = run_batch(dc, db)
-        # Fetch only the [P] decisions — never any [P, N] working set.
-        chosen = jax.device_get(chosen)
-        dc = dataclasses.replace(
-            dc,
-            requested=final["requested"],
-            nonzero_req=final["nonzero"],
-            num_pods=final["num_pods"],
+def bench_interpod(n_nodes, n_pods):
+    """Config 3: InterPodAffinity/AntiAffinity (quadratic pod×pod term)."""
+    from kubernetes_tpu.api.types import (
+        Affinity,
+        Container,
+        LabelSelector,
+        Pod,
+        PodAffinityTerm,
+        PodAntiAffinity,
+    )
+
+    pods = []
+    for i in range(n_pods):
+        group = f"g{i % 50}"
+        anti = PodAntiAffinity(
+            required_during_scheduling_ignored_during_execution=(
+                PodAffinityTerm(
+                    topology_key="kubernetes.io/hostname",
+                    label_selector=LabelSelector(match_labels={"group": group}),
+                ),
+            )
         )
-        t_dev += time.perf_counter() - td
-        scheduled += int((chosen[: len(chunk)] >= 0).sum())
-    dt = time.perf_counter() - t0
+        pods.append(
+            Pod(
+                name=f"pod-{i}",
+                labels={"group": group},
+                affinity=Affinity(pod_anti_affinity=anti),
+                containers=[
+                    Container(name="c", requests={"cpu": "100m", "memory": "64Mi"})
+                ],
+            )
+        )
+    return _run_workload(_basic_nodes(n_nodes), pods)
+
+
+def bench_spread(n_nodes, n_pods):
+    """Config 4: PodTopologySpread maxSkew across zones."""
+    from kubernetes_tpu.api.types import (
+        Container,
+        LabelSelector,
+        Pod,
+        TopologySpreadConstraint,
+    )
+
+    pods = []
+    for i in range(n_pods):
+        app = f"a{i % 20}"
+        pods.append(
+            Pod(
+                name=f"pod-{i}",
+                labels={"app": app},
+                topology_spread_constraints=(
+                    TopologySpreadConstraint(
+                        max_skew=5,
+                        topology_key="topology.kubernetes.io/zone",
+                        when_unsatisfiable="DoNotSchedule",
+                        label_selector=LabelSelector(match_labels={"app": app}),
+                    ),
+                ),
+                containers=[
+                    Container(name="c", requests={"cpu": "100m", "memory": "64Mi"})
+                ],
+            )
+        )
+    return _run_workload(_basic_nodes(n_nodes, zones=8), pods)
+
+
+def main():
+    n_nodes = int(os.environ.get("BENCH_NODES", "5000"))
+    n_pods = int(os.environ.get("BENCH_PODS", "10000"))
+    full = os.environ.get("BENCH_FULL", "1") != "0"
+
+    ok1, dt1, s1 = bench_basic(n_nodes, n_pods)
+    v1 = ok1 / dt1
     print(
-        f"# pack={t_pack:.2f}s device+fetch={t_dev:.2f}s total={dt:.2f}s",
+        f"# config1 basic: {ok1} pods in {dt1:.2f}s "
+        f"(fast={s1.metrics['fast_batches']} scan={s1.metrics['scan_batches']})",
         file=sys.stderr,
     )
 
-    pods_per_s = scheduled / dt
+    configs = {}
+    if full:
+        ok2, dt2, s2 = bench_affinity_taints(1000, 10000)
+        configs["config2_affinity_taints_1000n_10000p"] = round(ok2 / dt2, 1)
+        print(
+            f"# config2 affinity+taints: {ok2} pods in {dt2:.2f}s "
+            f"(fast={s2.metrics['fast_batches']} scan={s2.metrics['scan_batches']})",
+            file=sys.stderr,
+        )
+        ok3, dt3, _ = bench_interpod(1000, 5000)
+        configs["config3_interpod_1000n_5000p"] = round(ok3 / dt3, 1)
+        print(f"# config3 interpod: {ok3} pods in {dt3:.2f}s", file=sys.stderr)
+        n4 = int(os.environ.get("BENCH_SPREAD_PODS", "50000"))
+        ok4, dt4, _ = bench_spread(5000, n4)
+        configs["config4_spread_5000n_50000p"] = round(ok4 / dt4, 1)
+        print(f"# config4 spread: {ok4} pods in {dt4:.2f}s", file=sys.stderr)
+
     print(
         json.dumps(
             {
-                "metric": f"scheduling_throughput_{N_NODES}nodes_{N_PODS}pods",
-                "value": round(pods_per_s, 1),
+                "metric": f"scheduling_throughput_{n_nodes}nodes_{n_pods}pods",
+                "value": round(v1, 1),
                 "unit": "pods/s",
-                "vs_baseline": round(pods_per_s / BASELINE_PODS_PER_S, 2),
+                "vs_baseline": round(v1 / BASELINE_PODS_PER_S, 2),
+                "configs": configs,
             }
         )
     )

@@ -285,7 +285,11 @@ class Pod:
         """Pod-level resource request (framework/types.go:926 calculateResource):
         sum of container requests, elementwise-max with each non-restartable
         init container, restartable (sidecar) inits added to the running sum,
-        plus pod overhead."""
+        plus pod overhead.  Memoized — callers must treat the result as
+        read-only (spec updates arrive as NEW Pod objects)."""
+        cached = self.__dict__.get("_req_memo")
+        if cached is not None:
+            return cached
         total = Resource()
         for c in self.containers:
             total.add(Resource.from_map(c.requests))
@@ -303,6 +307,7 @@ class Pod:
         total.max_with(init_max)
         if self.overhead:
             total.add(Resource.from_map(self.overhead))
+        self.__dict__["_req_memo"] = total
         return total
 
     def host_ports(self) -> List[ContainerPort]:

@@ -43,6 +43,10 @@ class CachedNode:
     requested: Resource = field(default_factory=Resource)
     non_zero_requested: Resource = field(default_factory=Resource)
     generation: int = 0
+    # bumped only when the Node OBJECT changes (labels/taints/capacity) —
+    # not on pod accounting; device caches keyed on this skip re-uploads
+    # for usage-only churn
+    static_generation: int = 0
 
     def add_pod(self, pod: Pod) -> None:
         req = pod.compute_requests()
@@ -82,18 +86,41 @@ class Cache:
         self.nodes: Dict[str, CachedNode] = {}
         self.pod_states: Dict[str, _PodState] = {}
         self.assumed: set[str] = set()
+        # O(1) feature counters + change version so consumers (device
+        # mirror, fast path) can gate expensive rebuilds without scans
+        self.pod_version = 0
+        self.n_term_pods = 0  # placed pods carrying (anti-)affinity terms
+        self.n_port_pods = 0  # placed pods using host ports
+
+    @staticmethod
+    def _pod_flags(pod: Pod) -> Tuple[bool, bool]:
+        has_terms = pod.affinity is not None and (
+            pod.affinity.pod_affinity is not None
+            or pod.affinity.pod_anti_affinity is not None
+        )
+        return has_terms, bool(pod.host_ports())
+
+    def _count_pod(self, pod: Pod, sign: int) -> None:
+        self.pod_version += 1
+        has_terms, has_ports = self._pod_flags(pod)
+        if has_terms:
+            self.n_term_pods += sign
+        if has_ports:
+            self.n_port_pods += sign
 
     # ----- nodes (informer) -----------------------------------------------
 
     def add_node(self, node: Node) -> None:
         cn = self.nodes.get(node.name)
         if cn is None:
+            g = next_generation()
             self.nodes[node.name] = CachedNode(
-                node=node, generation=next_generation()
+                node=node, generation=g, static_generation=g
             )
         else:
             cn.node = node
             cn.generation = next_generation()
+            cn.static_generation = cn.generation
 
     def update_node(self, node: Node) -> None:
         self.add_node(node)
@@ -123,6 +150,7 @@ class Cache:
         assumed.node_name = node_name
         cn = self.nodes.setdefault(node_name, CachedNode(node=None))
         cn.add_pod(assumed)
+        self._count_pod(assumed, +1)
         self.pod_states[pod.uid] = _PodState(assumed)
         self.assumed.add(pod.uid)
 
@@ -172,6 +200,7 @@ class Cache:
             else:
                 # Same node: adopt the API object (it is the truth).
                 self.nodes[pod.node_name].pods[pod.uid] = pod
+                self.pod_version += 1
             # Confirmed: no longer assumed.
             self.assumed.discard(pod.uid)
             ps.pod = pod
@@ -207,11 +236,13 @@ class Cache:
     def _add_pod_internal(self, pod: Pod) -> None:
         cn = self.nodes.setdefault(pod.node_name, CachedNode(node=None))
         cn.add_pod(pod)
+        self._count_pod(pod, +1)
 
     def _remove_pod_internal(self, pod: Pod) -> None:
         cn = self.nodes.get(pod.node_name)
         if cn is None or not cn.remove_pod(pod):
             raise CacheError(f"pod {pod.key} not found on node {pod.node_name!r}")
+        self._count_pod(pod, -1)
 
     # ----- introspection ----------------------------------------------------
 

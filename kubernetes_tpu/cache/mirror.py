@@ -24,11 +24,12 @@ import numpy as np
 
 from kubernetes_tpu.cache.cache import Cache
 from kubernetes_tpu.snapshot.cluster import accumulate_node_usage
-from kubernetes_tpu.snapshot.interner import Vocab
+from kubernetes_tpu.snapshot.interner import PAD, Vocab
 from kubernetes_tpu.snapshot.schema import (
     MEM_UNIT,
     NodeTensors,
     ResourceLanes,
+    append_existing_pods,
     bucket_cap,
     pack_existing_pods,
     pack_nodes,
@@ -40,24 +41,114 @@ class SnapshotMirror:
     def __init__(self, vocab: Optional[Vocab] = None):
         self.vocab = vocab or Vocab()
         self.generation = 0
+        self.static_generation = 0  # max CachedNode.static_generation seen
         self.nodes: Optional[NodeTensors] = None
-        self.existing = None
-        self._pod_population: tuple = ()
+        self._existing = None
+        self._existing_version = -1  # cache.pod_version it was built at
         self._full_packs = 0
         self._row_updates = 0
         self._force_full = False
+        self._cache = None  # last cache seen (lazy existing rebuild)
+        self._ns_labels = None
+        self._epod_slots = None  # uid → (slot, id(pod)) in _existing
+        self._eterm_count = 0
+        # expected total placed pods (queue pressure) — pre-sizes the E/M
+        # axes so the gang pipeline compiles ONCE instead of per doubling
+        self.e_cap_hint = 0
+
+    @property
+    def existing(self):
+        """Placed-pod tensors, materialized LAZILY: only the quadratic
+        (inter-pod) kernels read them, so resource-only batches never pay
+        the O(all placed pods) repack.  Pure additions (the steady state
+        between full packs) APPEND rows in place instead of rebuilding."""
+        if (
+            self._cache is not None
+            and self._existing_version != self._cache.pod_version
+        ):
+            self._rebuild_existing()
+        return self._existing
+
+    def _rebuild_existing(self) -> None:
+        placed = self._cache.placed_pods()
+        slots = self._epod_slots
+        if (
+            self._existing is not None
+            and slots is not None
+            # a raised capacity hint forces one rebuild at the final shape
+            # instead of a recompile per doubling
+            and self._existing.node_idx.shape[0] >= self._e_cap(len(placed))
+        ):
+            cur = {p.uid: p for p in placed}
+            if len(cur) >= len(slots) and all(
+                id(cur.get(uid)) == oid for uid, (_, oid) in slots.items()
+            ):
+                new = [p for p in placed if p.uid not in slots]
+                n_terms = append_existing_pods(
+                    self._existing,
+                    new,
+                    len(slots),
+                    self._eterm_count,
+                    self.nodes.name_to_idx,
+                    self.vocab,
+                    self._ns_labels,
+                )
+                if n_terms is not None:
+                    base = len(slots)
+                    for i, p in enumerate(new):
+                        slots[p.uid] = (base + i, id(p))
+                    self._eterm_count = n_terms
+                    self._existing_version = self._cache.pod_version
+                    return
+        for p in placed:
+            for k, v in p.labels.items():
+                self.vocab.intern_label(k, v)
+            self.vocab.namespaces.intern(p.namespace)
+        self._existing = pack_existing_pods(
+            placed,
+            self.nodes.name_to_idx,
+            self.vocab,
+            e_cap=self._e_cap(len(placed)),
+            k_cap=self.nodes.k_cap,
+            namespace_labels=self._ns_labels,
+            m_cap=self._m_cap_for(placed),
+        )
+        self._epod_slots = {p.uid: (i, id(p)) for i, p in enumerate(placed)}
+        self._eterm_count = int((self._existing.term_kind != PAD).sum())
+        self._existing_version = self._cache.pod_version
+
+
+    def _e_cap(self, n_placed: int) -> int:
+        return bucket_cap(max(self.e_cap_hint, n_placed))
+
+    def _m_cap_for(self, placed) -> int:
+        # scale expected term rows by the same growth ratio as pods
+        n = max(len(placed), 1)
+        n_terms = sum(
+            1
+            for p in placed
+            if p.affinity is not None
+            and (p.affinity.pod_affinity or p.affinity.pod_anti_affinity)
+        )
+        # upper-bound terms/pod at observed density (x4 slack for multi-term)
+        est = self._e_cap(len(placed)) * (n_terms * 4) // n
+        return bucket_cap(max(est, 1), 1)
 
     def update(self, cache: Cache, namespace_labels=None) -> None:
         """Bring the mirror up to date with the cache (incremental)."""
+        self._cache = cache
+        self._ns_labels = namespace_labels
         real = cache.real_nodes()
         names = [cn.node.name for cn in real]
-        placed = cache.placed_pods()
 
         need_full = (
             self._force_full
             or self.nodes is None
             or len(real) > self.nodes.n_cap
             or bucket_cap(len(self.vocab.label_keys)) > self.nodes.k_cap
+            # new label VALUES (e.g. from pending pods) outran the packed
+            # parsed-int table — Gt/Lt selector eval would read stale rows
+            or len(self.vocab.label_vals) > self.nodes.val_ints.shape[0]
             or set(names) != set(self.nodes.name_to_idx)
         )
         if need_full:
@@ -71,8 +162,10 @@ class SnapshotMirror:
             if cn.generation <= self.generation:
                 continue
             i = self.nodes.name_to_idx[cn.node.name]
-            if not write_node_row(self.nodes, i, cn.node, self.vocab):
-                self._force_full = True  # slot axis truncated (taints/labels/…)
+            if cn.static_generation > self.static_generation:
+                # node OBJECT changed — rewrite the static row too
+                if not write_node_row(self.nodes, i, cn.node, self.vocab):
+                    self._force_full = True  # slot axis truncated
             self._write_usage_row(cn, i, lanes)
             if self._force_full:
                 break  # overflow: everything below is repacked anyway
@@ -87,23 +180,15 @@ class SnapshotMirror:
             self._full_pack(cache, namespace_labels)
             return
 
-        # id() is part of the key: update_pod replaces the stored object, so
-        # label-only changes still trigger a placed-pod tensor rebuild.
-        population = tuple(sorted((p.uid, id(p)) for p in placed))
-        if population != self._pod_population:
-            # Pod set changed: rebuild placed-pod tensors (+ per-node usage
-            # accounting rows were already updated above via generations).
-            self.existing = pack_existing_pods(
-                placed,
-                self.nodes.name_to_idx,
-                self.vocab,
-                k_cap=self.nodes.k_cap,
-                namespace_labels=namespace_labels,
-            )
-            self._pod_population = population
+        # Placed-pod tensors rebuild lazily via the `existing` property —
+        # cache.pod_version (bumped on every pod add/remove/replace) is the
+        # staleness signal.
 
         self.generation = max(
             (cn.generation for cn in real), default=self.generation
+        )
+        self.static_generation = max(
+            (cn.static_generation for cn in real), default=self.static_generation
         )
 
     def _write_usage_row(self, cn, i: int, lanes: ResourceLanes) -> None:
@@ -141,15 +226,22 @@ class SnapshotMirror:
             self.vocab.namespaces.intern(p.namespace)
         self.nodes = pack_nodes([cn.node for cn in real], self.vocab)
         accumulate_node_usage(self.nodes, placed, self.vocab)
-        self.existing = pack_existing_pods(
+        self._existing = pack_existing_pods(
             placed,
             self.nodes.name_to_idx,
             self.vocab,
+            e_cap=self._e_cap(len(placed)),
             k_cap=self.nodes.k_cap,
             namespace_labels=namespace_labels,
+            m_cap=self._m_cap_for(placed),
         )
-        self._pod_population = tuple(sorted((p.uid, id(p)) for p in placed))
+        self._existing_version = cache.pod_version
+        self._epod_slots = {p.uid: (i, id(p)) for i, p in enumerate(placed)}
+        self._eterm_count = int((self._existing.term_kind != PAD).sum())
         self.generation = max((cn.generation for cn in real), default=0)
+        self.static_generation = max(
+            (cn.static_generation for cn in real), default=0
+        )
         self._full_packs += 1
 
     def stats(self) -> Dict[str, int]:

@@ -1,0 +1,298 @@
+"""Host half of the fast commit path: exact sequential-equivalent greedy.
+
+Given per-signature static scores/masks from ops.fastpath.static_eval,
+replays the reference's one-pod-at-a-time argmax commit
+(schedule_one.go:65 ScheduleOne → selectHost first-max policy) in pure
+integer arithmetic IDENTICAL to the gang kernels' formulas (ops/gang.py
+scan step: LeastAllocated, BalancedAllocation, resource-fit, pod-count),
+so decisions bit-match the scan — property-tested in tests/test_fastpath.py.
+
+Data structure: one lazy heap per signature keyed (-score, node).  A commit
+touches exactly one node; fresh entries for that node are pushed into every
+ACTIVE signature heap, and stale entries are re-validated on pop (the key
+is recomputed; mismatches are re-pushed).  Resource infeasibility is
+monotone within a batch (usage only grows), so infeasible pops are dropped
+permanently.  Per-pod cost is O(active_signatures · log N) host work with
+no device round-trips.
+"""
+
+from __future__ import annotations
+
+import heapq
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from kubernetes_tpu.api.types import Pod
+from kubernetes_tpu.snapshot.schema import (
+    LANE_CPU,
+    LANE_MEM,
+    MEM_UNIT,
+    N_FIXED_LANES,
+    NodeTensors,
+    ResourceLanes,
+)
+
+MAX = 100  # MaxNodeScore
+
+
+def signature_key(pod: Pod, lanes: ResourceLanes, n_lanes: int):
+    """Hashable identity of everything that affects a pod's row in the
+    resource-only pipeline; None when the pod is not fast-path eligible
+    (spread / inter-pod terms / host ports / preset node / nomination)."""
+    if pod.topology_spread_constraints:
+        return None
+    if pod.affinity is not None and (
+        pod.affinity.pod_affinity is not None
+        or pod.affinity.pod_anti_affinity is not None
+    ):
+        return None
+    if pod.host_ports() or pod.nominated_node_name:
+        return None
+    req = pod.compute_requests()
+    row = tuple(int(x) for x in lanes.request_row(req, n_lanes))
+    nz = req.non_zero_defaulted()
+    node_aff = pod.affinity.node_affinity if pod.affinity is not None else None
+    return (
+        row,
+        (nz.milli_cpu, -(-nz.memory // MEM_UNIT)),
+        pod.tolerations,
+        tuple(sorted(pod.node_selector.items())),
+        node_aff,
+        pod.images,
+        pod.node_name,
+    )
+
+
+@dataclass
+class Signature:
+    req_row: Tuple[int, ...]
+    nz0: int
+    nz1: int
+    all_zero: bool
+    static_ok: np.ndarray  # bool [N]
+    img: Optional[List[int]] = None  # i64 per node, None when unused
+    remaining: int = 0  # pods of this signature still unplaced
+    heap: Optional[list] = None
+    # last KNOWN true score per node — the lazy-heap invariant is "heap
+    # keys are never stale-LOW", so a commit only needs a fresh push when
+    # the node's score INCREASED (balanced-allocation can go up)
+    known: Optional[List[int]] = None
+
+
+class FastCommitter:
+    """One batch's sequential greedy over host state (numpy mirror copy)."""
+
+    def __init__(
+        self,
+        nodes: NodeTensors,
+        weights: Tuple[int, ...],
+        check_fit: bool = True,
+    ):
+        # weights in gang.WEIGHT_ORDER
+        (
+            self.w_taint,
+            self.w_naff,
+            self.w_spread,
+            self.w_ip,
+            self.w_fit,
+            self.w_bal,
+            self.w_img,
+        ) = weights
+        self.check_fit = check_fit
+        n = nodes.valid.shape[0]
+        self.n = n
+        self.rn = nodes.allocatable.shape[1]
+        # python-int state columns (hot loop avoids numpy scalar overhead)
+        self.alloc_rows = nodes.allocatable.tolist()
+        self.used_rows = [list(r) for r in nodes.requested.tolist()]
+        self.alloc0 = [r[LANE_CPU] for r in self.alloc_rows]
+        self.alloc1 = [r[LANE_MEM] for r in self.alloc_rows]
+        self.nz0 = [int(x) for x in nodes.nonzero_req[:, 0]]
+        self.nz1 = [int(x) for x in nodes.nonzero_req[:, 1]]
+        self.num_pods = [int(x) for x in nodes.num_pods.tolist()]
+        self.allowed = [int(x) for x in nodes.allowed_pods.tolist()]
+        self.touched: set = set()
+
+    # ----- integer score/feasibility — MUST match ops/gang.py scan step -----
+
+    def score_int(self, n: int, sig: Signature) -> int:
+        a0 = self.alloc0[n]
+        a1 = self.alloc1[n]
+        total = 0
+        if self.w_fit:
+            s = 0
+            w = 0
+            if a0 > 0:
+                nz = self.nz0[n] + sig.nz0
+                s += 0 if nz > a0 else (a0 - nz) * MAX // a0
+                w += 1
+            if a1 > 0:
+                nz = self.nz1[n] + sig.nz1
+                s += 0 if nz > a1 else (a1 - nz) * MAX // a1
+                w += 1
+            total += self.w_fit * (s // w if w else 0)
+        if self.w_bal:
+            if a0 > 0 and a1 > 0:
+                r0 = self.used_rows[n][LANE_CPU] + sig.req_row[LANE_CPU]
+                r1 = self.used_rows[n][LANE_MEM] + sig.req_row[LANE_MEM]
+                if r0 > a0:
+                    r0 = a0
+                if r1 > a1:
+                    r1 = a1
+                d = r0 * a1 - r1 * a0
+                if d < 0:
+                    d = -d
+                den = a0 * a1
+                bal = MAX - (50 * d + den - 1) // den
+            else:
+                bal = MAX
+            total += self.w_bal * bal
+        if self.w_img and sig.img is not None:
+            total += self.w_img * sig.img[n]
+        return total
+
+    def feasible_int(self, n: int, sig: Signature) -> bool:
+        if not self.check_fit:
+            return True
+        if self.num_pods[n] + 1 > self.allowed[n]:
+            return False
+        if sig.all_zero:
+            return True
+        used = self.used_rows[n]
+        alloc = self.alloc_rows[n]
+        rn = self.rn
+        for r, v in enumerate(sig.req_row):
+            if r >= N_FIXED_LANES and v == 0:
+                continue
+            avail = (alloc[r] - used[r]) if r < rn else 0
+            if v > avail:
+                return False
+        return True
+
+    # ----- the greedy -------------------------------------------------------
+
+    def _build_heap(self, sig: Signature) -> list:
+        # vectorized initial scores (numpy), exact-int formulas
+        a0 = np.asarray(self.alloc0, dtype=np.int64)
+        a1 = np.asarray(self.alloc1, dtype=np.int64)
+        total = np.zeros(self.n, dtype=np.int64)
+        if self.w_fit:
+            nz0 = np.asarray(self.nz0, dtype=np.int64) + sig.nz0
+            nz1 = np.asarray(self.nz1, dtype=np.int64) + sig.nz1
+            f0 = np.where(nz0 > a0, 0, (a0 - nz0) * MAX // np.maximum(a0, 1))
+            f1 = np.where(nz1 > a1, 0, (a1 - nz1) * MAX // np.maximum(a1, 1))
+            h0 = a0 > 0
+            h1 = a1 > 0
+            w = h0.astype(np.int64) + h1
+            least = np.where(
+                w > 0,
+                (np.where(h0, f0, 0) + np.where(h1, f1, 0)) // np.maximum(w, 1),
+                0,
+            )
+            total += self.w_fit * least
+        if self.w_bal:
+            u0 = np.asarray([r[LANE_CPU] for r in self.used_rows], np.int64)
+            u1 = np.asarray([r[LANE_MEM] for r in self.used_rows], np.int64)
+            r0 = np.minimum(u0 + sig.req_row[LANE_CPU], a0)
+            r1 = np.minimum(u1 + sig.req_row[LANE_MEM], a1)
+            d = np.abs(r0 * a1 - r1 * a0)
+            den = np.maximum(a0 * a1, 1)
+            bal = np.where(
+                (a0 > 0) & (a1 > 0), MAX - (50 * d + den - 1) // den, MAX
+            )
+            total += self.w_bal * bal
+        if self.w_img and sig.img is not None:
+            total += self.w_img * np.asarray(sig.img, dtype=np.int64)
+        sig.known = total.tolist()
+        idx = np.nonzero(sig.static_ok)[0]
+        heap = list(zip((-total[idx]).tolist(), idx.tolist()))
+        heapq.heapify(heap)
+        return heap
+
+    def run(self, pod_sigs: Sequence[Signature]) -> List[int]:
+        """pod_sigs[i] is pod i's signature (shared objects).  Returns the
+        chosen node index per pod (-1 unschedulable), in batch order."""
+        for sig in pod_sigs:
+            sig.remaining += 1
+        active = {id(s): s for s in pod_sigs}
+        choices: List[int] = []
+        for sig in pod_sigs:
+            if sig.heap is None:
+                sig.heap = self._build_heap(sig)
+            heap = sig.heap
+            known = sig.known
+            choice = -1
+            while heap:
+                negsc, n = heap[0]
+                if not self.feasible_int(n, sig):
+                    heapq.heappop(heap)  # monotone: never feasible again
+                    continue
+                cur = -self.score_int(n, sig)
+                known[n] = -cur
+                if cur == negsc:
+                    choice = n
+                    break
+                heapq.heapreplace(heap, (cur, n))  # stale → re-rank
+            sig.remaining -= 1
+            choices.append(choice)
+            if choice < 0:
+                continue
+            # commit: one node touched
+            n = choice
+            used = self.used_rows[n]
+            rn = self.rn
+            for r, v in enumerate(sig.req_row):
+                if r < rn:
+                    used[r] += v
+            self.nz0[n] += sig.nz0
+            self.nz1[n] += sig.nz1
+            self.num_pods[n] += 1
+            self.touched.add(n)
+            # Invariant: heap keys never stale-LOW.  Score decreases are
+            # healed by pop-time revalidation; only INCREASES need a fresh
+            # push (and only into still-active heaps).
+            for other in active.values():
+                if (
+                    other.remaining <= 0
+                    or other.heap is None
+                    or not other.static_ok[n]
+                ):
+                    continue
+                new = self.score_int(n, other)
+                if new > other.known[n]:
+                    heapq.heappush(other.heap, (-new, n))
+                other.known[n] = new
+        return choices
+
+    # ----- failure diagnosis (per signature, lazy) --------------------------
+
+    def diagnose(self, sig: Signature, masks: Dict[str, np.ndarray], node_valid: np.ndarray) -> Dict[str, int]:
+        """Per-kernel rejected-node counts at CURRENT sim state, first-
+        failure attribution in chain order (matches gang.DIAG_KERNELS
+        semantics for the static kernels + NodeResourcesFit).  ``masks``
+        holds this signature's [N] per-kernel mask rows."""
+        remaining = node_valid.copy()
+        out: Dict[str, int] = {}
+        for name, key in (
+            ("NodeUnschedulable", "m_unsched"),
+            ("NodeName", "m_nodename"),
+            ("TaintToleration", "m_taints"),
+            ("NodeAffinity", "m_nodeaff"),
+        ):
+            m = masks[key]
+            rej = int(np.sum(remaining & ~m))
+            if rej:
+                out[name] = rej
+            remaining &= m
+        if self.check_fit:
+            fit = np.fromiter(
+                (self.feasible_int(n, sig) for n in range(self.n)),
+                dtype=bool,
+                count=self.n,
+            )
+            rej = int(np.sum(remaining & ~fit))
+            if rej:
+                out["NodeResourcesFit"] = rej
+        return out

@@ -62,14 +62,20 @@ class DTable:
     term_valid: Any  # bool [...]
 
     @classmethod
-    def from_host(cls, t: ConjunctionTable) -> "DTable":
+    def host_tree(cls, t: ConjunctionTable) -> "DTable":
+        """numpy-leaved instance — callers device_put whole pytrees at once
+        (ONE transfer instead of one per field; remote device links care)."""
         return cls(
-            req_key=jnp.asarray(t.req_key, I32),
-            req_op=jnp.asarray(t.req_op, I32),
-            req_vals=jnp.asarray(t.req_vals, I32),
-            req_rhs=jnp.asarray(t.req_rhs, I32),
-            term_valid=jnp.asarray(t.term_valid, bool),
+            req_key=np.asarray(t.req_key, np.int32),
+            req_op=np.asarray(t.req_op, np.int32),
+            req_vals=np.asarray(t.req_vals, np.int32),
+            req_rhs=np.asarray(t.req_rhs, np.int32),
+            term_valid=np.asarray(t.term_valid, bool),
         )
+
+    @classmethod
+    def from_host(cls, t: ConjunctionTable) -> "DTable":
+        return jax.device_put(cls.host_tree(t))
 
 
 @_register_pytree
@@ -123,43 +129,43 @@ class DeviceCluster:
         log_tab = np.round(
             np.log(np.arange(nt.n_cap + 2, dtype=np.float64) + 2.0) * (1 << 32)
         ).astype(np.int64)
-        return cls(
-            allocatable=jnp.asarray(nt.allocatable, I32),
-            requested=jnp.asarray(nt.requested, I32),
-            nonzero_req=jnp.asarray(nt.nonzero_req, I32),
-            num_pods=jnp.asarray(nt.num_pods, I32),
-            allowed_pods=jnp.asarray(nt.allowed_pods, I32),
-            node_labels=jnp.asarray(nt.label_vals, I32),
-            val_ints=jnp.asarray(nt.val_ints, I32),
-            taint_key=jnp.asarray(nt.taint_key, I32),
-            taint_val=jnp.asarray(nt.taint_val, I32),
-            taint_effect=jnp.asarray(nt.taint_effect, I32),
-            unschedulable=jnp.asarray(nt.unschedulable, bool),
-            node_valid=jnp.asarray(nt.valid, bool),
-            used_ppk=jnp.asarray(nt.used_ppk, I32),
-            used_ip=jnp.asarray(nt.used_ip, I32),
-            used_wild=jnp.asarray(nt.used_wild, bool),
-            img_sizes=jnp.asarray(nt.img_sizes, I64),
-            epod_node=jnp.asarray(ep.node_idx, I32),
-            epod_ns=jnp.asarray(ep.ns_id, I32),
-            epod_labels=jnp.asarray(ep.label_vals, I32),
-            epod_valid=jnp.asarray(ep.valid, bool),
-            epod_deleting=jnp.asarray(ep.deleting, bool),
-            term_pod=jnp.asarray(ep.term_pod, I32),
-            term_kind=jnp.asarray(ep.term_kind, I32),
-            term_topo=jnp.asarray(ep.term_topo_key, I32),
-            term_weight=jnp.asarray(ep.term_weight, I32),
-            term_table=DTable.from_host(ep.term_table),
-            term_ns_all=jnp.asarray(ep.term_ns_all, bool),
-            term_ns_ids=jnp.asarray(ep.term_ns_ids, I32),
-            name_key=jnp.asarray(vocab.label_keys.lookup(METADATA_NAME_KEY), I32),
-            unsched_key=jnp.asarray(
+        return jax.device_put(cls(
+            allocatable=np.asarray(nt.allocatable, np.int32),
+            requested=np.asarray(nt.requested, np.int32),
+            nonzero_req=np.asarray(nt.nonzero_req, np.int32),
+            num_pods=np.asarray(nt.num_pods, np.int32),
+            allowed_pods=np.asarray(nt.allowed_pods, np.int32),
+            node_labels=np.asarray(nt.label_vals, np.int32),
+            val_ints=np.asarray(nt.val_ints, np.int32),
+            taint_key=np.asarray(nt.taint_key, np.int32),
+            taint_val=np.asarray(nt.taint_val, np.int32),
+            taint_effect=np.asarray(nt.taint_effect, np.int32),
+            unschedulable=np.asarray(nt.unschedulable, bool),
+            node_valid=np.asarray(nt.valid, bool),
+            used_ppk=np.asarray(nt.used_ppk, np.int32),
+            used_ip=np.asarray(nt.used_ip, np.int32),
+            used_wild=np.asarray(nt.used_wild, bool),
+            img_sizes=np.asarray(nt.img_sizes, np.int64),
+            epod_node=np.asarray(ep.node_idx, np.int32),
+            epod_ns=np.asarray(ep.ns_id, np.int32),
+            epod_labels=np.asarray(ep.label_vals, np.int32),
+            epod_valid=np.asarray(ep.valid, bool),
+            epod_deleting=np.asarray(ep.deleting, bool),
+            term_pod=np.asarray(ep.term_pod, np.int32),
+            term_kind=np.asarray(ep.term_kind, np.int32),
+            term_topo=np.asarray(ep.term_topo_key, np.int32),
+            term_weight=np.asarray(ep.term_weight, np.int32),
+            term_table=DTable.host_tree(ep.term_table),
+            term_ns_all=np.asarray(ep.term_ns_all, bool),
+            term_ns_ids=np.asarray(ep.term_ns_ids, np.int32),
+            name_key=np.asarray(vocab.label_keys.lookup(METADATA_NAME_KEY), np.int32),
+            unsched_key=np.asarray(
                 vocab.label_keys.lookup("node.kubernetes.io/unschedulable"), I32
             ),
-            empty_val=jnp.asarray(vocab.label_vals.lookup(""), I32),
-            n_valid_nodes=jnp.asarray(n, I32),
-            log_tab=jnp.asarray(log_tab),
-        )
+            empty_val=np.asarray(vocab.label_vals.lookup(""), np.int32),
+            n_valid_nodes=np.asarray(n, np.int32),
+            log_tab=np.asarray(log_tab),
+        ))
 
 
 @_register_pytree
@@ -202,40 +208,40 @@ class DeviceBatch:
 
     @classmethod
     def from_host(cls, pb: PodBatch) -> "DeviceBatch":
-        return cls(
-            requests=jnp.asarray(pb.requests, I32),
-            nonzero_req=jnp.asarray(pb.nonzero_req, I32),
-            ns_id=jnp.asarray(pb.ns_id, I32),
-            priority=jnp.asarray(pb.priority, I32),
-            labels=jnp.asarray(pb.label_vals, I32),
-            valid=jnp.asarray(pb.valid, bool),
-            node_sel=DTable.from_host(pb.node_sel),
-            pref_node=DTable.from_host(pb.pref_node),
-            pref_weight=jnp.asarray(pb.pref_weight, I32),
-            tol_key=jnp.asarray(pb.tol_key, I32),
-            tol_op=jnp.asarray(pb.tol_op, I32),
-            tol_val=jnp.asarray(pb.tol_val, I32),
-            tol_effect=jnp.asarray(pb.tol_effect, I32),
-            tsc_table=DTable.from_host(pb.tsc_table),
-            tsc_topo=jnp.asarray(pb.tsc_topo_key, I32),
-            tsc_max_skew=jnp.asarray(pb.tsc_max_skew, I32),
-            tsc_hard=jnp.asarray(pb.tsc_hard, bool),
-            tsc_min_domains=jnp.asarray(pb.tsc_min_domains, I32),
-            tsc_honor_affinity=jnp.asarray(pb.tsc_honor_affinity, bool),
-            tsc_honor_taints=jnp.asarray(pb.tsc_honor_taints, bool),
-            aff_table=DTable.from_host(pb.aff_table),
-            aff_kind=jnp.asarray(pb.aff_kind, I32),
-            aff_topo=jnp.asarray(pb.aff_topo_key, I32),
-            aff_weight=jnp.asarray(pb.aff_weight, I32),
-            aff_ns_all=jnp.asarray(pb.aff_ns_all, bool),
-            aff_ns_ids=jnp.asarray(pb.aff_ns_ids, I32),
-            target_name_val=jnp.asarray(pb.target_name_val, I32),
-            want_ppk=jnp.asarray(pb.want_ppk, I32),
-            want_ip=jnp.asarray(pb.want_ip, I32),
-            want_wild=jnp.asarray(pb.want_wild, bool),
-            img_ids=jnp.asarray(pb.img_ids, I32),
-            n_containers=jnp.asarray(pb.n_containers, I32),
-        )
+        return jax.device_put(cls(
+            requests=np.asarray(pb.requests, np.int32),
+            nonzero_req=np.asarray(pb.nonzero_req, np.int32),
+            ns_id=np.asarray(pb.ns_id, np.int32),
+            priority=np.asarray(pb.priority, np.int32),
+            labels=np.asarray(pb.label_vals, np.int32),
+            valid=np.asarray(pb.valid, bool),
+            node_sel=DTable.host_tree(pb.node_sel),
+            pref_node=DTable.host_tree(pb.pref_node),
+            pref_weight=np.asarray(pb.pref_weight, np.int32),
+            tol_key=np.asarray(pb.tol_key, np.int32),
+            tol_op=np.asarray(pb.tol_op, np.int32),
+            tol_val=np.asarray(pb.tol_val, np.int32),
+            tol_effect=np.asarray(pb.tol_effect, np.int32),
+            tsc_table=DTable.host_tree(pb.tsc_table),
+            tsc_topo=np.asarray(pb.tsc_topo_key, np.int32),
+            tsc_max_skew=np.asarray(pb.tsc_max_skew, np.int32),
+            tsc_hard=np.asarray(pb.tsc_hard, bool),
+            tsc_min_domains=np.asarray(pb.tsc_min_domains, np.int32),
+            tsc_honor_affinity=np.asarray(pb.tsc_honor_affinity, bool),
+            tsc_honor_taints=np.asarray(pb.tsc_honor_taints, bool),
+            aff_table=DTable.host_tree(pb.aff_table),
+            aff_kind=np.asarray(pb.aff_kind, np.int32),
+            aff_topo=np.asarray(pb.aff_topo_key, np.int32),
+            aff_weight=np.asarray(pb.aff_weight, np.int32),
+            aff_ns_all=np.asarray(pb.aff_ns_all, bool),
+            aff_ns_ids=np.asarray(pb.aff_ns_ids, np.int32),
+            target_name_val=np.asarray(pb.target_name_val, np.int32),
+            want_ppk=np.asarray(pb.want_ppk, np.int32),
+            want_ip=np.asarray(pb.want_ip, np.int32),
+            want_wild=np.asarray(pb.want_wild, bool),
+            img_ids=np.asarray(pb.img_ids, np.int32),
+            n_containers=np.asarray(pb.n_containers, np.int32),
+        ))
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,87 @@
+"""Device half of the fast commit path: per-SIGNATURE static evaluation.
+
+The gang scan (ops/gang.py) is sequential-equivalent but pays one scan step
+per pod.  For batches whose only batch-dynamic constraints are resources
+(no inter-pod terms, no spread constraints, no host ports, no nominations),
+pods collapse into a handful of SIGNATURES (identical requests + static
+constraints), and the per-pod work factors as
+
+    total(p, n) = static(sig(p), n) + dynamic_resources(state(n), sig(p))
+
+This module evaluates the static half ONCE per signature on device —
+[S, N] instead of [P, N] with S ~ 10 — and ships it to the host, where
+kubernetes_tpu.fastpath replays the exact sequential greedy with integer
+score math identical to the kernels.  Mirrors the role of
+findNodesThatFitPod's static predicate subset (schedule_one.go:460) without
+the per-pod loop.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from kubernetes_tpu.ops import filters as F
+from kubernetes_tpu.ops import scores as S
+
+
+@functools.partial(jax.jit, static_argnames=("enabled", "has_images"))
+def static_eval(dc, db, enabled: frozenset, has_images: bool):
+    """Static filters + raw static scores for a representative batch.
+
+    Returns dict of [S, N] arrays:
+      mask        — statics-feasible (node valid, name, unschedulable,
+                    taints, node affinity)
+      m_taints / m_nodeaff / m_nodename / m_unsched — per-kernel masks
+                    (failure diagnosis)
+      taint_raw / naff_raw — raw score inputs (the host verifies they are
+                    CONSTANT over the feasible set, which makes their
+                    normalized contribution argmax-neutral)
+      img         — ImageLocality contribution (already weight-free raw,
+                    no normalization pass in the reference)
+    """
+    P = db.valid.shape[0]
+    N = dc.node_valid.shape[0]
+    true_pn = jnp.ones((P, N), bool)
+    tolerated = F._tolerated(dc, db)
+    m_nodename = F.mask_node_name(dc, db) if "NodeName" in enabled else true_pn
+    m_unsched = (
+        F.mask_unschedulable(dc, db)
+        if "NodeUnschedulable" in enabled
+        else true_pn
+    )
+    m_taints = (
+        F.mask_taints(dc, db, tolerated)
+        if "TaintToleration" in enabled
+        else true_pn
+    )
+    m_nodeaff = (
+        F.mask_node_affinity(dc, db) if "NodeAffinity" in enabled else true_pn
+    )
+    mask = (
+        dc.node_valid[None, :]
+        & db.valid[:, None]
+        & m_nodename
+        & m_unsched
+        & m_taints
+        & m_nodeaff
+    )
+    taint_raw = S.score_taint_toleration(dc, db)
+    naff_raw = S.score_node_affinity(dc, db)
+    img = (
+        S.score_image_locality(dc, db)
+        if has_images
+        else jnp.zeros((P, N), jnp.int64)
+    )
+    return {
+        "mask": mask,
+        "m_nodename": m_nodename,
+        "m_unsched": m_unsched,
+        "m_taints": m_taints,
+        "m_nodeaff": m_nodeaff,
+        "taint_raw": taint_raw,
+        "naff_raw": naff_raw,
+        "img": img,
+    }

@@ -351,6 +351,18 @@ def _scatter_by_domain(values_j, dom_j, v_cap: int):
     return out.reshape(lead + (v_cap + 1,))
 
 
+def _domain_gather_sum(contrib_cj, dom_cj, dv_cn):
+    """Σ_j contrib[c, j] over entries whose domain equals node n's domain:
+    [C, J] ints + [C, J] ids + [C, N] node-domain ids → [C, N].
+
+    Equivalent to scatter-by-domain followed by a gather at each node's
+    domain id, but expressed as a dense equality reduction — scatters
+    serialize on TPU; this shape (C×N×J) rides the vector units.
+    """
+    eq = (dv_cn[:, :, None] >= 0) & (dv_cn[:, :, None] == dom_cj[:, None, :])
+    return jnp.sum(jnp.where(eq, contrib_cj[:, None, :], 0), axis=2)
+
+
 # Diagnosis rows of the [P, N_DIAG] reason-count output, in chain order.
 DIAG_KERNELS = (
     "NodeUnschedulable",

@@ -165,17 +165,24 @@ class Scheduler:
         )
         self._dirty_pending = False
         self._oracle_cache: Optional[OracleState] = None
+        # bumped on every EXTERNAL node-state mutation (informer events,
+        # forgets) — NOT on this scheduler's own commits, which the fast
+        # committer already tracks itself
+        self._external_mutations = 0
         self.metrics: Dict[str, float] = {
             "schedule_attempts": 0,
             "scheduled": 0,
             "unschedulable": 0,
             "errors": 0,
+            "fast_batches": 0,
+            "scan_batches": 0,
         }
 
     # ----- event handlers (eventhandlers.go:345-428) ------------------------
 
     def on_node_add(self, node: Node) -> None:
         self._invalidate_view()
+        self._external_mutations += 1
         self.cache.add_node(node)
         self.queue.move_all_on_event(
             ClusterEvent(EventResource.NODE, ActionType.ADD), None, node
@@ -183,6 +190,7 @@ class Scheduler:
 
     def on_node_update(self, old: Node, new: Node) -> None:
         self._invalidate_view()
+        self._external_mutations += 1
         self.cache.update_node(new)
         action = ActionType(0)
         if old.labels != new.labels:
@@ -202,6 +210,7 @@ class Scheduler:
 
     def on_node_delete(self, node: Node) -> None:
         self._invalidate_view()
+        self._external_mutations += 1
         self.cache.remove_node(node.name)
         self.queue.move_all_on_event(
             ClusterEvent(EventResource.NODE, ActionType.DELETE), node, None
@@ -210,6 +219,7 @@ class Scheduler:
     def on_pod_add(self, pod: Pod) -> None:
         self._invalidate_view()
         if pod.node_name:
+            self._external_mutations += 1
             self.cache.add_pod(pod)
             self.queue.move_all_on_event(
                 ClusterEvent(EventResource.ASSIGNED_POD, ActionType.ADD),
@@ -222,6 +232,7 @@ class Scheduler:
     def on_pod_update(self, old: Pod, new: Pod) -> None:
         self._invalidate_view()
         if new.node_name:
+            self._external_mutations += 1
             if old.node_name:
                 self.cache.update_pod(old, new)
             else:
@@ -239,6 +250,7 @@ class Scheduler:
     def on_pod_delete(self, pod: Pod) -> None:
         self._invalidate_view()
         if pod.node_name:
+            self._external_mutations += 1
             self.cache.remove_pod(pod)
             self.queue.move_all_on_event(
                 ClusterEvent(EventResource.ASSIGNED_POD, ActionType.DELETE),
@@ -277,6 +289,12 @@ class Scheduler:
         """Drain the active queue in gang batches; returns all outcomes."""
         outcomes: List[ScheduleOutcome] = []
         batches = 0
+        # Pre-size the placed-pod tensor axes for the whole drain: every
+        # distinct shape costs an XLA recompile of the gang pipeline.
+        self.mirror.e_cap_hint = max(
+            self.mirror.e_cap_hint,
+            len(self.cache.pod_states) + len(self.queue),
+        )
         while True:
             batch = self.queue.pop_batch(self.config.batch_size)
             if not batch:
@@ -316,15 +334,39 @@ class Scheduler:
                 return outcomes
         pods = [qp.pod for qp in batch]
 
-        # 1. snapshot: incremental host-side pack + device upload
-        self.mirror.update(self.cache, self.namespace_labels)
+        # 1. snapshot: incremental host-side pack + device upload.  Pod
+        # labels are interned FIRST so a fresh full pack covers them (stale
+        # val-int tables would force a second repack next cycle).
         vocab = self.mirror.vocab
         for pod in pods:
             for k, v in pod.labels.items():
                 vocab.intern_label(k, v)
+        self.mirror.update(self.cache, self.namespace_labels)
         if bucket_cap(len(vocab.label_keys)) > self.mirror.nodes.k_cap:
             self.mirror._force_full = True
             self.mirror.update(self.cache, self.namespace_labels)
+
+        # 1a. FAST PATH: when the batch has no batch-dynamic constraints
+        # beyond resources (no inter-pod/spread/ports/nominations/host
+        # filters), pods collapse into signatures — one tiny device static
+        # eval + exact host greedy replaces the per-pod device scan.
+        enabled = fwk.device_enabled()
+        weights = tuple(
+            fwk.score_weights.get(n, 0) for n in gang.WEIGHT_ORDER
+        )
+        if (
+            not fwk.has_host_filters()
+            and not len(self.nominator)
+            and self.cache.n_term_pods == 0
+            and self.cache.n_port_pods == 0
+        ):
+            fast = self._try_fast_schedule(
+                fwk, state, batch, enabled, weights, outcomes
+            )
+            if fast is not None:
+                self.metrics["fast_batches"] += 1
+                return fast
+        self.metrics["scan_batches"] += 1
 
         p_cap = bucket_cap(len(pods), 1)
         pb = pack_pod_batch(
@@ -347,19 +389,6 @@ class Scheduler:
         has_images = bool((pb.img_ids >= 0).any())
         has_ports = bool(
             (pb.want_ppk != PAD).any() or (self.mirror.nodes.used_ppk != PAD).any()
-        )
-        enabled = fwk.device_enabled()
-        weights = tuple(
-            fwk.score_weights.get(n, 0)
-            for n in (
-                "TaintToleration",
-                "NodeAffinity",
-                "PodTopologySpread",
-                "InterPodAffinity",
-                "NodeResourcesFit",
-                "NodeResourcesBalancedAllocation",
-                "ImageLocality",
-            )
         )
 
         # 1b. host-backed Filter plugins veto (pod, node) pairs the device
@@ -428,6 +457,159 @@ class Scheduler:
             node_name = node_names[idx]
             outcome = self._commit(fwk, state, qp, node_name, int(n_feas[i]))
             outcomes.append(outcome)
+        return outcomes
+
+    def _static_device_cluster(self) -> DeviceCluster:
+        """DeviceCluster cached across batches for STATIC reads only
+        (labels/taints/allocatable/images) — usage-only churn (generation)
+        does NOT invalidate it, so steady-state batches upload nothing."""
+        key = (
+            self.mirror.static_generation,
+            self.mirror._full_packs,
+            len(self.mirror.vocab.label_vals),
+        )
+        if getattr(self, "_static_dc_key", None) != key:
+            self._static_dc = DeviceCluster.from_host(
+                self.mirror.nodes, self.mirror.existing, self.mirror.vocab
+            )
+            self._static_dc_key = key
+        return self._static_dc
+
+    def _try_fast_schedule(
+        self, fwk, state, batch, enabled, weights, outcomes
+    ) -> Optional[List[ScheduleOutcome]]:
+        """The signature fast path (ops/fastpath.py + fastpath.py).
+
+        Returns completed outcomes, or None when the batch isn't eligible
+        (ineligible pods, or static score rawss vary so normalization is
+        batch-state-dependent) — the caller falls back to the gang scan.
+        """
+        import numpy as np
+
+        from kubernetes_tpu import fastpath as fp
+        from kubernetes_tpu.ops import fastpath as ops_fp
+        from kubernetes_tpu.snapshot.schema import ResourceLanes
+
+        vocab = self.mirror.vocab
+        lanes = ResourceLanes(vocab)
+        n_lanes = self.mirror.nodes.allocatable.shape[1]
+        keys = []
+        for qp in batch:
+            k = fp.signature_key(qp.pod, lanes, n_lanes)
+            if k is None:
+                return None
+            keys.append(k)
+
+        # Per-signature static results are cached across batches keyed on
+        # the static snapshot: steady-state batches reuse them and make
+        # ZERO device calls (signatures recur — bench workloads have ~10).
+        dc_key = (
+            self.mirror.static_generation,
+            self.mirror._full_packs,
+            fwk.profile_name,
+        )
+        cache = getattr(self, "_sig_cache", None)
+        if cache is None or self._sig_cache_key != dc_key:
+            cache = self._sig_cache = {}
+            self._sig_cache_key = dc_key
+
+        order: Dict[object, int] = {}
+        reps: List[Pod] = []
+        for k, qp in zip(keys, batch):
+            if k not in order and k not in cache:
+                order[k] = len(reps)
+                reps.append(qp.pod)
+
+        w_taint, w_naff = weights[0], weights[1]
+        if reps:
+            has_images = any(p.images for p in reps)
+            pb = pack_pod_batch(
+                reps,
+                vocab,
+                k_cap=self.mirror.nodes.k_cap,
+                p_cap=bucket_cap(len(reps), 1),
+            )
+            db = DeviceBatch.from_host(pb)
+            dc = self._static_device_cluster()
+            res = ops_fp.static_eval(
+                dc, db, enabled=enabled, has_images=has_images
+            )
+            res = {k: np.asarray(v) for k, v in jax.device_get(res).items()}
+            for k, s in order.items():
+                cache[k] = {name: res[name][s] for name in res}
+
+        # The committer (and its signature heaps) persists across batches:
+        # its state evolves exactly by the commits it made itself, so only
+        # EXTERNAL mutations or repacks force a rebuild.
+        fc_key = (
+            self._external_mutations,
+            self.mirror._full_packs,
+            enabled,
+            weights,
+            fwk.profile_name,
+        )
+        committer = getattr(self, "_fast_committer", None)
+        if committer is None or self._fc_key != fc_key:
+            committer = fp.FastCommitter(
+                self.mirror.nodes,
+                weights,
+                check_fit="NodeResourcesFit" in enabled,
+            )
+            self._fast_committer = committer
+            self._fc_key = fc_key
+            self._sig_objs: Dict[object, fp.Signature] = {}
+
+        sigs = self._sig_objs
+        for k in keys:
+            if k in sigs:
+                continue
+            row = cache[k]
+            m = row["mask"]
+            # Normalized static scores are argmax-neutral ONLY when their
+            # raws are constant over the feasible set (then every feasible
+            # node gets the same normalized value).
+            for w, raw in ((w_taint, row["taint_raw"]), (w_naff, row["naff_raw"])):
+                if not w:
+                    continue
+                vals = raw[m]
+                if vals.size and int(vals.min()) != int(vals.max()):
+                    return None
+            req_row, nz, *_ = k
+            img_list = None
+            if weights[6] and row["img"].any():
+                img_list = row["img"].tolist()
+            sigs[k] = fp.Signature(
+                req_row=req_row,
+                nz0=nz[0],
+                nz1=nz[1],
+                all_zero=all(v == 0 for v in req_row),
+                static_ok=m,
+                img=img_list,
+            )
+        pod_sigs = [sigs[k] for k in keys]
+        choices = committer.run(pod_sigs)
+
+        node_names = self.mirror.nodes.names
+        node_valid = np.asarray(self.mirror.nodes.valid)
+        n_nodes = len(self.cache.real_nodes())
+        diag_cache: Dict[int, Dict[str, int]] = {}
+        for qp, sig, k, idx in zip(batch, pod_sigs, keys, choices):
+            self.metrics["schedule_attempts"] += 1
+            if idx < 0:
+                diag = diag_cache.get(id(sig))
+                if diag is None:
+                    diag = committer.diagnose(sig, cache[k], node_valid)
+                    diag_cache[id(sig)] = diag
+                status = Status.unschedulable(fit_error_message(n_nodes, diag))
+                outcomes.append(
+                    self._post_filter_or_fail(
+                        fwk, state, qp, status, 0, diag, set(diag)
+                    )
+                )
+                continue
+            outcomes.append(
+                self._commit(fwk, state, qp, node_names[idx], -1)
+            )
         return outcomes
 
     def _nominated_arrays(self, exclude_uids):
@@ -518,6 +700,7 @@ class Scheduler:
 
         s = fwk.run_reserve(state, pod, node_name)
         if not s.ok:
+            self._external_mutations += 1  # committer state diverges
             self.cache.forget_pod(pod)
             self._handle_failure(qp, s)
             return ScheduleOutcome(pod, None, s, n_feas)
@@ -525,6 +708,7 @@ class Scheduler:
         s = fwk.run_permit(state, pod, node_name)
         if s.rejected or s.code == Code.ERROR:
             fwk.run_unreserve(state, pod, node_name)
+            self._external_mutations += 1  # committer state diverges
             self.cache.forget_pod(pod)
             self._handle_failure(qp, s)
             return ScheduleOutcome(pod, None, s, n_feas)
@@ -532,6 +716,7 @@ class Scheduler:
             s = fwk.wait_on_permit(pod)
             if not s.ok:
                 fwk.run_unreserve(state, pod, node_name)
+                self._external_mutations += 1  # committer state diverges
                 self.cache.forget_pod(pod)
                 self._handle_failure(qp, s)
                 return ScheduleOutcome(pod, None, s, n_feas)
@@ -539,6 +724,7 @@ class Scheduler:
         s = fwk.run_pre_bind(state, pod, node_name)
         if not s.ok:
             fwk.run_unreserve(state, pod, node_name)
+            self._external_mutations += 1  # committer state diverges
             self.cache.forget_pod(pod)
             self._handle_failure(qp, s)
             return ScheduleOutcome(pod, None, s, n_feas)
@@ -548,6 +734,7 @@ class Scheduler:
             # The in-flight ledger is still intact here, so events that
             # arrived during the attempt replay through add_unschedulable.
             fwk.run_unreserve(state, pod, node_name)
+            self._external_mutations += 1  # committer state diverges
             self.cache.forget_pod(pod)
             self._handle_failure(qp, s)
             return ScheduleOutcome(pod, None, s, n_feas)

@@ -460,13 +460,17 @@ def pack_existing_pods(
     e_cap: Optional[int] = None,
     k_cap: Optional[int] = None,
     namespace_labels: Optional[Dict[str, Dict[str, str]]] = None,
+    m_cap: Optional[int] = None,
 ) -> ExistingPodTensors:
+    """``e_cap``/``m_cap`` hints pre-size the pod/term axes: every distinct
+    (E, M) shape costs an XLA recompile of the gang pipeline, so callers
+    that can predict growth (queue pressure) should size ONCE."""
     for pod in pods:
         for k, v in pod.labels.items():
             vocab.intern_label(k, v)
         vocab.namespaces.intern(pod.namespace)
 
-    E = e_cap or bucket_cap(len(pods))
+    E = max(e_cap or 0, bucket_cap(len(pods)))
     K = k_cap or bucket_cap(len(vocab.label_keys))
 
     node_idx = np.full(E, ABSENT, dtype=np.int32)
@@ -502,7 +506,7 @@ def pack_existing_pods(
             r_all.append(ns_all)
             r_ns.append(ns_ids_)
 
-    M = bucket_cap(len(rows), 1)
+    M = max(m_cap or 0, bucket_cap(len(rows), 1))
     NS = bucket_cap(max((len(x) for x in r_ns), default=1), 1)
     term_pod = np.full(M, ABSENT, dtype=np.int32)
     term_kind = np.full(M, PAD, dtype=np.int32)
@@ -538,6 +542,87 @@ def pack_existing_pods(
         term_ns_ids=term_ns_ids,
         keys=keys,
     )
+
+
+def append_existing_pods(
+    ep: ExistingPodTensors,
+    pods: Sequence[Pod],
+    start_slot: int,
+    term_start: int,
+    node_name_to_idx: Dict[str, int],
+    vocab: Vocab,
+    namespace_labels: Optional[Dict[str, Dict[str, str]]] = None,
+) -> Optional[int]:
+    """Append rows for NEW placed pods in place (the common between-full-
+    packs case: the placed-pod set only grows).  Returns the new term row
+    count, or None when any axis would overflow (caller falls back to a
+    full pack_existing_pods at grown buckets)."""
+    E = ep.node_idx.shape[0]
+    K = ep.label_vals.shape[1]
+    if start_slot + len(pods) > E:
+        return None
+    # compile terms first so overflow aborts before any mutation
+    compiled = []
+    for i, pod in enumerate(pods):
+        if any(
+            vocab.intern_label(k, v)[0] >= K for k, v in pod.labels.items()
+        ):
+            return None
+        for row in iter_pod_affinity_terms(pod, vocab, namespace_labels):
+            compiled.append((start_slot + i, row))
+    M = ep.term_pod.shape[0]
+    NS = ep.term_ns_ids.shape[1]
+    tbl = ep.term_table
+    R = tbl.req_key.shape[2]
+    V = tbl.req_vals.shape[3]
+    if term_start + len(compiled) > M:
+        return None
+    for _, (c, kind, topo, weight, ns_all, ns_ids_) in compiled:
+        if len(ns_ids_) > NS:
+            return None
+        if not c.match_nothing and (
+            c.n_reqs > R or any(len(vs) > V for vs in c.vals)
+        ):
+            return None
+
+    for i, pod in enumerate(pods):
+        s = start_slot + i
+        ep.node_idx[s] = node_name_to_idx.get(pod.node_name, ABSENT)
+        ep.ns_id[s] = vocab.namespaces.intern(pod.namespace)
+        ep.label_vals[s] = _pod_label_row(pod, vocab, K)
+        ep.valid[s] = ep.node_idx[s] != ABSENT
+        ep.deleting[s] = pod.deletion_timestamp is not None
+        if s < len(ep.keys):
+            ep.keys[s] = pod.key
+        else:
+            while len(ep.keys) < s:
+                ep.keys.append("")
+            ep.keys.append(pod.key)
+    for j, (slot, (c, kind, topo, weight, ns_all, ns_ids_)) in enumerate(
+        compiled, start=term_start
+    ):
+        ep.term_pod[j] = slot
+        ep.term_kind[j] = kind
+        ep.term_topo_key[j] = topo
+        ep.term_weight[j] = weight
+        ep.term_ns_all[j] = ns_all
+        ep.term_ns_ids[j] = PAD
+        for m, nsid in enumerate(ns_ids_[:NS]):
+            ep.term_ns_ids[j, m] = nsid
+        tbl.req_key[j, 0] = PAD
+        tbl.req_op[j, 0] = PAD
+        tbl.req_vals[j, 0] = PAD
+        tbl.req_rhs[j, 0] = 0
+        tbl.term_valid[j, 0] = False
+        if not c.match_nothing:
+            tbl.term_valid[j, 0] = True
+            for k in range(min(c.n_reqs, R)):
+                tbl.req_key[j, 0, k] = c.keys[k]
+                tbl.req_op[j, 0, k] = c.ops[k]
+                tbl.req_rhs[j, 0, k] = c.rhs_int[k]
+                for m, v in enumerate(c.vals[k][:V]):
+                    tbl.req_vals[j, 0, k, m] = v
+    return term_start + len(compiled)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,180 @@
+"""Fast-path decision identity: the signature greedy must bit-match the
+gang scan (which is property-tested against the serial oracle)."""
+
+import random
+
+import pytest
+
+from kubernetes_tpu.api.resource import Resource
+from kubernetes_tpu.api.types import (
+    Container,
+    NodeAffinity,
+    NodeSelector,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    Affinity,
+    Node,
+    Pod,
+    Taint,
+    Toleration,
+)
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.testing.fake_cluster import FakeCluster
+
+
+def _mk_cluster(rng, n_nodes):
+    nodes = []
+    for i in range(n_nodes):
+        taints = ()
+        if rng.random() < 0.2:
+            taints = (Taint(key="dedicated", value=rng.choice(["a", "b"])),)
+        nodes.append(
+            Node(
+                name=f"n{i:03d}",
+                labels={
+                    "kubernetes.io/hostname": f"n{i:03d}",
+                    "zone": f"z{i % 3}",
+                    "disk": rng.choice(["ssd", "hdd"]),
+                },
+                capacity=Resource.from_map(
+                    {
+                        "cpu": rng.choice(["2", "4", "8"]),
+                        "memory": rng.choice(["8Gi", "16Gi"]),
+                        "pods": rng.choice([5, 20]),
+                    }
+                ),
+                taints=taints,
+            )
+        )
+    return nodes
+
+
+def _mk_pod(rng, i):
+    kwargs = {}
+    if rng.random() < 0.3:
+        kwargs["tolerations"] = (
+            Toleration(key="dedicated", operator="Equal", value="a"),
+        )
+    if rng.random() < 0.3:
+        kwargs["node_selector"] = {"disk": rng.choice(["ssd", "hdd"])}
+    if rng.random() < 0.2:
+        kwargs["affinity"] = Affinity(
+            node_affinity=NodeAffinity(
+                required_during_scheduling_ignored_during_execution=NodeSelector(
+                    (
+                        NodeSelectorTerm(
+                            match_expressions=(
+                                NodeSelectorRequirement(
+                                    "zone", "In", (rng.choice(["z0", "z1"]),)
+                                ),
+                            )
+                        ),
+                    )
+                )
+            )
+        )
+    return Pod(
+        name=f"p{i:04d}",
+        containers=[
+            Container(
+                name="c",
+                requests={
+                    "cpu": rng.choice(["100m", "250m", "500m", "1"]),
+                    "memory": rng.choice(["64Mi", "256Mi", "1Gi"]),
+                },
+            )
+        ],
+        **kwargs,
+    )
+
+
+def _run(pods_fn, nodes, force_scan: bool):
+    cluster = FakeCluster()
+    sched = Scheduler()
+    if force_scan:
+        sched._try_fast_schedule = lambda *a, **k: None
+    cluster.connect(sched)
+    for n in nodes:
+        cluster.create_node(n)
+    for p in pods_fn():
+        cluster.create_pod(p)
+    out = sched.schedule_pending()
+    return {o.pod.name: o.node for o in out}, sched
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_fast_path_matches_scan(seed):
+    rng = random.Random(seed)
+    nodes = _mk_cluster(rng, 40)
+    spec = [(_mk_pod(random.Random(seed * 1000 + i), i)) for i in range(120)]
+
+    def pods():
+        import copy
+
+        return [copy.deepcopy(p) for p in spec]
+
+    fast, s_fast = _run(pods, nodes, force_scan=False)
+    scan, s_scan = _run(pods, nodes, force_scan=True)
+    assert s_fast.metrics["fast_batches"] > 0, "fast path never engaged"
+    assert fast == scan
+
+
+def test_fast_path_engages_on_basic_workload():
+    nodes = [
+        Node(
+            name=f"n{i}",
+            labels={"kubernetes.io/hostname": f"n{i}"},
+            capacity=Resource.from_map({"cpu": "4", "memory": "16Gi", "pods": 50}),
+        )
+        for i in range(10)
+    ]
+
+    def pods():
+        return [
+            Pod(
+                name=f"p{i}",
+                containers=[Container(name="c", requests={"cpu": "500m"})],
+            )
+            for i in range(30)
+        ]
+
+    got, sched = _run(pods, nodes, force_scan=False)
+    assert sched.metrics["fast_batches"] == 1
+    assert sched.metrics["scan_batches"] == 0
+    assert all(v is not None for v in got.values())
+
+
+def test_fast_path_falls_back_on_spread():
+    from kubernetes_tpu.api.types import LabelSelector, TopologySpreadConstraint
+
+    nodes = [
+        Node(
+            name=f"n{i}",
+            labels={"kubernetes.io/hostname": f"n{i}", "zone": f"z{i%2}"},
+            capacity=Resource.from_map({"cpu": "4", "memory": "16Gi", "pods": 50}),
+        )
+        for i in range(4)
+    ]
+
+    def pods():
+        return [
+            Pod(
+                name=f"p{i}",
+                labels={"app": "x"},
+                topology_spread_constraints=(
+                    TopologySpreadConstraint(
+                        max_skew=1,
+                        topology_key="zone",
+                        when_unsatisfiable="DoNotSchedule",
+                        label_selector=LabelSelector(match_labels={"app": "x"}),
+                    ),
+                ),
+                containers=[Container(name="c", requests={"cpu": "100m"})],
+            )
+            for i in range(8)
+        ]
+
+    got, sched = _run(pods, nodes, force_scan=False)
+    assert sched.metrics["fast_batches"] == 0
+    assert sched.metrics["scan_batches"] >= 1
+    assert all(v is not None for v in got.values())
