@@ -30,3 +30,14 @@ Package layout:
 """
 
 __version__ = "0.1.0"
+
+# The score kernels do exact integer arithmetic in int64 (emulated on TPU;
+# float64 is never used, so TPU compatibility is preserved).  Without x64,
+# packing real-world quantities (memory in bytes > 2^31) overflows at the
+# jit boundary, so the requirement is enforced at import.
+import jax as _jax
+
+try:
+    _jax.config.update("jax_enable_x64", True)
+except Exception:  # backend pinned by the embedding process — leave it be
+    pass
