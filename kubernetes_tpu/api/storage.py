@@ -1,0 +1,246 @@
+"""Storage API objects the scheduler consumes.
+
+A scheduler-relevant mirror of the corev1/storagev1 surface used by the
+volume plugins (reference: staging/src/k8s.io/api/core/v1 PersistentVolume /
+PersistentVolumeClaim and storage/v1 StorageClass / CSINode / CSIDriver /
+CSIStorageCapacity, scoped to what
+pkg/scheduler/framework/plugins/volumebinding, volumezone,
+volumerestrictions and nodevolumelimits actually read).
+
+All objects carry a ``resource_version`` maintained by the API store — the
+generic assume cache (kubernetes_tpu/util/assumecache.py) uses it to decide
+whether an informer event supersedes an assumed object.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Tuple
+
+from kubernetes_tpu.api.resource import parse_int_quantity
+from kubernetes_tpu.api.types import LabelSelector, NodeSelector
+
+# -- volume binding modes (storagev1.StorageClass) ---------------------------
+BINDING_IMMEDIATE = "Immediate"
+BINDING_WAIT_FOR_FIRST_CONSUMER = "WaitForFirstConsumer"
+
+# -- PV/PVC phases ------------------------------------------------------------
+PV_AVAILABLE = "Available"
+PV_BOUND = "Bound"
+PV_RELEASED = "Released"
+PVC_PENDING = "Pending"
+PVC_BOUND = "Bound"
+PVC_LOST = "Lost"
+
+# -- access modes ---------------------------------------------------------------
+RWO = "ReadWriteOnce"
+ROX = "ReadOnlyMany"
+RWX = "ReadWriteMany"
+RWOP = "ReadWriteOncePod"
+
+# Annotation the binder writes on dynamically-provisioned claims so the
+# provisioner knows the chosen node (volume/persistentvolume/util).
+ANN_SELECTED_NODE = "volume.kubernetes.io/selected-node"
+# StorageClass provisioner value that means "no dynamic provisioning"
+# (kubernetes.io/no-provisioner — used by local volumes).
+NO_PROVISIONER = "kubernetes.io/no-provisioner"
+
+# Zone/region topology label keys VolumeZone compares (volumezone/volume_zone.go
+# topologyLabels — both GA and legacy beta forms).
+ZONE_LABELS = (
+    "topology.kubernetes.io/zone",
+    "failure-domain.beta.kubernetes.io/zone",
+)
+REGION_LABELS = (
+    "topology.kubernetes.io/region",
+    "failure-domain.beta.kubernetes.io/region",
+)
+VOLUME_TOPOLOGY_LABELS = ZONE_LABELS + REGION_LABELS
+
+
+@dataclass
+class ObjectRef:
+    """PV.spec.claimRef — which claim a PV is bound to."""
+
+    namespace: str = ""
+    name: str = ""
+    uid: str = ""
+
+
+@dataclass
+class PersistentVolume:
+    """corev1.PersistentVolume, scheduler view.
+
+    ``source_kind``/``source_id`` collapse the one-of volume-source union the
+    scheduler inspects (gcePersistentDisk.pdName, awsElasticBlockStore
+    .volumeID, azureDisk.diskName, csi.driver+volumeHandle, local, hostPath…)
+    into (kind, opaque id) — VolumeRestrictions only compares ids for
+    equality, NodeVolumeLimits only maps to a CSI driver name.
+    """
+
+    name: str
+    labels: Dict[str, str] = field(default_factory=dict)
+    capacity: int = 0  # spec.capacity["storage"], bytes
+    access_modes: Tuple[str, ...] = (RWO,)
+    storage_class_name: str = ""
+    node_affinity: Optional[NodeSelector] = None  # spec.nodeAffinity.required
+    claim_ref: Optional[ObjectRef] = None
+    phase: str = PV_AVAILABLE
+    volume_mode: str = "Filesystem"
+    source_kind: str = "csi"  # csi / gce-pd / aws-ebs / azure-disk / local / ...
+    source_id: str = ""  # driver-scoped volume handle / disk name
+    csi_driver: str = ""  # source_kind == "csi": spec.csi.driver
+    read_only: bool = False
+    resource_version: int = 0
+
+    @classmethod
+    def make(cls, name: str, capacity: str | int = "1Gi", **kw) -> "PersistentVolume":
+        return cls(name=name, capacity=parse_int_quantity(capacity), **kw)
+
+    @property
+    def key(self) -> str:
+        return self.name
+
+    def clone(self) -> "PersistentVolume":
+        import copy
+
+        return copy.deepcopy(self)
+
+
+@dataclass
+class PersistentVolumeClaim:
+    name: str
+    namespace: str = "default"
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    # spec.storageClassName; None means "no class" (matches only classless PVs)
+    storage_class_name: Optional[str] = None
+    access_modes: Tuple[str, ...] = (RWO,)
+    request: int = 0  # spec.resources.requests["storage"], bytes
+    selector: Optional[LabelSelector] = None
+    volume_mode: str = "Filesystem"
+    volume_name: str = ""  # spec.volumeName — the bound PV
+    phase: str = PVC_PENDING
+    deletion_timestamp: Optional[float] = None
+    resource_version: int = 0
+
+    @classmethod
+    def make(
+        cls, name: str, request: str | int = "1Gi", **kw
+    ) -> "PersistentVolumeClaim":
+        return cls(name=name, request=parse_int_quantity(request), **kw)
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+    def is_fully_bound(self) -> bool:
+        """binder.go isPVCFullyBound: bound volume name + Bound phase."""
+        return bool(self.volume_name) and self.phase == PVC_BOUND
+
+    def clone(self) -> "PersistentVolumeClaim":
+        import copy
+
+        return copy.deepcopy(self)
+
+
+@dataclass
+class TopologySelectorTerm:
+    """storagev1 allowedTopologies entry: matchLabelExpressions ANDed,
+    each (key, values) requires node.labels[key] ∈ values."""
+
+    match_label_expressions: Tuple[Tuple[str, Tuple[str, ...]], ...] = ()
+
+    def matches(self, node_labels: Dict[str, str]) -> bool:
+        for key, values in self.match_label_expressions:
+            if node_labels.get(key) not in values:
+                return False
+        return True
+
+
+@dataclass
+class StorageClass:
+    name: str
+    provisioner: str = "test.csi.example.com"
+    volume_binding_mode: str = BINDING_IMMEDIATE
+    allowed_topologies: Tuple[TopologySelectorTerm, ...] = ()
+    resource_version: int = 0
+
+    @property
+    def key(self) -> str:
+        return self.name
+
+    def is_wait_for_first_consumer(self) -> bool:
+        return self.volume_binding_mode == BINDING_WAIT_FOR_FIRST_CONSUMER
+
+    def topology_allows(self, node_labels: Dict[str, str]) -> bool:
+        """Terms ORed; empty list allows every node."""
+        if not self.allowed_topologies:
+            return True
+        return any(t.matches(node_labels) for t in self.allowed_topologies)
+
+
+@dataclass
+class CSINodeDriver:
+    name: str  # driver name
+    node_id: str = ""
+    # spec.drivers[].allocatable.count — max attachable volumes; None = no limit
+    allocatable_count: Optional[int] = None
+
+
+@dataclass
+class CSINode:
+    """storagev1.CSINode — one per node, same name as the node."""
+
+    name: str
+    drivers: Tuple[CSINodeDriver, ...] = ()
+    resource_version: int = 0
+
+    @property
+    def key(self) -> str:
+        return self.name
+
+    def driver(self, name: str) -> Optional[CSINodeDriver]:
+        for d in self.drivers:
+            if d.name == name:
+                return d
+        return None
+
+
+@dataclass
+class CSIDriver:
+    name: str
+    # spec.storageCapacity: whether the scheduler must check
+    # CSIStorageCapacity objects before provisioning on a node
+    storage_capacity: bool = False
+    resource_version: int = 0
+
+    @property
+    def key(self) -> str:
+        return self.name
+
+
+@dataclass
+class CSIStorageCapacity:
+    """storagev1.CSIStorageCapacity — provisioner-published free capacity
+    for (storage class, node topology segment)."""
+
+    name: str
+    storage_class_name: str = ""
+    # nodeTopology: labels a node must carry to be in this segment
+    node_topology: Optional[LabelSelector] = None
+    capacity: int = 0  # bytes; 0 = unknown/none
+    maximum_volume_size: Optional[int] = None
+    resource_version: int = 0
+
+    @property
+    def key(self) -> str:
+        return self.name
+
+    def topology_matches(self, node_labels: Dict[str, str]) -> bool:
+        from kubernetes_tpu.api import labels as k8slabels
+
+        if self.node_topology is None:
+            return True
+        sel = k8slabels.selector_from_label_selector(self.node_topology)
+        return sel.matches(node_labels)

@@ -201,8 +201,16 @@ class Container:
 
 @dataclass(frozen=True)
 class Volume:
+    """One pod volume.  Either a PVC reference or an inline source
+    (gcePersistentDisk / awsElasticBlockStore / azureDisk / csi …) collapsed
+    to (kind, opaque id) — what VolumeRestrictions/NodeVolumeLimits compare."""
+
     name: str = ""
     pvc_name: Optional[str] = None  # persistentVolumeClaim.claimName
+    source_kind: str = ""  # "" for PVC-backed; gce-pd / aws-ebs / azure-disk / csi
+    source_id: str = ""  # disk name / volume id / driver-scoped handle
+    driver: str = ""  # inline CSI volumes: spec.csi.driver
+    read_only: bool = False
 
 
 # ---------------------------------------------------------------------------
@@ -266,6 +274,8 @@ class Pod:
     topology_spread_constraints: Tuple[TopologySpreadConstraint, ...] = ()
     scheduling_gates: Tuple[str, ...] = ()
     volumes: Tuple[Volume, ...] = ()
+    # spec.resourceClaims[*].resourceClaimName (DRA)
+    resource_claims: Tuple[str, ...] = ()
     host_network: bool = False
     images: Tuple[str, ...] = ()
 

@@ -53,6 +53,14 @@ DEFAULT_MULTI_POINT: List[Tuple[str, int]] = [
 
 DEFAULT_SCHEDULER_NAME = "default-scheduler"
 
+# Scheduler-relevant feature gates and their reference defaults
+# (pkg/features/kube_features.go @ v1.31).
+DEFAULT_FEATURE_GATES: List[Tuple[str, bool]] = [
+    ("DynamicResourceAllocation", False),  # alpha
+    ("SchedulerQueueingHints", True),
+    ("VolumeCapacityPriority", False),  # alpha
+]
+
 
 @dataclass
 class PluginRef:
@@ -121,6 +129,11 @@ class SchedulerConfiguration:
     pod_initial_backoff_seconds: float = 1.0
     pod_max_backoff_seconds: float = 10.0
     batch_size: int = 256  # TPU extension: gang batch width
+    # component-base/featuregate tier (pkg/features/kube_features.go) —
+    # only the scheduler-relevant gates exist
+    feature_gates: Dict[str, bool] = field(
+        default_factory=lambda: dict(DEFAULT_FEATURE_GATES)
+    )
 
     def validate(self) -> None:
         names = [p.scheduler_name for p in self.profiles]
@@ -151,12 +164,13 @@ PLUGIN_POINTS: Dict[str, Tuple[str, ...]] = {
     "NodePorts": ("preFilter", "filter"),
     "NodeResourcesFit": ("preFilter", "filter", "preScore", "score"),
     "VolumeRestrictions": ("preFilter", "filter"),
-    "NodeVolumeLimits": ("filter",),
+    "NodeVolumeLimits": ("preFilter", "filter"),
     "VolumeBinding": ("preFilter", "filter", "reserve", "preBind", "score"),
-    "VolumeZone": ("filter",),
+    "VolumeZone": ("preFilter", "filter"),
     "PodTopologySpread": ("preFilter", "filter", "preScore", "score"),
     "InterPodAffinity": ("preFilter", "filter", "preScore", "score"),
     "DefaultPreemption": ("postFilter",),
+    "DynamicResources": ("preEnqueue", "preFilter", "filter", "reserve", "preBind"),
     "NodeResourcesBalancedAllocation": ("preScore", "score"),
     "ImageLocality": ("score",),
     "DefaultBinder": ("bind",),
@@ -178,9 +192,15 @@ _SNAKE = {
 }
 
 
-def default_plugins() -> Plugins:
+def default_plugins(feature_gates: Optional[Dict[str, bool]] = None) -> Plugins:
+    """Default plugin set, adjusted for feature gates
+    (apis/config/v1/default_plugins.go getDefaultPlugins/applyFeatureGates)."""
     p = Plugins()
-    p.multi_point.enabled = [PluginRef(n, w) for n, w in DEFAULT_MULTI_POINT]
+    refs = [PluginRef(n, w) for n, w in DEFAULT_MULTI_POINT]
+    if (feature_gates or {}).get("DynamicResourceAllocation"):
+        binder = next(i for i, r in enumerate(refs) if r.name == "DefaultBinder")
+        refs.insert(binder, PluginRef("DynamicResources", 0))
+    p.multi_point.enabled = refs
     return p
 
 
@@ -209,7 +229,9 @@ def _merge_plugin_set(default: PluginSet, custom: PluginSet) -> PluginSet:
     return PluginSet(enabled=enabled, disabled=list(custom.disabled))
 
 
-def expand_profile(profile: Profile) -> Dict[str, List[PluginRef]]:
+def expand_profile(
+    profile: Profile, feature_gates: Optional[Dict[str, bool]] = None
+) -> Dict[str, List[PluginRef]]:
     """MultiPoint expansion + per-point enable/disable merge.
 
     Returns extensionPoint → ordered [PluginRef] with effective weights.
@@ -223,7 +245,9 @@ def expand_profile(profile: Profile) -> Dict[str, List[PluginRef]]:
     # default_plugins.go:107 mergePluginSet): user-enabled plugins override
     # same-named defaults in place or append; disabled names (or '*') drop
     # defaults.
-    mp = _merge_plugin_set(default_plugins().multi_point, plugins.multi_point)
+    mp = _merge_plugin_set(
+        default_plugins(feature_gates).multi_point, plugins.multi_point
+    )
     mp_disabled = {d.name for d in mp.disabled}
     mp_all_disabled = "*" in mp_disabled
 

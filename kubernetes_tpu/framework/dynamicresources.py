@@ -1,0 +1,289 @@
+"""DynamicResources (DRA) plugin — structured-parameters claim allocation.
+
+Host-backed stateful plugin mirroring pkg/scheduler/framework/plugins/
+dynamicresources/dynamicresources.go (:419 PreEnqueue, :709 PreFilter, :902
+Filter, :1156 Reserve, :1306 Unreserve, :1367 PreBind) over the generic
+assume cache, with the structured allocator reduced to its scheduling
+semantics: a claim's device requests are satisfied by free devices from the
+node's ResourceSlices whose attributes pass the DeviceClass + request
+selectors; cross-claim exclusivity comes from the allocated-device set of
+every other claim in the cache.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set, Tuple
+
+from kubernetes_tpu.api import dra
+from kubernetes_tpu.api.types import Pod
+from kubernetes_tpu.framework.interface import (
+    ActionType,
+    ClusterEvent,
+    ClusterEventWithHint,
+    CycleState,
+    EnqueueExtensions,
+    EventResource,
+    FilterPlugin,
+    PreBindPlugin,
+    PreEnqueuePlugin,
+    PreFilterPlugin,
+    QueueingHint,
+    ReservePlugin,
+    Status,
+)
+
+
+class DynamicResources(
+    PreEnqueuePlugin,
+    PreFilterPlugin,
+    FilterPlugin,
+    ReservePlugin,
+    PreBindPlugin,
+    EnqueueExtensions,
+):
+    name = "DynamicResources"
+    _STATE_KEY = "DynamicResources"
+
+    def maybe_relevant(self, pod: Pod) -> bool:
+        return bool(pod.resource_claims)
+
+    # -- PreEnqueue (:419): claims must exist before the pod may queue -------
+
+    def pre_enqueue(self, pod: Pod) -> Status:
+        for name in pod.resource_claims:
+            if self.handle.claim_cache.get(f"{pod.namespace}/{name}") is None:
+                return Status.unresolvable(
+                    f'waiting for resource claim "{name}" to be created',
+                    plugin=self.name,
+                )
+        return Status.success()
+
+    # -- PreFilter (:709) -------------------------------------------------------
+
+    def pre_filter(self, state: CycleState, pod: Pod) -> Status:
+        if not pod.resource_claims:
+            return Status.skip()
+        claims: List[dra.ResourceClaim] = []
+        for name in pod.resource_claims:
+            claim = self.handle.claim_cache.get(f"{pod.namespace}/{name}")
+            if claim is None:
+                return Status.unresolvable(
+                    f'resourceclaim "{name}" not found', plugin=self.name
+                )
+            if claim.deletion_timestamp is not None:
+                return Status.unresolvable(
+                    f'resourceclaim "{name}" is being deleted', plugin=self.name
+                )
+            if claim.allocation is not None:
+                if (
+                    pod.uid not in claim.reserved_for
+                    and len(claim.reserved_for) >= dra.ResourceClaim.MAX_RESERVED
+                ):
+                    return Status.unschedulable(
+                        f'resourceclaim "{name}" is reserved by too many pods',
+                        plugin=self.name,
+                    )
+            claims.append(claim)
+        # Per-cycle precomputes so Filter is O(node's slices), not
+        # O(all claims + all slices) per node: the cluster-wide
+        # allocated-device set (own allocated claims included — their
+        # devices are taken too) and a node_name → slices index.
+        slices_by_node: Dict[str, List] = {}
+        for sl in self.handle.list_resource_slices():
+            slices_by_node.setdefault(sl.node_name, []).append(sl)
+        state.write(
+            (self._STATE_KEY, pod.uid),
+            {
+                "claims": claims,
+                "by_node": {},
+                "taken_base": self._allocated_devices(),
+                "slices_by_node": slices_by_node,
+            },
+        )
+        return Status.success()
+
+    # -- allocator ---------------------------------------------------------------
+
+    def _allocated_devices(self) -> Set[Tuple[str, str, str]]:
+        """(driver, pool, device) triples held by ANY allocated claim —
+        the in-memory allocated-state the structured allocator checks.
+        A pod's own allocated claims count too (their devices are taken;
+        only its UNallocated claims receive new grants)."""
+        out: Set[Tuple[str, str, str]] = set()
+        for claim in self.handle.claim_cache.list():
+            if claim.allocation is None:
+                continue
+            for r in claim.allocation.results:
+                out.add((r.driver, r.pool, r.device))
+        return out
+
+    def _allocate_on_node(
+        self,
+        claim: dra.ResourceClaim,
+        node_name: str,
+        node_slices: List[dra.ResourceSlice],
+        taken: Set[Tuple[str, str, str]],
+    ) -> Optional[dra.AllocationResult]:
+        """Try to satisfy every request of the claim from the node's slices;
+        ``taken`` accumulates devices granted earlier in this pod's own
+        allocation so claims don't double-book."""
+        results: List[dra.DeviceRequestAllocationResult] = []
+        for req in claim.requests:
+            device_class = self.handle.get_device_class(req.device_class_name)
+            if device_class is None:
+                return None
+            found: List[dra.DeviceRequestAllocationResult] = []
+            want = req.count if req.allocation_mode == dra.ALLOCATION_MODE_EXACT else None
+            for sl in node_slices:
+                for dev in sl.devices:
+                    key = (sl.driver, sl.pool, dev.name)
+                    if key in taken:
+                        continue
+                    attrs = dev.attr_map()
+                    if not device_class.admits(attrs):
+                        continue
+                    if not all(s.matches(attrs) for s in req.selectors):
+                        continue
+                    found.append(
+                        dra.DeviceRequestAllocationResult(
+                            request=req.name,
+                            driver=sl.driver,
+                            pool=sl.pool,
+                            device=dev.name,
+                        )
+                    )
+                    taken.add(key)
+                    if want is not None and len(found) >= want:
+                        break
+                if want is not None and len(found) >= want:
+                    break
+            if want is not None and len(found) < want:
+                for r in found:  # give back partial grants
+                    taken.discard((r.driver, r.pool, r.device))
+                return None
+            if want is None and not found:
+                return None
+            results.extend(found)
+        return dra.AllocationResult(results=tuple(results), node_name=node_name)
+
+    # -- Filter (:902) -------------------------------------------------------------
+
+    def filter(self, state: CycleState, pod: Pod, node_state) -> Status:
+        data = state.read((self._STATE_KEY, pod.uid))
+        if data is None:
+            return Status.success()
+        node_name = node_state.node.name
+        taken = set(data["taken_base"])
+        node_slices = data["slices_by_node"].get(node_name, [])
+        allocations: List[Optional[dra.AllocationResult]] = []
+        for claim in data["claims"]:
+            if claim.allocation is not None:
+                # already allocated: usable only on the allocation's node
+                if claim.allocation.node_name and claim.allocation.node_name != node_name:
+                    return Status.unschedulable(
+                        f'resourceclaim "{claim.name}" is allocated for node '
+                        f"{claim.allocation.node_name}",
+                        plugin=self.name,
+                    )
+                allocations.append(None)  # nothing new to allocate
+                continue
+            alloc = self._allocate_on_node(claim, node_name, node_slices, taken)
+            if alloc is None:
+                return Status.unschedulable(
+                    f'cannot allocate all devices for resourceclaim "{claim.name}"',
+                    plugin=self.name,
+                )
+            allocations.append(alloc)
+        data["by_node"][node_name] = allocations
+        return Status.success()
+
+    # -- Reserve / Unreserve (:1156, :1306) ------------------------------------------
+
+    def reserve(self, state: CycleState, pod: Pod, node_name: str) -> Status:
+        data = state.read((self._STATE_KEY, pod.uid))
+        if data is None:
+            return Status.success()
+        allocations = data["by_node"].get(node_name)
+        if allocations is None:
+            return Status.error(
+                f"no DRA decisions recorded for node {node_name}", plugin=self.name
+            )
+        assumed: List[Tuple[dra.ResourceClaim, bool]] = []
+        for claim, alloc in zip(data["claims"], allocations):
+            nc = claim.clone()
+            if alloc is not None:
+                nc.allocation = alloc
+            if pod.uid not in nc.reserved_for:
+                nc.reserved_for = nc.reserved_for + (pod.uid,)
+            self.handle.claim_cache.assume(nc)
+            assumed.append((nc, alloc is not None))
+        data["assumed"] = assumed
+        return Status.success()
+
+    def unreserve(self, state: CycleState, pod: Pod, node_name: str) -> None:
+        """:1306 — restore the cache view AND undo any API writes PreBind
+        already made (the reference's Unreserve patches claims to drop the
+        reservation / deallocate a scheduler-made allocation)."""
+        data = state.read((self._STATE_KEY, pod.uid))
+        if data is None:
+            return
+        for claim, allocated_by_us in data.get("assumed", []):
+            self.handle.claim_cache.restore(claim.key)
+            api_obj = self.handle.claim_cache.get_api_obj(claim.key)
+            if api_obj is None or pod.uid not in api_obj.reserved_for:
+                continue  # never persisted — cache restore is enough
+            rb = api_obj.clone()
+            rb.reserved_for = tuple(u for u in rb.reserved_for if u != pod.uid)
+            if allocated_by_us and not rb.reserved_for:
+                rb.allocation = None
+            try:
+                self.handle.write_claim(rb)
+            except Exception:  # noqa: BLE001 — rollback is best-effort
+                pass
+        data.pop("assumed", None)
+
+    # -- PreBind (:1367): persist allocation + reservation through the API ----
+
+    def pre_bind(self, state: CycleState, pod: Pod, node_name: str) -> Status:
+        data = state.read((self._STATE_KEY, pod.uid))
+        if data is None:
+            return Status.success()
+        for claim, _ in data.get("assumed", []):
+            try:
+                self.handle.write_claim(claim)
+            except Exception as e:  # noqa: BLE001 — surfaced as Status
+                return Status.error(str(e), plugin=self.name)
+        return Status.success()
+
+    # -- queueing hints (:379 EventsToRegister) ---------------------------------
+
+    def events_to_register(self) -> List[ClusterEventWithHint]:
+        def claim_hint(pod: Pod, old, new) -> QueueingHint:
+            # A claim change helps only pods referencing that claim (:434).
+            if new is None or new.namespace != pod.namespace:
+                return QueueingHint.SKIP
+            return (
+                QueueingHint.QUEUE
+                if new.name in pod.resource_claims
+                else QueueingHint.SKIP
+            )
+
+        return [
+            ClusterEventWithHint(
+                ClusterEvent(
+                    EventResource.RESOURCE_CLAIM,
+                    ActionType.ADD | ActionType.UPDATE | ActionType.DELETE,
+                ),
+                claim_hint,
+            ),
+            ClusterEventWithHint(
+                ClusterEvent(
+                    EventResource.RESOURCE_SLICE,
+                    ActionType.ADD | ActionType.UPDATE,
+                )
+            ),
+            ClusterEventWithHint(
+                ClusterEvent(EventResource.DEVICE_CLASS, ActionType.ADD | ActionType.UPDATE)
+            ),
+            ClusterEventWithHint(ClusterEvent(EventResource.NODE, ActionType.ADD)),
+        ]

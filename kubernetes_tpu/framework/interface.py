@@ -160,6 +160,13 @@ class FilterPlugin(Plugin):
     def filter(self, state: CycleState, pod: Pod, node_state) -> Status:
         raise NotImplementedError
 
+    def maybe_relevant(self, pod: Pod) -> bool:
+        """Cheap spec-only predicate: could this plugin's Filter possibly
+        act on the pod?  Used by the batch dispatcher to decide host-filter
+        serialization BEFORE PreFilter runs; must be a superset of
+        "PreFilter would not Skip".  Default: always relevant."""
+        return True
+
 
 class DeviceFilterPlugin(Plugin):
     """Device-backed filter: contributes a [P, N] feasibility mask.
@@ -279,7 +286,11 @@ class EventResource(str, enum.Enum):
     PV = "PersistentVolume"
     STORAGE_CLASS = "StorageClass"
     CSI_NODE = "CSINode"
+    CSI_DRIVER = "CSIDriver"
+    CSI_STORAGE_CAPACITY = "CSIStorageCapacity"
     RESOURCE_CLAIM = "ResourceClaim"
+    RESOURCE_SLICE = "ResourceSlice"
+    DEVICE_CLASS = "DeviceClass"
     WILDCARD = "*"
 
 

@@ -329,6 +329,14 @@ class DefaultPreemption(PostFilterPlugin, EnqueueExtensions):
         ]
 
 
+from kubernetes_tpu.framework.dynamicresources import DynamicResources  # noqa: E402
+from kubernetes_tpu.framework.volume_plugins import (  # noqa: E402
+    NodeVolumeLimits,
+    VolumeRestrictions,
+    VolumeZone,
+)
+from kubernetes_tpu.framework.volumebinding import VolumeBinding  # noqa: E402
+
 DEFAULT_PLUGINS = [
     PrioritySort,
     SchedulingGates,
@@ -344,4 +352,9 @@ DEFAULT_PLUGINS = [
     InterPodAffinity,
     PodTopologySpread,
     DefaultBinder,
+    VolumeBinding,
+    VolumeRestrictions,
+    VolumeZone,
+    NodeVolumeLimits,
+    DynamicResources,
 ]

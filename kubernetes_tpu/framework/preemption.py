@@ -30,7 +30,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from kubernetes_tpu.api.types import Pod, PodDisruptionBudget
-from kubernetes_tpu.framework.interface import Status
+from kubernetes_tpu.framework.interface import Code, CycleState, Status
 from kubernetes_tpu.oracle import filters as OF
 from kubernetes_tpu.oracle.state import NodeState, OracleState
 
@@ -77,6 +77,9 @@ class Evaluator:
         self.handle = handle
         self.percentage = percentage
         self.min_candidates = min_candidates
+        # host-filter context for the current preempt() call
+        self._hf_fwk = None
+        self._hf_state = None
 
     # ----- entry point ------------------------------------------------------
 
@@ -88,6 +91,23 @@ class Evaluator:
         ok, msg = self.pod_eligible(pod, state)
         if not ok:
             return None, Status.unschedulable(msg, plugin=self.plugin_name)
+
+        # Host-backed Filter plugins (volumebinding class) must judge the
+        # dry-run too — otherwise preemption evicts victims on nodes the
+        # pod's volumes can never bind to.  PreFilter runs once here; the
+        # per-node veto happens inside _fits.
+        self._hf_fwk = self._hf_state = None
+        fwk = getattr(self.handle, "framework_for", lambda p: None)(pod)
+        if fwk is not None and fwk.has_host_filters():
+            cs = CycleState()
+            failures = fwk.run_pre_filter(cs, [pod])
+            if failures:
+                return "", Status.unschedulable(
+                    "preemption is not helpful for scheduling",
+                    plugin=self.plugin_name,
+                )
+            if fwk.active_host_filters(cs, [pod]):
+                self._hf_fwk, self._hf_state = fwk, cs
 
         if potential_nodes is None:
             potential_nodes = self.potential_nodes(pod, state)
@@ -155,6 +175,13 @@ class Evaluator:
                 continue
             if OF.filter_node_affinity(pod, ns):
                 continue
+            if self._hf_fwk is not None:
+                # only UnschedulableAndUnresolvable excludes a node from the
+                # dry-run (NodesForStatusCode semantics) — victim removal
+                # may resolve a plain Unschedulable host veto
+                s = self._hf_fwk.run_host_filters(self._hf_state, pod, ns)
+                if s.code == Code.UNSCHEDULABLE_AND_UNRESOLVABLE:
+                    continue
             out.append(name)
         return out
 
@@ -258,6 +285,11 @@ class Evaluator:
             counts = OF.spread_pair_counts(pod, state)
             if OF.filter_topology_spread(pod, ns, state, counts):
                 return False
+            if self._hf_fwk is not None:
+                if not self._hf_fwk.run_host_filters(
+                    self._hf_state, pod, ns
+                ).ok:
+                    return False
             return True
         finally:
             for np in nominated:

@@ -63,10 +63,11 @@ class Framework:
         profile: cfg.Profile,
         registry: Registry,
         handle=None,
+        feature_gates=None,
     ):
         self.profile_name = profile.scheduler_name
         self.handle = handle
-        self._expanded = cfg.expand_profile(profile)
+        self._expanded = cfg.expand_profile(profile, feature_gates)
         self._instances: Dict[str, Plugin] = {}
         self.score_weights: Dict[str, int] = {}
         self.waiting_pods: Dict[str, WaitingPod] = {}
@@ -171,6 +172,16 @@ class Framework:
 
     def has_host_filters(self) -> bool:
         return bool(self.host_filter_plugins())
+
+    def active_host_filters(self, state: CycleState, pods: Sequence[Pod]) -> List[FilterPlugin]:
+        """Host Filter plugins NOT PreFilter-skipped for every pod in the
+        batch.  Stateful plugins (volumebinding class) Skip when a pod has
+        no relevant spec, so volume-less batches keep the device fast path."""
+        return [
+            p
+            for p in self.host_filter_plugins()
+            if any(not state.is_filter_skipped(pod.uid, p.name) for pod in pods)
+        ]
 
     def has_post_filter(self) -> bool:
         return bool(self._by_point.get("postFilter"))

@@ -1,0 +1,462 @@
+"""VolumeBinding: PVC↔PV matching + dynamic-provisioning decisions.
+
+The host-backed stateful plugin path (SURVEY.md §7 "stateful plugins"):
+volume feasibility is low-volume, string/object-heavy control logic that
+gates the device pipeline through the host Filter veto, so it stays on the
+host by design — the batched kernels never see it.
+
+Semantics mirror pkg/scheduler/framework/plugins/volumebinding/
+volume_binding.go (:322 PreFilter, :394 Filter, :476 Reserve, :501 PreBind)
+and binder.go (FindPodVolumes :281, AssumePodVolumes :441, BindPodVolumes
+:512), re-expressed over the generic assume caches.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from kubernetes_tpu.api import labels as k8slabels
+from kubernetes_tpu.api import storage as st
+from kubernetes_tpu.api.types import Node, Pod, node_selector_matches
+from kubernetes_tpu.framework.interface import (
+    ActionType,
+    ClusterEvent,
+    ClusterEventWithHint,
+    CycleState,
+    EnqueueExtensions,
+    EventResource,
+    FilterPlugin,
+    PreBindPlugin,
+    PreFilterPlugin,
+    QueueingHint,
+    ReservePlugin,
+    ScorePlugin,
+    Status,
+)
+
+# Conflict reasons (binder.go:66-74)
+REASON_BIND_CONFLICT = "node(s) didn't find available persistent volumes to bind"
+REASON_NODE_CONFLICT = "node(s) had volume node affinity conflict"
+REASON_NOT_ENOUGH_SPACE = "node(s) did not have enough free storage"
+REASON_PV_NOT_EXIST = (
+    "node(s) unavailable due to one or more pvc(s) bound to non-existent pv(s)"
+)
+
+
+@dataclass
+class BindingInfo:
+    """One static binding decision: this claim onto this PV (binder.go:77)."""
+
+    pvc: st.PersistentVolumeClaim
+    pv: st.PersistentVolume
+
+
+@dataclass
+class PodVolumes:
+    static_bindings: List[BindingInfo] = field(default_factory=list)
+    dynamic_provisions: List[st.PersistentVolumeClaim] = field(default_factory=list)
+
+
+@dataclass
+class PodVolumeClaims:
+    """GetPodVolumeClaims output (binder.go:205)."""
+
+    bound_claims: List[st.PersistentVolumeClaim] = field(default_factory=list)
+    claims_to_bind: List[st.PersistentVolumeClaim] = field(default_factory=list)
+    unbound_claims_immediate: List[st.PersistentVolumeClaim] = field(
+        default_factory=list
+    )
+    # storage class → available PVs for delayed binding (binder.go:861)
+    unbound_volumes_delay_binding: Dict[str, List[st.PersistentVolume]] = field(
+        default_factory=dict
+    )
+
+
+def pv_matches_claim(
+    pv: st.PersistentVolume, pvc: st.PersistentVolumeClaim
+) -> bool:
+    """FindMatchingVolume's per-PV eligibility (pkg/volume/util): class,
+    volumeMode, access modes subset, selector, capacity, and not bound to a
+    different claim."""
+    if (pvc.storage_class_name or "") != pv.storage_class_name:
+        return False
+    if pv.volume_mode != pvc.volume_mode:
+        return False
+    if not set(pvc.access_modes).issubset(set(pv.access_modes)):
+        return False
+    if pv.claim_ref is not None and not (
+        pv.claim_ref.namespace == pvc.namespace and pv.claim_ref.name == pvc.name
+    ):
+        return False
+    if pv.phase not in (st.PV_AVAILABLE, st.PV_BOUND):
+        return False
+    if pv.capacity < pvc.request:
+        return False
+    if pvc.selector is not None:
+        sel = k8slabels.selector_from_label_selector(pvc.selector)
+        if not sel.matches(pv.labels):
+            return False
+    return True
+
+
+def pv_node_affinity_matches(pv: st.PersistentVolume, node: Node) -> bool:
+    """CheckVolumeNodeAffinity: nil affinity matches everywhere."""
+    if pv.node_affinity is None:
+        return True
+    return node_selector_matches(pv.node_affinity, node)
+
+
+class VolumeBinder:
+    """SchedulerVolumeBinder (binder.go:152) over assume caches.
+
+    ``handle`` supplies: pv_cache, pvc_cache (AssumeCache), storage_class /
+    csi_driver / capacity listers, and the pv/pvc API writers.
+    """
+
+    def __init__(self, handle):
+        self.handle = handle
+
+    # -- claim classification (binder.go:825 GetPodVolumeClaims) -------------
+
+    def get_pod_volume_claims(self, pod: Pod) -> Tuple[Optional[PodVolumeClaims], Optional[Status]]:
+        claims = PodVolumeClaims()
+        for name in pod.pvc_names():
+            pvc = self.handle.pvc_cache.get(f"{pod.namespace}/{name}")
+            if pvc is None:
+                return None, Status.unresolvable(
+                    f'persistentvolumeclaim "{name}" not found',
+                    plugin=VolumeBinding.name,
+                )
+            if pvc.deletion_timestamp is not None:
+                return None, Status.unresolvable(
+                    f'persistentvolumeclaim "{name}" is being deleted',
+                    plugin=VolumeBinding.name,
+                )
+            if pvc.is_fully_bound():
+                claims.bound_claims.append(pvc)
+            else:
+                sc = self.handle.get_storage_class(pvc.storage_class_name or "")
+                if sc is not None and sc.is_wait_for_first_consumer():
+                    claims.claims_to_bind.append(pvc)
+                else:
+                    claims.unbound_claims_immediate.append(pvc)
+        for pvc in claims.claims_to_bind:
+            cls = pvc.storage_class_name or ""
+            if cls not in claims.unbound_volumes_delay_binding:
+                claims.unbound_volumes_delay_binding[cls] = [
+                    pv
+                    for pv in self.handle.pv_cache.list()
+                    if pv.storage_class_name == cls
+                ]
+        return claims, None
+
+    # -- per-node feasibility (binder.go:281 FindPodVolumes) -----------------
+
+    def find_pod_volumes(
+        self, pod: Pod, claims: PodVolumeClaims, node: Node
+    ) -> Tuple[PodVolumes, List[str]]:
+        reasons: List[str] = []
+        volumes = PodVolumes()
+
+        # bound claims: PV must exist and its node affinity must admit the
+        # node (binder.go:868 checkBoundClaims)
+        for pvc in claims.bound_claims:
+            pv = self.handle.pv_cache.get(pvc.volume_name)
+            if pv is None:
+                return volumes, [REASON_PV_NOT_EXIST]
+            if not pv_node_affinity_matches(pv, node):
+                return volumes, [REASON_NODE_CONFLICT]
+
+        unbound: List[st.PersistentVolumeClaim] = []
+        if claims.claims_to_bind:
+            # static matching: smallest eligible PV per claim, largest
+            # claims first so they see the full pool (FindMatchingVolume)
+            matched_pvs: set = set()
+            for pvc in sorted(claims.claims_to_bind, key=lambda c: -c.request):
+                pool = claims.unbound_volumes_delay_binding.get(
+                    pvc.storage_class_name or "", []
+                )
+                best = None
+                for pv in pool:
+                    if pv.name in matched_pvs:
+                        continue
+                    if not pv_matches_claim(pv, pvc):
+                        continue
+                    if not pv_node_affinity_matches(pv, node):
+                        continue
+                    if best is None or pv.capacity < best.capacity:
+                        best = pv
+                if best is not None:
+                    matched_pvs.add(best.name)
+                    volumes.static_bindings.append(BindingInfo(pvc, best))
+                else:
+                    unbound.append(pvc)
+
+        if unbound:
+            # dynamic provisioning (binder.go:945 checkVolumeProvisions)
+            provision_ok = True
+            space_ok = True
+            for pvc in unbound:
+                sc = self.handle.get_storage_class(pvc.storage_class_name or "")
+                if sc is None or sc.provisioner == st.NO_PROVISIONER:
+                    provision_ok = False
+                    continue
+                if not sc.topology_allows(node.labels):
+                    provision_ok = False
+                    continue
+                if not self._has_enough_capacity(sc, pvc, node):
+                    space_ok = False
+                    continue
+                volumes.dynamic_provisions.append(pvc)
+            if not provision_ok:
+                reasons.append(REASON_BIND_CONFLICT)
+            if not space_ok:
+                reasons.append(REASON_NOT_ENOUGH_SPACE)
+        return volumes, reasons
+
+    def _has_enough_capacity(
+        self, sc: st.StorageClass, pvc: st.PersistentVolumeClaim, node: Node
+    ) -> bool:
+        """binder.go:1005 hasEnoughCapacity: only checked when the CSI
+        driver opts in via spec.storageCapacity."""
+        driver = self.handle.get_csi_driver(sc.provisioner)
+        if driver is None or not driver.storage_capacity:
+            return True
+        for cap in self.handle.list_capacities():
+            if cap.storage_class_name != sc.name:
+                continue
+            if not cap.topology_matches(node.labels):
+                continue
+            if cap.maximum_volume_size is not None and pvc.request > cap.maximum_volume_size:
+                continue
+            if cap.capacity >= pvc.request:
+                return True
+        return False
+
+    # -- assume / revert / bind (binder.go:441,504,512) -----------------------
+
+    def assume_pod_volumes(
+        self, pod: Pod, node_name: str, volumes: PodVolumes
+    ) -> bool:
+        """Installs the decisions into the assume caches; returns
+        all_bound=True when there was nothing to do."""
+        if not volumes.static_bindings and not volumes.dynamic_provisions:
+            return True
+        new_bindings = []
+        for b in volumes.static_bindings:
+            pv = b.pv.clone()
+            pv.claim_ref = st.ObjectRef(b.pvc.namespace, b.pvc.name)
+            self.handle.pv_cache.assume(pv)
+            new_bindings.append(BindingInfo(b.pvc, pv))
+        volumes.static_bindings = new_bindings
+        new_provisions = []
+        for pvc in volumes.dynamic_provisions:
+            npvc = pvc.clone()
+            npvc.annotations[st.ANN_SELECTED_NODE] = node_name
+            self.handle.pvc_cache.assume(npvc)
+            new_provisions.append(npvc)
+        volumes.dynamic_provisions = new_provisions
+        return False
+
+    def revert_assumed_pod_volumes(self, volumes: PodVolumes) -> None:
+        for b in volumes.static_bindings:
+            self.handle.pv_cache.restore(b.pv.key)
+        for pvc in volumes.dynamic_provisions:
+            self.handle.pvc_cache.restore(pvc.key)
+
+    def bind_pod_volumes(self, pod: Pod, volumes: PodVolumes) -> Optional[str]:
+        """bindAPIUpdate + checkBindings: write the assumed objects through
+        the API, then verify the PV controller completed the binding.
+        Returns an error string or None.  The in-proc fake controller reacts
+        synchronously inside the write, so one post-write check replaces the
+        reference's poll loop (binder.go:512-538)."""
+        for b in volumes.static_bindings:
+            self.handle.write_pv(b.pv)
+        for pvc in volumes.dynamic_provisions:
+            self.handle.write_pvc(pvc)
+        return self._check_bindings(pod, volumes)
+
+    def _check_bindings(self, pod: Pod, volumes: PodVolumes) -> Optional[str]:
+        for b in volumes.static_bindings:
+            pvc = self.handle.pvc_cache.get_api_obj(b.pvc.key)
+            if pvc is None:
+                return f"pvc {b.pvc.key} lost while binding"
+            if not pvc.is_fully_bound() or pvc.volume_name != b.pv.name:
+                return f"pvc {b.pvc.key} not bound to pv {b.pv.name} yet"
+        for p in volumes.dynamic_provisions:
+            pvc = self.handle.pvc_cache.get_api_obj(p.key)
+            if pvc is None:
+                return f"pvc {p.key} lost while provisioning"
+            if pvc.annotations.get(st.ANN_SELECTED_NODE) != p.annotations.get(
+                st.ANN_SELECTED_NODE
+            ):
+                return f"pvc {p.key} selected-node annotation was reset"
+            if not pvc.is_fully_bound():
+                return f"pvc {p.key} not provisioned yet"
+        return None
+
+
+class VolumeBinding(
+    PreFilterPlugin, FilterPlugin, ScorePlugin, ReservePlugin, PreBindPlugin, EnqueueExtensions
+):
+    """volume_binding.go — the plugin shim over VolumeBinder."""
+
+    name = "VolumeBinding"
+
+    _STATE_KEY = "VolumeBinding"
+
+    def __init__(self, args: Optional[dict] = None, handle=None):
+        super().__init__(args, handle)
+        self.binder = VolumeBinder(handle)
+        # VolumeCapacityPriority-gated scorer; shape points as
+        # [(utilization, score)], None = disabled (the default)
+        self.shape = self.args.get("shape")
+
+    def maybe_relevant(self, pod: Pod) -> bool:
+        return bool(pod.pvc_names())
+
+    # -- PreFilter (volume_binding.go:322) -----------------------------------
+
+    def pre_filter(self, state: CycleState, pod: Pod) -> Status:
+        if not pod.pvc_names():
+            return Status.skip()
+        claims, status = self.binder.get_pod_volume_claims(pod)
+        if status is not None:
+            return status
+        if claims.unbound_claims_immediate:
+            return Status.unresolvable(
+                "pod has unbound immediate PersistentVolumeClaims",
+                plugin=self.name,
+            )
+        state.write((self._STATE_KEY, pod.uid), {"claims": claims, "by_node": {}})
+        return Status.success()
+
+    # -- Filter (volume_binding.go:394) ----------------------------------------
+
+    def filter(self, state: CycleState, pod: Pod, node_state) -> Status:
+        data = state.read((self._STATE_KEY, pod.uid))
+        if data is None:  # PreFilter skipped — no PVCs
+            return Status.success()
+        node = node_state.node
+        volumes, reasons = self.binder.find_pod_volumes(pod, data["claims"], node)
+        if reasons:
+            # UnschedulableAndUnresolvable (volume_binding.go:414): no
+            # victim eviction frees a PV / fixes node affinity, so these
+            # nodes must not enter preemption dry-runs.
+            return Status.unresolvable(*reasons, plugin=self.name)
+        data["by_node"][node.name] = volumes
+        return Status.success()
+
+    # -- Score (volume_binding.go:441; VolumeCapacityPriority) -----------------
+
+    def score(self, state: CycleState, pod: Pod, node_state) -> int:
+        if self.shape is None:
+            return 0
+        data = state.read((self._STATE_KEY, pod.uid))
+        if data is None:
+            return 0
+        volumes = data["by_node"].get(node_state.node.name)
+        if volumes is None or not volumes.static_bindings:
+            return 0
+        classes: Dict[str, List[int]] = {}
+        for b in volumes.static_bindings:
+            req, cap = classes.setdefault(b.pv.storage_class_name, [0, 0])
+            classes[b.pv.storage_class_name] = [req + b.pvc.request, cap + b.pv.capacity]
+        if not classes:
+            return 0
+        total = 0.0
+        for req, cap in classes.values():
+            util = 100 if (cap == 0 or req > cap) else req * 100 // cap
+            total += self._shape_value(util)
+        return int(round(total / len(classes)))
+
+    def _shape_value(self, utilization: int) -> float:
+        """helper.BuildBrokenLinearFunction over self.shape points."""
+        pts = sorted(self.shape)
+        if utilization <= pts[0][0]:
+            return pts[0][1]
+        for (x0, y0), (x1, y1) in zip(pts, pts[1:]):
+            if utilization <= x1:
+                return y0 + (y1 - y0) * (utilization - x0) / (x1 - x0)
+        return pts[-1][1]
+
+    # -- Reserve / Unreserve (volume_binding.go:476,528) -----------------------
+
+    def reserve(self, state: CycleState, pod: Pod, node_name: str) -> Status:
+        data = state.read((self._STATE_KEY, pod.uid))
+        if data is None:
+            return Status.success()
+        volumes = data["by_node"].get(node_name)
+        if volumes is None:
+            return Status.error(
+                f"no volume decisions recorded for node {node_name}", plugin=self.name
+            )
+        data["all_bound"] = self.binder.assume_pod_volumes(pod, node_name, volumes)
+        data["reserved_node"] = node_name
+        return Status.success()
+
+    def unreserve(self, state: CycleState, pod: Pod, node_name: str) -> None:
+        data = state.read((self._STATE_KEY, pod.uid))
+        if data is None:
+            return
+        volumes = data["by_node"].get(node_name)
+        if volumes is not None and not data.get("all_bound", True):
+            self.binder.revert_assumed_pod_volumes(volumes)
+
+    # -- PreBind (volume_binding.go:501) ----------------------------------------
+
+    def pre_bind(self, state: CycleState, pod: Pod, node_name: str) -> Status:
+        data = state.read((self._STATE_KEY, pod.uid))
+        if data is None or data.get("all_bound", True):
+            return Status.success()
+        volumes = data["by_node"].get(node_name)
+        err = self.binder.bind_pod_volumes(pod, volumes)
+        if err is not None:
+            return Status.error(err, plugin=self.name)
+        return Status.success()
+
+    # -- queueing hints (volume_binding.go:97 EventsToRegister) -----------------
+
+    def events_to_register(self) -> List[ClusterEventWithHint]:
+        def pvc_hint(pod: Pod, old, new) -> QueueingHint:
+            # Only this pod's own claims becoming bindable matter
+            # (:159 isSchedulableAfterPersistentVolumeClaimChange).
+            if new is None:
+                return QueueingHint.SKIP
+            if new.namespace != pod.namespace:
+                return QueueingHint.SKIP
+            return (
+                QueueingHint.QUEUE
+                if new.name in pod.pvc_names()
+                else QueueingHint.SKIP
+            )
+
+        return [
+            ClusterEventWithHint(
+                ClusterEvent(EventResource.PVC, ActionType.ADD | ActionType.UPDATE),
+                pvc_hint,
+            ),
+            ClusterEventWithHint(
+                ClusterEvent(EventResource.PV, ActionType.ADD | ActionType.UPDATE)
+            ),
+            ClusterEventWithHint(
+                ClusterEvent(EventResource.STORAGE_CLASS, ActionType.ADD | ActionType.UPDATE)
+            ),
+            ClusterEventWithHint(
+                ClusterEvent(EventResource.CSI_NODE, ActionType.ADD | ActionType.UPDATE)
+            ),
+            ClusterEventWithHint(
+                ClusterEvent(
+                    EventResource.CSI_STORAGE_CAPACITY,
+                    ActionType.ADD | ActionType.UPDATE,
+                )
+            ),
+            ClusterEventWithHint(
+                ClusterEvent(
+                    EventResource.CSI_DRIVER,
+                    ActionType.UPDATE | ActionType.DELETE,
+                )
+            ),
+            ClusterEventWithHint(ClusterEvent(EventResource.NODE, ActionType.ADD)),
+        ]

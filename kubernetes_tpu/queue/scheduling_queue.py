@@ -322,6 +322,21 @@ class SchedulingQueue:
                 del self._unschedulable[uid]
                 self._requeue(qp, immediately=False)
                 moved += 1
+        # Gated (PreEnqueue-rejected) pods re-run their gate when an event
+        # their gating plugin registered for fires (e.g. DRA's claim-created
+        # hint) — pod updates alone aren't the only ungating trigger.
+        for uid in list(self._gated):
+            qp = self._gated[uid]
+            if not self._is_worth_requeuing(qp, event, old, new):
+                continue
+            if self.pre_enqueue_check is not None:
+                status = self.pre_enqueue_check(qp.pod)
+                if status is not None and not getattr(status, "ok", True):
+                    continue  # still gated
+            del self._gated[uid]
+            qp.gated = False
+            self._push_active(qp)
+            moved += 1
         return moved
 
     def _is_worth_requeuing(

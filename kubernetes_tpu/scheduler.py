@@ -91,6 +91,47 @@ class Handle:
     def bind(self, pod: Pod, node_name: str) -> None:
         self._s.binding_sink(pod, node_name)
 
+    # -- storage listers / assume caches (scheduler.go:298-302) -------------
+
+    @property
+    def pv_cache(self):
+        return self._s.pv_cache
+
+    @property
+    def pvc_cache(self):
+        return self._s.pvc_cache
+
+    @property
+    def claim_cache(self):
+        return self._s.claim_cache
+
+    def get_storage_class(self, name: str):
+        return self._s.storage_classes.get(name)
+
+    def get_csinode(self, name: str):
+        return self._s.csinodes.get(name)
+
+    def get_csi_driver(self, name: str):
+        return self._s.csidrivers.get(name)
+
+    def list_capacities(self):
+        return list(self._s.capacities.values())
+
+    def list_resource_slices(self):
+        return list(self._s.resource_slices.values())
+
+    def get_device_class(self, name: str):
+        return self._s.device_classes.get(name)
+
+    def write_pv(self, pv) -> None:
+        self._s.pv_writer(pv)
+
+    def write_pvc(self, pvc) -> None:
+        self._s.pvc_writer(pvc)
+
+    def write_claim(self, claim) -> None:
+        self._s.claim_writer(claim)
+
     def oracle_state(self) -> OracleState:
         return self._s.oracle_view()
 
@@ -104,6 +145,9 @@ class Handle:
 
     def list_pdbs(self):
         return self._s.pdb_lister()
+
+    def framework_for(self, pod: Pod):
+        return self._s.profiles.get(pod.scheduler_name)
 
     def get_waiting_pod(self, uid: str):
         for fwk in self._s.profiles.values():
@@ -137,10 +181,31 @@ class Scheduler:
         self.cache = Cache()
         self.mirror = SnapshotMirror()
         self.nominator = Nominator()
+
+        # storage/DRA object views: assume caches for the objects plugins
+        # optimistically mutate (PV/PVC/ResourceClaim, scheduler.go:298-302),
+        # plain lister maps for the rest
+        from kubernetes_tpu.util.assumecache import AssumeCache
+
+        self.pv_cache = AssumeCache("persistent volumes")
+        self.pvc_cache = AssumeCache("persistent volume claims")
+        self.claim_cache = AssumeCache("resource claims")
+        self.storage_classes: Dict[str, object] = {}
+        self.csinodes: Dict[str, object] = {}
+        self.csidrivers: Dict[str, object] = {}
+        self.capacities: Dict[str, object] = {}
+        self.resource_slices: Dict[str, object] = {}
+        self.device_classes: Dict[str, object] = {}
+        self.pv_writer = lambda pv: None
+        self.pvc_writer = lambda pvc: None
+        self.claim_writer = lambda claim: None
+
         handle = Handle(self)
         reg = registry or default_registry()
         self.profiles: Dict[str, Framework] = {
-            p.scheduler_name: Framework(p, reg, handle)
+            p.scheduler_name: Framework(
+                p, reg, handle, feature_gates=self.config.feature_gates
+            )
             for p in self.config.profiles
         }
 
@@ -264,6 +329,56 @@ class Scheduler:
     def _responsible_for(self, pod: Pod) -> bool:
         return pod.scheduler_name in self.profiles
 
+    def storage_handlers(self, resource: EventResource):
+        """(add, update, delete) informer handlers for a storage/DRA
+        resource kind — feed the right cache, then requeue through the
+        queueing-hint machinery (the dynamic per-GVK handlers of
+        eventhandlers.go:431-602)."""
+        assume_caches = {
+            EventResource.PV: self.pv_cache,
+            EventResource.PVC: self.pvc_cache,
+            EventResource.RESOURCE_CLAIM: self.claim_cache,
+        }
+        lister_maps = {
+            EventResource.STORAGE_CLASS: self.storage_classes,
+            EventResource.CSI_NODE: self.csinodes,
+            EventResource.CSI_DRIVER: self.csidrivers,
+            EventResource.CSI_STORAGE_CAPACITY: self.capacities,
+            EventResource.RESOURCE_SLICE: self.resource_slices,
+            EventResource.DEVICE_CLASS: self.device_classes,
+        }
+        cache = assume_caches.get(resource)
+        lister = lister_maps.get(resource)
+
+        def on_add(obj):
+            if cache is not None:
+                cache.on_add(obj)
+            if lister is not None:
+                lister[obj.key] = obj
+            self.queue.move_all_on_event(
+                ClusterEvent(resource, ActionType.ADD), None, obj
+            )
+
+        def on_update(old, new):
+            if cache is not None:
+                cache.on_update(old, new)
+            if lister is not None:
+                lister[new.key] = new
+            self.queue.move_all_on_event(
+                ClusterEvent(resource, ActionType.UPDATE), old, new
+            )
+
+        def on_delete(obj):
+            if cache is not None:
+                cache.on_delete(obj)
+            if lister is not None:
+                lister.pop(obj.key, None)
+            self.queue.move_all_on_event(
+                ClusterEvent(resource, ActionType.DELETE), obj, None
+            )
+
+        return on_add, on_update, on_delete
+
     # ----- views ------------------------------------------------------------
 
     def _invalidate_view(self) -> None:
@@ -316,6 +431,34 @@ class Scheduler:
             batch[0].pod.scheduler_name, next(iter(self.profiles.values()))
         )
         outcomes: List[ScheduleOutcome] = []
+
+        if len(batch) > 1:
+            # Host-stateful Filter plugins (volumebinding/DRA class) judge
+            # against cache state that earlier commits in the SAME batch
+            # mutate — their veto masks can't be batched.  Pods those
+            # plugins could act on (cheap spec check — maybe_relevant)
+            # degrade to one-pod cycles (the reference's native granularity,
+            # schedule_one.go:65); contiguous runs of clean pods stay on the
+            # batched device path.  Runs preserve queue order, so decisions
+            # stay sequential-equivalent.
+            hf = fwk.host_filter_plugins()
+            if hf:
+                run: List = []
+                split = False
+                for qp in batch:
+                    if not any(p.maybe_relevant(qp.pod) for p in hf):
+                        run.append(qp)
+                        continue
+                    split = True
+                    if run:
+                        outcomes.extend(self._schedule_batch(run))
+                        run = []
+                    outcomes.extend(self._schedule_batch([qp]))
+                if split:
+                    if run:
+                        outcomes.extend(self._schedule_batch(run))
+                    return outcomes
+
         state = CycleState()
 
         # 0. PreFilter (runtime:698): per-pod rejection + Skip bookkeeping
@@ -354,8 +497,9 @@ class Scheduler:
         weights = tuple(
             fwk.score_weights.get(n, 0) for n in gang.WEIGHT_ORDER
         )
+        active_host = fwk.active_host_filters(state, pods)
         if (
-            not fwk.has_host_filters()
+            not active_host
             and not len(self.nominator)
             and self.cache.n_term_pods == 0
             and self.cache.n_port_pods == 0
@@ -394,8 +538,11 @@ class Scheduler:
         # 1b. host-backed Filter plugins veto (pod, node) pairs the device
         # kernels can't judge (stateful plugins — volumebinding class).
         extra_mask = None
-        if fwk.has_host_filters():
-            extra_mask = self._host_filter_mask(fwk, state, pods, p_cap)
+        host_diags = host_plugin_sets = None
+        if active_host:
+            extra_mask, host_diags, host_plugin_sets = self._host_filter_mask(
+                fwk, state, pods, p_cap
+            )
 
         # 1c. nominated preemptors (victims still terminating) charge their
         # nominated node for pods of lower priority (runtime:973).
@@ -441,13 +588,20 @@ class Scheduler:
                     for k, c in zip(gang.DIAG_KERNELS, counts[i])
                     if c > 0
                 }
+                plugins = set(diag)
+                if "HostFilters" in plugins:
+                    # replace the aggregate bucket with the per-plugin
+                    # reasons recorded while building the veto mask
+                    plugins.discard("HostFilters")
+                    diag.pop("HostFilters", None)
+                    if host_diags is not None:
+                        diag.update(host_diags[i])
+                        plugins |= host_plugin_sets[i]
+                    else:
+                        plugins |= {p.name for p in fwk.host_filter_plugins()}
                 status = Status.unschedulable(
                     fit_error_message(n_nodes, diag)
                 )
-                plugins = set(diag)
-                if "HostFilters" in plugins:
-                    plugins.discard("HostFilters")
-                    plugins |= {p.name for p in fwk.host_filter_plugins()}
                 outcomes.append(
                     self._post_filter_or_fail(
                         fwk, state, qp, status, int(n_feas[i]), diag, plugins
@@ -638,7 +792,12 @@ class Scheduler:
 
     def _host_filter_mask(self, fwk, state, pods, p_cap: int):
         """[p_cap, N] bool: True where host Filter plugins allow the pair
-        (the post-device-veto path of runtime:861 for host-backed plugins)."""
+        (the post-device-veto path of runtime:861 for host-backed plugins).
+
+        Also returns per-pod failure detail for Diagnosis fidelity
+        (types.go:367): ``diags[i]`` maps reason-string → node count and
+        ``plugin_sets[i]`` names the rejecting plugins (drives queueing
+        hints)."""
         import numpy as np
 
         nt = self.mirror.nodes
@@ -649,13 +808,20 @@ class Scheduler:
             st.nodes.get(nt.names[j]) if j < len(nt.names) else None
             for j in range(n_cap)
         ]
+        diags: List[Dict[str, int]] = [dict() for _ in pods]
+        plugin_sets: List[set] = [set() for _ in pods]
         for i, pod in enumerate(pods):
             for j, ns in enumerate(node_states):
                 if ns is None or not nt.valid[j]:
                     continue
-                if not fwk.run_host_filters(state, pod, ns).ok:
+                s = fwk.run_host_filters(state, pod, ns)
+                if not s.ok:
                     mask[i, j] = False
-        return jnp.asarray(mask)
+                    reason = s.merge_reason() or s.plugin
+                    diags[i][reason] = diags[i].get(reason, 0) + 1
+                    if s.plugin:
+                        plugin_sets[i].add(s.plugin)
+        return jnp.asarray(mask), diags, plugin_sets
 
     def _post_filter_or_fail(
         self,

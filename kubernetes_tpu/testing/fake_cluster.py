@@ -6,16 +6,62 @@ import copy
 from dataclasses import replace
 from typing import Callable, Dict, List, Optional
 
-from kubernetes_tpu.api.types import Node, Pod
+from kubernetes_tpu.api import storage as st
+from kubernetes_tpu.api.types import Node, NodeSelector, NodeSelectorRequirement, NodeSelectorTerm, Pod
+
+
+class _ObjectStore:
+    """One watched resource kind: name-keyed store with resource-version
+    bumping and add/update/delete handler fan-out (the per-resource slice of
+    a real apiserver's watch cache)."""
+
+    def __init__(self, cluster: "FakeCluster") -> None:
+        self._cluster = cluster
+        self.objects: Dict[str, object] = {}
+        self.handlers: List[tuple] = []  # (add, update, delete)
+
+    def watch(self, on_add, on_update, on_delete) -> None:
+        self.handlers.append((on_add, on_update, on_delete))
+        for obj in list(self.objects.values()):
+            on_add(copy.deepcopy(obj))
+
+    def create(self, obj) -> None:
+        obj = copy.deepcopy(obj)
+        obj.resource_version = self._cluster._next_rv()
+        self.objects[obj.key] = obj
+        for add, _, _ in self.handlers:
+            add(copy.deepcopy(obj))
+
+    def update(self, obj) -> None:
+        obj = copy.deepcopy(obj)
+        old = self.objects.get(obj.key)
+        obj.resource_version = self._cluster._next_rv()
+        self.objects[obj.key] = obj
+        for _, update, _ in self.handlers:
+            update(copy.deepcopy(old), copy.deepcopy(obj))
+
+    def delete(self, key: str) -> None:
+        obj = self.objects.pop(key, None)
+        if obj is None:
+            return
+        for _, _, delete in self.handlers:
+            delete(copy.deepcopy(obj))
+
+    def get(self, key: str):
+        return self.objects.get(key)
 
 
 class FakeCluster:
     """A miniature apiserver: CRUD on nodes/pods, watch handler fan-out, and
     the pods/binding subresource (registry/core/pod/storage/storage.go:169
     assignPod semantics — sets spec.nodeName via the store, then notifies
-    watchers)."""
+    watchers).  Storage objects (PV/PVC/StorageClass/CSINode/CSIDriver/
+    CSIStorageCapacity) live in generic watched stores; ``pv_controller``
+    emulates kube-controller-manager's PV binder + an external dynamic
+    provisioner so VolumeBinding's PreBind write-and-wait completes in-proc
+    (the integration-test role of the real PV controller)."""
 
-    def __init__(self) -> None:
+    def __init__(self, pv_controller: bool = True) -> None:
         self.nodes: Dict[str, Node] = {}
         self.pods: Dict[str, Pod] = {}
         self.pdbs: Dict[str, object] = {}  # name → PodDisruptionBudget
@@ -23,6 +69,85 @@ class FakeCluster:
         self._pod_handlers: List[tuple] = []
         self.bindings: Dict[str, str] = {}  # pod uid → node name
         self.evictions: List[str] = []  # uids deleted via preemption
+        self._rv = 0
+        self.pvs = _ObjectStore(self)
+        self.pvcs = _ObjectStore(self)
+        self.storage_classes = _ObjectStore(self)
+        self.csinodes = _ObjectStore(self)
+        self.csidrivers = _ObjectStore(self)
+        self.capacities = _ObjectStore(self)
+        self.resource_claims = _ObjectStore(self)
+        self.resource_slices = _ObjectStore(self)
+        self.device_classes = _ObjectStore(self)
+        self._pv_controller = pv_controller
+        self.provisioned: List[str] = []  # PV names the fake provisioner made
+
+    def _next_rv(self) -> int:
+        self._rv += 1
+        return self._rv
+
+    # ----- the in-proc PV controller + provisioner ---------------------------
+
+    def _reconcile_volumes(self) -> None:
+        """Bind PVs whose claimRef is set (the PV controller's syncVolume)
+        and provision WaitForFirstConsumer claims annotated with a selected
+        node (an external provisioner's watch loop)."""
+        if not self._pv_controller:
+            return
+        changed = True
+        while changed:
+            changed = False
+            for pv in list(self.pvs.objects.values()):
+                if pv.claim_ref is None:
+                    continue
+                pvc = self.pvcs.get(f"{pv.claim_ref.namespace}/{pv.claim_ref.name}")
+                if pvc is None:
+                    continue
+                if pvc.volume_name != pv.name or pvc.phase != st.PVC_BOUND:
+                    pvc = pvc.clone()
+                    pvc.volume_name = pv.name
+                    pvc.phase = st.PVC_BOUND
+                    self.pvcs.update(pvc)
+                    changed = True
+                if pv.phase != st.PV_BOUND:
+                    pv = pv.clone()
+                    pv.phase = st.PV_BOUND
+                    self.pvs.update(pv)
+                    changed = True
+            for pvc in list(self.pvcs.objects.values()):
+                node_name = pvc.annotations.get(st.ANN_SELECTED_NODE)
+                if not node_name or pvc.volume_name:
+                    continue
+                sc = self.storage_classes.get(pvc.storage_class_name or "")
+                if sc is None or sc.provisioner == st.NO_PROVISIONER:
+                    continue
+                pv_name = f"pv-provisioned-{pvc.namespace}-{pvc.name}"
+                if self.pvs.get(pv_name) is not None:
+                    continue
+                affinity = NodeSelector(
+                    (
+                        NodeSelectorTerm(
+                            match_fields=(
+                                NodeSelectorRequirement(
+                                    "metadata.name", "In", (node_name,)
+                                ),
+                            )
+                        ),
+                    )
+                )
+                pv = st.PersistentVolume(
+                    name=pv_name,
+                    capacity=pvc.request,
+                    access_modes=pvc.access_modes,
+                    storage_class_name=pvc.storage_class_name or "",
+                    node_affinity=affinity,
+                    claim_ref=st.ObjectRef(pvc.namespace, pvc.name),
+                    csi_driver=sc.provisioner,
+                    source_id=pv_name,
+                )
+                self.provisioned.append(pv_name)
+                self.pvs.create(pv)
+                changed = True
 
     # ----- watch registration ----------------------------------------------
 
@@ -119,6 +244,36 @@ class FakeCluster:
     def create_pdb(self, pdb) -> None:
         self.pdbs[pdb.name] = pdb
 
+    # ----- storage objects ----------------------------------------------------
+
+    def create_pv(self, pv: st.PersistentVolume) -> None:
+        self.pvs.create(pv)
+        self._reconcile_volumes()
+
+    def update_pv(self, pv: st.PersistentVolume) -> None:
+        self.pvs.update(pv)
+        self._reconcile_volumes()
+
+    def create_pvc(self, pvc: st.PersistentVolumeClaim) -> None:
+        self.pvcs.create(pvc)
+        self._reconcile_volumes()
+
+    def update_pvc(self, pvc: st.PersistentVolumeClaim) -> None:
+        self.pvcs.update(pvc)
+        self._reconcile_volumes()
+
+    def create_storage_class(self, sc: st.StorageClass) -> None:
+        self.storage_classes.create(sc)
+
+    def create_csinode(self, cn: st.CSINode) -> None:
+        self.csinodes.create(cn)
+
+    def create_csidriver(self, d: st.CSIDriver) -> None:
+        self.csidrivers.create(d)
+
+    def create_capacity(self, c: st.CSIStorageCapacity) -> None:
+        self.capacities.create(c)
+
     # ----- wiring -----------------------------------------------------------
 
     def connect(self, scheduler) -> None:
@@ -138,3 +293,23 @@ class FakeCluster:
         scheduler.pod_deleter = evict
         scheduler.pdb_lister = lambda: list(self.pdbs.values())
         scheduler.status_patcher = self.patch_pod_status
+
+        # storage informers → scheduler assume caches + requeue events
+        # (the per-GVK dynamic handlers of eventhandlers.go:431)
+        from kubernetes_tpu.framework.interface import EventResource
+
+        for store, res in (
+            (self.pvs, EventResource.PV),
+            (self.pvcs, EventResource.PVC),
+            (self.storage_classes, EventResource.STORAGE_CLASS),
+            (self.csinodes, EventResource.CSI_NODE),
+            (self.csidrivers, EventResource.CSI_DRIVER),
+            (self.capacities, EventResource.CSI_STORAGE_CAPACITY),
+            (self.resource_claims, EventResource.RESOURCE_CLAIM),
+            (self.resource_slices, EventResource.RESOURCE_SLICE),
+            (self.device_classes, EventResource.DEVICE_CLASS),
+        ):
+            store.watch(*scheduler.storage_handlers(res))
+        scheduler.pvc_writer = self.update_pvc
+        scheduler.pv_writer = self.update_pv
+        scheduler.claim_writer = self.resource_claims.update

@@ -1,0 +1,1 @@
+"""Shared scheduler utilities (reference pkg/scheduler/util)."""

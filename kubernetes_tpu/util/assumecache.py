@@ -1,0 +1,105 @@
+"""Generic assume cache (reference pkg/scheduler/util/assumecache/assume_cache.go).
+
+An informer-backed object store that lets the scheduler optimistically
+"assume" a newer version of an object ahead of the watch confirming it:
+
+  * informer add/update events only overwrite an entry when the incoming
+    ``resource_version`` is >= the stored one (assume_cache.go:218-263 —
+    an event older than the assumed object is the watch still catching up,
+    so the assumed version wins);
+  * ``assume(obj)`` installs a local version; it must carry the SAME
+    resource_version as the currently stored object (the optimistic-
+    concurrency precondition, :426-462) — it is replaced as soon as the
+    watch delivers the real post-write object with a bumped version;
+  * ``restore(key)`` reverts an assumed entry to the latest API object
+    (:464).
+
+Objects must expose ``.key`` (unique id) and ``.resource_version`` (int).
+Single-writer scheduler loop ⇒ no locking needed (the reference's mutex
+guards informer goroutines; here events are delivered on the same thread).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Generic, List, Optional, TypeVar
+
+T = TypeVar("T")
+
+
+class AssumeCacheError(Exception):
+    pass
+
+
+class _Entry(Generic[T]):
+    __slots__ = ("latest_api_obj", "obj")
+
+    def __init__(self, api_obj: T):
+        self.latest_api_obj = api_obj  # last object seen from the informer
+        self.obj = api_obj  # what Get returns (assumed or api)
+
+
+class AssumeCache(Generic[T]):
+    def __init__(self, description: str = "") -> None:
+        self.description = description
+        self._entries: Dict[str, _Entry[T]] = {}
+
+    # ----- informer event handlers -----------------------------------------
+
+    def on_add(self, obj: T) -> None:
+        if obj is None:
+            return
+        cur = self._entries.get(obj.key)
+        if cur is not None and obj.resource_version <= cur.obj.resource_version:
+            # Stale or same-version redelivery (resync/at-least-once watch):
+            # keep the stored object — an assumed object carries the SAME
+            # version as the API object it shadows (assume_cache.go:249
+            # skips on newVersion <= storedVersion for exactly this case).
+            return
+        self._entries[obj.key] = _Entry(obj)
+
+    def on_update(self, old: Optional[T], new: T) -> None:
+        self.on_add(new)
+
+    def on_delete(self, obj: T) -> None:
+        if obj is not None:
+            self._entries.pop(obj.key, None)
+
+    # ----- reads -------------------------------------------------------------
+
+    def get(self, key: str) -> Optional[T]:
+        e = self._entries.get(key)
+        return e.obj if e else None
+
+    def get_api_obj(self, key: str) -> Optional[T]:
+        e = self._entries.get(key)
+        return e.latest_api_obj if e else None
+
+    def list(self, predicate: Optional[Callable[[T], bool]] = None) -> List[T]:
+        out = [e.obj for e in self._entries.values()]
+        if predicate is not None:
+            out = [o for o in out if predicate(o)]
+        return out
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    # ----- assume / restore ---------------------------------------------------
+
+    def assume(self, obj: T) -> None:
+        """Install a locally-modified version of a stored object.  The
+        incoming object must carry the stored object's resource_version
+        (assume_cache.go:426: 'can only assume latest version')."""
+        e = self._entries.get(obj.key)
+        if e is None:
+            raise AssumeCacheError(f"{self.description}: {obj.key!r} not found")
+        if obj.resource_version != e.obj.resource_version:
+            raise AssumeCacheError(
+                f"{self.description}: assume {obj.key!r} at version "
+                f"{obj.resource_version}, cache has {e.obj.resource_version}"
+            )
+        e.obj = obj
+
+    def restore(self, key: str) -> None:
+        e = self._entries.get(key)
+        if e is not None:
+            e.obj = e.latest_api_obj

@@ -1,0 +1,209 @@
+"""DynamicResources (DRA): structured-parameter claim allocation through
+the scheduling cycle (the SchedulingWithResourceClaims-shaped scenarios)."""
+
+from kubernetes_tpu.api import dra
+from kubernetes_tpu.api.resource import Resource
+from kubernetes_tpu.api.types import Container, Node, Pod
+from kubernetes_tpu.framework.config import SchedulerConfiguration
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.testing import FakeCluster
+
+
+def build_env(batch_size=8):
+    api = FakeCluster()
+    config = SchedulerConfiguration(batch_size=batch_size)
+    config.feature_gates["DynamicResourceAllocation"] = True
+    sched = Scheduler(configuration=config)
+    api.connect(sched)
+    return api, sched
+
+
+def make_node(name):
+    return Node(
+        name=name,
+        labels={"kubernetes.io/hostname": name},
+        capacity=Resource.from_map({"cpu": "8", "memory": "16Gi", "pods": 110}),
+    )
+
+
+def make_pod(name, claims=()):
+    return Pod(
+        name=name,
+        containers=[Container(name="c", requests={"cpu": "100m"})],
+        resource_claims=tuple(claims),
+    )
+
+
+def gpu_slice(name, node, n_devices, vendor="example.com"):
+    return dra.ResourceSlice(
+        name=name,
+        node_name=node,
+        driver="gpu.example.com",
+        pool=f"{node}-pool",
+        devices=tuple(
+            dra.Device(name=f"gpu-{i}", attributes=(("vendor", vendor),))
+            for i in range(n_devices)
+        ),
+    )
+
+
+GPU_CLASS = dra.DeviceClass(
+    name="gpu",
+    selectors=(dra.DeviceSelector("vendor", "In", ("example.com",)),),
+)
+
+
+def test_claim_allocated_on_node_with_devices():
+    api, sched = build_env()
+    api.create_node(make_node("node-1"))
+    api.create_node(make_node("node-2"))
+    api.device_classes.create(GPU_CLASS)
+    api.resource_slices.create(gpu_slice("sl-2", "node-2", 2))
+    api.resource_claims.create(
+        dra.ResourceClaim(
+            name="claim-g",
+            requests=(dra.DeviceRequest(name="gpu", device_class_name="gpu", count=1),),
+        )
+    )
+    api.create_pod(make_pod("pod-g", claims=("claim-g",)))
+
+    outcomes = sched.schedule_pending()
+    assert outcomes[0].node == "node-2"
+    claim = api.resource_claims.get("default/claim-g")
+    assert claim.allocation is not None
+    assert claim.allocation.node_name == "node-2"
+    assert len(claim.allocation.results) == 1
+    assert claim.allocation.results[0].driver == "gpu.example.com"
+    assert outcomes[0].pod.uid in claim.reserved_for
+
+
+def test_device_exclusivity_across_claims():
+    """One device on the node: the second claim cannot allocate there."""
+    api, sched = build_env()
+    api.create_node(make_node("node-1"))
+    api.device_classes.create(GPU_CLASS)
+    api.resource_slices.create(gpu_slice("sl-1", "node-1", 1))
+    for i in range(2):
+        api.resource_claims.create(
+            dra.ResourceClaim(
+                name=f"claim-{i}",
+                requests=(
+                    dra.DeviceRequest(name="gpu", device_class_name="gpu", count=1),
+                ),
+            )
+        )
+        api.create_pod(make_pod(f"pod-{i}", claims=(f"claim-{i}",)))
+
+    outcomes = sched.schedule_pending()
+    by_name = {o.pod.name: o for o in outcomes}
+    landed = [o for o in by_name.values() if o.node == "node-1"]
+    failed = [o for o in by_name.values() if o.node is None]
+    assert len(landed) == 1 and len(failed) == 1
+    assert "cannot allocate" in failed[0].status.merge_reason()
+
+
+def test_count_and_selector_matching():
+    """count=2 with a per-request selector: only the node with two matching
+    devices qualifies."""
+    api, sched = build_env()
+    api.create_node(make_node("node-1"))
+    api.create_node(make_node("node-2"))
+    api.device_classes.create(GPU_CLASS)
+    api.resource_slices.create(gpu_slice("sl-1", "node-1", 1))
+    api.resource_slices.create(gpu_slice("sl-2", "node-2", 3))
+    api.resource_claims.create(
+        dra.ResourceClaim(
+            name="claim-2",
+            requests=(
+                dra.DeviceRequest(
+                    name="gpus",
+                    device_class_name="gpu",
+                    count=2,
+                    selectors=(
+                        dra.DeviceSelector("vendor", "In", ("example.com",)),
+                    ),
+                ),
+            ),
+        )
+    )
+    api.create_pod(make_pod("pod-2", claims=("claim-2",)))
+    outcomes = sched.schedule_pending()
+    assert outcomes[0].node == "node-2"
+    claim = api.resource_claims.get("default/claim-2")
+    assert len(claim.allocation.results) == 2
+
+
+def test_preallocated_claim_pins_node():
+    api, sched = build_env()
+    api.create_node(make_node("node-1"))
+    api.create_node(make_node("node-2"))
+    api.device_classes.create(GPU_CLASS)
+    api.resource_claims.create(
+        dra.ResourceClaim(
+            name="claim-p",
+            requests=(dra.DeviceRequest(name="gpu", device_class_name="gpu"),),
+            allocation=dra.AllocationResult(
+                results=(
+                    dra.DeviceRequestAllocationResult(
+                        "gpu", "gpu.example.com", "node-1-pool", "gpu-0"
+                    ),
+                ),
+                node_name="node-1",
+            ),
+        )
+    )
+    api.create_pod(make_pod("pod-p", claims=("claim-p",)))
+    outcomes = sched.schedule_pending()
+    assert outcomes[0].node == "node-1"
+
+
+def test_missing_claim_gates_pod_until_created():
+    """PreEnqueue keeps the pod out of the queue until the claim exists;
+    the claim-created hint then ungates it (dynamicresources.go:419)."""
+    api, sched = build_env()
+    api.create_node(make_node("node-1"))
+    api.device_classes.create(GPU_CLASS)
+    api.resource_slices.create(gpu_slice("sl-1", "node-1", 1))
+    api.create_pod(make_pod("pod-w", claims=("claim-w",)))
+
+    outcomes = sched.schedule_pending()
+    assert outcomes == []  # gated — never reached the active queue
+    assert len(sched.queue._gated) == 1
+
+    api.resource_claims.create(
+        dra.ResourceClaim(
+            name="claim-w",
+            requests=(dra.DeviceRequest(name="gpu", device_class_name="gpu"),),
+        )
+    )
+    outcomes = sched.schedule_pending()
+    assert len(outcomes) == 1 and outcomes[0].node == "node-1"
+
+
+def test_unreserve_rolls_back_assumed_claim():
+    """A reserve-stage failure must restore the claim cache view."""
+    api, sched = build_env()
+    api.create_node(make_node("node-1"))
+    api.device_classes.create(GPU_CLASS)
+    api.resource_slices.create(gpu_slice("sl-1", "node-1", 1))
+    api.resource_claims.create(
+        dra.ResourceClaim(
+            name="claim-r",
+            requests=(dra.DeviceRequest(name="gpu", device_class_name="gpu"),),
+        )
+    )
+    # make binding fail so the whole commit unwinds
+    api.create_pod(make_pod("pod-r", claims=("claim-r",)))
+
+    def failing_bind(pod, node):
+        raise RuntimeError("api down")
+
+    sched.binding_sink = failing_bind
+    outcomes = sched.schedule_pending()
+    assert outcomes[0].node is None
+    # the assumed allocation must have been rolled back in the cache
+    cached = sched.claim_cache.get("default/claim-r")
+    assert cached.allocation is None
+    assert cached.reserved_for == ()
+    # and the API object was never written
+    assert api.resource_claims.get("default/claim-r").allocation is None

@@ -1,0 +1,518 @@
+"""Volume stack: assume cache, VolumeBinding, VolumeZone,
+VolumeRestrictions, NodeVolumeLimits — the SchedulingInTreePVs /
+SchedulingCSIPVs-shaped tier-2 scenarios (SURVEY.md §4, §6)."""
+
+import pytest
+
+from kubernetes_tpu.api import storage as st
+from kubernetes_tpu.api.resource import Resource
+from kubernetes_tpu.api.types import (
+    Container,
+    Node,
+    NodeSelector,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    Pod,
+    Volume,
+)
+from kubernetes_tpu.framework.config import SchedulerConfiguration
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.testing import FakeCluster
+from kubernetes_tpu.util.assumecache import AssumeCache, AssumeCacheError
+
+
+class FakeClock:
+    def __init__(self) -> None:
+        self.now = 1000.0
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, s: float) -> None:
+        self.now += s
+
+
+def build_env(batch_size=8):
+    api = FakeCluster()
+    clock = FakeClock()
+    sched = Scheduler(
+        configuration=SchedulerConfiguration(batch_size=batch_size), clock=clock
+    )
+    sched._test_clock = clock
+    api.connect(sched)
+    return api, sched
+
+
+def make_node(name, cpu="8", mem="16Gi", labels=None):
+    return Node(
+        name=name,
+        labels={"kubernetes.io/hostname": name, **(labels or {})},
+        capacity=Resource.from_map({"cpu": cpu, "memory": mem, "pods": 110}),
+    )
+
+
+def make_pod(name, pvcs=(), volumes=(), cpu="100m"):
+    vols = tuple(Volume(name=f"v-{p}", pvc_name=p) for p in pvcs) + tuple(volumes)
+    return Pod(
+        name=name,
+        containers=[Container(name="c", requests={"cpu": cpu})],
+        volumes=vols,
+    )
+
+
+def node_affinity_to(*names):
+    return NodeSelector(
+        (
+            NodeSelectorTerm(
+                match_fields=(
+                    NodeSelectorRequirement("metadata.name", "In", tuple(names)),
+                )
+            ),
+        )
+    )
+
+
+# ---------------------------------------------------------------------------
+# generic assume cache
+# ---------------------------------------------------------------------------
+
+
+def test_assume_cache_event_vs_assume_ordering():
+    c = AssumeCache("pv")
+    pv = st.PersistentVolume.make("pv-1", "1Gi")
+    pv.resource_version = 5
+    c.on_add(pv)
+
+    assumed = pv.clone()
+    assumed.claim_ref = st.ObjectRef("default", "claim")
+    c.assume(assumed)
+    assert c.get("pv-1").claim_ref is not None
+
+    # stale informer delivery (older rv) must not clobber the assumed obj
+    stale = pv.clone()
+    stale.resource_version = 4
+    c.on_add(stale)
+    assert c.get("pv-1").claim_ref is not None
+
+    # newer rv from the watch replaces the assumed version
+    newer = pv.clone()
+    newer.resource_version = 6
+    c.on_update(pv, newer)
+    assert c.get("pv-1").claim_ref is None
+
+    # assume must carry the stored resource_version
+    wrong = newer.clone()
+    wrong.resource_version = 3
+    with pytest.raises(AssumeCacheError):
+        c.assume(wrong)
+
+
+def test_assume_cache_restore():
+    c = AssumeCache("pvc")
+    pvc = st.PersistentVolumeClaim.make("c1")
+    pvc.resource_version = 1
+    c.on_add(pvc)
+    assumed = pvc.clone()
+    assumed.annotations[st.ANN_SELECTED_NODE] = "node-1"
+    c.assume(assumed)
+    c.restore(pvc.key)
+    assert st.ANN_SELECTED_NODE not in c.get(pvc.key).annotations
+
+
+# ---------------------------------------------------------------------------
+# VolumeBinding
+# ---------------------------------------------------------------------------
+
+
+def test_static_binding_wait_for_first_consumer():
+    """A WFFC claim binds to the node-affine PV chosen during scheduling
+    (SchedulingInTreePVs shape)."""
+    api, sched = build_env()
+    for n in ("node-1", "node-2"):
+        api.create_node(make_node(n))
+    api.create_storage_class(
+        st.StorageClass(
+            name="local",
+            provisioner=st.NO_PROVISIONER,
+            volume_binding_mode=st.BINDING_WAIT_FOR_FIRST_CONSUMER,
+        )
+    )
+    # the only matching PV lives on node-2
+    api.create_pv(
+        st.PersistentVolume.make(
+            "pv-a",
+            "10Gi",
+            storage_class_name="local",
+            node_affinity=node_affinity_to("node-2"),
+        )
+    )
+    pvc = st.PersistentVolumeClaim.make("claim-a", "5Gi", storage_class_name="local")
+    api.create_pvc(pvc)
+    api.create_pod(make_pod("pod-a", pvcs=("claim-a",)))
+
+    outcomes = sched.schedule_pending()
+    assert len(outcomes) == 1
+    assert outcomes[0].node == "node-2"
+    bound = api.pvcs.get("default/claim-a")
+    assert bound.volume_name == "pv-a"
+    assert bound.phase == st.PVC_BOUND
+    assert api.pvs.get("pv-a").claim_ref.name == "claim-a"
+
+
+def test_unbound_immediate_claim_is_unresolvable():
+    api, sched = build_env()
+    api.create_node(make_node("node-1"))
+    api.create_storage_class(st.StorageClass(name="fast"))  # Immediate mode
+    api.create_pvc(
+        st.PersistentVolumeClaim.make("claim-i", storage_class_name="fast")
+    )
+    api.create_pod(make_pod("pod-i", pvcs=("claim-i",)))
+
+    outcomes = sched.schedule_pending()
+    assert outcomes[0].node is None
+    assert "unbound immediate" in outcomes[0].status.merge_reason()
+
+
+def test_missing_pvc_is_unresolvable():
+    api, sched = build_env()
+    api.create_node(make_node("node-1"))
+    api.create_pod(make_pod("pod-x", pvcs=("nope",)))
+    outcomes = sched.schedule_pending()
+    assert outcomes[0].node is None
+    assert "not found" in outcomes[0].status.merge_reason()
+
+
+def test_bound_claim_pv_node_affinity_steers_pod():
+    """Pre-bound PVC: pod must follow the PV's node affinity."""
+    api, sched = build_env()
+    for n in ("node-1", "node-2", "node-3"):
+        api.create_node(make_node(n))
+    api.create_storage_class(st.StorageClass(name="fast"))
+    api.create_pv(
+        st.PersistentVolume.make(
+            "pv-b",
+            "10Gi",
+            storage_class_name="fast",
+            node_affinity=node_affinity_to("node-3"),
+            claim_ref=st.ObjectRef("default", "claim-b"),
+        )
+    )
+    api.create_pvc(
+        st.PersistentVolumeClaim.make("claim-b", storage_class_name="fast")
+    )
+    # the fake PV controller has bound them now
+    assert api.pvcs.get("default/claim-b").is_fully_bound()
+    api.create_pod(make_pod("pod-b", pvcs=("claim-b",)))
+    outcomes = sched.schedule_pending()
+    assert outcomes[0].node == "node-3"
+
+
+def test_dynamic_provisioning_selected_node():
+    """No matching PV + WFFC class with a real provisioner → the scheduler
+    picks a node, writes the selected-node annotation, the (fake) external
+    provisioner creates and binds a PV there."""
+    api, sched = build_env()
+    for n in ("node-1", "node-2"):
+        api.create_node(make_node(n))
+    api.create_storage_class(
+        st.StorageClass(
+            name="csi-wffc",
+            provisioner="test.csi.example.com",
+            volume_binding_mode=st.BINDING_WAIT_FOR_FIRST_CONSUMER,
+        )
+    )
+    api.create_pvc(
+        st.PersistentVolumeClaim.make("claim-d", "2Gi", storage_class_name="csi-wffc")
+    )
+    api.create_pod(make_pod("pod-d", pvcs=("claim-d",)))
+
+    outcomes = sched.schedule_pending()
+    assert outcomes[0].node is not None
+    pvc = api.pvcs.get("default/claim-d")
+    assert pvc.annotations[st.ANN_SELECTED_NODE] == outcomes[0].node
+    assert pvc.is_fully_bound()
+    assert api.provisioned  # the provisioner made the PV
+
+
+def test_provisioning_respects_allowed_topologies():
+    api, sched = build_env()
+    api.create_node(make_node("node-1", labels={"zone": "z1"}))
+    api.create_node(make_node("node-2", labels={"zone": "z2"}))
+    api.create_storage_class(
+        st.StorageClass(
+            name="zonal",
+            provisioner="test.csi.example.com",
+            volume_binding_mode=st.BINDING_WAIT_FOR_FIRST_CONSUMER,
+            allowed_topologies=(
+                st.TopologySelectorTerm((("zone", ("z2",)),)),
+            ),
+        )
+    )
+    api.create_pvc(
+        st.PersistentVolumeClaim.make("claim-z", storage_class_name="zonal")
+    )
+    api.create_pod(make_pod("pod-z", pvcs=("claim-z",)))
+    outcomes = sched.schedule_pending()
+    assert outcomes[0].node == "node-2"
+
+
+def test_csi_storage_capacity_gates_provisioning():
+    """Driver opts into capacity checks; only node-2's segment has space."""
+    api, sched = build_env()
+    api.create_node(make_node("node-1", labels={"seg": "a"}))
+    api.create_node(make_node("node-2", labels={"seg": "b"}))
+    api.create_csidriver(
+        st.CSIDriver(name="cap.csi.example.com", storage_capacity=True)
+    )
+    api.create_storage_class(
+        st.StorageClass(
+            name="cap",
+            provisioner="cap.csi.example.com",
+            volume_binding_mode=st.BINDING_WAIT_FOR_FIRST_CONSUMER,
+        )
+    )
+    from kubernetes_tpu.api.types import LabelSelector
+
+    api.create_capacity(
+        st.CSIStorageCapacity(
+            name="cap-b",
+            storage_class_name="cap",
+            node_topology=LabelSelector(match_labels={"seg": "b"}),
+            capacity=10 * 1024**3,
+        )
+    )
+    api.create_pvc(
+        st.PersistentVolumeClaim.make("claim-c", "5Gi", storage_class_name="cap")
+    )
+    api.create_pod(make_pod("pod-c", pvcs=("claim-c",)))
+    outcomes = sched.schedule_pending()
+    assert outcomes[0].node == "node-2"
+
+
+def test_no_pv_available_unschedulable_then_requeued_on_pv_add():
+    """BindConflict → unschedulable; creating a matching PV requeues the
+    pod through the PV queueing hint and it schedules."""
+    api, sched = build_env()
+    api.create_node(make_node("node-1"))
+    api.create_storage_class(
+        st.StorageClass(
+            name="local",
+            provisioner=st.NO_PROVISIONER,
+            volume_binding_mode=st.BINDING_WAIT_FOR_FIRST_CONSUMER,
+        )
+    )
+    api.create_pvc(
+        st.PersistentVolumeClaim.make("claim-n", storage_class_name="local")
+    )
+    api.create_pod(make_pod("pod-n", pvcs=("claim-n",)))
+    outcomes = sched.schedule_pending()
+    assert outcomes[0].node is None
+    assert "persistent volumes to bind" in outcomes[0].status.merge_reason()
+
+    api.create_pv(
+        st.PersistentVolume.make(
+            "pv-n",
+            "10Gi",
+            storage_class_name="local",
+            node_affinity=node_affinity_to("node-1"),
+        )
+    )
+    sched._test_clock.advance(30)  # let the requeue's backoff expire
+    outcomes = sched.schedule_pending()
+    assert len(outcomes) == 1 and outcomes[0].node == "node-1"
+
+
+# ---------------------------------------------------------------------------
+# VolumeZone
+# ---------------------------------------------------------------------------
+
+
+def test_volume_zone_conflict():
+    api, sched = build_env()
+    api.create_node(
+        make_node("node-1", labels={"topology.kubernetes.io/zone": "z1"})
+    )
+    api.create_node(
+        make_node("node-2", labels={"topology.kubernetes.io/zone": "z2"})
+    )
+    api.create_storage_class(st.StorageClass(name="fast"))
+    api.create_pv(
+        st.PersistentVolume.make(
+            "pv-z",
+            "10Gi",
+            storage_class_name="fast",
+            labels={"topology.kubernetes.io/zone": "z2"},
+            claim_ref=st.ObjectRef("default", "claim-vz"),
+        )
+    )
+    api.create_pvc(
+        st.PersistentVolumeClaim.make("claim-vz", storage_class_name="fast")
+    )
+    api.create_pod(make_pod("pod-vz", pvcs=("claim-vz",)))
+    outcomes = sched.schedule_pending()
+    assert outcomes[0].node == "node-2"
+
+
+# ---------------------------------------------------------------------------
+# VolumeRestrictions
+# ---------------------------------------------------------------------------
+
+
+def test_read_write_once_pod_conflict():
+    api, sched = build_env()
+    api.create_node(make_node("node-1"))
+    api.create_storage_class(st.StorageClass(name="fast"))
+    api.create_pv(
+        st.PersistentVolume.make(
+            "pv-r",
+            "10Gi",
+            storage_class_name="fast",
+            access_modes=(st.RWOP,),
+            claim_ref=st.ObjectRef("default", "claim-r"),
+        )
+    )
+    api.create_pvc(
+        st.PersistentVolumeClaim.make(
+            "claim-r", storage_class_name="fast", access_modes=(st.RWOP,)
+        )
+    )
+    api.create_pod(make_pod("pod-r1", pvcs=("claim-r",)))
+    outcomes = sched.schedule_pending()
+    assert outcomes[0].node == "node-1"
+
+    api.create_pod(make_pod("pod-r2", pvcs=("claim-r",)))
+    outcomes = sched.schedule_pending()
+    assert outcomes[0].node is None
+    assert "ReadWriteOncePod" in outcomes[0].status.merge_reason()
+
+
+def test_inline_disk_conflict():
+    """Two pods mounting the same gce-pd read-write cannot share a node."""
+    api, sched = build_env()
+    api.create_node(make_node("node-1"))
+    api.create_node(make_node("node-2"))
+    disk = Volume(name="d", source_kind="gce-pd", source_id="disk-1")
+    api.create_pod(make_pod("pod-g1", volumes=(disk,)))
+    outcomes = sched.schedule_pending()
+    first = outcomes[0].node
+    assert first is not None
+
+    api.create_pod(make_pod("pod-g2", volumes=(disk,)))
+    outcomes = sched.schedule_pending()
+    assert outcomes[0].node is not None
+    assert outcomes[0].node != first
+
+
+# ---------------------------------------------------------------------------
+# NodeVolumeLimits
+# ---------------------------------------------------------------------------
+
+
+def test_csi_volume_limits():
+    """CSINode advertises 2 attachable volumes; the third distinct volume
+    must go elsewhere (or fail on a 1-node cluster)."""
+    api, sched = build_env()
+    api.create_node(make_node("node-1"))
+    api.create_csinode(
+        st.CSINode(
+            name="node-1",
+            drivers=(
+                st.CSINodeDriver(
+                    name="test.csi.example.com", allocatable_count=2
+                ),
+            ),
+        )
+    )
+    api.create_storage_class(
+        st.StorageClass(name="csi", provisioner="test.csi.example.com")
+    )
+    for i in range(3):
+        api.create_pv(
+            st.PersistentVolume.make(
+                f"pv-l{i}",
+                "10Gi",
+                storage_class_name="csi",
+                csi_driver="test.csi.example.com",
+                source_id=f"vol-{i}",
+                claim_ref=st.ObjectRef("default", f"claim-l{i}"),
+            )
+        )
+        api.create_pvc(
+            st.PersistentVolumeClaim.make(f"claim-l{i}", storage_class_name="csi")
+        )
+    for i in range(3):
+        api.create_pod(make_pod(f"pod-l{i}", pvcs=(f"claim-l{i}",)))
+
+    outcomes = sched.schedule_pending()
+    by_name = {o.pod.name: o for o in outcomes}
+    scheduled = [o for o in by_name.values() if o.node == "node-1"]
+    failed = [o for o in by_name.values() if o.node is None]
+    assert len(scheduled) == 2
+    assert len(failed) == 1
+    assert "max volume count" in failed[0].status.merge_reason()
+
+
+# ---------------------------------------------------------------------------
+# preemption × volumes
+# ---------------------------------------------------------------------------
+
+
+def test_preemption_respects_volume_node_affinity():
+    """A high-priority pod whose PV is pinned to node-1 must not evict
+    victims on node-2 (the dry-run runs host volume filters too)."""
+    api, sched = build_env()
+    api.create_node(make_node("node-1", cpu="1"))
+    api.create_node(make_node("node-2", cpu="1"))
+    api.create_storage_class(st.StorageClass(name="fast"))
+    api.create_pv(
+        st.PersistentVolume.make(
+            "pv-p",
+            "10Gi",
+            storage_class_name="fast",
+            node_affinity=node_affinity_to("node-1"),
+            claim_ref=st.ObjectRef("default", "claim-p"),
+        )
+    )
+    api.create_pvc(
+        st.PersistentVolumeClaim.make("claim-p", storage_class_name="fast")
+    )
+    # both nodes full with low-priority pods
+    for n in ("node-1", "node-2"):
+        victim = Pod(
+            name=f"victim-{n}",
+            priority=0,
+            node_name=n,
+            containers=[Container(name="c", requests={"cpu": "900m"})],
+        )
+        api.create_pod(victim)
+    victim_node2_uid = next(
+        p.uid for p in api.pods.values() if p.name == "victim-node-2"
+    )
+    preemptor = make_pod("pod-p", pvcs=("claim-p",), cpu="500m")
+    preemptor.priority = 100
+    api.create_pod(preemptor)
+
+    outcomes = sched.schedule_pending()
+    assert outcomes[0].node is None
+    # only node-1's victim may be targeted — never node-2's
+    assert victim_node2_uid not in api.evictions
+    assert outcomes[0].pod.nominated_node_name in ("node-1", "")
+
+
+# ---------------------------------------------------------------------------
+# fastpath preservation
+# ---------------------------------------------------------------------------
+
+
+def test_volumeless_batch_keeps_fast_path():
+    """Volume plugins Skip at PreFilter for PVC-less pods, so the signature
+    fast path must still engage with the full default profile."""
+    api, sched = build_env(batch_size=16)
+    for i in range(4):
+        api.create_node(make_node(f"node-{i}"))
+    for i in range(8):
+        api.create_pod(make_pod(f"plain-{i}"))
+    outcomes = sched.schedule_pending()
+    assert all(o.node is not None for o in outcomes)
+    assert sched.metrics["fast_batches"] >= 1
+    assert sched.metrics["scan_batches"] == 0
