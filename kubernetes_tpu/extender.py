@@ -1,0 +1,197 @@
+"""Scheduler extenders — the legacy HTTP webhook protocol.
+
+Mirrors pkg/scheduler/extender.go (HTTPExtender :78-140, Filter :455,
+Prioritize, Bind, ProcessPreemption) and the staging kube-scheduler
+extender/v1 wire types: Filter/Prioritize POST ``ExtenderArgs`` JSON and
+read ``ExtenderFilterResult`` / ``HostPriorityList``; Bind POSTs
+``ExtenderBindingArgs``.
+
+Extender-interested pods leave the batched device path and run one-pod
+cycles over the host oracle (kubernetes_tpu/oracle/pipeline.py) — webhooks
+are serial per-pod HTTP round-trips in the reference too
+(schedule_one.go:701-745), so nothing is lost by not batching them.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from kubernetes_tpu.api.types import Pod
+from kubernetes_tpu.framework import config as cfg
+
+
+class ExtenderError(Exception):
+    pass
+
+
+class Extender:
+    """framework.Extender interface (extender.go / interface.go)."""
+
+    name: str = ""
+    weight: int = 1
+    ignorable: bool = False
+
+    def is_interested(self, pod: Pod) -> bool:
+        """IsInterested: true when the extender manages no specific
+        resources, or the pod requests one of its managed resources."""
+        raise NotImplementedError
+
+    def is_filter(self) -> bool:
+        return False
+
+    def is_prioritizer(self) -> bool:
+        return False
+
+    def is_binder(self) -> bool:
+        return False
+
+    def supports_preemption(self) -> bool:
+        return False
+
+    def filter(
+        self, pod: Pod, node_names: Sequence[str]
+    ) -> Tuple[List[str], Dict[str, str], Dict[str, str]]:
+        """Returns (feasible, failed{node: reason},
+        failed_and_unresolvable{node: reason}); raises ExtenderError on
+        transport/protocol errors."""
+        raise NotImplementedError
+
+    def prioritize(
+        self, pod: Pod, node_names: Sequence[str]
+    ) -> Dict[str, int]:
+        """Node → score on the extender's own 0-10 scale (the caller
+        multiplies by self.weight)."""
+        raise NotImplementedError
+
+    def bind(self, pod: Pod, node_name: str) -> None:
+        raise NotImplementedError
+
+    def process_preemption(
+        self, pod: Pod, victims_by_node: Dict[str, list]
+    ) -> Dict[str, list]:
+        """ProcessPreemption: may shrink the candidate map (extender.go).
+        Default passthrough."""
+        return victims_by_node
+
+
+def _managed_resource_interest(managed: Sequence[str], pod: Pod) -> bool:
+    if not managed:
+        return True
+    wanted = set(managed)
+    for c in list(pod.containers) + list(pod.init_containers):
+        for m in (c.requests, c.limits):
+            if m and any(name in wanted for name in m):
+                return True
+    return False
+
+
+class HTTPExtender(Extender):
+    """extender.go HTTPExtender: JSON POST per verb."""
+
+    def __init__(self, spec: cfg.Extender):
+        self.spec = spec
+        self.name = spec.url_prefix
+        self.weight = spec.weight or 1
+        self.ignorable = spec.ignorable
+
+    def is_interested(self, pod: Pod) -> bool:
+        return _managed_resource_interest(self.spec.managed_resources, pod)
+
+    def is_filter(self) -> bool:
+        return bool(self.spec.filter_verb)
+
+    def is_prioritizer(self) -> bool:
+        return bool(self.spec.prioritize_verb)
+
+    def is_binder(self) -> bool:
+        return bool(self.spec.bind_verb)
+
+    def supports_preemption(self) -> bool:
+        return bool(self.spec.preempt_verb)
+
+    # -- wire ------------------------------------------------------------------
+
+    def _post(self, verb: str, payload: dict) -> dict:
+        url = self.spec.url_prefix.rstrip("/") + "/" + verb
+        body = json.dumps(payload).encode()
+        req = urllib.request.Request(
+            url, data=body, headers={"Content-Type": "application/json"}
+        )
+        try:
+            with urllib.request.urlopen(
+                req, timeout=self.spec.http_timeout_s
+            ) as resp:
+                return json.loads(resp.read().decode())
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            raise ExtenderError(f"extender {self.name} {verb}: {e}") from e
+
+    @staticmethod
+    def _pod_payload(pod: Pod) -> dict:
+        return {
+            "metadata": {
+                "name": pod.name,
+                "namespace": pod.namespace,
+                "uid": pod.uid,
+            }
+        }
+
+    def filter(self, pod, node_names):
+        result = self._post(
+            self.spec.filter_verb,
+            {"pod": self._pod_payload(pod), "nodenames": list(node_names)},
+        )
+        if result.get("error"):
+            raise ExtenderError(f"extender {self.name}: {result['error']}")
+        feasible = list(result.get("nodenames") or [])
+        failed = dict(result.get("failedNodes") or {})
+        unresolvable = dict(result.get("failedAndUnresolvableNodes") or {})
+        return feasible, failed, unresolvable
+
+    def prioritize(self, pod, node_names):
+        result = self._post(
+            self.spec.prioritize_verb,
+            {"pod": self._pod_payload(pod), "nodenames": list(node_names)},
+        )
+        out: Dict[str, int] = {}
+        for entry in result or []:
+            out[entry.get("host", "")] = int(entry.get("score", 0))
+        return out
+
+    def bind(self, pod, node_name):
+        result = self._post(
+            self.spec.bind_verb,
+            {
+                "podName": pod.name,
+                "podNamespace": pod.namespace,
+                "podUID": pod.uid,
+                "node": node_name,
+            },
+        )
+        err = (result or {}).get("error")
+        if err:
+            raise ExtenderError(f"extender {self.name} bind: {err}")
+
+    def process_preemption(self, pod, victims_by_node):
+        result = self._post(
+            self.spec.preempt_verb,
+            {
+                "pod": self._pod_payload(pod),
+                "nodeNameToVictims": {
+                    node: {
+                        "pods": [self._pod_payload(v) for v in victims.pods],
+                        "numPDBViolations": victims.num_pdb_violations,
+                    }
+                    for node, victims in victims_by_node.items()
+                },
+            },
+        )
+        kept = set((result or {}).get("nodeNameToMetaVictims") or {})
+        return {n: v for n, v in victims_by_node.items() if n in kept}
+
+
+def build_extenders(specs: Sequence[cfg.Extender]) -> List[Extender]:
+    """buildExtenders (scheduler.go:285)."""
+    return [HTTPExtender(s) for s in specs if s.url_prefix]

@@ -129,9 +129,46 @@ class Evaluator:
                 plugin=self.plugin_name,
             )
 
+        candidates, err = self._call_extenders(pod, candidates)
+        if err is not None:
+            return None, Status.error(err, plugin=self.plugin_name)
+        if not candidates:
+            return "", Status.unschedulable(
+                "no preemption victims survived extender processing",
+                plugin=self.plugin_name,
+            )
+
         best = self.select_candidate(candidates)
+        prom = getattr(self.handle, "prom", None)
+        if prom is not None:
+            prom.preemption_attempts.inc()
+            prom.preemption_victims.observe(len(best.victims.pods))
         self.prepare_candidate(pod, best)
         return best.name, Status.success()
+
+    def _call_extenders(
+        self, pod: Pod, candidates: List["Candidate"]
+    ) -> Tuple[List["Candidate"], Optional[str]]:
+        """callExtenders (preemption.go:255): preemption-capable interested
+        extenders may shrink the candidate map; non-ignorable transport
+        errors abort the preemption."""
+        exts = getattr(self.handle, "list_extenders", lambda: [])()
+        for ext in exts:
+            if not candidates:
+                break
+            if not ext.supports_preemption() or not ext.is_interested(pod):
+                continue
+            victims_map = {c.name: c.victims for c in candidates}
+            try:
+                victims_map = ext.process_preemption(pod, victims_map)
+            except Exception as e:  # noqa: BLE001 — ExtenderError class
+                if getattr(ext, "ignorable", False):
+                    continue
+                return [], str(e)
+            candidates = [
+                Candidate(name=n, victims=v) for n, v in victims_map.items()
+            ]
+        return candidates, None
 
     # ----- eligibility (default_preemption.go:239) --------------------------
 

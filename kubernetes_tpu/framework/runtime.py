@@ -123,6 +123,37 @@ class Framework:
 
     # ----- extension-point execution --------------------------------------
 
+    def _observe_point(self, point: str, ok: bool, dt: float) -> None:
+        """framework_extension_point_duration_seconds (metrics.go:150,
+        recorded through the async recorder like instrumented_plugins.go)."""
+        prom = getattr(self.handle, "prom", None) if self.handle else None
+        if prom is None:
+            return
+        prom.recorder.observe(
+            prom.extension_point_duration,
+            dt,
+            extension_point=point,
+            status="Success" if ok else "Unschedulable",
+            profile=self.profile_name,
+        )
+
+    def _observe_plugin(self, plugin: str, point: str, ok: bool, dt: float) -> None:
+        """plugin_execution_duration_seconds, 1-in-10 sampled like the
+        reference (schedule_one.go:48 pluginMetricsSamplePercent)."""
+        self._plugin_sample = getattr(self, "_plugin_sample", 0) + 1
+        if self._plugin_sample % 10:
+            return
+        prom = getattr(self.handle, "prom", None) if self.handle else None
+        if prom is None:
+            return
+        prom.recorder.observe(
+            prom.plugin_execution_duration,
+            dt,
+            plugin=plugin,
+            extension_point=point,
+            status="Success" if ok else "Unschedulable",
+        )
+
     def run_pre_enqueue(self, pod: Pod) -> Status:
         for p in self._by_point.get("preEnqueue", []):
             if isinstance(p, PreEnqueuePlugin):
@@ -145,9 +176,12 @@ class Framework:
         ]
         if not plugins:
             return failures
+        t0 = time.perf_counter()
         for pod in pods:
             for p in plugins:
+                t1 = time.perf_counter()
                 s = p.pre_filter(state, pod)
+                self._observe_plugin(p.name, "PreFilter", s.ok, time.perf_counter() - t1)
                 if s.code == Code.SKIP:
                     state.mark_skip_filter(pod.uid, p.name)
                 elif not s.ok:
@@ -155,6 +189,7 @@ class Framework:
                         s.plugin = p.name
                     failures[pod.uid] = s
                     break
+        self._observe_point("PreFilter", not failures, time.perf_counter() - t0)
         return failures
 
     def run_host_filters(self, state: CycleState, pod: Pod, node_state) -> Status:
@@ -187,12 +222,15 @@ class Framework:
         return bool(self._by_point.get("postFilter"))
 
     def run_reserve(self, state: CycleState, pod: Pod, node_name: str) -> Status:
+        t0 = time.perf_counter()
         for p in self._by_point.get("reserve", []):
             if isinstance(p, ReservePlugin):
                 s = p.reserve(state, pod, node_name)
                 if not s.ok:
                     self.run_unreserve(state, pod, node_name)
+                    self._observe_point("Reserve", False, time.perf_counter() - t0)
                     return s
+        self._observe_point("Reserve", True, time.perf_counter() - t0)
         return Status.success()
 
     def run_unreserve(self, state: CycleState, pod: Pod, node_name: str) -> None:
@@ -234,21 +272,32 @@ class Framework:
         return wp.decision
 
     def run_pre_bind(self, state: CycleState, pod: Pod, node_name: str) -> Status:
+        t0 = time.perf_counter()
         for p in self._by_point.get("preBind", []):
             if isinstance(p, PreBindPlugin):
                 s = p.pre_bind(state, pod, node_name)
                 if not s.ok:
+                    self._observe_point("PreBind", False, time.perf_counter() - t0)
                     return s
+        self._observe_point("PreBind", True, time.perf_counter() - t0)
         return Status.success()
 
     def run_bind(self, state: CycleState, pod: Pod, node_name: str) -> Status:
-        for p in self._by_point.get("bind", []):
-            if isinstance(p, BindPlugin):
-                s = p.bind(state, pod, node_name)
-                if s.code == Code.SKIP:
-                    continue
-                return s
-        return Status.error("no bind plugin handled the pod")
+        t0 = time.perf_counter()
+        try:
+            for p in self._by_point.get("bind", []):
+                if isinstance(p, BindPlugin):
+                    s = p.bind(state, pod, node_name)
+                    if s.code == Code.SKIP:
+                        continue
+                    return s
+            return Status.error("no bind plugin handled the pod")
+        finally:
+            prom = getattr(self.handle, "prom", None) if self.handle else None
+            if prom is not None:
+                prom.recorder.observe(
+                    prom.binding_duration, time.perf_counter() - t0
+                )
 
     def run_post_bind(self, state: CycleState, pod: Pod, node_name: str) -> None:
         for p in self._by_point.get("postBind", []):

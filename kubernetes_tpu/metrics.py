@@ -1,0 +1,445 @@
+"""Scheduler metrics: Prometheus-compatible series + async recorder.
+
+Mirrors pkg/scheduler/metrics/metrics.go:86-260 (the ~25 scheduler series,
+stability labels dropped) and metric_recorder.go (the lock-free buffered
+async recorder, flush interval 1s).  The TPU build adds device-path series
+(gang dispatch timing, fast-path batch counts, HBM upload bytes) because
+the hot loop is one fused kernel dispatch rather than per-pod goroutines.
+
+Export is the Prometheus text exposition format (``registry.expose()``) —
+what the server wrapper serves at /metrics.
+"""
+
+from __future__ import annotations
+
+import bisect
+import math
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+# ---------------------------------------------------------------------------
+# core metric types
+# ---------------------------------------------------------------------------
+
+
+def _fmt_labels(labels: Tuple[Tuple[str, str], ...]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in labels)
+    return "{" + inner + "}"
+
+
+class Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help_: str = "", label_names: Sequence[str] = ()):
+        self.name = name
+        self.help = help_
+        self.label_names = tuple(label_names)
+
+    def expose(self) -> List[str]:
+        raise NotImplementedError
+
+    def _key(self, labels: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
+        return tuple((k, str(labels.get(k, ""))) for k in self.label_names)
+
+
+class Counter(Metric):
+    kind = "counter"
+
+    def __init__(self, name, help_="", label_names=()):
+        super().__init__(name, help_, label_names)
+        self._values: Dict[Tuple, float] = {}
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        k = self._key(labels)
+        self._values[k] = self._values.get(k, 0.0) + amount
+
+    def value(self, **labels) -> float:
+        return self._values.get(self._key(labels), 0.0)
+
+    def expose(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {self.kind}"]
+        for k, v in sorted(self._values.items()):
+            out.append(f"{self.name}{_fmt_labels(k)} {v:g}")
+        return out
+
+
+class Gauge(Metric):
+    kind = "gauge"
+
+    def __init__(self, name, help_="", label_names=()):
+        super().__init__(name, help_, label_names)
+        self._values: Dict[Tuple, float] = {}
+
+    def set(self, value: float, **labels) -> None:
+        self._values[self._key(labels)] = float(value)
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        k = self._key(labels)
+        self._values[k] = self._values.get(k, 0.0) + amount
+
+    def value(self, **labels) -> float:
+        return self._values.get(self._key(labels), 0.0)
+
+    def expose(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {self.kind}"]
+        for k, v in sorted(self._values.items()):
+            out.append(f"{self.name}{_fmt_labels(k)} {v:g}")
+        return out
+
+
+# the reference's default scheduler duration buckets: 0.001 → ~16s
+def duration_buckets() -> List[float]:
+    return [0.001 * (2**i) for i in range(15)]
+
+
+class Histogram(Metric):
+    kind = "histogram"
+
+    def __init__(self, name, help_="", label_names=(), buckets: Optional[Sequence[float]] = None):
+        super().__init__(name, help_, label_names)
+        self.buckets = sorted(buckets if buckets is not None else duration_buckets())
+        self._counts: Dict[Tuple, List[int]] = {}
+        self._sum: Dict[Tuple, float] = {}
+        self._n: Dict[Tuple, int] = {}
+
+    def observe(self, value: float, **labels) -> None:
+        k = self._key(labels)
+        counts = self._counts.get(k)
+        if counts is None:
+            counts = self._counts[k] = [0] * (len(self.buckets) + 1)
+            self._sum[k] = 0.0
+            self._n[k] = 0
+        counts[bisect.bisect_left(self.buckets, value)] += 1
+        self._sum[k] += value
+        self._n[k] += 1
+
+    def count(self, **labels) -> int:
+        return self._n.get(self._key(labels), 0)
+
+    def total_sum(self, **labels) -> float:
+        return self._sum.get(self._key(labels), 0.0)
+
+    def percentile(self, q: float, **labels) -> float:
+        """Bucket-interpolated quantile (the promql histogram_quantile
+        estimate) over ALL label sets when none given, else one set."""
+        if self.label_names and not labels:
+            # aggregate across label sets
+            agg = [0] * (len(self.buckets) + 1)
+            for counts in self._counts.values():
+                for i, c in enumerate(counts):
+                    agg[i] += c
+            counts, n = agg, sum(agg)
+        else:
+            k = self._key(labels)
+            counts = self._counts.get(k, [0] * (len(self.buckets) + 1))
+            n = self._n.get(k, 0)
+        if n == 0:
+            return 0.0
+        rank = q * n
+        cum = 0
+        for i, c in enumerate(counts):
+            cum += c
+            if cum >= rank:
+                if i >= len(self.buckets):
+                    return self.buckets[-1] if self.buckets else 0.0
+                lo = self.buckets[i - 1] if i > 0 else 0.0
+                hi = self.buckets[i]
+                frac = (rank - (cum - c)) / c if c else 0.0
+                return lo + (hi - lo) * frac
+        return self.buckets[-1] if self.buckets else 0.0
+
+    def expose(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {self.kind}"]
+        for k in sorted(self._counts):
+            counts = self._counts[k]
+            cum = 0
+            for b, c in zip(self.buckets, counts):
+                cum += c
+                lab = k + (("le", f"{b:g}"),)
+                out.append(f"{self.name}_bucket{_fmt_labels(lab)} {cum}")
+            cum += counts[-1]
+            lab = k + (("le", "+Inf"),)
+            out.append(f"{self.name}_bucket{_fmt_labels(lab)} {cum}")
+            out.append(f"{self.name}_sum{_fmt_labels(k)} {self._sum[k]:g}")
+            out.append(f"{self.name}_count{_fmt_labels(k)} {self._n[k]}")
+        return out
+
+
+class Registry:
+    def __init__(self) -> None:
+        self._metrics: List[Metric] = []
+
+    def register(self, metric: Metric) -> Metric:
+        self._metrics.append(metric)
+        return metric
+
+    def expose(self) -> str:
+        lines: List[str] = []
+        for m in self._metrics:
+            lines.extend(m.expose())
+        return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# async recorder (metric_recorder.go)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class _Observation:
+    metric: Histogram
+    value: float
+    labels: Dict[str, str]
+
+
+class MetricAsyncRecorder:
+    """Buffered histogram recorder: observations append to a bounded buffer
+    and flush on interval or overflow (metric_recorder.go: bufferSize 1000,
+    interval 1s).  The scheduler loop is single-threaded here, so flushing
+    happens inline rather than on a goroutine; the buffer still decouples
+    the hot path from histogram bucket math."""
+
+    BUFFER_SIZE = 1000
+
+    def __init__(self, flush_interval_s: float = 1.0, clock=time.monotonic):
+        self._buf: List[_Observation] = []
+        self._interval = flush_interval_s
+        self._clock = clock
+        self._last_flush = clock()
+
+    def observe(self, metric: Histogram, value: float, **labels) -> None:
+        self._buf.append(_Observation(metric, value, labels))
+        if (
+            len(self._buf) >= self.BUFFER_SIZE
+            or self._clock() - self._last_flush >= self._interval
+        ):
+            self.flush()
+
+    def flush(self) -> None:
+        for obs in self._buf:
+            obs.metric.observe(obs.value, **obs.labels)
+        self._buf.clear()
+        self._last_flush = self._clock()
+
+
+# ---------------------------------------------------------------------------
+# the scheduler's series (metrics.go:86-260)
+# ---------------------------------------------------------------------------
+
+SCHEDULED = "scheduled"
+UNSCHEDULABLE = "unschedulable"
+ERROR = "error"
+
+
+class SchedulerMetrics:
+    def __init__(self) -> None:
+        r = self.registry = Registry()
+        self.schedule_attempts = r.register(
+            Counter(
+                "scheduler_schedule_attempts_total",
+                "Number of attempts to schedule pods, by result and profile.",
+                ("result", "profile"),
+            )
+        )
+        self.attempt_duration = r.register(
+            Histogram(
+                "scheduler_scheduling_attempt_duration_seconds",
+                "Scheduling attempt latency (algorithm + binding).",
+                ("result", "profile"),
+            )
+        )
+        self.algorithm_duration = r.register(
+            Histogram(
+                "scheduler_scheduling_algorithm_duration_seconds",
+                "Scheduling algorithm latency.",
+                ("profile",),
+            )
+        )
+        self.pod_scheduling_sli_duration = r.register(
+            Histogram(
+                "scheduler_pod_scheduling_sli_duration_seconds",
+                "E2e latency for a pod being scheduled, from first attempt.",
+                ("attempts",),
+            )
+        )
+        self.pod_scheduling_attempts = r.register(
+            Histogram(
+                "scheduler_pod_scheduling_attempts",
+                "Number of attempts to successfully schedule a pod.",
+                (),
+                buckets=[1, 2, 4, 8, 16],
+            )
+        )
+        self.extension_point_duration = r.register(
+            Histogram(
+                "scheduler_framework_extension_point_duration_seconds",
+                "Latency for running all plugins of an extension point.",
+                ("extension_point", "status", "profile"),
+            )
+        )
+        self.plugin_execution_duration = r.register(
+            Histogram(
+                "scheduler_plugin_execution_duration_seconds",
+                "Duration for running a plugin at an extension point.",
+                ("plugin", "extension_point", "status"),
+                buckets=[0.00001 * (1.5**i) for i in range(20)],
+            )
+        )
+        self.queue_incoming_pods = r.register(
+            Counter(
+                "scheduler_queue_incoming_pods_total",
+                "Number of pods added to scheduling queues by event and queue type.",
+                ("queue", "event"),
+            )
+        )
+        self.pending_pods = r.register(
+            Gauge(
+                "scheduler_pending_pods",
+                "Pending pods by queue: active, backoff, unschedulable, gated.",
+                ("queue",),
+            )
+        )
+        self.cache_size = r.register(
+            Gauge(
+                "scheduler_scheduler_cache_size",
+                "Number of nodes, pods and assumed pods in the scheduler cache.",
+                ("type",),
+            )
+        )
+        self.preemption_attempts = r.register(
+            Counter(
+                "scheduler_preemption_attempts_total",
+                "Total preemption attempts in the cluster until now.",
+            )
+        )
+        self.preemption_victims = r.register(
+            Histogram(
+                "scheduler_preemption_victims",
+                "Number of selected preemption victims.",
+                (),
+                buckets=[1, 2, 4, 8, 16, 32, 64],
+            )
+        )
+        self.goroutines = r.register(
+            Gauge(
+                "scheduler_goroutines",
+                "Number of running goroutines split by work type (threads here).",
+                ("work",),
+            )
+        )
+        self.event_handling_duration = r.register(
+            Histogram(
+                "scheduler_event_handling_duration_seconds",
+                "Event handling latency by resource and action.",
+                ("event",),
+                buckets=[0.00001 * (1.5**i) for i in range(20)],
+            )
+        )
+        self.queueing_hint_duration = r.register(
+            Histogram(
+                "scheduler_queueing_hint_execution_duration_seconds",
+                "Latency of QueueingHintFn execution.",
+                ("plugin", "event", "hint"),
+                buckets=[0.00001 * (1.5**i) for i in range(20)],
+            )
+        )
+        self.binding_duration = r.register(
+            Histogram(
+                "scheduler_binding_duration_seconds",
+                "Binding latency.",
+                (),
+            )
+        )
+        self.permit_wait_duration = r.register(
+            Histogram(
+                "scheduler_permit_wait_duration_seconds",
+                "Latency of waiting on Permit.",
+                ("result",),
+            )
+        )
+        self.unschedulable_reasons = r.register(
+            Gauge(
+                "scheduler_unschedulable_pods",
+                "Number of unschedulable pods by plugin name.",
+                ("plugin",),
+            )
+        )
+        # --- TPU-path extensions (no reference counterpart: the hot loop
+        # is a fused device dispatch, not per-pod goroutines) ---
+        self.gang_dispatch_duration = r.register(
+            Histogram(
+                "scheduler_tpu_gang_dispatch_duration_seconds",
+                "Device time for one fused gang dispatch (batch filter+score+select).",
+                ("path",),  # fast / scan
+            )
+        )
+        self.batch_size_hist = r.register(
+            Histogram(
+                "scheduler_tpu_batch_size",
+                "Pods per gang batch.",
+                (),
+                buckets=[1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024],
+            )
+        )
+        self.snapshot_pack_duration = r.register(
+            Histogram(
+                "scheduler_tpu_snapshot_pack_duration_seconds",
+                "Host time packing the incremental snapshot mirror.",
+                (),
+            )
+        )
+        self.recorder = MetricAsyncRecorder()
+
+    def expose(self) -> str:
+        self.recorder.flush()
+        return self.registry.expose()
+
+
+# ---------------------------------------------------------------------------
+# slow-cycle tracing (utiltrace: schedule_one.go:409-449 — any scheduling
+# cycle over 100ms dumps its per-step timings)
+# ---------------------------------------------------------------------------
+
+SLOW_CYCLE_THRESHOLD_S = 0.100
+
+
+class Trace:
+    """k8s.io/utils/trace analogue: named steps, dumped when the total
+    exceeds a threshold."""
+
+    def __init__(self, name: str, clock=time.monotonic, sink=None, **fields):
+        self.name = name
+        self.fields = fields
+        self._clock = clock
+        self._start = clock()
+        self._steps: List[Tuple[float, str]] = []
+        self._sink = sink  # callable(str); default logging
+
+    def step(self, msg: str) -> None:
+        self._steps.append((self._clock(), msg))
+
+    def log_if_long(self, threshold_s: float = SLOW_CYCLE_THRESHOLD_S) -> Optional[str]:
+        total = self._clock() - self._start
+        if total < threshold_s:
+            return None
+        parts = [
+            f'Trace "{self.name}" '
+            + ",".join(f"{k}:{v}" for k, v in self.fields.items())
+            + f" (total {total * 1000:.1f}ms):"
+        ]
+        prev = self._start
+        for t, msg in self._steps:
+            parts.append(f"  +{(t - prev) * 1000:.1f}ms {msg}")
+            prev = t
+        text = "\n".join(parts)
+        if self._sink is not None:
+            self._sink(text)
+        else:
+            import logging
+
+            logging.getLogger("kubernetes_tpu.trace").info(text)
+        return text

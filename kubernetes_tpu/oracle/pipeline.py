@@ -43,36 +43,53 @@ class FitResult:
     reasons: Dict[str, List[str]] = field(default_factory=dict)
 
 
-def feasible_nodes(pod: Pod, state: OracleState) -> FitResult:
-    """All default-profile Filter plugins, in the reference's iteration
-    shape (every node, all reasons collected)."""
-    spread_counts = F.spread_pair_counts(pod, state)
+ALL_FILTERS = frozenset(
+    {
+        "NodeName",
+        "NodeUnschedulable",
+        "TaintToleration",
+        "NodeAffinity",
+        "NodePorts",
+        "NodeResourcesFit",
+        "InterPodAffinity",
+        "PodTopologySpread",
+    }
+)
+
+
+def feasible_nodes(
+    pod: Pod, state: OracleState, enabled: frozenset = ALL_FILTERS
+) -> FitResult:
+    """Filter plugins in the reference's iteration shape (every node, all
+    reasons collected).  ``enabled`` limits evaluation to a profile's
+    enabled plugin set (kernel names)."""
+    spread_counts = (
+        F.spread_pair_counts(pod, state) if "PodTopologySpread" in enabled else None
+    )
+    checks = [
+        ("NodeName", lambda ns: F.filter_node_name(pod, ns)),
+        ("NodeUnschedulable", lambda ns: F.filter_node_unschedulable(pod, ns)),
+        ("TaintToleration", lambda ns: F.filter_taints(pod, ns)),
+        ("NodeAffinity", lambda ns: F.filter_node_affinity(pod, ns)),
+        ("NodePorts", lambda ns: F.filter_node_ports(pod, ns)),
+        ("InterPodAffinity", lambda ns: F.filter_interpod_affinity(pod, ns, state)),
+        (
+            "PodTopologySpread",
+            lambda ns: F.filter_topology_spread(pod, ns, state, spread_counts),
+        ),
+    ]
+    checks = [c for c in checks if c[0] in enabled]
+    check_resources = "NodeResourcesFit" in enabled
     feasible: List[str] = []
     reasons: Dict[str, List[str]] = {}
     for name, ns in state.nodes.items():
         rs: List[str] = []
-        r = F.filter_node_name(pod, ns)
-        if r:
-            rs.append(r)
-        r = F.filter_node_unschedulable(pod, ns)
-        if r:
-            rs.append(r)
-        r = F.filter_taints(pod, ns)
-        if r:
-            rs.append(r)
-        r = F.filter_node_affinity(pod, ns)
-        if r:
-            rs.append(r)
-        r = F.filter_node_ports(pod, ns)
-        if r:
-            rs.append(r)
-        rs.extend(F.filter_node_resources(pod, ns))
-        r = F.filter_interpod_affinity(pod, ns, state)
-        if r:
-            rs.append(r)
-        r = F.filter_topology_spread(pod, ns, state, spread_counts)
-        if r:
-            rs.append(r)
+        for _, fn in checks:
+            r = fn(ns)
+            if r:
+                rs.append(r)
+        if check_resources:
+            rs.extend(F.filter_node_resources(pod, ns))
         if rs:
             reasons[name] = rs
         else:

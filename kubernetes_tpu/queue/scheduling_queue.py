@@ -96,6 +96,8 @@ class SchedulingQueue:
         # in-flight pods + events ledger (active_queue.go:74-126)
         self._in_flight: Dict[str, List[Tuple[ClusterEvent, Any, Any]]] = {}
         self._last_unsched_flush = self.clock()
+        # optional queue_incoming_pods_total Counter (metrics.py)
+        self.incoming_counter = None
 
     # ----- ordering --------------------------------------------------------
 
@@ -150,8 +152,10 @@ class SchedulingQueue:
                 self._gated[pod.uid] = qp
                 self._in_queue[pod.uid] = "gated"
                 self._items[pod.uid] = qp
+                self._count_incoming("gated", "PodAdd")
                 return
         self._push_active(qp)
+        self._count_incoming("active", "PodAdd")
 
     def update(self, old: Optional[Pod], new: Pod) -> None:
         where = self._in_queue.get(new.uid)
@@ -283,11 +287,12 @@ class SchedulingQueue:
                 qp.pod = new
         for ev, old, new in events:
             if self._is_worth_requeuing(qp, ev, old, new):
-                self._requeue(qp, immediately=False)
+                self._requeue(qp, immediately=False, event="ScheduleAttemptFailure")
                 return
         self._unschedulable[qp.uid] = qp
         self._in_queue[qp.uid] = "unschedulable"
         self._items[qp.uid] = qp
+        self._count_incoming("unschedulable", "ScheduleAttemptFailure")
 
     def done(self, uid: str) -> None:
         """Pod's scheduling attempt fully concluded (bound or failed)."""
@@ -360,11 +365,18 @@ class SchedulingQueue:
                     return True  # hint error → requeue (fail open, :447)
         return False
 
-    def _requeue(self, qp: QueuedPodInfo, immediately: bool) -> None:
+    def _requeue(self, qp: QueuedPodInfo, immediately: bool, event: str = "ClusterEvent") -> None:
         if immediately or self._backoff_expiry(qp) <= self.clock():
             self._push_active(qp)
+            self._count_incoming("active", event)
         else:
             self._push_backoff(qp)
+            self._count_incoming("backoff", event)
+
+    def _count_incoming(self, queue: str, event: str) -> None:
+        """queue_incoming_pods_total (metrics.go:200)."""
+        if self.incoming_counter is not None:
+            self.incoming_counter.inc(queue=queue, event=event)
 
     # ----- introspection ----------------------------------------------------
 
@@ -372,6 +384,11 @@ class SchedulingQueue:
         if self._in_queue.get(uid) is None:
             return None
         return self._items.get(uid)
+
+    def stats(self) -> Dict[str, int]:
+        """Live counts per sub-queue (feeds the pending_pods gauge)."""
+        p = self.pending_pods()
+        return {name: len(pods) for name, pods in p.items()}
 
     def pending_pods(self) -> Dict[str, List[Pod]]:
         """PendingPods introspection (:1146)."""

@@ -54,6 +54,9 @@ class ScheduleOutcome:
     # plugin name → count of nodes it rejected (Diagnosis.NodeToStatus
     # aggregate, framework/types.go:367)
     diagnosis: Optional[Dict[str, int]] = None
+    # metrics context (pod_scheduling_sli/attempts series)
+    pod_attempts: int = 1
+    first_enqueue_time: Optional[float] = None
 
 
 # FitError reason strings keyed by diagnosis kernel (types.go:420-465 /
@@ -149,6 +152,13 @@ class Handle:
     def framework_for(self, pod: Pod):
         return self._s.profiles.get(pod.scheduler_name)
 
+    def list_extenders(self):
+        return list(self._s.extenders)
+
+    @property
+    def prom(self):
+        return getattr(self._s, "prom", None)
+
     def get_waiting_pod(self, uid: str):
         for fwk in self._s.profiles.values():
             wp = fwk.waiting_pods.get(uid)
@@ -168,9 +178,17 @@ class Scheduler:
         binding_sink=None,
         namespace_labels: Optional[Dict[str, Dict[str, str]]] = None,
         clock=time.monotonic,
+        extenders=None,
     ):
         self.config = configuration or cfg.SchedulerConfiguration()
         self.config.validate()
+        from kubernetes_tpu.extender import build_extenders
+
+        # HTTP extenders from config + injected in-proc extenders (the
+        # fake-extender test pattern, testing/framework/fake_extender.go)
+        self.extenders = build_extenders(self.config.extenders) + list(
+            extenders or []
+        )
         self.binding_sink = binding_sink or (lambda pod, node: None)
         self.pod_deleter = lambda pod: None  # victim eviction sink
         self.pdb_lister = lambda: []
@@ -228,6 +246,10 @@ class Scheduler:
             max_backoff_s=self.config.pod_max_backoff_seconds,
             clock=clock,
         )
+        from kubernetes_tpu.metrics import SchedulerMetrics
+
+        self.prom = SchedulerMetrics()
+        self.queue.incoming_counter = self.prom.queue_incoming_pods
         self._dirty_pending = False
         self._oracle_cache: Optional[OracleState] = None
         # bumped on every EXTERNAL node-state mutation (informer events,
@@ -419,12 +441,61 @@ class Scheduler:
             groups: Dict[str, list] = {}
             for qp in batch:
                 groups.setdefault(qp.pod.scheduler_name, []).append(qp)
-            for group in groups.values():
-                outcomes.extend(self._schedule_batch(group))
+            for profile_name, group in groups.items():
+                t0 = time.perf_counter()
+                outs = self._schedule_batch(group)
+                dt = time.perf_counter() - t0
+                self._record_batch_metrics(profile_name, group, outs, dt)
+                outcomes.extend(outs)
             batches += 1
             if max_batches is not None and batches >= max_batches:
                 break
         return outcomes
+
+    def _record_batch_metrics(self, profile, group, outs, dt: float) -> None:
+        """Attempt counters + latency histograms (metrics.go:86-147).  The
+        batch shares one device dispatch, so per-pod attempt latency is the
+        batch latency amortized over its pods."""
+        from kubernetes_tpu import metrics as M
+
+        prom = self.prom
+        rec = prom.recorder
+        prom.batch_size_hist.observe(len(group))
+        rec.observe(prom.algorithm_duration, dt, profile=profile)
+        per_pod = dt / max(len(outs), 1)
+        now = self.clock()
+        for o in outs:
+            if o.node is not None:
+                result = M.SCHEDULED
+                prom.pod_scheduling_attempts.observe(o.pod_attempts or 1)
+                if o.first_enqueue_time is not None:
+                    prom.pod_scheduling_sli_duration.observe(
+                        max(now - o.first_enqueue_time, 0.0),
+                        attempts=str(min(o.pod_attempts or 1, 16)),
+                    )
+            elif o.status.code == Code.ERROR:
+                result = M.ERROR
+            else:
+                result = M.UNSCHEDULABLE
+            prom.schedule_attempts.inc(result=result, profile=profile)
+            rec.observe(
+                prom.attempt_duration, per_pod, result=result, profile=profile
+            )
+
+    def refresh_gauges(self) -> None:
+        """pending_pods / cache_size gauges (metrics.go:180-220), refreshed
+        on scrape rather than on every mutation."""
+        stats = self.queue.stats()
+        for queue_name, n in stats.items():
+            self.prom.pending_pods.set(n, queue=queue_name)
+        self.prom.cache_size.set(len(self.cache.real_nodes()), type="nodes")
+        self.prom.cache_size.set(len(self.cache.pod_states), type="pods")
+        self.prom.cache_size.set(len(self.cache.assumed), type="assumed_pods")
+
+    def expose_metrics(self) -> str:
+        """Prometheus text exposition (the /metrics handler body)."""
+        self.refresh_gauges()
+        return self.prom.expose()
 
     def _schedule_batch(self, batch) -> List[ScheduleOutcome]:
         fwk = self.profiles.get(
@@ -435,18 +506,21 @@ class Scheduler:
         if len(batch) > 1:
             # Host-stateful Filter plugins (volumebinding/DRA class) judge
             # against cache state that earlier commits in the SAME batch
-            # mutate — their veto masks can't be batched.  Pods those
-            # plugins could act on (cheap spec check — maybe_relevant)
+            # mutate — their veto masks can't be batched; extender webhooks
+            # are serial per-pod HTTP round-trips by protocol.  Pods either
+            # could act on (cheap spec check — maybe_relevant/is_interested)
             # degrade to one-pod cycles (the reference's native granularity,
             # schedule_one.go:65); contiguous runs of clean pods stay on the
             # batched device path.  Runs preserve queue order, so decisions
             # stay sequential-equivalent.
             hf = fwk.host_filter_plugins()
-            if hf:
+            if hf or self.extenders:
                 run: List = []
                 split = False
                 for qp in batch:
-                    if not any(p.maybe_relevant(qp.pod) for p in hf):
+                    if not any(
+                        p.maybe_relevant(qp.pod) for p in hf
+                    ) and not any(e.is_interested(qp.pod) for e in self.extenders):
                         run.append(qp)
                         continue
                     split = True
@@ -458,6 +532,11 @@ class Scheduler:
                     if run:
                         outcomes.extend(self._schedule_batch(run))
                     return outcomes
+
+        if len(batch) == 1 and any(
+            e.is_interested(batch[0].pod) for e in self.extenders
+        ):
+            return self._schedule_one_extender(fwk, batch[0])
 
         state = CycleState()
 
@@ -476,10 +555,20 @@ class Scheduler:
             if not batch:
                 return outcomes
         pods = [qp.pod for qp in batch]
+        from kubernetes_tpu.metrics import Trace
+
+        trace = Trace(
+            "Scheduling batch",
+            clock=time.perf_counter,
+            pods=len(pods),
+            profile=fwk.profile_name,
+        )
+        trace.step("PreFilter done")
 
         # 1. snapshot: incremental host-side pack + device upload.  Pod
         # labels are interned FIRST so a fresh full pack covers them (stale
         # val-int tables would force a second repack next cycle).
+        t_pack = time.perf_counter()
         vocab = self.mirror.vocab
         for pod in pods:
             for k, v in pod.labels.items():
@@ -488,6 +577,10 @@ class Scheduler:
         if bucket_cap(len(vocab.label_keys)) > self.mirror.nodes.k_cap:
             self.mirror._force_full = True
             self.mirror.update(self.cache, self.namespace_labels)
+        self.prom.recorder.observe(
+            self.prom.snapshot_pack_duration, time.perf_counter() - t_pack
+        )
+        trace.step("Snapshot mirror updated")
 
         # 1a. FAST PATH: when the batch has no batch-dynamic constraints
         # beyond resources (no inter-pod/spread/ports/nominations/host
@@ -504,11 +597,19 @@ class Scheduler:
             and self.cache.n_term_pods == 0
             and self.cache.n_port_pods == 0
         ):
+            t_fast = time.perf_counter()
             fast = self._try_fast_schedule(
                 fwk, state, batch, enabled, weights, outcomes
             )
             if fast is not None:
                 self.metrics["fast_batches"] += 1
+                self.prom.recorder.observe(
+                    self.prom.gang_dispatch_duration,
+                    time.perf_counter() - t_fast,
+                    path="fast",
+                )
+                trace.step("Fast-path commit done")
+                trace.log_if_long()
                 return fast
         self.metrics["scan_batches"] += 1
 
@@ -553,6 +654,7 @@ class Scheduler:
             )
 
         # 2. one fused device dispatch (the whole Filter→Score→Select loop)
+        t_gang = time.perf_counter()
         chosen, n_feas, reason_counts, _ = gang.gang_run(
             dc,
             db,
@@ -571,6 +673,12 @@ class Scheduler:
         )
         chosen = jax.device_get(chosen)
         n_feas = jax.device_get(n_feas)
+        self.prom.recorder.observe(
+            self.prom.gang_dispatch_duration,
+            time.perf_counter() - t_gang,
+            path="scan",
+        )
+        trace.step("Gang dispatch done")
         counts = None  # fetched lazily — only failures read it
 
         # 3. per-pod commit: assume → reserve → permit → bind
@@ -611,6 +719,8 @@ class Scheduler:
             node_name = node_names[idx]
             outcome = self._commit(fwk, state, qp, node_name, int(n_feas[i]))
             outcomes.append(outcome)
+        trace.step("Commits done")
+        trace.log_if_long()
         return outcomes
 
     def _static_device_cluster(self) -> DeviceCluster:
@@ -766,6 +876,119 @@ class Scheduler:
             )
         return outcomes
 
+    def _schedule_one_extender(self, fwk, qp) -> List[ScheduleOutcome]:
+        """One-pod cycle through the host oracle with the extender chain:
+        in-tree Filter → extender Filter (serial, schedule_one.go:701-745)
+        → in-tree Score → extender Prioritize (:796-854) → select → commit
+        (extender Bind replaces in-tree bind plugins when offered)."""
+        from kubernetes_tpu.extender import ExtenderError
+        from kubernetes_tpu.oracle.pipeline import (
+            feasible_nodes,
+            prioritize,
+            select_host,
+        )
+
+        pod = qp.pod
+        state = CycleState()
+        self.metrics["schedule_attempts"] += 1
+
+        pf_failures = fwk.run_pre_filter(state, [pod])
+        if pf_failures:
+            return [
+                self._post_filter_or_fail(fwk, state, qp, pf_failures[pod.uid], 0)
+            ]
+
+        st = self.oracle_view()
+        n_nodes = len(st.nodes)
+        fit = feasible_nodes(pod, st, enabled=fwk.device_enabled())
+        feasible = fit.feasible
+        diag: Dict[str, int] = {}
+        for rs in fit.reasons.values():
+            for r in rs:
+                diag[r] = diag.get(r, 0) + 1
+        plugins: set = set()
+        if fwk.has_host_filters():
+            kept = []
+            for n in feasible:
+                s = fwk.run_host_filters(state, pod, st.nodes[n])
+                if s.ok:
+                    kept.append(n)
+                else:
+                    reason = s.merge_reason() or s.plugin
+                    diag[reason] = diag.get(reason, 0) + 1
+                    plugins.add(s.plugin)
+            feasible = kept
+
+        for ext in self.extenders:
+            if not feasible:
+                break
+            if not ext.is_filter() or not ext.is_interested(pod):
+                continue
+            try:
+                feasible, failed, unresolvable = ext.filter(pod, feasible)
+            except ExtenderError as e:
+                if ext.ignorable:
+                    continue
+                status = Status.error(str(e))
+                self._handle_failure(qp, status)
+                return [ScheduleOutcome(pod, None, status, 0, diag)]
+            for reason_map in (failed, unresolvable):
+                for _, reason in reason_map.items():
+                    key = reason or f"rejected by extender {ext.name}"
+                    diag[key] = diag.get(key, 0) + 1
+
+        if not feasible:
+            status = Status.unschedulable(fit_error_message(n_nodes, diag))
+            return [
+                self._post_filter_or_fail(
+                    fwk, state, qp, status, 0, diag, plugins or None
+                )
+            ]
+
+        totals = prioritize(pod, st, feasible, weights=fwk.score_weights)
+        for ext in self.extenders:
+            if not ext.is_prioritizer() or not ext.is_interested(pod):
+                continue
+            try:
+                scores = ext.prioritize(pod, feasible)
+            except ExtenderError as e:
+                if ext.ignorable:
+                    continue
+                status = Status.error(str(e))
+                self._handle_failure(qp, status)
+                return [ScheduleOutcome(pod, None, status, len(feasible), diag)]
+            for n, s in scores.items():
+                if n in totals:
+                    totals[n] += s * ext.weight
+
+        node = select_host(totals) if totals else feasible[0]
+        binder = next(
+            (
+                e
+                for e in self.extenders
+                if e.is_binder() and e.is_interested(pod)
+            ),
+            None,
+        )
+        binder_override = None
+        if binder is not None:
+
+            def binder_override(pod, node_name, _ext=binder):
+                try:
+                    _ext.bind(pod, node_name)
+                    # the extender performed the API write; mirror it into
+                    # the fake/real store like DefaultBinder would
+                    self.binding_sink(pod, node_name)
+                except ExtenderError as e:
+                    return Status.error(str(e))
+                return Status.success()
+
+        return [
+            self._commit(
+                fwk, state, qp, node, len(feasible), binder_override=binder_override
+            )
+        ]
+
     def _nominated_arrays(self, exclude_uids):
         """Pack nominations (minus this batch's own pods) into the gang
         dispatch's nom_* arrays."""
@@ -858,8 +1081,12 @@ class Scheduler:
         self._handle_failure(qp, status, plugins)
         return ScheduleOutcome(pod, None, status, n_feas, diagnosis)
 
-    def _commit(self, fwk, state, qp, node_name: str, n_feas: int) -> ScheduleOutcome:
-        """assume → reserve → permit → bind (schedulingCycle/bindingCycle)."""
+    def _commit(
+        self, fwk, state, qp, node_name: str, n_feas: int, binder_override=None
+    ) -> ScheduleOutcome:
+        """assume → reserve → permit → bind (schedulingCycle/bindingCycle).
+        ``binder_override`` replaces the in-tree bind plugins when a binder
+        extender claims the pod (schedule_one.go extendersBinding)."""
         pod = qp.pod
         self._invalidate_view()
         self.cache.assume_pod(pod, node_name)
@@ -895,7 +1122,10 @@ class Scheduler:
             self._handle_failure(qp, s)
             return ScheduleOutcome(pod, None, s, n_feas)
 
-        s = fwk.run_bind(state, pod, node_name)
+        if binder_override is not None:
+            s = binder_override(pod, node_name)
+        else:
+            s = fwk.run_bind(state, pod, node_name)
         if not s.ok:
             # The in-flight ledger is still intact here, so events that
             # arrived during the attempt replay through add_unschedulable.
@@ -909,7 +1139,14 @@ class Scheduler:
         self.cache.finish_binding(pod)
         self.nominator.delete(pod)
         self.metrics["scheduled"] += 1
-        return ScheduleOutcome(pod, node_name, Status.success(), n_feas)
+        return ScheduleOutcome(
+            pod,
+            node_name,
+            Status.success(),
+            n_feas,
+            pod_attempts=qp.attempts,
+            first_enqueue_time=qp.timestamp,
+        )
 
     def _handle_failure(self, qp, status: Status, plugins: Optional[set] = None) -> None:
         """handleSchedulingFailure (schedule_one.go:1020).  ``plugins`` is

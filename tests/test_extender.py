@@ -1,0 +1,254 @@
+"""Extenders: filter/prioritize/bind/preemption webhooks alter decisions
+(the fake_extender.go + test/integration/scheduler/extender patterns)."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+from kubernetes_tpu.api.resource import Resource
+from kubernetes_tpu.api.types import Container, Node, Pod
+from kubernetes_tpu.extender import Extender, ExtenderError, HTTPExtender
+from kubernetes_tpu.framework.config import Extender as ExtenderSpec
+from kubernetes_tpu.framework.config import SchedulerConfiguration
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.testing import FakeCluster
+
+
+def make_node(name, cpu="8"):
+    return Node(
+        name=name,
+        labels={"kubernetes.io/hostname": name},
+        capacity=Resource.from_map({"cpu": cpu, "memory": "16Gi", "pods": 110}),
+    )
+
+
+def make_pod(name, cpu="100m", priority=0):
+    return Pod(
+        name=name,
+        priority=priority,
+        containers=[Container(name="c", requests={"cpu": cpu})],
+    )
+
+
+class FakeExtender(Extender):
+    """In-process extender (testing/framework/fake_extender.go role)."""
+
+    name = "fake"
+
+    def __init__(
+        self,
+        allow=None,
+        scores=None,
+        binder=False,
+        fail=False,
+        ignorable=False,
+        weight=1,
+        interested=lambda pod: True,
+        preempt_keep=None,
+    ):
+        self.allow = allow
+        self.scores = scores or {}
+        self._binder = binder
+        self.fail = fail
+        self.ignorable = ignorable
+        self.weight = weight
+        self._interested = interested
+        self.preempt_keep = preempt_keep
+        self.bound = []
+        self.filter_calls = 0
+
+    def is_interested(self, pod):
+        return self._interested(pod)
+
+    def is_filter(self):
+        return self.allow is not None or self.fail
+
+    def is_prioritizer(self):
+        return bool(self.scores)
+
+    def is_binder(self):
+        return self._binder
+
+    def supports_preemption(self):
+        return self.preempt_keep is not None
+
+    def filter(self, pod, node_names):
+        self.filter_calls += 1
+        if self.fail:
+            raise ExtenderError("extender down")
+        feasible = [n for n in node_names if n in self.allow]
+        failed = {
+            n: "not allowed by fake extender"
+            for n in node_names
+            if n not in self.allow
+        }
+        return feasible, failed, {}
+
+    def prioritize(self, pod, node_names):
+        return {n: self.scores.get(n, 0) for n in node_names}
+
+    def bind(self, pod, node_name):
+        self.bound.append((pod.name, node_name))
+
+    def process_preemption(self, pod, victims_by_node):
+        return {
+            n: v for n, v in victims_by_node.items() if n in self.preempt_keep
+        }
+
+
+def build_env(extenders, batch_size=8):
+    api = FakeCluster()
+    sched = Scheduler(
+        configuration=SchedulerConfiguration(batch_size=batch_size),
+        extenders=extenders,
+    )
+    api.connect(sched)
+    return api, sched
+
+
+def test_extender_filter_steers_decision():
+    ext = FakeExtender(allow={"node-2"})
+    api, sched = build_env([ext])
+    for n in ("node-1", "node-2", "node-3"):
+        api.create_node(make_node(n))
+    api.create_pod(make_pod("p1"))
+    outcomes = sched.schedule_pending()
+    assert outcomes[0].node == "node-2"
+    assert ext.filter_calls == 1
+
+
+def test_extender_prioritize_changes_selection():
+    # all nodes equal in-tree; the extender strongly prefers node-3
+    ext = FakeExtender(allow={"node-1", "node-2", "node-3"}, scores={"node-3": 10}, weight=100)
+    api, sched = build_env([ext])
+    for n in ("node-1", "node-2", "node-3"):
+        api.create_node(make_node(n))
+    api.create_pod(make_pod("p1"))
+    outcomes = sched.schedule_pending()
+    assert outcomes[0].node == "node-3"
+
+
+def test_non_ignorable_extender_error_aborts_cycle():
+    ext = FakeExtender(fail=True)
+    api, sched = build_env([ext])
+    api.create_node(make_node("node-1"))
+    api.create_pod(make_pod("p1"))
+    outcomes = sched.schedule_pending()
+    assert outcomes[0].node is None
+    assert "extender down" in outcomes[0].status.merge_reason()
+    assert sched.metrics["errors"] == 1
+
+
+def test_ignorable_extender_error_is_skipped():
+    ext = FakeExtender(fail=True, ignorable=True)
+    api, sched = build_env([ext])
+    api.create_node(make_node("node-1"))
+    api.create_pod(make_pod("p1"))
+    outcomes = sched.schedule_pending()
+    assert outcomes[0].node == "node-1"
+
+
+def test_binder_extender_binds():
+    ext = FakeExtender(allow={"node-1"}, binder=True)
+    api, sched = build_env([ext])
+    api.create_node(make_node("node-1"))
+    api.create_pod(make_pod("p1"))
+    outcomes = sched.schedule_pending()
+    assert outcomes[0].node == "node-1"
+    assert ext.bound == [("p1", "node-1")]
+    assert list(api.bindings.values()) == ["node-1"]
+
+
+def test_uninterested_extender_keeps_fast_path():
+    ext = FakeExtender(
+        allow={"node-1"}, interested=lambda pod: "special" in pod.name
+    )
+    api, sched = build_env([ext], batch_size=16)
+    for i in range(4):
+        api.create_node(make_node(f"node-{i}"))
+    for i in range(8):
+        api.create_pod(make_pod(f"plain-{i}"))
+    outcomes = sched.schedule_pending()
+    assert all(o.node is not None for o in outcomes)
+    assert ext.filter_calls == 0
+    assert sched.metrics["fast_batches"] >= 1
+
+
+def test_extender_preemption_narrows_candidates():
+    """The extender only allows preemption on node-2: victims must come
+    from there even if node-1 ranks better."""
+    ext = FakeExtender(preempt_keep={"node-2"})
+    api, sched = build_env([ext])
+    api.create_node(make_node("node-1", cpu="1"))
+    api.create_node(make_node("node-2", cpu="1"))
+    uid_by_node = {}
+    for n in ("node-1", "node-2"):
+        victim = Pod(
+            name=f"victim-{n}",
+            priority=0,
+            node_name=n,
+            containers=[Container(name="c", requests={"cpu": "900m"})],
+        )
+        api.create_pod(victim)
+        uid_by_node[n] = next(
+            p.uid for p in api.pods.values() if p.name == f"victim-{n}"
+        )
+    api.create_pod(make_pod("preemptor", cpu="500m", priority=100))
+    outcomes = sched.schedule_pending()
+    assert outcomes[0].node is None  # nominated, victims terminating
+    assert outcomes[0].pod.nominated_node_name == "node-2"
+    assert api.evictions == [uid_by_node["node-2"]]
+
+
+class _ExtenderHandler(BaseHTTPRequestHandler):
+    def do_POST(self):
+        length = int(self.headers["Content-Length"])
+        args = json.loads(self.rfile.read(length))
+        if self.path.endswith("/filter"):
+            names = [n for n in args["nodenames"] if n.endswith("-2")]
+            resp = {
+                "nodenames": names,
+                "failedNodes": {
+                    n: "wrong suffix" for n in args["nodenames"] if n not in names
+                },
+            }
+        elif self.path.endswith("/prioritize"):
+            resp = [{"host": n, "score": 7} for n in args["nodenames"]]
+        else:
+            resp = {"error": "unknown verb"}
+        body = json.dumps(resp).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):
+        pass
+
+
+def test_http_extender_round_trip():
+    server = HTTPServer(("127.0.0.1", 0), _ExtenderHandler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        spec = ExtenderSpec(
+            url_prefix=f"http://127.0.0.1:{server.server_port}",
+            filter_verb="filter",
+            prioritize_verb="prioritize",
+            weight=2,
+        )
+        api = FakeCluster()
+        sched = Scheduler(
+            configuration=SchedulerConfiguration(batch_size=8, extenders=[spec])
+        )
+        api.connect(sched)
+        assert len(sched.extenders) == 1
+        assert isinstance(sched.extenders[0], HTTPExtender)
+        for n in ("node-1", "node-2", "node-3"):
+            api.create_node(make_node(n))
+        api.create_pod(make_pod("p1"))
+        outcomes = sched.schedule_pending()
+        assert outcomes[0].node == "node-2"
+    finally:
+        server.shutdown()
