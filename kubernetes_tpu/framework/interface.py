@@ -112,6 +112,12 @@ class CycleState:
     def is_filter_skipped(self, pod_uid: str, plugin: str) -> bool:
         return (pod_uid, plugin) in self.skip_filter_plugins
 
+    def mark_skip_score(self, pod_uid: str, plugin: str) -> None:
+        self.skip_score_plugins.add((pod_uid, plugin))
+
+    def is_score_skipped(self, pod_uid: str, plugin: str) -> bool:
+        return (pod_uid, plugin) in self.skip_score_plugins
+
     def clone(self) -> "CycleState":
         cs = CycleState()
         cs._data = dict(self._data)
@@ -187,6 +193,8 @@ class PostFilterPlugin(Plugin):
 
 class PreScorePlugin(Plugin):
     def pre_score(self, state: CycleState, pods: Sequence[Pod], nodes) -> Status:
+        """Per-batch PreScore (runtime/framework.go:1052 semantics):
+        Status.skip() disables the coupled Score for these pods."""
         return Status.success()
 
 
@@ -198,6 +206,12 @@ class ScorePlugin(Plugin):
 
     def normalize(self, state: CycleState, pod: Pod, scores: List[int]) -> List[int]:
         return scores
+
+    def score_relevant(self, pod: Pod) -> bool:
+        """Cheap spec-only predicate: could this plugin's Score produce a
+        non-constant contribution for the pod?  Lets the batch dispatcher
+        keep the device fast paths when no host score applies."""
+        return True
 
 
 class DeviceScorePlugin(Plugin):

@@ -29,6 +29,7 @@ from kubernetes_tpu.framework.interface import (
     PreBindPlugin,
     PreEnqueuePlugin,
     PreFilterPlugin,
+    PreScorePlugin,
     PermitPlugin,
     QueueSortPlugin,
     ReservePlugin,
@@ -119,6 +120,15 @@ class Framework:
             p
             for p in self._by_point.get("filter", [])
             if isinstance(p, FilterPlugin) and not isinstance(p, DevicePluginMixin)
+        ]
+
+    def host_score_plugins(self) -> List[ScorePlugin]:
+        """Enabled Score plugins with NO device kernel — executed host-side
+        and merged into the batched selection (runtime/framework.go:1101)."""
+        return [
+            p
+            for p in self._by_point.get("score", [])
+            if isinstance(p, ScorePlugin) and not isinstance(p, DevicePluginMixin)
         ]
 
     # ----- extension-point execution --------------------------------------
@@ -220,6 +230,60 @@ class Framework:
 
     def has_post_filter(self) -> bool:
         return bool(self._by_point.get("postFilter"))
+
+    def run_pre_score(self, state: CycleState, pods: Sequence[Pod], nodes) -> None:
+        """RunPreScorePlugins (runtime/framework.go:1052) for HOST-backed
+        score plugins: a Skip status marks the plugin's coupled Score
+        skipped for the batch's pods (device-backed plugins' PreScore work
+        lives inside the fused dispatch's precompute)."""
+        t0 = time.perf_counter()
+        host_names = {p.name for p in self.host_score_plugins()}
+        for p in self._by_point.get("preScore", []):
+            if not isinstance(p, PreScorePlugin) or p.name not in host_names:
+                continue
+            s = p.pre_score(state, pods, nodes)
+            if s.code == Code.SKIP:
+                for pod in pods:
+                    state.mark_skip_score(pod.uid, p.name)
+        self._observe_point("PreScore", True, time.perf_counter() - t0)
+
+    def run_host_scores(
+        self, state: CycleState, pod: Pod, node_states: Sequence
+    ) -> Dict[str, List[int]]:
+        """Host Score plugins over a node list (runtime/framework.go:1128):
+        returns plugin name → per-node raw scores with NormalizeScore
+        (:1158) already applied.  Weighting (:1177) is the caller's job so
+        the batched merge can reuse self.score_weights."""
+        out: Dict[str, List[int]] = {}
+        for p in self.host_score_plugins():
+            if state.is_score_skipped(pod.uid, p.name):
+                continue
+            t1 = time.perf_counter()
+            scores = [
+                p.score(state, pod, ns) if ns is not None else 0
+                for ns in node_states
+            ]
+            scores = p.normalize(state, pod, scores)
+            self._observe_plugin(p.name, "Score", True, time.perf_counter() - t1)
+            out[p.name] = scores
+        return out
+
+    def active_host_scores(
+        self, state: CycleState, pods: Sequence[Pod]
+    ) -> List[ScorePlugin]:
+        """Host Score plugins that could contribute for ANY pod of the batch
+        (spec-relevant, not PreScore-skipped for every pod, non-zero
+        weight)."""
+        return [
+            p
+            for p in self.host_score_plugins()
+            if self.score_weights.get(p.name, 0)
+            and any(
+                not state.is_score_skipped(pod.uid, p.name)
+                and p.score_relevant(pod)
+                for pod in pods
+            )
+        ]
 
     def run_reserve(self, state: CycleState, pod: Pod, node_name: str) -> Status:
         t0 = time.perf_counter()

@@ -316,6 +316,11 @@ class VolumeBinding(
     def maybe_relevant(self, pod: Pod) -> bool:
         return bool(pod.pvc_names())
 
+    def score_relevant(self, pod: Pod) -> bool:
+        # VolumeCapacityPriority only contributes when the shape is
+        # configured and the pod has claims (volume_binding.go:441).
+        return self.shape is not None and bool(pod.pvc_names())
+
     # -- PreFilter (volume_binding.go:322) -----------------------------------
 
     def pre_filter(self, state: CycleState, pod: Pod) -> Status:

@@ -13,6 +13,14 @@ diverge (SURVEY.md §7 "intra-batch conflicts").  The design:
     tallies, spread/inter-pod counts contributed by batch placements, score
     normalization over the current feasible set) followed by argmax commit.
 
+The scan step is built for TPU op latency: NO scatters, segment-sums or
+vocab-wide gathers in the loop body.  Every state-dependent count is a fused
+dense equality-contraction over small axes ([C,N,J]-shaped compare+reduce
+against the assigned-node domain values), so the per-step cost is a handful
+of VPU/MXU passes over row slices instead of serialized scatter ops.  The
+only per-step dynamic indexing is row slices of the per-pod statics and
+[C,J]-sized gathers of the assigned nodes' domain values.
+
 The scan step mirrors, piece by piece, what the serial oracle recomputes
 between pods, so gang results are identical to scheduling the pods one by
 one — property-tested against the serial oracle in tests/test_gang.py.
@@ -35,6 +43,7 @@ from kubernetes_tpu.ops.common import (
     I64,
     domain_stats,
     eval_table,
+    gather_at,
     ns_member,
     per_node_counts,
 )
@@ -47,6 +56,7 @@ from kubernetes_tpu.snapshot.schema import (
     TERM_PREFERRED_ANTI,
     TERM_REQUIRED_AFFINITY,
     TERM_REQUIRED_ANTI,
+    bucket_cap,
 )
 
 MAX = S.MAX_NODE_SCORE
@@ -73,6 +83,7 @@ class GangStatics(NamedTuple):
     sp_node_cnt: jnp.ndarray  # i32 [P, C, N] raw per-node matching counts
     sp_sc_dom: jnp.ndarray  # i32 [P, C, N] score-gated per-domain counts
     sp_all_keys: jnp.ndarray  # bool [P, N] node has every soft topo key
+    sp_cdv: jnp.ndarray  # i32 [P, C, N] compact domain ids (<0: host/absent)
     # inter-pod
     ip_dv: jnp.ndarray  # i32 [P, AT, N]
     ip_dom_cnt: jnp.ndarray  # i32 [P, AT, N] matching existing in node's domain
@@ -85,6 +96,8 @@ class GangStatics(NamedTuple):
     ip_is_anti: jnp.ndarray  # bool [P, AT]
     ip_pref_w: jnp.ndarray  # i64 [P, AT]
     ip_sym_w: jnp.ndarray  # i64 [P, AT] weight of p's terms once p is placed
+    ip_key_idx: jnp.ndarray  # i32 [P, AT] index into ip_key_cols (<0 absent)
+    ip_key_cols: jnp.ndarray  # i32 [Kd, N] node label value per distinct key
     # static raw scores
     sc_taint: jnp.ndarray  # i64 [P, N]
     sc_nodeaff: jnp.ndarray  # i64 [P, N]
@@ -102,6 +115,73 @@ class GangStatics(NamedTuple):
     d_extra: jnp.ndarray  # bool [P, N] (host-filter veto mask)
 
 
+def batch_tables(tsc_topo, aff_topo, node_label_vals, hostname_id: int):
+    """Host-side per-batch key tables for the scan's dense domain math.
+
+    tsc_topo/aff_topo: numpy [P, C]/[P, AT] interned topology-key ids of the
+    batch (PAD in empty slots); node_label_vals: numpy [N, K] interned node
+    label values (the mirror's column-per-key layout).
+
+    Returns a dict of gang_run kwargs:
+      sp_keys    i32 [Kd]   distinct NON-hostname spread topology keys
+      sp_cdv_tab i32 [Kd,N] per-key compact domain id per node (-1: absent)
+      ip_keys    i32 [Kd2]  distinct inter-pod topology keys (incl hostname)
+      d_cap      int        static bucket over the max distinct-domain count
+
+    Compact ids let the scan count distinct-domains-with-feasible-nodes as a
+    [C, N, d_cap] fused compare+reduce instead of a vocab-wide segment op
+    (the TPU-hostile pattern this file avoids); hostname-topology constraints
+    use node identity directly so their domain count never inflates d_cap.
+    """
+    import numpy as np
+
+    lv = np.asarray(node_label_vals)
+    n_cap, K = lv.shape
+
+    def _distinct(keys_arr, exclude_host: bool):
+        ids = np.unique(np.asarray(keys_arr).reshape(-1))
+        out = []
+        for k in ids:
+            k = int(k)
+            if k < 0 or k >= K:
+                continue
+            if exclude_host and k == hostname_id:
+                continue
+            out.append(k)
+        return out
+
+    sp_ids = _distinct(tsc_topo, exclude_host=True)
+    d_max = 1
+    rows = []
+    for k in sp_ids:
+        col = lv[:, k]
+        cdv = np.full(n_cap, -1, np.int32)
+        pos = col >= 0
+        if pos.any():
+            uniq, inv = np.unique(col[pos], return_inverse=True)
+            cdv[pos] = inv.astype(np.int32)
+            d_max = max(d_max, len(uniq))
+        rows.append(cdv)
+    kd = bucket_cap(max(len(sp_ids), 1), 1)
+    sp_keys = np.full(kd, -1, np.int32)
+    sp_keys[: len(sp_ids)] = sp_ids
+    sp_cdv_tab = np.full((kd, n_cap), -1, np.int32)
+    for i, r in enumerate(rows):
+        sp_cdv_tab[i] = r
+
+    ip_ids = _distinct(aff_topo, exclude_host=False)
+    kd2 = bucket_cap(max(len(ip_ids), 1), 1)
+    ip_keys = np.full(kd2, -1, np.int32)
+    ip_keys[: len(ip_ids)] = ip_ids
+
+    return dict(
+        sp_keys=jnp.asarray(sp_keys),
+        sp_cdv_tab=jnp.asarray(sp_cdv_tab),
+        ip_keys=jnp.asarray(ip_keys),
+        d_cap=bucket_cap(d_max, 8),
+    )
+
+
 def precompute(
     dc: DeviceCluster,
     db: DeviceBatch,
@@ -114,12 +194,16 @@ def precompute(
     has_images: bool = True,
     enabled: frozenset = F.ALL_FILTER_KERNELS,
     extra_mask=None,
+    sp_keys=None,
+    sp_cdv_tab=None,
+    ip_keys=None,
 ) -> GangStatics:
     """When a has_* flag is False the corresponding statics are built with a
     ZERO-width constraint axis; the scan step's reductions over that axis
     vanish at compile time (the PreFilter-Skip of the gang path — shape-
     driven rather than flag-plumbed).  ``enabled`` reflects the profile's
-    Filter plugin set."""
+    Filter plugin set.  sp_keys/sp_cdv_tab/ip_keys come from batch_tables();
+    they are required whenever the matching has_* flag is set."""
     P = db.valid.shape[0]
     N = dc.node_valid.shape[0]
     tolerated = F._tolerated(dc, db)
@@ -162,6 +246,22 @@ def precompute(
         b_sel = eval_table(db.tsc_table, db.labels, dc.val_ints)  # [P, C, J]
         same_ns = db.ns_id[:, None] == db.ns_id[None, :]
         sp_bmatch = b_sel & same_ns[:, None, :] & db.valid[None, None, :]
+        if sp_keys is None:
+            # Missing tables would silently zero n_dom for every non-host
+            # soft constraint (wrong topologyNormalizingWeight) — fail loud.
+            raise ValueError(
+                "precompute: sp_keys/sp_cdv_tab (from batch_tables) are "
+                "required when has_spread is set"
+            )
+        else:
+            k_eq = (db.tsc_topo[:, :, None] == sp_keys[None, None, :]) & (
+                sp_keys >= 0
+            )[None, None, :]  # [P, C, Kd]
+            any_k = jnp.any(k_eq, axis=-1)
+            ki = jnp.argmax(k_eq, axis=-1)
+            sp_cdv = jnp.where(
+                any_k[:, :, None], sp_cdv_tab[ki], -1
+            )  # [P, C, N]
         sp = dict(
             sp_hard=spre.exists & db.tsc_hard,
             sp_soft=soft,
@@ -177,6 +277,7 @@ def precompute(
             sp_node_cnt=cnt_n,
             sp_sc_dom=jnp.where(spre.dv >= 0, sc_dom, 0),
             sp_all_keys=all_keys,
+            sp_cdv=sp_cdv,
         )
     else:
         z2 = jnp.zeros((P, 0), bool)
@@ -197,6 +298,7 @@ def precompute(
             sp_node_cnt=z3i,
             sp_sc_dom=z3i,
             sp_all_keys=jnp.ones((P, N), bool),
+            sp_cdv=z3i,
         )
 
     # ---- inter-pod ----
@@ -231,6 +333,24 @@ def precompute(
             hard_pod_affinity_weight,
             pref_w.astype(I32),
         ).astype(I64)
+        AT = is_aff.shape[1]
+        if ip_keys is None:
+            # Without the key table the batch-cross (pod vs already-committed
+            # batch peer) term evaluation has nothing to factor over and
+            # anti-affinity between batch members would silently vanish.
+            raise ValueError(
+                "precompute: ip_keys (from batch_tables) is required when "
+                "has_interpod is set"
+            )
+        else:
+            k_eq = (db.aff_topo[:, :, None] == ip_keys[None, None, :]) & (
+                ip_keys >= 0
+            )[None, None, :]
+            any_k = jnp.any(k_eq, axis=-1)
+            ip_key_idx = jnp.where(
+                any_k, jnp.argmax(k_eq, axis=-1).astype(I32), -1
+            )
+            ip_key_cols = gather_at(dc.node_labels.T, ip_keys)  # [Kd2, N]
         ip = dict(
             ip_dv=ipre.inc_dv,
             ip_dom_cnt=ip_dom_cnt,
@@ -243,6 +363,8 @@ def precompute(
             ip_is_anti=is_anti,
             ip_pref_w=pref_w,
             ip_sym_w=sym_w,
+            ip_key_idx=ip_key_idx,
+            ip_key_cols=ip_key_cols,
         )
     else:
         ip = dict(
@@ -257,6 +379,8 @@ def precompute(
             ip_is_anti=jnp.zeros((P, 0), bool),
             ip_pref_w=jnp.zeros((P, 0), I64),
             ip_sym_w=jnp.zeros((P, 0), I64),
+            ip_key_idx=jnp.zeros((P, 0), I32),
+            ip_key_cols=jnp.full((1, N), ABSENT, I32),
         )
 
     # ---- batch port conflicts (node_ports.go semantics, pod×pod) ----
@@ -337,32 +461,6 @@ def _norm_spread(raw, valid, feas):
     return jnp.where(use & any_valid, out, 0)
 
 
-def _scatter_by_domain(values_j, dom_j, v_cap: int):
-    """Σ values grouped by domain id: [.., J] ints + [.., J] ids →
-    [.., v_cap+1] (invalid ids land in the dump slot v_cap)."""
-    seg = jnp.where((dom_j >= 0) & (dom_j < v_cap), dom_j, v_cap)
-    lead = values_j.shape[:-1]
-    J = values_j.shape[-1]
-    flat_v = values_j.reshape((-1, J))
-    flat_s = seg.reshape((-1, J))
-    out = jax.vmap(
-        lambda v, s: jax.ops.segment_sum(v, s, num_segments=v_cap + 1)
-    )(flat_v, flat_s)
-    return out.reshape(lead + (v_cap + 1,))
-
-
-def _domain_gather_sum(contrib_cj, dom_cj, dv_cn):
-    """Σ_j contrib[c, j] over entries whose domain equals node n's domain:
-    [C, J] ints + [C, J] ids + [C, N] node-domain ids → [C, N].
-
-    Equivalent to scatter-by-domain followed by a gather at each node's
-    domain id, but expressed as a dense equality reduction — scatters
-    serialize on TPU; this shape (C×N×J) rides the vector units.
-    """
-    eq = (dv_cn[:, :, None] >= 0) & (dv_cn[:, :, None] == dom_cj[:, None, :])
-    return jnp.sum(jnp.where(eq, contrib_cj[:, None, :], 0), axis=2)
-
-
 # Diagnosis rows of the [P, N_DIAG] reason-count output, in chain order.
 DIAG_KERNELS = (
     "NodeUnschedulable",
@@ -390,7 +488,9 @@ WEIGHT_ORDER = (
 DEFAULT_WEIGHTS = tuple(S.DEFAULT_SCORE_WEIGHTS[n] for n in WEIGHT_ORDER)
 
 
-@functools.partial(jax.jit, static_argnames=("v_cap", "weights", "check_fit"))
+@functools.partial(
+    jax.jit, static_argnames=("v_cap", "weights", "check_fit", "d_cap")
+)
 def gang_schedule(
     dc: DeviceCluster,
     db: DeviceBatch,
@@ -401,33 +501,55 @@ def gang_schedule(
     nom_node=None,
     nom_prio=None,
     nom_req=None,
+    d_cap: int = 8,
+    extra_score=None,
 ):
     """Scan the batch in order; each pod sees all prior in-batch placements.
 
+    extra_score (optional i64 [P, N]) carries host-plugin Score
+    contributions, already normalized and weighted (run_host_scores) — the
+    post-device merge point of RunScorePlugins (runtime/framework.go:1177)
+    for plugins without kernels.
+
     nom_* (optional [G] / [G, Rn] arrays) carry NOMINATED pods — preemptors
     whose victims are still terminating.  Their resources are charged to
-    their nominated node for every pod of lower-or-equal... strictly lower
-    priority than the nominee (RunFilterPluginsWithNominatedPods,
-    runtime/framework.go:973: nominated pods with priority >= the evaluated
-    pod count as present).
+    their nominated node for every pod of lower-or-equal priority
+    (RunFilterPluginsWithNominatedPods, runtime/framework.go:973: nominated
+    pods with priority >= the evaluated pod count as present).
 
     Returns (chosen [P] i32 node index or -1, n_feasible [P] i32).
     """
     P, N = g.static_mask.shape
     Rn = dc.requested.shape[1]
     Rp = db.requests.shape[1]
+    C = g.sp_dv.shape[1]
+    AT = g.ip_dv.shape[1]
+    Kd2 = g.ip_key_cols.shape[0]
+
+    # Nominated-pod node charge matrix, built once outside the scan: per-step
+    # work is a tiny [G]·[G,N] contraction instead of a segment scatter.
+    if nom_node is not None:
+        nom_oh = (
+            nom_node[:, None] == jnp.arange(N, dtype=I32)[None, :]
+        ).astype(I32)  # [G, N]
 
     init = dict(
         requested=dc.requested,
         nonzero=dc.nonzero_req,
         num_pods=dc.num_pods,
         assigned=jnp.full((P,), ABSENT, I32),
-        onehot=jnp.zeros((P, N), bool),
     )
 
     def step(state, p):
-        assigned_valid = state["assigned"] >= 0  # [J]
-        a_clip = jnp.clip(state["assigned"], 0, N - 1)
+        assigned = state["assigned"]
+        assigned_valid = assigned >= 0  # [J]
+        a_clip = jnp.clip(assigned, 0, N - 1)
+        av = assigned_valid[None, :]
+        # [J, N] node-identity of each assigned batch peer — shared by the
+        # port-conflict check and the hostname-topology spread counts.
+        eqJ = (a_clip[:, None] == jnp.arange(N, dtype=I32)[None, :]) & (
+            assigned_valid[:, None]
+        )
 
         # ---------------- dynamic filters ----------------
         req = db.requests[p]  # [Rp]
@@ -438,14 +560,11 @@ def gang_schedule(
             nom_cnt = 0
             nom_delta = 0
             if nom_node is not None:
-                gate = nom_prio >= db.priority[p]  # [G]
-                seg = jnp.clip(nom_node, 0, N - 1)
-                nom_delta = jax.ops.segment_sum(
-                    jnp.where(gate[:, None], nom_req, 0), seg, num_segments=N
+                gate = (nom_prio >= db.priority[p]).astype(I32)  # [G]
+                nom_cnt = jnp.einsum("g,gn->n", gate, nom_oh)
+                nom_delta = jnp.einsum(
+                    "gr,gn->nr", nom_req * gate[:, None], nom_oh
                 )  # [N, Rn]
-                nom_cnt = jax.ops.segment_sum(
-                    gate.astype(I32), seg, num_segments=N
-                )
             fits = state["num_pods"] + nom_cnt + 1 <= dc.allowed_pods
             all_zero = jnp.all(req == 0)
             avail = dc.allocatable - state["requested"] - nom_delta  # [N, Rn]
@@ -461,26 +580,29 @@ def gang_schedule(
             m_fit = fits & (all_zero | lane_ok)
             mask = mask & m_fit
 
-        av = assigned_valid[None, :]
         m_portb = true_n
         if g.port_b.shape[1]:
-            port_conf = jnp.any(g.port_b[p][:, None] & state["onehot"], axis=0)
+            port_conf = jnp.any(g.port_b[p][:, None] & eqJ, axis=0)
             m_portb = ~port_conf
             mask = mask & m_portb
 
         # ---------------- spread (hard) ----------------
-        dv = g.sp_dv[p]  # [C, N]
-        dv_at = None
-        if g.sp_dv.shape[1]:
-            te_at = jnp.take_along_axis(g.sp_te[p], a_clip[None, :], axis=1)
+        if C:
+            dv = g.sp_dv[p]  # [C, N]
             dv_at = jnp.take_along_axis(dv, a_clip[None, :], axis=1)  # [C, J]
-            contrib = (g.sp_bmatch[p] & av & te_at).astype(I32)
-            dom_add = _scatter_by_domain(
-                contrib, jnp.where(av, dv_at, -1), v_cap
-            )  # [C, V+1]
-            dyn = jnp.take_along_axis(dom_add, jnp.clip(dv, 0, v_cap), axis=1)
-            dyn = jnp.where(dv >= 0, dyn, 0)
-            total = g.sp_dom_cnt[p] + dyn  # [C, N]
+            te_at = jnp.take_along_axis(g.sp_te[p], a_clip[None, :], axis=1)
+            bm = g.sp_bmatch[p] & av  # [C, J]
+            # Same-domain indicator of each node vs each assigned peer's
+            # node, as a fused dense compare (dv space): [C, N, J].
+            eq_dom = (
+                (dv[:, :, None] >= 0)
+                & (dv_at[:, None, :] >= 0)
+                & (dv[:, :, None] == dv_at[:, None, :])
+            )
+            dyn_f = jnp.sum(
+                (eq_dom & (bm & te_at)[:, None, :]).astype(I32), axis=2
+            )  # [C, N]
+            total = g.sp_dom_cnt[p] + dyn_f  # [C, N]
             big32 = jnp.iinfo(jnp.int32).max
             min_match = jnp.min(jnp.where(g.sp_te[p], total, big32), axis=1)
             min_match = jnp.where(
@@ -501,17 +623,16 @@ def gang_schedule(
             m_spread = true_n
 
         # ---------------- inter-pod (hard) ----------------
-        if g.ip_dv.shape[1]:
+        if AT:
             ip_dv = g.ip_dv[p]  # [AT, N]
             ip_dv_at = jnp.take_along_axis(ip_dv, a_clip[None, :], axis=1)
-            ip_contrib = (g.ip_bmatch[p] & av).astype(I32)
-            ip_add = _scatter_by_domain(
-                ip_contrib, jnp.where(av, ip_dv_at, -1), v_cap
-            )
-            ip_dyn = jnp.take_along_axis(
-                ip_add, jnp.clip(ip_dv, 0, v_cap), axis=1
-            )
-            ip_dyn = jnp.where(ip_dv >= 0, ip_dyn, 0)
+            ip_eq = (
+                (ip_dv[:, :, None] >= 0)
+                & (ip_dv_at[:, None, :] >= 0)
+                & (ip_dv[:, :, None] == ip_dv_at[:, None, :])
+            )  # [AT, N, J]
+            ip_bm = g.ip_bmatch[p] & av  # [AT, J]
+            ip_dyn = jnp.sum((ip_eq & ip_bm[:, None, :]).astype(I32), axis=2)
             ip_total = g.ip_dom_cnt[p] + ip_dyn  # [AT, N]
 
             topo_present = ip_dv >= 0
@@ -522,7 +643,7 @@ def gang_schedule(
                 ~g.ip_is_aff[p][:, None] | (topo_present & (ip_total > 0)),
                 axis=0,
             )
-            any_dyn = jnp.any(g.ip_is_aff[p][:, None] & g.ip_bmatch[p] & av)
+            any_dyn = jnp.any(g.ip_is_aff[p][:, None] & ip_bm)
             any_match = g.ip_any_static[p] | any_dyn
             topo_all = jnp.all(
                 ~g.ip_is_aff[p][:, None] | topo_present, axis=0
@@ -530,26 +651,42 @@ def gang_schedule(
             escape = jnp.any(g.ip_is_aff[p]) & ~any_match & g.ip_self_all[p]
             ok3 = aff_ok | (escape & topo_all)
 
-            # batch-assigned pods' terms vs p: p matches j's term u
-            #   ⇔ ip_bmatch[j, u, p]
+            # Batch-assigned peers' terms vs p, factored by distinct topology
+            # key so the contraction reads [Kd2, N] columns instead of the
+            # full [P, AT, N] domain tensor each step.  dv_ju[j, u] = the
+            # topology value at j's assigned node for j's term u.
             m_jp = g.ip_bmatch[:, :, p] & assigned_valid[:, None]  # [J, AT]
-            dv_ju = jnp.take_along_axis(
-                g.ip_dv, a_clip[:, None, None], axis=2
-            )[:, :, 0]  # [J, AT]
-            eq = (dv_ju >= 0)[:, :, None] & (
-                g.ip_dv == dv_ju[:, :, None]
-            )  # [J, AT, N]
-            viol_b = jnp.any(
-                (m_jp & g.ip_is_anti)[:, :, None] & eq, axis=(0, 1)
-            )
+            cols_at_a = jnp.take_along_axis(
+                g.ip_key_cols, a_clip[None, :], axis=1
+            )  # [Kd2, J]
+            ki = g.ip_key_idx  # [J, AT]
+            ki_clip = jnp.clip(ki, 0, Kd2 - 1)
+            dv_ju = jnp.take_along_axis(cols_at_a.T, ki_clip, axis=1)  # [J, AT]
+            term_live = m_jp & (ki >= 0) & (dv_ju >= 0)
+            g_anti = (term_live & g.ip_is_anti).reshape(-1)  # [J·AT]
+            w_sym = jnp.where(term_live, g.ip_sym_w, 0).astype(I32).reshape(-1)
+            ki_f = ki_clip.reshape(-1)
+            live_f = (ki >= 0).reshape(-1)
+            dvf = dv_ju.reshape(-1)
+            viol_b = jnp.zeros((N,), bool)
+            sym_b = jnp.zeros((N,), I32)
+            for k in range(Kd2):
+                in_k = live_f & (ki_f == k)
+                eqk = (dvf[:, None] == g.ip_key_cols[k][None, :]) & (
+                    g.ip_key_cols[k] >= 0
+                )[None, :]  # [J·AT, N]
+                viol_b = viol_b | jnp.any(
+                    (g_anti & in_k)[:, None] & eqk, axis=0
+                )
+                sym_b = sym_b + jnp.einsum(
+                    "t,tn->n",
+                    jnp.where(in_k, w_sym, 0),
+                    eqk.astype(I32),
+                )
             m_interpod = ~g.ip_viol_existing[p] & ~viol2 & ok3 & ~viol_b
             mask = mask & m_interpod
         else:
             m_interpod = true_n
-            ip_total = g.ip_dom_cnt[p]
-            topo_present = g.ip_dv[p] >= 0
-            m_jp = g.ip_bmatch[:, :, p] & assigned_valid[:, None]
-            eq = jnp.zeros((P, 0, N), bool)
         feas = mask
         n_feas = jnp.sum(feas.astype(I32))
 
@@ -616,7 +753,7 @@ def gang_schedule(
 
         # InterPodAffinity: static symmetric + incoming preferred (with batch
         # contributions) + symmetric from batch-assigned pods' terms.
-        if g.ip_dv.shape[1]:
+        if AT:
             pref = jnp.sum(
                 jnp.where(
                     topo_present,
@@ -625,16 +762,14 @@ def gang_schedule(
                 ),
                 axis=0,
             )
-            w_jp = jnp.where(m_jp, g.ip_sym_w, 0)  # [J, AT] i64
-            sym_b = jnp.sum(w_jp[:, :, None] * eq.astype(I64), axis=(0, 1))
-            ip_raw = g.ip_sym[p] + pref + sym_b
+            ip_raw = g.ip_sym[p] + pref + sym_b.astype(I64)
         else:
             ip_raw = g.ip_sym[p]
 
         # PodTopologySpread score
-        if g.sp_dv.shape[1]:
+        if C:
             sp_raw, sp_valid = _spread_score(
-                dc, db, g, state, p, feas, dv_at, v_cap
+                dc, db, g, p, feas, dv, dv_at, bm, eqJ, a_clip, d_cap
             )
         else:
             sp_raw = jnp.zeros((N,), I64)
@@ -658,6 +793,8 @@ def gang_schedule(
             total_score += w_bal * balanced
         if w_img:
             total_score += w_img * g.sc_image[p]
+        if extra_score is not None:
+            total_score += extra_score[p]
 
         neg = jnp.iinfo(jnp.int64).min
         ranked = jnp.where(feas, total_score, neg)
@@ -674,7 +811,6 @@ def gang_schedule(
             + onehot_n[:, None].astype(I32) * db.nonzero_req[p][None, :],
             num_pods=state["num_pods"] + onehot_n.astype(I32),
             assigned=state["assigned"].at[p].set(choice),
-            onehot=state["onehot"].at[p].set(onehot_n),
         )
         return state, (choice, n_feas, reason_counts)
 
@@ -702,6 +838,7 @@ def gang_schedule(
         "has_images",
         "enabled",
         "weights",
+        "d_cap",
     ),
 )
 def gang_run(
@@ -720,6 +857,11 @@ def gang_run(
     nom_node=None,
     nom_prio=None,
     nom_req=None,
+    sp_keys=None,
+    sp_cdv_tab=None,
+    ip_keys=None,
+    d_cap: int = 8,
+    extra_score=None,
 ):
     """Fused precompute + scan: ONE device dispatch per batch."""
     g = precompute(
@@ -734,6 +876,9 @@ def gang_run(
         has_images=has_images,
         enabled=enabled,
         extra_mask=extra_mask,
+        sp_keys=sp_keys,
+        sp_cdv_tab=sp_cdv_tab,
+        ip_keys=ip_keys,
     )
     return gang_schedule(
         dc,
@@ -745,56 +890,62 @@ def gang_run(
         nom_node=nom_node,
         nom_prio=nom_prio,
         nom_req=nom_req,
+        d_cap=d_cap,
+        extra_score=extra_score,
     )
 
 
-def _spread_score(dc, db, g, state, p, feas, dv_at, v_cap):
+def _spread_score(dc, db, g, p, feas, dv, dv_at, bm, eqJ, a_clip, d_cap):
     """ScheduleAnyway scoring for one pod given current batch placements
-    (podtopologyspread/scoring.go, fixed-point log weights)."""
+    (podtopologyspread/scoring.go, fixed-point log weights).
+
+    The per-domain machinery of the original formulation is replaced by
+    dense equivalents:
+      * domain presence (``pair_pres``) is dropped outright — a node whose
+        score is ever consumed is ``counted`` (feasible ∧ has all soft topo
+        keys), and a counted node's own domain trivially contains it, so the
+        where(pair_pres, ., 0) gate was a no-op at every consumed node;
+      * the count of domains containing counted nodes uses the host-built
+        compact domain ids (g.sp_cdv, batch_tables()) as a [C, N, d_cap]
+        compare+reduce;
+      * hostname-topology counts use the [J, N] assigned-node identity
+        (eqJ) as an i32 matmul, non-host domain counts reuse the filter's
+        [C, N, J] same-domain compare gated by the score-counting mask.
+    """
     soft = g.sp_soft[p]  # [C]
     has_soft = jnp.any(soft)
-    dv = g.sp_dv[p]  # [C, N]
     C, N = dv.shape
-    av = (state["assigned"] >= 0)[None, :]
 
     ignored = feas & ~g.sp_all_keys[p]
     counted = feas & g.sp_all_keys[p]  # filtered, non-ignored
-
-    # pair-init presence + topoSize over counted nodes (dynamic: depends on
-    # current feasibility)
-    pres_add = _scatter_by_domain(
-        jnp.broadcast_to(counted[None, :], (C, N)).astype(I32),
-        jnp.where(counted[None, :], dv, -1),
-        v_cap,
-    )  # [C, V+1]
-    pair_pres = (
-        jnp.take_along_axis(pres_add, jnp.clip(dv, 0, v_cap), axis=1) > 0
-    )
-    pair_pres = pair_pres & (dv >= 0)
-    n_dom = jnp.sum((pres_add[:, :v_cap] > 0).astype(I32), axis=1)  # [C]
     n_counted = jnp.sum(counted.astype(I32))
+
+    cdv = g.sp_cdv[p]  # [C, N]
+    dom_hit = (cdv[:, :, None] == jnp.arange(d_cap, dtype=I32)[None, None, :]) & (
+        counted[None, :, None]
+    )  # [C, N, D]
+    n_dom = jnp.sum(jnp.any(dom_hit, axis=1).astype(I32), axis=1)  # [C]
     size = jnp.where(g.sp_is_host[p], n_counted, n_dom)  # [C]
     w_fx = dc.log_tab[jnp.clip(size, 0, dc.log_tab.shape[0] - 1)]  # [C] i64
 
-    # batch contributions to score counts (gated by the score counting mask
-    # at the assigned node)
-    cg_at = jnp.take_along_axis(g.sp_counting[p], jnp.clip(
-        state["assigned"], 0, N - 1)[None, :], axis=1)  # [C, J]
-    contrib = (g.sp_bmatch[p] & av & cg_at).astype(I32)
-    dom_add = _scatter_by_domain(contrib, jnp.where(av, dv_at, -1), v_cap)
-    dyn_dom = jnp.take_along_axis(dom_add, jnp.clip(dv, 0, v_cap), axis=1)
-    dyn_dom = jnp.where(dv >= 0, dyn_dom, 0)
-
-    # hostname constraints count per node directly
-    dyn_host = jnp.sum(
-        (g.sp_bmatch[p][:, :, None] & state["onehot"][None, :, :]).astype(I32),
-        axis=1,
+    # batch contributions: hostname constraints count per assigned node
+    # directly (ungated), domain constraints are gated by the score-counting
+    # mask at the assigned node (scoring.go: only counted nodes contribute).
+    dyn_host = jnp.einsum(
+        "cj,jn->cn", bm.astype(I32), eqJ.astype(I32)
     )  # [C, N]
+    cg_at = jnp.take_along_axis(g.sp_counting[p], a_clip[None, :], axis=1)
+    eq_dom = (
+        (dv[:, :, None] >= 0)
+        & (dv_at[:, None, :] >= 0)
+        & (dv[:, :, None] == dv_at[:, None, :])
+    )
+    dyn_dom = jnp.sum((eq_dom & (bm & cg_at)[:, None, :]).astype(I32), axis=2)
 
     cnt = jnp.where(
         g.sp_is_host[p][:, None],
         g.sp_node_cnt[p] + dyn_host,
-        jnp.where(pair_pres, g.sp_sc_dom[p] + dyn_dom, 0),
+        g.sp_sc_dom[p] + dyn_dom,
     )  # [C, N]
 
     contrib_fx = cnt.astype(I64) * w_fx[:, None] + (

@@ -591,8 +591,14 @@ class Scheduler:
             fwk.score_weights.get(n, 0) for n in gang.WEIGHT_ORDER
         )
         active_host = fwk.active_host_filters(state, pods)
+        # Host PreScore/Score plugins (runtime/framework.go:1052,1101):
+        # PreScore may Skip; surviving plugins contribute a pre-weighted
+        # [P, N] score matrix merged before the device argmax.
+        fwk.run_pre_score(state, pods, self.mirror.nodes.names)
+        active_scores = fwk.active_host_scores(state, pods)
         if (
             not active_host
+            and not active_scores
             and not len(self.nominator)
             and self.cache.n_term_pods == 0
             and self.cache.n_port_pods == 0
@@ -625,6 +631,12 @@ class Scheduler:
         db = DeviceBatch.from_host(pb)
         v_cap = bucket_cap(len(vocab.label_vals))
         hostname_key = jnp.asarray(vocab.label_keys.lookup(HOSTNAME_LABEL), I32)
+        tables = gang.batch_tables(
+            pb.tsc_topo_key,
+            pb.aff_topo_key,
+            self.mirror.nodes.label_vals,
+            vocab.label_keys.lookup(HOSTNAME_LABEL),
+        )
 
         has_interpod = bool(
             (pb.aff_kind != PAD).any()
@@ -644,6 +656,14 @@ class Scheduler:
             extra_mask, host_diags, host_plugin_sets = self._host_filter_mask(
                 fwk, state, pods, p_cap
             )
+
+        # 1b'. host-backed Score plugins → pre-weighted additive [P, N]
+        # matrix merged into the device selection (the RunScorePlugins
+        # weight+sum pass, runtime/framework.go:1177, for kernel-less
+        # plugins — e.g. VolumeBinding's VolumeCapacityPriority shape).
+        extra_score = None
+        if active_scores:
+            extra_score = self._host_score_matrix(fwk, state, pods, p_cap)
 
         # 1c. nominated preemptors (victims still terminating) charge their
         # nominated node for pods of lower priority (runtime:973).
@@ -670,6 +690,8 @@ class Scheduler:
             nom_node=nom_node,
             nom_prio=nom_prio,
             nom_req=nom_req,
+            extra_score=extra_score,
+            **tables,
         )
         chosen = jax.device_get(chosen)
         n_feas = jax.device_get(n_feas)
@@ -946,6 +968,17 @@ class Scheduler:
             ]
 
         totals = prioritize(pod, st, feasible, weights=fwk.score_weights)
+        # host Score plugins contribute here too (the one-pod analogue of
+        # the batched extra_score merge)
+        fwk.run_pre_score(state, [pod], feasible)
+        if fwk.active_host_scores(state, [pod]):
+            node_states = [st.nodes.get(n) for n in feasible]
+            for name, scores in fwk.run_host_scores(
+                state, pod, node_states
+            ).items():
+                w = fwk.score_weights.get(name, 0)
+                for n, s in zip(feasible, scores):
+                    totals[n] = totals.get(n, 0) + s * w
         for ext in self.extenders:
             if not ext.is_prioritizer() or not ext.is_interested(pod):
                 continue
@@ -1045,6 +1078,39 @@ class Scheduler:
                     if s.plugin:
                         plugin_sets[i].add(s.plugin)
         return jnp.asarray(mask), diags, plugin_sets
+
+    def _host_score_matrix(self, fwk, state, pods, p_cap: int):
+        """[p_cap, N] i64: Σ weight·normalized host-plugin scores per
+        (pod, node) — merged additively into the device total before the
+        argmax (RunScorePlugins runtime/framework.go:1101-1207 for plugins
+        without kernels).  NormalizeScore runs over the valid node set; a
+        kernel-less plugin whose normalize depends on the *dynamic* feasible
+        set is not representable here (none in-tree does)."""
+        import numpy as np
+
+        nt = self.mirror.nodes
+        n_cap = nt.valid.shape[0]
+        total = np.zeros((p_cap, n_cap), dtype=np.int64)
+        st = self.oracle_view()
+        node_states = [
+            st.nodes.get(nt.names[j]) if j < len(nt.names) and nt.valid[j] else None
+            for j in range(n_cap)
+        ]
+        relevant = {p.name: p for p in fwk.active_host_scores(state, pods)}
+        for i, pod in enumerate(pods):
+            if not any(
+                p.score_relevant(pod)
+                and not state.is_score_skipped(pod.uid, p.name)
+                for p in relevant.values()
+            ):
+                continue
+            per_plugin = fwk.run_host_scores(state, pod, node_states)
+            for name, scores in per_plugin.items():
+                w = fwk.score_weights.get(name, 0)
+                if not w:
+                    continue
+                total[i] += np.asarray(scores, dtype=np.int64) * w
+        return jnp.asarray(total)
 
     def _post_filter_or_fail(
         self,

@@ -43,8 +43,15 @@ def run_gang(state, pending):
     db = DeviceBatch.from_host(pb)
     v_cap = bucket_cap(len(vocab.label_vals))
     hostname_key = jnp.asarray(vocab.label_keys.lookup(HOSTNAME_LABEL), I32)
-    g = gang.precompute(dc, db, hostname_key, v_cap)
-    chosen, n_feas, _, _ = gang.gang_schedule(dc, db, g, v_cap)
+    tables = gang.batch_tables(
+        pb.tsc_topo_key,
+        pb.aff_topo_key,
+        pc.nodes.label_vals,
+        vocab.label_keys.lookup(HOSTNAME_LABEL),
+    )
+    d_cap = tables.pop("d_cap")
+    g = gang.precompute(dc, db, hostname_key, v_cap, **tables)
+    chosen, n_feas, _, _ = gang.gang_schedule(dc, db, g, v_cap, d_cap=d_cap)
     names = list(state.nodes)
     return [
         names[int(c)] if int(c) >= 0 else None

@@ -1,0 +1,142 @@
+"""Host-backed Score plugins must influence batched placement.
+
+The reference runs Score plugins host-side in three passes
+(runtime/framework.go:1101-1207); here kernel-less Score plugins contribute
+a pre-weighted [P, N] matrix merged into the device selection
+(Scheduler._host_score_matrix → gang extra_score).  A host-only Score
+plugin must be able to flip the chosen node of a batched pod.
+"""
+
+from kubernetes_tpu.api.resource import Resource
+from kubernetes_tpu.api.types import Container, Node, Pod
+from kubernetes_tpu.framework import config as cfg
+from kubernetes_tpu.framework.interface import (
+    CycleState,
+    PreScorePlugin,
+    ScorePlugin,
+    Status,
+)
+from kubernetes_tpu.framework.registry import default_registry
+from kubernetes_tpu.scheduler import Scheduler
+
+
+class FavorNode(ScorePlugin):
+    """Host-only scorer strongly preferring one node by name."""
+
+    name = "FavorNode"
+
+    def score(self, state, pod, node_state) -> int:
+        return 100 if node_state.node.name == self.args["favorite"] else 0
+
+
+class SkippingFavorNode(FavorNode, PreScorePlugin):
+    name = "SkippingFavorNode"
+
+    def pre_score(self, state, pods, nodes) -> Status:
+        return Status.skip()
+
+
+def _mk_sched(plugin_cls, favorite: str, weight: int = 10):
+    reg = default_registry()
+    reg.register(plugin_cls.name, lambda args, handle: plugin_cls(args, handle))
+    profile = cfg.Profile(
+        plugins=cfg.Plugins(
+            score=cfg.PluginSet(
+                enabled=[cfg.PluginRef(plugin_cls.name, weight=weight)]
+            ),
+            pre_score=cfg.PluginSet(enabled=[cfg.PluginRef(plugin_cls.name)]),
+        ),
+        plugin_config={plugin_cls.name: {"favorite": favorite}},
+    )
+    conf = cfg.SchedulerConfiguration(profiles=[profile])
+    sched = Scheduler(configuration=conf, registry=reg)
+    bindings = {}
+    sched.binding_sink = lambda pod, node: bindings.__setitem__(pod.uid, node)
+    return sched, bindings
+
+
+def _nodes():
+    # identical nodes: without the host scorer the tie breaks to the first
+    return [
+        Node(
+            name=f"node-{i}",
+            labels={"kubernetes.io/hostname": f"node-{i}"},
+            capacity=Resource.from_map({"cpu": "4", "memory": "8Gi"}),
+        )
+        for i in range(4)
+    ]
+
+
+def _pods(n):
+    return [
+        Pod(
+            name=f"p{i}",
+            containers=[Container(requests={"cpu": "100m", "memory": "64Mi"})],
+        )
+        for i in range(n)
+    ]
+
+
+def test_host_score_flips_choice():
+    sched, bindings = _mk_sched(FavorNode, favorite="node-2")
+    for n in _nodes():
+        sched.on_node_add(n)
+    for p in _pods(3):
+        sched.on_pod_add(p)
+    outs = sched.schedule_pending()
+    assert all(o.node == "node-2" for o in outs), [o.node for o in outs]
+
+
+def test_without_host_score_first_node_wins():
+    from kubernetes_tpu.scheduler import Scheduler
+
+    sched = Scheduler()
+    sched.binding_sink = lambda pod, node: None
+    for n in _nodes():
+        sched.on_node_add(n)
+    for p in _pods(1):
+        sched.on_pod_add(p)
+    outs = sched.schedule_pending()
+    # no host scorer → identical nodes tie-break to index 0
+    assert outs[0].node == "node-0"
+
+
+def test_pre_score_skip_disables_host_score():
+    sched, bindings = _mk_sched(SkippingFavorNode, favorite="node-2")
+    for n in _nodes():
+        sched.on_node_add(n)
+    for p in _pods(1):
+        sched.on_pod_add(p)
+    outs = sched.schedule_pending()
+    assert outs[0].node == "node-0"
+
+
+def test_one_pod_path_host_score(monkeypatch):
+    """The one-pod (extender-class) cycle merges host scores too."""
+    from kubernetes_tpu.extender import Extender
+
+    class NopExtender(Extender):
+        name = "nop"
+        weight = 1
+        ignorable = False
+
+        def is_interested(self, pod):
+            return True
+
+        def is_filter(self):
+            return False
+
+        def is_prioritizer(self):
+            return False
+
+        def is_binder(self):
+            return False
+
+    sched, bindings = _mk_sched(FavorNode, favorite="node-3")
+    sched.extenders.append(NopExtender())
+    for n in _nodes():
+        sched.on_node_add(n)
+    for p in _pods(1):
+        sched.on_pod_add(p)
+    outs = sched.schedule_pending()
+    assert outs[0].node == "node-3"

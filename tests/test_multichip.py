@@ -36,7 +36,13 @@ def _problem(seed=3, n_nodes=16, n_placed=24, n_pending=16):
     db = DeviceBatch.from_host(pb)
     v_cap = bucket_cap(len(vocab.label_vals))
     hostname_key = jnp.asarray(vocab.label_keys.lookup(HOSTNAME_LABEL), I32)
-    return dc, db, hostname_key, v_cap
+    tables = gang.batch_tables(
+        pb.tsc_topo_key,
+        pb.aff_topo_key,
+        pc.nodes.label_vals,
+        vocab.label_keys.lookup(HOSTNAME_LABEL),
+    )
+    return dc, db, hostname_key, v_cap, tables
 
 
 @pytest.fixture(scope="module")
@@ -46,18 +52,18 @@ def problem():
 
 @pytest.fixture(scope="module")
 def single_device_decisions(problem):
-    dc, db, hostname_key, v_cap = problem
-    chosen, n_feas, _, _ = gang.gang_run(dc, db, hostname_key, v_cap)
+    dc, db, hostname_key, v_cap, tables = problem
+    chosen, n_feas, _, _ = gang.gang_run(dc, db, hostname_key, v_cap, **tables)
     return jax.device_get(chosen), jax.device_get(n_feas)
 
 
 def _run_on_mesh(problem, pods_axis):
-    dc, db, hostname_key, v_cap = problem
+    dc, db, hostname_key, v_cap, tables = problem
     mesh = make_mesh(8, pods_axis=pods_axis)
     assert mesh.shape["pods"] == pods_axis
     dcs = place_cluster(mesh, dc)
     dbs = place_batch(mesh, db)
-    chosen, n_feas, _, _ = gang.gang_run(dcs, dbs, hostname_key, v_cap)
+    chosen, n_feas, _, _ = gang.gang_run(dcs, dbs, hostname_key, v_cap, **tables)
     return jax.device_get(chosen), jax.device_get(n_feas)
 
 
