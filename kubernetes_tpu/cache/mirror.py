@@ -52,9 +52,23 @@ class SnapshotMirror:
         self._ns_labels = None
         self._epod_slots = None  # uid → (slot, id(pod)) in _existing
         self._eterm_count = 0
+        # bumped whenever the existing-pod tensors are REBUILT (not
+        # appended) — the device-mirror cache invalidation signal
+        self._existing_rebuilds = 0
+        self._m_cap_max = 1  # sticky: term axis never shrinks (recompiles)
         # expected total placed pods (queue pressure) — pre-sizes the E/M
         # axes so the gang pipeline compiles ONCE instead of per doubling
         self.e_cap_hint = 0
+
+    @property
+    def e_used(self) -> int:
+        """Occupied placed-pod slots (append cursor)."""
+        return len(self._epod_slots or {})
+
+    @property
+    def m_used(self) -> int:
+        """Occupied term rows (append cursor)."""
+        return self._eterm_count
 
     @property
     def existing(self):
@@ -80,9 +94,7 @@ class SnapshotMirror:
             and self._existing.node_idx.shape[0] >= self._e_cap(len(placed))
         ):
             cur = {p.uid: p for p in placed}
-            if len(cur) >= len(slots) and all(
-                id(cur.get(uid)) == oid for uid, (_, oid) in slots.items()
-            ):
+            if len(cur) >= len(slots) and self._adopt_equivalent(cur, slots):
                 new = [p for p in placed if p.uid not in slots]
                 n_terms = append_existing_pods(
                     self._existing,
@@ -96,7 +108,7 @@ class SnapshotMirror:
                 if n_terms is not None:
                     base = len(slots)
                     for i, p in enumerate(new):
-                        slots[p.uid] = (base + i, id(p))
+                        slots[p.uid] = (base + i, p)
                     self._eterm_count = n_terms
                     self._existing_version = self._cache.pod_version
                     return
@@ -113,10 +125,37 @@ class SnapshotMirror:
             namespace_labels=self._ns_labels,
             m_cap=self._m_cap_for(placed),
         )
-        self._epod_slots = {p.uid: (i, id(p)) for i, p in enumerate(placed)}
+        self._epod_slots = {p.uid: (i, p) for i, p in enumerate(placed)}
         self._eterm_count = int((self._existing.term_kind != PAD).sum())
         self._existing_version = self._cache.pod_version
+        self._existing_rebuilds += 1
 
+    @staticmethod
+    def _adopt_equivalent(cur, slots) -> bool:
+        """True when every slotted pod is still present with a pack-
+        equivalent object (the API confirmation of an assumed pod replaces
+        the object without changing any packed field, cache.go:484) —
+        adopting the new objects keeps the append-only discipline instead
+        of forcing a full repack per bind confirmation."""
+        adopted = []
+        for uid, (slot, old) in slots.items():
+            now = cur.get(uid)
+            if now is None:
+                return False
+            if now is old:
+                continue
+            if (
+                now.node_name == old.node_name
+                and now.labels == old.labels
+                and now.namespace == old.namespace
+                and now.deletion_timestamp == old.deletion_timestamp
+            ):
+                adopted.append((uid, slot, now))
+                continue
+            return False
+        for uid, slot, now in adopted:
+            slots[uid] = (slot, now)
+        return True
 
     def _e_cap(self, n_placed: int) -> int:
         return bucket_cap(max(self.e_cap_hint, n_placed))
@@ -132,7 +171,8 @@ class SnapshotMirror:
         )
         # upper-bound terms/pod at observed density (x4 slack for multi-term)
         est = self._e_cap(len(placed)) * (n_terms * 4) // n
-        return bucket_cap(max(est, 1), 1)
+        self._m_cap_max = max(self._m_cap_max, bucket_cap(max(est, 1), 1))
+        return self._m_cap_max
 
     def update(self, cache: Cache, namespace_labels=None) -> None:
         """Bring the mirror up to date with the cache (incremental)."""
@@ -236,8 +276,9 @@ class SnapshotMirror:
             m_cap=self._m_cap_for(placed),
         )
         self._existing_version = cache.pod_version
-        self._epod_slots = {p.uid: (i, id(p)) for i, p in enumerate(placed)}
+        self._epod_slots = {p.uid: (i, p) for i, p in enumerate(placed)}
         self._eterm_count = int((self._existing.term_kind != PAD).sum())
+        self._existing_rebuilds += 1
         self.generation = max((cn.generation for cn in real), default=0)
         self.static_generation = max(
             (cn.static_generation for cn in real), default=0

@@ -41,19 +41,28 @@ from kubernetes_tpu.framework.registry import Registry
 
 
 class WaitingPod:
-    """An entry in the Permit wait map (waiting_pods_map.go)."""
+    """An entry in the Permit wait map (waiting_pods_map.go).
+
+    Event-based: WaitOnPermit blocks a BINDING worker thread (the async
+    bindingCycle, schedule_one.go:263) until allow/reject/timeout — it never
+    stalls the scheduling loop."""
 
     def __init__(self, pod: Pod, node_name: str, deadline: float):
+        import threading
+
         self.pod = pod
         self.node_name = node_name
         self.deadline = deadline
         self.decision: Optional[Status] = None
+        self._event = threading.Event()
 
     def allow(self) -> None:
         self.decision = Status.success()
+        self._event.set()
 
     def reject(self, reason: str) -> None:
         self.decision = Status.unschedulable(reason)
+        self._event.set()
 
 
 class Framework:
@@ -322,14 +331,13 @@ class Framework:
             return Status.wait()
         return Status.success()
 
-    def wait_on_permit(self, pod: Pod, poll_s: float = 0.01) -> Status:
+    def wait_on_permit(self, pod: Pod) -> Status:
         """Blocks until the waiting pod is allowed/rejected/timed out
-        (runtime:1503)."""
+        (runtime:1503) — event wait, no polling."""
         wp = self.waiting_pods.get(pod.uid)
         if wp is None:
             return Status.success()
-        while wp.decision is None and time.monotonic() < wp.deadline:
-            time.sleep(poll_s)
+        wp._event.wait(timeout=max(wp.deadline - time.monotonic(), 0.0))
         self.waiting_pods.pop(pod.uid, None)
         if wp.decision is None:
             return Status.unschedulable("permit wait timeout")

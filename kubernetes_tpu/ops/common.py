@@ -75,7 +75,9 @@ class DTable:
 
     @classmethod
     def from_host(cls, t: ConjunctionTable) -> "DTable":
-        return jax.device_put(cls.host_tree(t))
+        from kubernetes_tpu.ops import wire
+
+        return wire.device_put_packed(cls.host_tree(t))
 
 
 @_register_pytree
@@ -123,13 +125,14 @@ class DeviceCluster:
 
     @classmethod
     def from_host(cls, nt: NodeTensors, ep: ExistingPodTensors, vocab) -> "DeviceCluster":
+        from kubernetes_tpu.ops import wire
         from kubernetes_tpu.snapshot.selectors import METADATA_NAME_KEY
 
         n = int(nt.valid.sum())
         log_tab = np.round(
             np.log(np.arange(nt.n_cap + 2, dtype=np.float64) + 2.0) * (1 << 32)
         ).astype(np.int64)
-        return jax.device_put(cls(
+        return wire.device_put_packed(cls(
             allocatable=np.asarray(nt.allocatable, np.int32),
             requested=np.asarray(nt.requested, np.int32),
             nonzero_req=np.asarray(nt.nonzero_req, np.int32),
@@ -208,7 +211,9 @@ class DeviceBatch:
 
     @classmethod
     def from_host(cls, pb: PodBatch) -> "DeviceBatch":
-        return jax.device_put(cls(
+        from kubernetes_tpu.ops import wire
+
+        return wire.device_put_packed(cls(
             requests=np.asarray(pb.requests, np.int32),
             nonzero_req=np.asarray(pb.nonzero_req, np.int32),
             ns_id=np.asarray(pb.ns_id, np.int32),

@@ -285,6 +285,13 @@ class SchedulingQueue:
                 and new.uid == qp.uid
             ):
                 qp.pod = new
+        if not qp.unschedulable_plugins:
+            # No failed plugin is associated — something unusual (an
+            # apiserver error during binding, etc).  No queueing hint will
+            # ever fire for it, so retry after backoff instead of parking
+            # in the unschedulable map (scheduling_queue.go:642-647).
+            self._requeue(qp, immediately=False, event="ScheduleAttemptFailure")
+            return
         for ev, old, new in events:
             if self._is_worth_requeuing(qp, ev, old, new):
                 self._requeue(qp, immediately=False, event="ScheduleAttemptFailure")

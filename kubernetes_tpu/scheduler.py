@@ -15,7 +15,9 @@ in kubernetes_tpu.testing; a real client would speak the same interface.
 
 from __future__ import annotations
 
+import threading
 import time
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -167,7 +169,8 @@ class Handle:
         return None
 
     def activate(self, pods) -> None:
-        self._s.queue.activate(pods)
+        with self._s._mu:
+            self._s.queue.activate(pods)
 
 
 class Scheduler:
@@ -198,7 +201,20 @@ class Scheduler:
 
         self.cache = Cache()
         self.mirror = SnapshotMirror()
+        from kubernetes_tpu.cache.device_mirror import DeviceClusterCache
+
+        self._dc_cache = DeviceClusterCache()
+        self._p_cap_max = 1  # sticky batch bucket: avoids per-size recompiles
         self.nominator = Nominator()
+        # Async binding pipeline (schedule_one.go:117-129): the scheduling
+        # loop stops at assume+reserve+permit; wait/prebind/bind/postbind run
+        # on worker threads against the assumed cache state, overlapping the
+        # next batch's device dispatch.  self._mu is the cache.mu analogue —
+        # every cache/queue mutation (informer handlers, commits, unwinds)
+        # holds it; the device dispatch and bind RTTs run outside it.
+        self._mu = threading.RLock()
+        self._bind_pool: Optional[ThreadPoolExecutor] = None
+        self._inflight_binds: List = []
 
         # storage/DRA object views: assume caches for the objects plugins
         # optimistically mutate (PV/PVC/ResourceClaim, scheduler.go:298-302),
@@ -268,14 +284,16 @@ class Scheduler:
     # ----- event handlers (eventhandlers.go:345-428) ------------------------
 
     def on_node_add(self, node: Node) -> None:
-        self._invalidate_view()
-        self._external_mutations += 1
-        self.cache.add_node(node)
-        self.queue.move_all_on_event(
-            ClusterEvent(EventResource.NODE, ActionType.ADD), None, node
-        )
+        with self._mu:
+            self._invalidate_view()
+            self._external_mutations += 1
+            self.cache.add_node(node)
+            self.queue.move_all_on_event(
+                ClusterEvent(EventResource.NODE, ActionType.ADD), None, node
+            )
 
     def on_node_update(self, old: Node, new: Node) -> None:
+      with self._mu:
         self._invalidate_view()
         self._external_mutations += 1
         self.cache.update_node(new)
@@ -296,6 +314,7 @@ class Scheduler:
             )
 
     def on_node_delete(self, node: Node) -> None:
+      with self._mu:
         self._invalidate_view()
         self._external_mutations += 1
         self.cache.remove_node(node.name)
@@ -304,9 +323,19 @@ class Scheduler:
         )
 
     def on_pod_add(self, pod: Pod) -> None:
+      with self._mu:
         self._invalidate_view()
         if pod.node_name:
-            self._external_mutations += 1
+            # Confirmation of OUR assumed pod on the same node changes no
+            # capacity state (the assume already counted it) — don't treat
+            # it as an external mutation (cache.go:484 reconciliation).
+            confirmed = (
+                pod.uid in self.cache.assumed
+                and (ps := self.cache.pod_states.get(pod.uid)) is not None
+                and ps.pod.node_name == pod.node_name
+            )
+            if not confirmed:
+                self._external_mutations += 1
             self.cache.add_pod(pod)
             self.queue.move_all_on_event(
                 ClusterEvent(EventResource.ASSIGNED_POD, ActionType.ADD),
@@ -317,9 +346,17 @@ class Scheduler:
             self.queue.add(pod)
 
     def on_pod_update(self, old: Pod, new: Pod) -> None:
+      with self._mu:
         self._invalidate_view()
         if new.node_name:
-            self._external_mutations += 1
+            confirmed = (
+                new.uid in self.cache.assumed
+                and (ps := self.cache.pod_states.get(new.uid)) is not None
+                and ps.pod.node_name == new.node_name
+                and old.labels == new.labels
+            )
+            if not confirmed:
+                self._external_mutations += 1
             if old.node_name:
                 self.cache.update_pod(old, new)
             else:
@@ -335,6 +372,7 @@ class Scheduler:
             self.queue.update(old, new)
 
     def on_pod_delete(self, pod: Pod) -> None:
+      with self._mu:
         self._invalidate_view()
         if pod.node_name:
             self._external_mutations += 1
@@ -373,31 +411,34 @@ class Scheduler:
         lister = lister_maps.get(resource)
 
         def on_add(obj):
-            if cache is not None:
-                cache.on_add(obj)
-            if lister is not None:
-                lister[obj.key] = obj
-            self.queue.move_all_on_event(
-                ClusterEvent(resource, ActionType.ADD), None, obj
-            )
+            with self._mu:
+                if cache is not None:
+                    cache.on_add(obj)
+                if lister is not None:
+                    lister[obj.key] = obj
+                self.queue.move_all_on_event(
+                    ClusterEvent(resource, ActionType.ADD), None, obj
+                )
 
         def on_update(old, new):
-            if cache is not None:
-                cache.on_update(old, new)
-            if lister is not None:
-                lister[new.key] = new
-            self.queue.move_all_on_event(
-                ClusterEvent(resource, ActionType.UPDATE), old, new
-            )
+            with self._mu:
+                if cache is not None:
+                    cache.on_update(old, new)
+                if lister is not None:
+                    lister[new.key] = new
+                self.queue.move_all_on_event(
+                    ClusterEvent(resource, ActionType.UPDATE), old, new
+                )
 
         def on_delete(obj):
-            if cache is not None:
-                cache.on_delete(obj)
-            if lister is not None:
-                lister.pop(obj.key, None)
-            self.queue.move_all_on_event(
-                ClusterEvent(resource, ActionType.DELETE), obj, None
-            )
+            with self._mu:
+                if cache is not None:
+                    cache.on_delete(obj)
+                if lister is not None:
+                    lister.pop(obj.key, None)
+                self.queue.move_all_on_event(
+                    ClusterEvent(resource, ActionType.DELETE), obj, None
+                )
 
         return on_add, on_update, on_delete
 
@@ -410,15 +451,16 @@ class Scheduler:
         """Host-object view of the cache for host-backed plugins/oracle.
         Cached until any cache mutation (informer event, assume/forget) —
         a batch's PostFilter calls share one build."""
-        if self._oracle_cache is None:
-            st = OracleState(namespace_labels=self.namespace_labels)
-            for cn in self.cache.real_nodes():
-                ns = NodeState(node=cn.node)
-                for p in cn.pods.values():
-                    ns.add_pod(p)
-                st.nodes[cn.node.name] = ns
-            self._oracle_cache = st
-        return self._oracle_cache
+        with self._mu:
+            if self._oracle_cache is None:
+                st = OracleState(namespace_labels=self.namespace_labels)
+                for cn in self.cache.real_nodes():
+                    ns = NodeState(node=cn.node)
+                    for p in cn.pods.values():
+                        ns.add_pod(p)
+                    st.nodes[cn.node.name] = ns
+                self._oracle_cache = st
+            return self._oracle_cache
 
     # ----- the scheduling loop ---------------------------------------------
 
@@ -428,12 +470,14 @@ class Scheduler:
         batches = 0
         # Pre-size the placed-pod tensor axes for the whole drain: every
         # distinct shape costs an XLA recompile of the gang pipeline.
-        self.mirror.e_cap_hint = max(
-            self.mirror.e_cap_hint,
-            len(self.cache.pod_states) + len(self.queue),
-        )
+        with self._mu:
+            self.mirror.e_cap_hint = max(
+                self.mirror.e_cap_hint,
+                len(self.cache.pod_states) + len(self.queue),
+            )
         while True:
-            batch = self.queue.pop_batch(self.config.batch_size)
+            with self._mu:
+                batch = self.queue.pop_batch(self.config.batch_size)
             if not batch:
                 break
             # Segregate by profile (schedule_one.go:376-382): each group
@@ -450,6 +494,12 @@ class Scheduler:
             batches += 1
             if max_batches is not None and batches >= max_batches:
                 break
+        # End-of-drain barrier: binding cycles of the LAST batches may still
+        # be in flight (they overlapped the later dispatches); callers read
+        # final outcomes, so settle them here.  Failed binds have been
+        # requeued with backoff by now — they surface on a later drain,
+        # exactly like the reference's retry flow.
+        self.wait_for_bindings()
         return outcomes
 
     def _record_batch_metrics(self, profile, group, outs, dt: float) -> None:
@@ -514,13 +564,20 @@ class Scheduler:
             # batched device path.  Runs preserve queue order, so decisions
             # stay sequential-equivalent.
             hf = fwk.host_filter_plugins()
-            if hf or self.extenders:
+            ns_plugins = self._normalizing_score_plugins(fwk)
+            if hf or self.extenders or ns_plugins:
                 run: List = []
                 split = False
                 for qp in batch:
-                    if not any(
-                        p.maybe_relevant(qp.pod) for p in hf
-                    ) and not any(e.is_interested(qp.pod) for e in self.extenders):
+                    if (
+                        not any(p.maybe_relevant(qp.pod) for p in hf)
+                        and not any(
+                            e.is_interested(qp.pod) for e in self.extenders
+                        )
+                        and not any(
+                            p.score_relevant(qp.pod) for p in ns_plugins
+                        )
+                    ):
                         run.append(qp)
                         continue
                     split = True
@@ -533,145 +590,183 @@ class Scheduler:
                         outcomes.extend(self._schedule_batch(run))
                     return outcomes
 
-        if len(batch) == 1 and any(
-            e.is_interested(batch[0].pod) for e in self.extenders
+        if len(batch) == 1 and (
+            any(e.is_interested(batch[0].pod) for e in self.extenders)
+            # a host Score plugin with a CUSTOM normalize must score over
+            # the true feasible set (runtime/framework.go:1158 runs
+            # NormalizeScore post-Filter) — the oracle one-pod cycle does;
+            # the batched extra_score merge cannot
+            or any(
+                p.score_relevant(batch[0].pod)
+                for p in self._normalizing_score_plugins(fwk)
+            )
         ):
             return self._schedule_one_extender(fwk, batch[0])
 
-        state = CycleState()
+        # Host-side preparation reads cache/mirror/assume-cache state that
+        # async binding workers mutate under self._mu — hold it for the
+        # whole prep (the device dispatch below runs outside the lock).
+        with self._mu:
+            state = CycleState()
 
-        # 0. PreFilter (runtime:698): per-pod rejection + Skip bookkeeping
-        pf_failures = fwk.run_pre_filter(state, [qp.pod for qp in batch])
-        if pf_failures:
-            live = []
-            for qp in batch:
-                s = pf_failures.get(qp.pod.uid)
-                if s is None:
-                    live.append(qp)
-                    continue
-                self.metrics["schedule_attempts"] += 1
-                outcomes.append(self._post_filter_or_fail(fwk, state, qp, s, 0))
-            batch = live
-            if not batch:
-                return outcomes
-        pods = [qp.pod for qp in batch]
-        from kubernetes_tpu.metrics import Trace
+            # 0. PreFilter (runtime:698): per-pod rejection + Skip bookkeeping
+            pf_failures = fwk.run_pre_filter(state, [qp.pod for qp in batch])
+            if pf_failures:
+                live = []
+                for qp in batch:
+                    s = pf_failures.get(qp.pod.uid)
+                    if s is None:
+                        live.append(qp)
+                        continue
+                    self.metrics["schedule_attempts"] += 1
+                    outcomes.append(self._post_filter_or_fail(fwk, state, qp, s, 0))
+                batch = live
+                if not batch:
+                    return outcomes
+            pods = [qp.pod for qp in batch]
+            from kubernetes_tpu.metrics import Trace
 
-        trace = Trace(
-            "Scheduling batch",
-            clock=time.perf_counter,
-            pods=len(pods),
-            profile=fwk.profile_name,
-        )
-        trace.step("PreFilter done")
+            trace = Trace(
+                "Scheduling batch",
+                clock=time.perf_counter,
+                pods=len(pods),
+                profile=fwk.profile_name,
+            )
+            trace.step("PreFilter done")
 
-        # 1. snapshot: incremental host-side pack + device upload.  Pod
-        # labels are interned FIRST so a fresh full pack covers them (stale
-        # val-int tables would force a second repack next cycle).
-        t_pack = time.perf_counter()
-        vocab = self.mirror.vocab
-        for pod in pods:
-            for k, v in pod.labels.items():
-                vocab.intern_label(k, v)
-        self.mirror.update(self.cache, self.namespace_labels)
-        if bucket_cap(len(vocab.label_keys)) > self.mirror.nodes.k_cap:
-            self.mirror._force_full = True
+            # 1. snapshot: incremental host-side pack + device upload.  Pod
+            # labels are interned FIRST so a fresh full pack covers them (stale
+            # val-int tables would force a second repack next cycle).
+            t_pack = time.perf_counter()
+            vocab = self.mirror.vocab
+            for pod in pods:
+                for k, v in pod.labels.items():
+                    vocab.intern_label(k, v)
             self.mirror.update(self.cache, self.namespace_labels)
-        self.prom.recorder.observe(
-            self.prom.snapshot_pack_duration, time.perf_counter() - t_pack
-        )
-        trace.step("Snapshot mirror updated")
-
-        # 1a. FAST PATH: when the batch has no batch-dynamic constraints
-        # beyond resources (no inter-pod/spread/ports/nominations/host
-        # filters), pods collapse into signatures — one tiny device static
-        # eval + exact host greedy replaces the per-pod device scan.
-        enabled = fwk.device_enabled()
-        weights = tuple(
-            fwk.score_weights.get(n, 0) for n in gang.WEIGHT_ORDER
-        )
-        active_host = fwk.active_host_filters(state, pods)
-        # Host PreScore/Score plugins (runtime/framework.go:1052,1101):
-        # PreScore may Skip; surviving plugins contribute a pre-weighted
-        # [P, N] score matrix merged before the device argmax.
-        fwk.run_pre_score(state, pods, self.mirror.nodes.names)
-        active_scores = fwk.active_host_scores(state, pods)
-        if (
-            not active_host
-            and not active_scores
-            and not len(self.nominator)
-            and self.cache.n_term_pods == 0
-            and self.cache.n_port_pods == 0
-        ):
-            t_fast = time.perf_counter()
-            fast = self._try_fast_schedule(
-                fwk, state, batch, enabled, weights, outcomes
+            if bucket_cap(len(vocab.label_keys)) > self.mirror.nodes.k_cap:
+                self.mirror._force_full = True
+                self.mirror.update(self.cache, self.namespace_labels)
+            self.prom.recorder.observe(
+                self.prom.snapshot_pack_duration, time.perf_counter() - t_pack
             )
-            if fast is not None:
-                self.metrics["fast_batches"] += 1
-                self.prom.recorder.observe(
-                    self.prom.gang_dispatch_duration,
-                    time.perf_counter() - t_fast,
-                    path="fast",
+            trace.step("Snapshot mirror updated")
+
+            # 1a. FAST PATH: when the batch has no batch-dynamic constraints
+            # beyond resources (no inter-pod/spread/ports/nominations/host
+            # filters), pods collapse into signatures — one tiny device static
+            # eval + exact host greedy replaces the per-pod device scan.
+            enabled = fwk.device_enabled()
+            weights = tuple(
+                fwk.score_weights.get(n, 0) for n in gang.WEIGHT_ORDER
+            )
+            active_host = fwk.active_host_filters(state, pods)
+            # Host PreScore/Score plugins (runtime/framework.go:1052,1101):
+            # PreScore may Skip; surviving plugins contribute a pre-weighted
+            # [P, N] score matrix merged before the device argmax.
+            fwk.run_pre_score(state, pods, self.mirror.nodes.names)
+            active_scores = fwk.active_host_scores(state, pods)
+            if (
+                not active_host
+                and not active_scores
+                and not len(self.nominator)
+                and self.cache.n_term_pods == 0
+                and self.cache.n_port_pods == 0
+            ):
+                t_fast = time.perf_counter()
+                fast = self._try_fast_schedule(
+                    fwk, state, batch, enabled, weights, outcomes
                 )
-                trace.step("Fast-path commit done")
-                trace.log_if_long()
-                return fast
-        self.metrics["scan_batches"] += 1
+                if fast is not None:
+                    self.metrics["fast_batches"] += 1
+                    self.prom.recorder.observe(
+                        self.prom.gang_dispatch_duration,
+                        time.perf_counter() - t_fast,
+                        path="fast",
+                    )
+                    trace.step("Fast-path commit done")
+                    trace.log_if_long()
+                    return fast
+            self.metrics["scan_batches"] += 1
 
-        p_cap = bucket_cap(len(pods), 1)
-        pb = pack_pod_batch(
-            pods,
-            vocab,
-            k_cap=self.mirror.nodes.k_cap,
-            p_cap=p_cap,
-            namespace_labels=self.namespace_labels,
-        )
-        dc = DeviceCluster.from_host(self.mirror.nodes, self.mirror.existing, vocab)
-        db = DeviceBatch.from_host(pb)
-        v_cap = bucket_cap(len(vocab.label_vals))
-        hostname_key = jnp.asarray(vocab.label_keys.lookup(HOSTNAME_LABEL), I32)
-        tables = gang.batch_tables(
-            pb.tsc_topo_key,
-            pb.aff_topo_key,
-            self.mirror.nodes.label_vals,
-            vocab.label_keys.lookup(HOSTNAME_LABEL),
-        )
+            self._p_cap_max = max(self._p_cap_max, bucket_cap(len(pods), 1))
+            p_cap = self._p_cap_max
+            pb = pack_pod_batch(
+                pods,
+                vocab,
+                k_cap=self.mirror.nodes.k_cap,
+                p_cap=p_cap,
+                namespace_labels=self.namespace_labels,
+            )
+            t_sync = time.perf_counter()
+            dc = self._dc_cache.sync(self.mirror, vocab)
+            db = DeviceBatch.from_host(pb)
+            self.prom.recorder.observe(
+                self.prom.snapshot_pack_duration,
+                time.perf_counter() - t_sync,
+                phase="device_sync",
+            )
+            v_cap = bucket_cap(len(vocab.label_vals))
+            hk_id = vocab.label_keys.lookup(HOSTNAME_LABEL)
+            if getattr(self, "_hk_cached", None) != hk_id:
+                self._hostname_key_dev = jnp.asarray(hk_id, I32)
+                self._hk_cached = hk_id
+            hostname_key = self._hostname_key_dev
+            # batch_tables' device arrays are reused across batches with the
+            # same key sets + node labels (re-uploading them each batch costs
+            # transfer round trips on remote device links)
+            import numpy as np
 
-        has_interpod = bool(
-            (pb.aff_kind != PAD).any()
-            or (self.mirror.existing.term_kind != PAD).any()
-        )
-        has_spread = bool((pb.tsc_topo_key != PAD).any())
-        has_images = bool((pb.img_ids >= 0).any())
-        has_ports = bool(
-            (pb.want_ppk != PAD).any() or (self.mirror.nodes.used_ppk != PAD).any()
-        )
+            tkey = (
+                self.mirror.static_generation,
+                self.mirror._full_packs,
+                len(vocab.label_vals),
+                tuple(np.unique(pb.tsc_topo_key).tolist()),
+                tuple(np.unique(pb.aff_topo_key).tolist()),
+            )
+            if getattr(self, "_tables_key", None) != tkey:
+                self._tables = gang.batch_tables(
+                    pb.tsc_topo_key,
+                    pb.aff_topo_key,
+                    self.mirror.nodes.label_vals,
+                    hk_id,
+                )
+                self._tables_key = tkey
+            tables = self._tables
 
-        # 1b. host-backed Filter plugins veto (pod, node) pairs the device
-        # kernels can't judge (stateful plugins — volumebinding class).
-        extra_mask = None
-        host_diags = host_plugin_sets = None
-        if active_host:
-            extra_mask, host_diags, host_plugin_sets = self._host_filter_mask(
-                fwk, state, pods, p_cap
+            has_interpod = bool(
+                (pb.aff_kind != PAD).any()
+                or (self.mirror.existing.term_kind != PAD).any()
+            )
+            has_spread = bool((pb.tsc_topo_key != PAD).any())
+            has_images = bool((pb.img_ids >= 0).any())
+            has_ports = bool(
+                (pb.want_ppk != PAD).any() or (self.mirror.nodes.used_ppk != PAD).any()
             )
 
-        # 1b'. host-backed Score plugins → pre-weighted additive [P, N]
-        # matrix merged into the device selection (the RunScorePlugins
-        # weight+sum pass, runtime/framework.go:1177, for kernel-less
-        # plugins — e.g. VolumeBinding's VolumeCapacityPriority shape).
-        extra_score = None
-        if active_scores:
-            extra_score = self._host_score_matrix(fwk, state, pods, p_cap)
+            # 1b. host-backed Filter plugins veto (pod, node) pairs the device
+            # kernels can't judge (stateful plugins — volumebinding class).
+            extra_mask = None
+            host_diags = host_plugin_sets = None
+            if active_host:
+                extra_mask, host_diags, host_plugin_sets = self._host_filter_mask(
+                    fwk, state, pods, p_cap
+                )
 
-        # 1c. nominated preemptors (victims still terminating) charge their
-        # nominated node for pods of lower priority (runtime:973).
-        nom_node = nom_prio = nom_req = None
-        if len(self.nominator):
-            nom_node, nom_prio, nom_req = self._nominated_arrays(
-                {qp.pod.uid for qp in batch}
-            )
+            # 1b'. host-backed Score plugins → pre-weighted additive [P, N]
+            # matrix merged into the device selection (the RunScorePlugins
+            # weight+sum pass, runtime/framework.go:1177, for kernel-less
+            # plugins — e.g. VolumeBinding's VolumeCapacityPriority shape).
+            extra_score = None
+            if active_scores:
+                extra_score = self._host_score_matrix(fwk, state, pods, p_cap)
+
+            # 1c. nominated preemptors (victims still terminating) charge their
+            # nominated node for pods of lower priority (runtime:973).
+            nom_node = nom_prio = nom_req = None
+            if len(self.nominator):
+                nom_node, nom_prio, nom_req = self._nominated_arrays(
+                    {qp.pod.uid for qp in batch}
+                )
 
         # 2. one fused device dispatch (the whole Filter→Score→Select loop)
         t_gang = time.perf_counter()
@@ -693,8 +788,8 @@ class Scheduler:
             extra_score=extra_score,
             **tables,
         )
-        chosen = jax.device_get(chosen)
-        n_feas = jax.device_get(n_feas)
+        both = jax.device_get(jnp.stack([chosen, n_feas]))
+        chosen, n_feas = both[0], both[1]
         self.prom.recorder.observe(
             self.prom.gang_dispatch_duration,
             time.perf_counter() - t_gang,
@@ -829,6 +924,7 @@ class Scheduler:
         # EXTERNAL mutations or repacks force a rebuild.
         fc_key = (
             self._external_mutations,
+            getattr(self, "_nonfast_commits", 0),
             self.mirror._full_packs,
             enabled,
             weights,
@@ -894,7 +990,7 @@ class Scheduler:
                 )
                 continue
             outcomes.append(
-                self._commit(fwk, state, qp, node_names[idx], -1)
+                self._commit(fwk, state, qp, node_names[idx], -1, from_fast=True)
             )
         return outcomes
 
@@ -1079,6 +1175,20 @@ class Scheduler:
                         plugin_sets[i].add(s.plugin)
         return jnp.asarray(mask), diags, plugin_sets
 
+    @staticmethod
+    def _normalizing_score_plugins(fwk):
+        """Enabled host Score plugins that OVERRIDE normalize — their
+        scores depend on the feasible set, which only the one-pod oracle
+        cycle knows (see the routing in _schedule_batch)."""
+        from kubernetes_tpu.framework.interface import ScorePlugin
+
+        return [
+            p
+            for p in fwk.host_score_plugins()
+            if fwk.score_weights.get(p.name, 0)
+            and type(p).normalize is not ScorePlugin.normalize
+        ]
+
     def _host_score_matrix(self, fwk, state, pods, p_cap: int):
         """[p_cap, N] i64: Σ weight·normalized host-plugin scores per
         (pod, node) — merged additively into the device total before the
@@ -1148,64 +1258,48 @@ class Scheduler:
         return ScheduleOutcome(pod, None, status, n_feas, diagnosis)
 
     def _commit(
-        self, fwk, state, qp, node_name: str, n_feas: int, binder_override=None
+        self,
+        fwk,
+        state,
+        qp,
+        node_name: str,
+        n_feas: int,
+        binder_override=None,
+        from_fast: bool = False,
     ) -> ScheduleOutcome:
-        """assume → reserve → permit → bind (schedulingCycle/bindingCycle).
+        """The scheduling-cycle tail: assume → reserve → permit, then hand
+        the pod to an async binding worker (schedule_one.go:117-129 — the
+        goroutine-per-pod bindingCycle).  The returned outcome is
+        provisional; a bind failure patches it to unschedulable before
+        schedule_pending returns (its end-of-drain barrier).
         ``binder_override`` replaces the in-tree bind plugins when a binder
         extender claims the pod (schedule_one.go extendersBinding)."""
         pod = qp.pod
-        self._invalidate_view()
-        self.cache.assume_pod(pod, node_name)
+        with self._mu:
+            self._invalidate_view()
+            if not from_fast:
+                # scan/extender-path commits advance cache state the fast
+                # committer didn't see — its cache key must change
+                self._nonfast_commits = getattr(self, "_nonfast_commits", 0) + 1
+            self.cache.assume_pod(pod, node_name)
 
-        s = fwk.run_reserve(state, pod, node_name)
-        if not s.ok:
-            self._external_mutations += 1  # committer state diverges
-            self.cache.forget_pod(pod)
-            self._handle_failure(qp, s)
-            return ScheduleOutcome(pod, None, s, n_feas)
-
-        s = fwk.run_permit(state, pod, node_name)
-        if s.rejected or s.code == Code.ERROR:
-            fwk.run_unreserve(state, pod, node_name)
-            self._external_mutations += 1  # committer state diverges
-            self.cache.forget_pod(pod)
-            self._handle_failure(qp, s)
-            return ScheduleOutcome(pod, None, s, n_feas)
-        if s.code == Code.WAIT:
-            s = fwk.wait_on_permit(pod)
+            s = fwk.run_reserve(state, pod, node_name)
             if not s.ok:
-                fwk.run_unreserve(state, pod, node_name)
                 self._external_mutations += 1  # committer state diverges
                 self.cache.forget_pod(pod)
                 self._handle_failure(qp, s)
                 return ScheduleOutcome(pod, None, s, n_feas)
 
-        s = fwk.run_pre_bind(state, pod, node_name)
-        if not s.ok:
-            fwk.run_unreserve(state, pod, node_name)
-            self._external_mutations += 1  # committer state diverges
-            self.cache.forget_pod(pod)
-            self._handle_failure(qp, s)
-            return ScheduleOutcome(pod, None, s, n_feas)
+            s = fwk.run_permit(state, pod, node_name)
+            if s.rejected or s.code == Code.ERROR:
+                fwk.run_unreserve(state, pod, node_name)
+                self._external_mutations += 1  # committer state diverges
+                self.cache.forget_pod(pod)
+                self._handle_failure(qp, s)
+                return ScheduleOutcome(pod, None, s, n_feas)
+        waited = s.code == Code.WAIT
 
-        if binder_override is not None:
-            s = binder_override(pod, node_name)
-        else:
-            s = fwk.run_bind(state, pod, node_name)
-        if not s.ok:
-            # The in-flight ledger is still intact here, so events that
-            # arrived during the attempt replay through add_unschedulable.
-            fwk.run_unreserve(state, pod, node_name)
-            self._external_mutations += 1  # committer state diverges
-            self.cache.forget_pod(pod)
-            self._handle_failure(qp, s)
-            return ScheduleOutcome(pod, None, s, n_feas)
-        self.queue.done(pod.uid)
-        fwk.run_post_bind(state, pod, node_name)
-        self.cache.finish_binding(pod)
-        self.nominator.delete(pod)
-        self.metrics["scheduled"] += 1
-        return ScheduleOutcome(
+        outcome = ScheduleOutcome(
             pod,
             node_name,
             Status.success(),
@@ -1213,15 +1307,85 @@ class Scheduler:
             pod_attempts=qp.attempts,
             first_enqueue_time=qp.timestamp,
         )
+        if self._bind_pool is None:
+            self._bind_pool = ThreadPoolExecutor(
+                max_workers=max(self.config.parallelism, 1),
+                thread_name_prefix="binding-cycle",
+            )
+        self._inflight_binds.append(
+            self._bind_pool.submit(
+                self._binding_cycle,
+                fwk,
+                state,
+                qp,
+                node_name,
+                waited,
+                binder_override,
+                outcome,
+            )
+        )
+        return outcome
+
+    def _binding_cycle(
+        self, fwk, state, qp, node_name, waited, binder_override, outcome
+    ) -> None:
+        """WaitOnPermit → PreBind → Bind → PostBind on a worker thread
+        (schedule_one.go:263-340); failure unwinds via Unreserve + ForgetPod
+        + requeue under the cache lock (:342-374)."""
+        pod = qp.pod
+        try:
+            s = fwk.wait_on_permit(pod) if waited else Status.success()
+            if s.ok:
+                s = fwk.run_pre_bind(state, pod, node_name)
+            if s.ok:
+                if binder_override is not None:
+                    s = binder_override(pod, node_name)
+                else:
+                    s = fwk.run_bind(state, pod, node_name)
+        except Exception as e:  # noqa: BLE001 — surfaced as Status
+            s = Status.error(f"binding cycle panicked: {e}")
+        if not s.ok:
+            with self._mu:
+                # The in-flight ledger is still intact here, so events that
+                # arrived during the attempt replay through add_unschedulable.
+                fwk.run_unreserve(state, pod, node_name)
+                self._external_mutations += 1  # committer state diverges
+                self._invalidate_view()
+                self.cache.forget_pod(pod)
+                self._handle_failure(qp, s)
+            outcome.node = None
+            outcome.status = s
+            return
+        with self._mu:
+            self.queue.done(pod.uid)
+            self.cache.finish_binding(pod)
+            self.nominator.delete(pod)
+            self.metrics["scheduled"] += 1
+        fwk.run_post_bind(state, pod, node_name)
+
+    def wait_for_bindings(self) -> None:
+        """Barrier: block until every in-flight binding cycle completed and
+        its outcome is final (the analogue of draining the reference's
+        binding goroutines)."""
+        while self._inflight_binds:
+            futs, self._inflight_binds = self._inflight_binds, []
+            for f in futs:
+                f.result()
 
     def _handle_failure(self, qp, status: Status, plugins: Optional[set] = None) -> None:
         """handleSchedulingFailure (schedule_one.go:1020).  ``plugins`` is
         the rejecting-plugin set driving queueing-hint requeue; it defaults
-        to the status's single plugin."""
-        if status.code == Code.ERROR:
-            self.metrics["errors"] += 1
-        else:
-            self.metrics["unschedulable"] += 1
-        if plugins is None:
-            plugins = {status.plugin} if status.plugin else set()
-        self.queue.add_unschedulable(qp, plugins)
+        to the status's single plugin.  Takes the cache lock itself: called
+        from both the scheduling loop and binding workers."""
+        with self._mu:
+            if status.code == Code.ERROR:
+                self.metrics["errors"] += 1
+                # Errors (API failures etc.) carry no rejector plugin —
+                # the queue retries them after plain backoff instead of
+                # waiting for a queueing hint (scheduling_queue.go:642).
+                plugins = set()
+            else:
+                self.metrics["unschedulable"] += 1
+            if plugins is None:
+                plugins = {status.plugin} if status.plugin else set()
+            self.queue.add_unschedulable(qp, plugins)

@@ -178,3 +178,62 @@ def test_fast_path_falls_back_on_spread():
     assert sched.metrics["fast_batches"] == 0
     assert sched.metrics["scan_batches"] >= 1
     assert all(v is not None for v in got.values())
+
+
+def test_fast_committer_sees_scan_path_commits():
+    """A fast batch AFTER a scan batch must account for the scan batch's
+    capacity consumption (the committer cache key includes non-fast
+    commits)."""
+    from kubernetes_tpu.api.types import (
+        Affinity,
+        LabelSelector,
+        PodAffinityTerm,
+        PodAntiAffinity,
+    )
+    from kubernetes_tpu.scheduler import Scheduler
+
+    sched = Scheduler()
+    sched.binding_sink = lambda pod, node: None
+    for i in range(2):
+        sched.on_node_add(
+            Node(
+                name=f"n{i}",
+                labels={"kubernetes.io/hostname": f"n{i}"},
+                capacity=Resource.from_map({"cpu": "1", "memory": "4Gi"}),
+            )
+        )
+    # drain A: plain pod (fast path) — builds the committer
+    sched.on_pod_add(
+        Pod(name="a", containers=[Container(requests={"cpu": "600m"})])
+    )
+    outs = sched.schedule_pending()
+    assert outs[0].node is not None
+    assert sched.metrics["fast_batches"] == 1
+    # drain B: anti-affinity pod (scan path) — consumes the other node
+    sched.on_pod_add(
+        Pod(
+            name="b",
+            labels={"grp": "g"},
+            affinity=Affinity(
+                pod_anti_affinity=PodAntiAffinity(
+                    required_during_scheduling_ignored_during_execution=(
+                        PodAffinityTerm(
+                            topology_key="kubernetes.io/hostname",
+                            label_selector=LabelSelector(match_labels={"grp": "g"}),
+                        ),
+                    )
+                )
+            ),
+            containers=[Container(requests={"cpu": "600m"})],
+        )
+    )
+    outs = sched.schedule_pending()
+    assert outs[0].node is not None
+    assert sched.metrics["scan_batches"] >= 1
+    # drain C: plain pod (fast path again) — 600m no longer fits anywhere;
+    # a stale committer would wrongly place it on the scan batch's node
+    sched.on_pod_add(
+        Pod(name="c", containers=[Container(requests={"cpu": "600m"})])
+    )
+    outs = sched.schedule_pending()
+    assert outs[0].node is None, outs[0]
