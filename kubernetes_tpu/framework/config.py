@@ -128,7 +128,7 @@ class SchedulerConfiguration:
     percentage_of_nodes_to_score: int = 0  # 0 = adaptive
     pod_initial_backoff_seconds: float = 1.0
     pod_max_backoff_seconds: float = 10.0
-    batch_size: int = 256  # TPU extension: gang batch width
+    batch_size: int = 512  # TPU extension: gang batch width
     # component-base/featuregate tier (pkg/features/kube_features.go) —
     # only the scheduler-relevant gates exist
     feature_gates: Dict[str, bool] = field(
@@ -371,7 +371,7 @@ def load_config(source) -> SchedulerConfiguration:
         percentage_of_nodes_to_score=d.get("percentageOfNodesToScore", 0),
         pod_initial_backoff_seconds=d.get("podInitialBackoffSeconds", 1.0),
         pod_max_backoff_seconds=d.get("podMaxBackoffSeconds", 10.0),
-        batch_size=d.get("batchSize", 256),
+        batch_size=d.get("batchSize", 512),
     )
     cfg.validate()
     return cfg

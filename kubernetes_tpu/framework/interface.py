@@ -159,6 +159,13 @@ class PreFilterPlugin(Plugin):
         unschedulable/unresolvable rejects the pod for the whole cycle."""
         return Status.success()
 
+    def pre_filter_result(self, pod: Pod) -> Optional[set]:
+        """PreFilterResult.NodeNames (interface.go:837-865): an optional
+        node-name set the pod could EVER land on; None = all nodes.  The
+        runtime intersects results across plugins; an empty intersection
+        rejects the pod UnschedulableAndUnresolvable before Filter."""
+        return None
+
 
 class FilterPlugin(Plugin):
     """Host-backed per-(pod, node) filter."""

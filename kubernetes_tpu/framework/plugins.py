@@ -158,9 +158,48 @@ class TaintToleration(DevicePluginMixin, FilterPlugin, ScorePlugin, EnqueueExten
         return [_node_event(ActionType.ADD | ActionType.UPDATE_NODE_TAINT)]
 
 
-class NodeAffinity(DevicePluginMixin, FilterPlugin, ScorePlugin, EnqueueExtensions):
+class NodeAffinity(
+    DevicePluginMixin, PreFilterPlugin, FilterPlugin, ScorePlugin, EnqueueExtensions
+):
     name = "NodeAffinity"
     kernel = "NodeAffinity"
+
+    def pre_filter(self, state, pod) -> Status:
+        aff = pod.affinity
+        required = (
+            aff.node_affinity.required_during_scheduling_ignored_during_execution
+            if aff and aff.node_affinity
+            else None
+        )
+        if required is None and not pod.node_selector:
+            return Status.skip()  # node_affinity.go:128
+        return Status.success()
+
+    def pre_filter_result(self, pod):
+        """metadata.name In-term narrowing (node_affinity.go:140-171):
+        terms are ORed; a term without a node-name matchField makes every
+        node eligible; In-requirements within a term intersect."""
+        aff = pod.affinity
+        required = (
+            aff.node_affinity.required_during_scheduling_ignored_during_execution
+            if aff and aff.node_affinity
+            else None
+        )
+        if required is None or not required.node_selector_terms:
+            return None
+        node_names = None
+        for t in required.node_selector_terms:
+            term_names = None
+            for r in t.match_fields:
+                if r.key == "metadata.name" and r.operator == "In":
+                    s = set(r.values)
+                    term_names = s if term_names is None else (term_names & s)
+            if term_names is None:
+                return None  # ORed terms: this one admits every node
+            node_names = (
+                term_names if node_names is None else (node_names | term_names)
+            )
+        return node_names
 
     def filter(self, state, pod, ns) -> Status:
         r = OF.filter_node_affinity(pod, ns)
@@ -205,8 +244,67 @@ class NodePorts(DevicePluginMixin, FilterPlugin, EnqueueExtensions):
 
 
 class NodeResourcesFit(DevicePluginMixin, FilterPlugin, ScorePlugin, EnqueueExtensions):
+    """noderesources/fit.go with all three scoring strategies
+    (LeastAllocated default, MostAllocated, RequestedToCapacityRatio —
+    requested_to_capacity_ratio.go:32).  Strategy parameters flow into the
+    device dispatch as static args (Framework.fit_strategy); resource specs
+    beyond cpu/memory are rejected up front rather than silently diverging
+    between the host and device paths."""
+
     name = "NodeResourcesFit"
     kernel = "NodeResourcesFit"
+
+    STRATEGY_IDS = {
+        "LeastAllocated": 0,
+        "MostAllocated": 1,
+        "RequestedToCapacityRatio": 2,
+    }
+    # config.MaxCustomPriorityScore: shape scores are 0-10, scaled to 0-100
+    MAX_CUSTOM_PRIORITY_SCORE = 10
+
+    def __init__(self, args=None, handle=None):
+        super().__init__(args, handle)
+        ss = self.args.get("scoringStrategy", {}) or {}
+        self.strategy = ss.get("type", "LeastAllocated")
+        if self.strategy not in self.STRATEGY_IDS:
+            raise ValueError(f"unknown scoringStrategy {self.strategy!r}")
+        res = ss.get("resources") or [
+            {"name": "cpu", "weight": 1},
+            {"name": "memory", "weight": 1},
+        ]
+        for r in res:
+            if r.get("name") not in ("cpu", "memory"):
+                raise ValueError(
+                    "scoringStrategy.resources supports cpu/memory "
+                    f"(got {r.get('name')!r})"
+                )
+        w = {r["name"]: int(r.get("weight", 1)) for r in res}
+        self.fit_res_weights = (w.get("cpu", 0), w.get("memory", 0))
+        scale = 100 // self.MAX_CUSTOM_PRIORITY_SCORE
+        raw_shape = ss.get("requestedToCapacityRatio", {}).get(
+            "shape",
+            [{"utilization": 0, "score": 0}, {"utilization": 100, "score": 10}],
+        )
+        # apis/config/validation: utilization strictly increasing in
+        # [0, 100], score in [0, MaxCustomPriorityScore]
+        prev = -1
+        for p in raw_shape:
+            u, s = int(p["utilization"]), int(p["score"])
+            if not 0 <= u <= 100:
+                raise ValueError(f"shape utilization {u} outside [0, 100]")
+            if u <= prev:
+                raise ValueError("shape utilization must be strictly increasing")
+            if not 0 <= s <= self.MAX_CUSTOM_PRIORITY_SCORE:
+                raise ValueError(
+                    f"shape score {s} outside [0, {self.MAX_CUSTOM_PRIORITY_SCORE}]"
+                )
+            prev = u
+        self.fit_shape = tuple(
+            (int(p["utilization"]), int(p["score"]) * scale) for p in raw_shape
+        )
+        self.fit_resources = tuple(
+            (name, weight) for name, weight in w.items() if weight
+        )
 
     def filter(self, state, pod, ns) -> Status:
         rs = OF.filter_node_resources(pod, ns)
@@ -215,10 +313,13 @@ class NodeResourcesFit(DevicePluginMixin, FilterPlugin, ScorePlugin, EnqueueExte
         )
 
     def score(self, state, pod, ns) -> int:
-        strategy = self.args.get("scoringStrategy", {}).get("type", "LeastAllocated")
-        if strategy == "MostAllocated":
-            return OS.score_most_allocated(pod, ns)
-        return OS.score_least_allocated(pod, ns)
+        if self.strategy == "MostAllocated":
+            return OS.score_most_allocated(pod, ns, self.fit_resources)
+        if self.strategy == "RequestedToCapacityRatio":
+            return OS.score_requested_to_capacity_ratio(
+                pod, ns, self.fit_shape, self.fit_resources
+            )
+        return OS.score_least_allocated(pod, ns, self.fit_resources)
 
     def events_to_register(self):
         def pod_hint(pod: Pod, old, new) -> QueueingHint:

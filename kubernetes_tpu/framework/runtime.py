@@ -123,6 +123,19 @@ class Framework:
     def device_weights(self) -> Dict[str, int]:
         return dict(self.score_weights)
 
+    def fit_strategy(self) -> tuple:
+        """(strategy_id, shape, lane_weights) — the NodeResourcesFit
+        scoring-strategy statics for the device dispatch (ops/gang.py
+        DEFAULT_FIT_STRATEGY shape)."""
+        inst = self._instances.get("NodeResourcesFit")
+        if inst is None:
+            return (0, (), (1, 1))
+        return (
+            inst.STRATEGY_IDS[inst.strategy],
+            inst.fit_shape if inst.strategy == "RequestedToCapacityRatio" else (),
+            inst.fit_res_weights,
+        )
+
     def host_filter_plugins(self) -> List[FilterPlugin]:
         """Enabled Filter plugins with NO device kernel (the host-veto set)."""
         return [
@@ -197,17 +210,34 @@ class Framework:
             return failures
         t0 = time.perf_counter()
         for pod in pods:
+            allowed = None  # PreFilterResult.NodeNames intersection
             for p in plugins:
                 t1 = time.perf_counter()
                 s = p.pre_filter(state, pod)
                 self._observe_plugin(p.name, "PreFilter", s.ok, time.perf_counter() - t1)
                 if s.code == Code.SKIP:
                     state.mark_skip_filter(pod.uid, p.name)
-                elif not s.ok:
+                    continue
+                if not s.ok:
                     if not s.plugin:
                         s.plugin = p.name
                     failures[pod.uid] = s
                     break
+                r = p.pre_filter_result(pod)
+                if r is not None:
+                    allowed = r if allowed is None else (allowed & r)
+                    if not allowed:
+                        # findNodesThatFitPod: empty PreFilterResult ⇒
+                        # every node rejected unresolvably (interface.go:855)
+                        failures[pod.uid] = Status.unresolvable(
+                            "node(s) didn't satisfy plugin "
+                            f"{p.name}'s node-name narrowing",
+                            plugin=p.name,
+                        )
+                        break
+            else:
+                if allowed is not None:
+                    state.write(("pre_filter_result", pod.uid), allowed)
         self._observe_point("PreFilter", not failures, time.perf_counter() - t0)
         return failures
 

@@ -488,8 +488,29 @@ WEIGHT_ORDER = (
 DEFAULT_WEIGHTS = tuple(S.DEFAULT_SCORE_WEIGHTS[n] for n in WEIGHT_ORDER)
 
 
+def _trunc_div(num, den):
+    """Go-style truncation toward zero (den > 0)."""
+    return jnp.where(num >= 0, num // den, -((-num) // den))
+
+
+def _broken_linear_dev(points: tuple, x):
+    """BuildBrokenLinearFunction (helper/shape_score.go:40) over an [N]
+    integer array; ``points`` is a static ((utilization, score), ...)."""
+    out = jnp.full_like(x, points[0][1])
+    for (x0, y0), (x1, y1) in zip(points, points[1:]):
+        seg = y0 + _trunc_div((y1 - y0) * (x - x0), x1 - x0)
+        out = jnp.where((x > x0) & (x <= x1), seg, out)
+    return jnp.where(x > points[-1][0], points[-1][1], out)
+
+
+# (strategy id, shape, per-lane weights) defaults — LeastAllocated with
+# cpu/memory weight 1, matching resource_allocation.go defaults.
+DEFAULT_FIT_STRATEGY = (0, (), (1, 1))
+
+
 @functools.partial(
-    jax.jit, static_argnames=("v_cap", "weights", "check_fit", "d_cap")
+    jax.jit,
+    static_argnames=("v_cap", "weights", "check_fit", "d_cap", "fit_strategy"),
 )
 def gang_schedule(
     dc: DeviceCluster,
@@ -503,6 +524,7 @@ def gang_schedule(
     nom_req=None,
     d_cap: int = 8,
     extra_score=None,
+    fit_strategy: tuple = DEFAULT_FIT_STRATEGY,
 ):
     """Scan the batch in order; each pod sees all prior in-batch placements.
 
@@ -713,7 +735,10 @@ def gang_schedule(
         reason_counts = jnp.stack(reason_counts)  # [N_DIAG]
 
         # ---------------- scores ----------------
-        # LeastAllocated on non-zero-defaulted requests
+        # NodeResourcesFit scoring strategy on non-zero-defaulted requests
+        # (resource_allocation.go:37-115): LeastAllocated (default),
+        # MostAllocated, or RequestedToCapacityRatio over cpu/memory.
+        strat_id, fit_shape, fit_w = fit_strategy
         nz = (
             state["nonzero"].astype(I64)
             + db.nonzero_req[p][None, :].astype(I64)
@@ -721,16 +746,38 @@ def gang_schedule(
         alloc2 = jnp.stack(
             [dc.allocatable[:, LANE_CPU], dc.allocatable[:, LANE_MEM]], axis=1
         ).astype(I64)
-        frac = jnp.where(
-            nz > alloc2, 0, (alloc2 - nz) * MAX // jnp.maximum(alloc2, 1)
-        )
         lane_has = alloc2 > 0
-        wsum = jnp.sum(lane_has.astype(I64), axis=1)
-        least = jnp.where(
-            wsum > 0,
-            jnp.sum(jnp.where(lane_has, frac, 0), axis=1) // jnp.maximum(wsum, 1),
-            0,
-        )
+        if strat_id == 1:  # MostAllocated (most_allocated.go)
+            frac = jnp.where(
+                nz > alloc2, 0, nz * MAX // jnp.maximum(alloc2, 1)
+            )
+        elif strat_id == 2:  # RequestedToCapacityRatio
+            util = jnp.where(
+                ~lane_has | (nz > alloc2),
+                MAX,
+                nz * MAX // jnp.maximum(alloc2, 1),
+            )
+            frac = _broken_linear_dev(fit_shape, util)
+        else:  # LeastAllocated (least_allocated.go:29-60)
+            frac = jnp.where(
+                nz > alloc2, 0, (alloc2 - nz) * MAX // jnp.maximum(alloc2, 1)
+            )
+        w2 = jnp.asarray(fit_w, I64)[None, :]
+        # RTCR only counts resources whose score is positive
+        # (requested_to_capacity_ratio.go:46-52)
+        use = lane_has & (frac > 0) if strat_id == 2 else lane_has
+        wsum = jnp.sum(jnp.where(use, w2, 0), axis=1)
+        total_fit = jnp.sum(jnp.where(use, frac * w2, 0), axis=1)
+        if strat_id == 2:  # math.Round of the weighted mean
+            least = jnp.where(
+                wsum > 0,
+                (2 * total_fit + wsum) // jnp.maximum(2 * wsum, 1),
+                0,
+            )
+        else:
+            least = jnp.where(
+                wsum > 0, total_fit // jnp.maximum(wsum, 1), 0
+            )
 
         # BalancedAllocation on real requests
         a0 = dc.allocatable[:, LANE_CPU].astype(I64)
@@ -839,6 +886,7 @@ def gang_schedule(
         "enabled",
         "weights",
         "d_cap",
+        "fit_strategy",
     ),
 )
 def gang_run(
@@ -862,6 +910,7 @@ def gang_run(
     ip_keys=None,
     d_cap: int = 8,
     extra_score=None,
+    fit_strategy: tuple = DEFAULT_FIT_STRATEGY,
 ):
     """Fused precompute + scan: ONE device dispatch per batch."""
     g = precompute(
@@ -892,6 +941,7 @@ def gang_run(
         nom_req=nom_req,
         d_cap=d_cap,
         extra_score=extra_score,
+        fit_strategy=fit_strategy,
     )
 
 

@@ -58,11 +58,16 @@ ALL_FILTERS = frozenset(
 
 
 def feasible_nodes(
-    pod: Pod, state: OracleState, enabled: frozenset = ALL_FILTERS
+    pod: Pod,
+    state: OracleState,
+    enabled: frozenset = ALL_FILTERS,
+    allowed: Optional[frozenset] = None,
 ) -> FitResult:
     """Filter plugins in the reference's iteration shape (every node, all
     reasons collected).  ``enabled`` limits evaluation to a profile's
-    enabled plugin set (kernel names)."""
+    enabled plugin set (kernel names); ``allowed`` is the PreFilterResult
+    node-name narrowing (findNodesThatFitPod evaluates only those,
+    schedule_one.go:478-486)."""
     spread_counts = (
         F.spread_pair_counts(pod, state) if "PodTopologySpread" in enabled else None
     )
@@ -83,6 +88,8 @@ def feasible_nodes(
     feasible: List[str] = []
     reasons: Dict[str, List[str]] = {}
     for name, ns in state.nodes.items():
+        if allowed is not None and name not in allowed:
+            continue
         rs: List[str] = []
         for _, fn in checks:
             r = fn(ns)
@@ -102,9 +109,11 @@ def prioritize(
     state: OracleState,
     feasible: Sequence[str],
     weights: Optional[Dict[str, int]] = None,
+    fit_scorer=None,
 ) -> Dict[str, int]:
     """Weighted sum of normalized plugin scores per feasible node
-    (prioritizeNodes, schedule_one.go:752)."""
+    (prioritizeNodes, schedule_one.go:752).  ``fit_scorer(pod, ns)``
+    overrides the NodeResourcesFit strategy (default LeastAllocated)."""
     w = dict(DEFAULT_SCORE_WEIGHTS if weights is None else weights)
     nodes = [state.nodes[n] for n in feasible]
     totals = {n: 0 for n in feasible}
@@ -127,9 +136,10 @@ def prioritize(
         raw = S.score_interpod_affinity_all(pod, state, list(feasible))
         accumulate("InterPodAffinity", S.normalize_interpod_affinity(raw))
     if w.get("NodeResourcesFit"):
+        scorer = fit_scorer or S.score_least_allocated
         accumulate(
             "NodeResourcesFit",
-            [S.score_least_allocated(pod, ns) for ns in nodes],
+            [scorer(pod, ns) for ns in nodes],
         )
     if w.get("NodeResourcesBalancedAllocation"):
         accumulate(

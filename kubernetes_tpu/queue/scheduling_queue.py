@@ -44,6 +44,24 @@ DEFAULT_UNSCHEDULABLE_FLUSH_INTERVAL = 30.0  # scheduling_queue.go:356
 _seq = itertools.count()
 
 
+class _LessKey:
+    """Adapts a QueueSort ``less(a, b)`` to the heap's ordering protocol."""
+
+    __slots__ = ("qp", "less")
+
+    def __init__(self, qp, less):
+        self.qp = qp
+        self.less = less
+
+    def __lt__(self, other) -> bool:
+        return self.less(self.qp, other.qp)
+
+    def __eq__(self, other) -> bool:
+        return not self.less(self.qp, other.qp) and not self.less(
+            other.qp, self.qp
+        )
+
+
 @dataclass
 class QueuedPodInfo:
     """framework.QueuedPodInfo (types.go:234)."""
@@ -109,7 +127,23 @@ class SchedulingQueue:
         return a.timestamp < b.timestamp
 
     def _active_key(self, qp: QueuedPodInfo):
-        return (-qp.pod.priority, qp.timestamp)
+        """Heap key honoring the configured QueueSort less function — a
+        custom QueueSort plugin orders the activeQ end to end (the
+        reference builds the activeQ heap directly on the profile's Less,
+        scheduler.go:340).  The key SNAPSHOTS the pod at push time: heap
+        invariants require immutable keys, and updates re-push a fresh
+        entry (the stale one dies lazily via _entry_live)."""
+        if self.less is SchedulingQueue._default_less:
+            # common case: a plain tuple key compares at C speed
+            return (-qp.pod.priority, qp.timestamp)
+        snap = QueuedPodInfo(
+            pod=qp.pod,
+            timestamp=qp.timestamp,
+            attempts=qp.attempts,
+            gated=qp.gated,
+            last_failure_time=qp.last_failure_time,
+        )
+        return _LessKey(snap, self.less)
 
     def _push_active(self, qp: QueuedPodInfo) -> None:
         eid = next(_seq)

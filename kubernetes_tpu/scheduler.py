@@ -215,6 +215,8 @@ class Scheduler:
         self._mu = threading.RLock()
         self._bind_pool: Optional[ThreadPoolExecutor] = None
         self._inflight_binds: List = []
+        # chained-dispatch state (see _try_dispatch_chained)
+        self._chain = None
 
         # storage/DRA object views: assume caches for the objects plugins
         # optimistically mutate (PV/PVC/ResourceClaim, scheduler.go:298-302),
@@ -255,7 +257,26 @@ class Scheduler:
             fwk = self.profiles.get(pod.scheduler_name)
             return fwk.run_pre_enqueue(pod) if fwk is not None else None
 
+        # One queue serves all profiles, ordered by the QueueSort of the
+        # first profile — the reference requires every profile to configure
+        # the SAME QueueSort (apis/config/validation) and builds the activeQ
+        # on its Less (scheduler.go:340).
+        qs_names = {
+            (fwk.queue_sort.name if fwk.queue_sort else None)
+            for fwk in self.profiles.values()
+        }
+        if len(qs_names) > 1:
+            raise ValueError(
+                f"all profiles must use the same QueueSort plugin, got {qs_names}"
+            )
+        first = self.profiles[self.config.profiles[0].scheduler_name]
+        less_fn = None
+        if first.queue_sort is not None:
+            qs = first.queue_sort
+            less_fn = lambda a, b: qs.less(a, b)  # noqa: E731
+
         self.queue = SchedulingQueue(
+            less_fn=less_fn,
             queueing_hints=hints,
             pre_enqueue_check=pre_enqueue,
             initial_backoff_s=self.config.pod_initial_backoff_seconds,
@@ -469,12 +490,24 @@ class Scheduler:
         outcomes: List[ScheduleOutcome] = []
         batches = 0
         # Pre-size the placed-pod tensor axes for the whole drain: every
-        # distinct shape costs an XLA recompile of the gang pipeline.
+        # distinct shape costs an XLA recompile of the gang pipeline.  One
+        # extra batch of margin covers the chained append's bucket-stride
+        # padding on the final partial batch.
         with self._mu:
             self.mirror.e_cap_hint = max(
                 self.mirror.e_cap_hint,
-                len(self.cache.pod_states) + len(self.queue),
+                len(self.cache.pod_states)
+                + len(self.queue)
+                + self.config.batch_size,
             )
+        from collections import deque
+
+        pending: deque = deque()  # chained batches awaiting result harvest
+
+        def flush(keep: int = 0) -> None:
+            while len(pending) > keep:
+                outcomes.extend(self._finish_chained(pending.popleft()))
+
         while True:
             with self._mu:
                 batch = self.queue.pop_batch(self.config.batch_size)
@@ -486,6 +519,34 @@ class Scheduler:
             for qp in batch:
                 groups.setdefault(qp.pod.scheduler_name, []).append(qp)
             for profile_name, group in groups.items():
+                fwk = self.profiles.get(
+                    profile_name, next(iter(self.profiles.values()))
+                )
+                rec = None
+                if self._chain_quickcheck(fwk, group):
+                    rec = self._try_dispatch_chained(
+                        fwk, group, outcomes, can_restart=not pending
+                    )
+                    if rec == "flush":
+                        flush(0)
+                        rec = self._try_dispatch_chained(
+                            fwk, group, outcomes, can_restart=True
+                        )
+                if isinstance(rec, dict):
+                    # pipelined: keep up to two batches in flight so the
+                    # harvest of batch k overlaps k+1's device compute AND
+                    # k+2's dispatch (the async result copy finishes before
+                    # the blocking fetch)
+                    pending.append(rec)
+                    flush(2)
+                    continue
+                if rec == "handled":
+                    continue
+                # direct path: settle the pipeline first — its commits must
+                # land before a non-chained dispatch reads host state — and
+                # drop the chain (these commits happen outside it)
+                flush(0)
+                self._chain = None
                 t0 = time.perf_counter()
                 outs = self._schedule_batch(group)
                 dt = time.perf_counter() - t0
@@ -494,6 +555,7 @@ class Scheduler:
             batches += 1
             if max_batches is not None and batches >= max_batches:
                 break
+        flush(0)
         # End-of-drain barrier: binding cycles of the LAST batches may still
         # be in flight (they overlapped the later dispatches); callers read
         # final outcomes, so settle them here.  Failed binds have been
@@ -552,6 +614,8 @@ class Scheduler:
             batch[0].pod.scheduler_name, next(iter(self.profiles.values()))
         )
         outcomes: List[ScheduleOutcome] = []
+        # direct-path commits happen outside any device chain
+        self._chain = None
 
         if len(batch) > 1:
             # Host-stateful Filter plugins (volumebinding/DRA class) judge
@@ -671,6 +735,8 @@ class Scheduler:
                 and not len(self.nominator)
                 and self.cache.n_term_pods == 0
                 and self.cache.n_port_pods == 0
+                # the signature committer assumes the default fit scoring
+                and fwk.fit_strategy() == gang.DEFAULT_FIT_STRATEGY
             ):
                 t_fast = time.perf_counter()
                 fast = self._try_fast_schedule(
@@ -706,32 +772,8 @@ class Scheduler:
                 phase="device_sync",
             )
             v_cap = bucket_cap(len(vocab.label_vals))
-            hk_id = vocab.label_keys.lookup(HOSTNAME_LABEL)
-            if getattr(self, "_hk_cached", None) != hk_id:
-                self._hostname_key_dev = jnp.asarray(hk_id, I32)
-                self._hk_cached = hk_id
-            hostname_key = self._hostname_key_dev
-            # batch_tables' device arrays are reused across batches with the
-            # same key sets + node labels (re-uploading them each batch costs
-            # transfer round trips on remote device links)
-            import numpy as np
-
-            tkey = (
-                self.mirror.static_generation,
-                self.mirror._full_packs,
-                len(vocab.label_vals),
-                tuple(np.unique(pb.tsc_topo_key).tolist()),
-                tuple(np.unique(pb.aff_topo_key).tolist()),
-            )
-            if getattr(self, "_tables_key", None) != tkey:
-                self._tables = gang.batch_tables(
-                    pb.tsc_topo_key,
-                    pb.aff_topo_key,
-                    self.mirror.nodes.label_vals,
-                    hk_id,
-                )
-                self._tables_key = tkey
-            tables = self._tables
+            hostname_key = self._hostname_dev(vocab)
+            tables = self._gang_tables(pb, vocab)
 
             has_interpod = bool(
                 (pb.aff_kind != PAD).any()
@@ -786,6 +828,7 @@ class Scheduler:
             nom_prio=nom_prio,
             nom_req=nom_req,
             extra_score=extra_score,
+            fit_strategy=fwk.fit_strategy(),
             **tables,
         )
         both = jax.device_get(jnp.stack([chosen, n_feas]))
@@ -796,13 +839,42 @@ class Scheduler:
             path="scan",
         )
         trace.step("Gang dispatch done")
-        counts = None  # fetched lazily — only failures read it
 
         # 3. per-pod commit: assume → reserve → permit → bind
+        self._process_results(
+            fwk,
+            state,
+            batch,
+            chosen,
+            n_feas,
+            reason_counts,
+            outcomes,
+            host_diags,
+            host_plugin_sets,
+        )
+        trace.step("Commits done")
+        trace.log_if_long()
+        return outcomes
+
+    def _process_results(
+        self,
+        fwk,
+        state,
+        batch,
+        chosen,
+        n_feas,
+        reason_counts,
+        outcomes,
+        host_diags=None,
+        host_plugin_sets=None,
+    ) -> None:
+        """The per-pod result walk shared by the direct and chained paths:
+        failures → diagnosis + PostFilter, successes → _commit (which hands
+        binding to the async workers)."""
         node_names = self.mirror.nodes.names
         n_nodes = len(self.cache.real_nodes())
+        counts = None  # fetched lazily — only failures read it
         for i, qp in enumerate(batch):
-            pod = qp.pod
             self.metrics["schedule_attempts"] += 1
             idx = int(chosen[i])
             if idx < 0:
@@ -836,9 +908,300 @@ class Scheduler:
             node_name = node_names[idx]
             outcome = self._commit(fwk, state, qp, node_name, int(n_feas[i]))
             outcomes.append(outcome)
-        trace.step("Commits done")
-        trace.log_if_long()
+
+    # ----- the chained (pipelined) dispatch path ---------------------------
+    #
+    # chain_dispatch (ops/chain.py) appends each batch's placements into the
+    # device cluster inside the dispatch itself, so batch k+1 launches
+    # against batch k's output WITHOUT waiting for k's results to reach the
+    # host — the drain becomes a software pipeline over the device link.
+    # Anything the device can't see (informer events, bind failures, fast-
+    # path or one-pod commits, vocab growth) changes the chain epoch and
+    # forces a fresh host upload.
+
+    def _chain_epoch(self, vocab):
+        return (
+            self._external_mutations,
+            self.metrics["fast_batches"],
+            self.mirror._full_packs,
+            len(vocab.label_vals),
+            len(vocab.label_keys),
+        )
+
+    def _chain_quickcheck(self, fwk, batch) -> bool:
+        """Spec-only gate: True when the batch can take the chained path
+        (no extenders/host-filter/host-score involvement, not a fast-path
+        candidate, mirror already initialized)."""
+        if self.extenders or self.mirror.nodes is None:
+            return False
+        # the device append doesn't splice node port-usage rows, so pods
+        # with host ports must take the direct path (which resyncs the
+        # snapshot from host state every batch)
+        if any(qp.pod.host_ports() for qp in batch):
+            return False
+        hf = fwk.host_filter_plugins()
+        if any(p.maybe_relevant(qp.pod) for p in hf for qp in batch):
+            return False
+        for p in fwk.host_score_plugins():
+            if fwk.score_weights.get(p.name, 0) and any(
+                p.score_relevant(qp.pod) for qp in batch
+            ):
+                return False
+        # a batch the signature fast path can commit is cheaper there
+        if (
+            not len(self.nominator)
+            and self.cache.n_term_pods == 0
+            and self.cache.n_port_pods == 0
+            and fwk.fit_strategy() == gang.DEFAULT_FIT_STRATEGY
+        ):
+            from kubernetes_tpu import fastpath as fp
+            from kubernetes_tpu.snapshot.schema import ResourceLanes
+
+            lanes = ResourceLanes(self.mirror.vocab)
+            n_lanes = self.mirror.nodes.allocatable.shape[1]
+            if all(
+                fp.signature_key(qp.pod, lanes, n_lanes) is not None
+                for qp in batch
+            ):
+                return False
+        return True
+
+    def _try_dispatch_chained(self, fwk, batch, outcomes, can_restart: bool):
+        """Dispatch the batch on the chained device cluster.  Returns a
+        pending record (dict), "handled" (nothing left to schedule),
+        "flush" (pipeline must settle before the chain can restart), or
+        None (fall back to the direct path)."""
+        from kubernetes_tpu.ops import chain as chain_ops
+
+        with self._mu:
+            vocab = self.mirror.vocab
+            for qp in batch:
+                for k, v in qp.pod.labels.items():
+                    vocab.intern_label(k, v)
+            epoch = self._chain_epoch(vocab)
+            ch = getattr(self, "_chain", None)
+            if (ch is None or ch["epoch"] != epoch) and not can_restart:
+                return "flush"
+
+            # ---- side-effect-free preparation: every bail-out below must
+            # happen BEFORE PreFilter runs (its failures mutate outcomes/
+            # queue/nominator and must not be replayed by the direct path)
+            t_pack = time.perf_counter()
+            self.mirror.update(self.cache, self.namespace_labels)
+            if bucket_cap(len(vocab.label_keys)) > self.mirror.nodes.k_cap:
+                self.mirror._force_full = True
+                self.mirror.update(self.cache, self.namespace_labels)
+            pods = [qp.pod for qp in batch]
+            self._p_cap_max = max(self._p_cap_max, bucket_cap(len(pods), 1))
+            pb = pack_pod_batch(
+                pods,
+                vocab,
+                k_cap=self.mirror.nodes.k_cap,
+                p_cap=self._p_cap_max,
+                namespace_labels=self.namespace_labels,
+            )
+            epoch = self._chain_epoch(vocab)  # interning may have grown it
+            ch = getattr(self, "_chain", None)
+            if ch is None or ch["epoch"] != epoch:
+                if not can_restart:
+                    # packing interned new vocab (epoch moved) — the
+                    # pipeline must settle before a host-state restart
+                    return "flush"
+                # (re)start: the host mirror is current (pipeline settled —
+                # can_restart) so its tensors are the ground truth
+                dc = self._dc_cache.sync(self.mirror, vocab)
+                # the chain will donate/diverge these buffers — the delta
+                # cache must not touch them again
+                self._dc_cache.invalidate()
+                ch = {
+                    "dc": dc,
+                    "e": self.mirror.e_used,
+                    "m": self.mirror.m_used,
+                    "epoch": epoch,
+                }
+            # capacity/width checks against the CHAINED cluster's own
+            # tensors — the live host mirror may have repacked to different
+            # buckets mid-chain
+            cdc = ch["dc"]
+            dc_shapes = (
+                cdc.term_table.req_key.shape[2],
+                cdc.term_table.req_vals.shape[3],
+                cdc.term_ns_ids.shape[1],
+                cdc.epod_labels.shape[1],
+            )
+            if not chain_ops.caps_compatible(dc_shapes, pb):
+                return None
+            P = pb.valid.shape[0]
+            append_terms = bool((pb.aff_kind != PAD).any())
+            AT = pb.aff_kind.shape[1] if append_terms else 0
+            E = cdc.epod_node.shape[0]
+            M = cdc.term_pod.shape[0]
+            if ch["e"] + P > E or ch["m"] + P * AT > M:
+                # cursor overflow (PAD-gap waste): a host resync compacts —
+                # settle the pipeline and retry once from host state
+                self._chain = None
+                if not can_restart:
+                    return "flush"
+                return None
+            self.prom.recorder.observe(
+                self.prom.snapshot_pack_duration, time.perf_counter() - t_pack
+            )
+
+            # ---- PreFilter (side effects OK now: the dispatch is certain)
+            state = CycleState()
+            pf_failures = fwk.run_pre_filter(state, [qp.pod for qp in batch])
+            if pf_failures:
+                live = []
+                for qp in batch:
+                    s = pf_failures.get(qp.pod.uid)
+                    if s is None:
+                        live.append(qp)
+                        continue
+                    self.metrics["schedule_attempts"] += 1
+                    outcomes.append(
+                        self._post_filter_or_fail(fwk, state, qp, s, 0)
+                    )
+                batch = live
+                if not batch:
+                    return "handled"
+                # repack without the rejected pods (their rows must not
+                # reach the device as schedulable entries)
+                pods = [qp.pod for qp in batch]
+                pb = pack_pod_batch(
+                    pods,
+                    vocab,
+                    k_cap=self.mirror.nodes.k_cap,
+                    p_cap=self._p_cap_max,
+                    namespace_labels=self.namespace_labels,
+                )
+                append_terms = bool((pb.aff_kind != PAD).any())
+                AT = pb.aff_kind.shape[1] if append_terms else 0
+
+            db = DeviceBatch.from_host(pb)
+            v_cap = bucket_cap(len(vocab.label_vals))
+            tables = self._gang_tables(pb, vocab)
+            nom_node = nom_prio = nom_req = None
+            if len(self.nominator):
+                nom_node, nom_prio, nom_req = self._nominated_arrays(
+                    {qp.pod.uid for qp in batch}
+                )
+            # any term row in the chained cluster (host rows OR device-
+            # appended ones, which ch["m"] counts past) keeps interpod on
+            has_interpod = bool((pb.aff_kind != PAD).any()) or ch["m"] > 0
+            has_spread = bool((pb.tsc_topo_key != PAD).any())
+            has_images = bool((pb.img_ids >= 0).any())
+            has_ports = bool(
+                (pb.want_ppk != PAD).any()
+                or (self.mirror.nodes.used_ppk != PAD).any()
+            )
+            enabled = fwk.device_enabled()
+            weights = tuple(
+                fwk.score_weights.get(n, 0) for n in gang.WEIGHT_ORDER
+            )
+            fit_strategy = fwk.fit_strategy()
+            t0 = time.perf_counter()
+            dc2, results, reasons = chain_ops.chain_dispatch(
+                ch["dc"],
+                db,
+                self._hostname_dev(vocab),
+                jnp.asarray(ch["e"], I32),
+                jnp.asarray(ch["m"], I32),
+                v_cap,
+                has_interpod=has_interpod,
+                has_spread=has_spread,
+                has_ports=has_ports,
+                has_images=has_images,
+                enabled=enabled,
+                weights=weights,
+                nom_node=nom_node,
+                nom_prio=nom_prio,
+                nom_req=nom_req,
+                append_terms=append_terms,
+                fit_strategy=fit_strategy,
+                **tables,
+            )
+            self._chain = {
+                "dc": dc2,
+                "e": ch["e"] + P,
+                "m": ch["m"] + P * AT,
+                "epoch": epoch,
+            }
+            self.metrics["chain_batches"] = (
+                self.metrics.get("chain_batches", 0) + 1
+            )
+            # start the host copy of the results as soon as the device
+            # finishes this batch — by harvest time it's already local
+            try:
+                results.copy_to_host_async()
+                reasons.copy_to_host_async()
+            except AttributeError:
+                pass
+            return {
+                "fwk": fwk,
+                "state": state,
+                "batch": batch,
+                "results": results,
+                "reasons": reasons,
+                "t0": t0,
+            }
+
+    def _finish_chained(self, rec) -> List[ScheduleOutcome]:
+        """Harvest one pipelined batch: fetch its results and walk the
+        commits (the host half that overlapped later dispatches)."""
+        outcomes: List[ScheduleOutcome] = []
+        both = jax.device_get(rec["results"])
+        self.prom.recorder.observe(
+            self.prom.gang_dispatch_duration,
+            time.perf_counter() - rec["t0"],
+            path="chain",
+        )
+        self._process_results(
+            rec["fwk"],
+            rec["state"],
+            rec["batch"],
+            both[0],
+            both[1],
+            rec["reasons"],
+            outcomes,
+        )
+        self._record_batch_metrics(
+            rec["fwk"].profile_name,
+            rec["batch"],
+            outcomes,
+            time.perf_counter() - rec["t0"],
+        )
         return outcomes
+
+    def _hostname_dev(self, vocab):
+        hk_id = vocab.label_keys.lookup(HOSTNAME_LABEL)
+        if getattr(self, "_hk_cached", None) != hk_id:
+            self._hostname_key_dev = jnp.asarray(hk_id, I32)
+            self._hk_cached = hk_id
+        return self._hostname_key_dev
+
+    def _gang_tables(self, pb, vocab):
+        """batch_tables' device arrays, reused across batches with the same
+        key sets + node labels (re-uploading them each batch costs transfer
+        round trips on remote device links)."""
+        import numpy as np
+
+        hk_id = vocab.label_keys.lookup(HOSTNAME_LABEL)
+        tkey = (
+            self.mirror.static_generation,
+            self.mirror._full_packs,
+            len(vocab.label_vals),
+            tuple(np.unique(pb.tsc_topo_key).tolist()),
+            tuple(np.unique(pb.aff_topo_key).tolist()),
+        )
+        if getattr(self, "_tables_key", None) != tkey:
+            self._tables = gang.batch_tables(
+                pb.tsc_topo_key,
+                pb.aff_topo_key,
+                self.mirror.nodes.label_vals,
+                hk_id,
+            )
+            self._tables_key = tkey
+        return self._tables
 
     def _static_device_cluster(self) -> DeviceCluster:
         """DeviceCluster cached across batches for STATIC reads only
@@ -1018,7 +1381,13 @@ class Scheduler:
 
         st = self.oracle_view()
         n_nodes = len(st.nodes)
-        fit = feasible_nodes(pod, st, enabled=fwk.device_enabled())
+        allowed = state.read(("pre_filter_result", pod.uid))
+        fit = feasible_nodes(
+            pod,
+            st,
+            enabled=fwk.device_enabled(),
+            allowed=frozenset(allowed) if allowed is not None else None,
+        )
         feasible = fit.feasible
         diag: Dict[str, int] = {}
         for rs in fit.reasons.values():
@@ -1063,7 +1432,15 @@ class Scheduler:
                 )
             ]
 
-        totals = prioritize(pod, st, feasible, weights=fwk.score_weights)
+        fit_inst = fwk._instances.get("NodeResourcesFit")
+        fit_scorer = (
+            (lambda pod_, ns_: fit_inst.score(state, pod_, ns_))
+            if fit_inst is not None
+            else None
+        )
+        totals = prioritize(
+            pod, st, feasible, weights=fwk.score_weights, fit_scorer=fit_scorer
+        )
         # host Score plugins contribute here too (the one-pod analogue of
         # the batched extra_score merge)
         fwk.run_pre_score(state, [pod], feasible)

@@ -1,0 +1,203 @@
+"""Chained (pipelined) dispatch must be decision-identical to the direct
+path — and to the serial oracle.
+
+chain_dispatch appends each batch's placements into the device cluster
+inside the dispatch (ops/chain.py), so consecutive batches pipeline without
+host round trips.  Decisions must match a scheduler with the chain disabled
+(which the gang tests in turn prove identical to one-pod-at-a-time).
+"""
+
+import random
+
+from kubernetes_tpu.api.resource import Resource
+from kubernetes_tpu.api.types import (
+    Affinity,
+    Container,
+    LabelSelector,
+    Node,
+    Pod,
+    PodAffinityTerm,
+    PodAntiAffinity,
+    TopologySpreadConstraint,
+)
+from kubernetes_tpu.framework import config as cfg
+from kubernetes_tpu.scheduler import Scheduler
+
+
+def _nodes(n=12, zones=3):
+    return [
+        Node(
+            name=f"n{i}",
+            labels={
+                "kubernetes.io/hostname": f"n{i}",
+                "topology.kubernetes.io/zone": f"z{i % zones}",
+            },
+            capacity=Resource.from_map({"cpu": "4", "memory": "8Gi", "pods": 20}),
+        )
+        for i in range(n)
+    ]
+
+
+def _mixed_pods(n, rng):
+    pods = []
+    for i in range(n):
+        kind = rng.randrange(3)
+        if kind == 0:
+            g = f"g{i % 5}"
+            pods.append(
+                Pod(
+                    name=f"p{i}",
+                    labels={"grp": g},
+                    affinity=Affinity(
+                        pod_anti_affinity=PodAntiAffinity(
+                            required_during_scheduling_ignored_during_execution=(
+                                PodAffinityTerm(
+                                    topology_key="kubernetes.io/hostname",
+                                    label_selector=LabelSelector(
+                                        match_labels={"grp": g}
+                                    ),
+                                ),
+                            )
+                        )
+                    ),
+                    containers=[
+                        Container(requests={"cpu": "100m", "memory": "64Mi"})
+                    ],
+                )
+            )
+        elif kind == 1:
+            app = f"a{i % 4}"
+            pods.append(
+                Pod(
+                    name=f"p{i}",
+                    labels={"app": app},
+                    topology_spread_constraints=(
+                        TopologySpreadConstraint(
+                            max_skew=2,
+                            topology_key="topology.kubernetes.io/zone",
+                            when_unsatisfiable="DoNotSchedule",
+                            label_selector=LabelSelector(
+                                match_labels={"app": app}
+                            ),
+                        ),
+                    ),
+                    containers=[
+                        Container(requests={"cpu": "100m", "memory": "64Mi"})
+                    ],
+                )
+            )
+        else:
+            # plain pods mixed in keep the batch OFF the signature fast
+            # path only when combined with the above (they alone would be)
+            pods.append(
+                Pod(
+                    name=f"p{i}",
+                    labels={"grp": f"g{i % 5}"},
+                    containers=[
+                        Container(
+                            requests={
+                                "cpu": f"{rng.choice([100, 200])}m",
+                                "memory": "64Mi",
+                            }
+                        )
+                    ],
+                )
+            )
+    return pods
+
+
+def _run(pods, batch_size=8, disable_chain=False):
+    conf = cfg.SchedulerConfiguration(batch_size=batch_size)
+    sched = Scheduler(configuration=conf)
+    bindings = {}
+    sched.binding_sink = lambda pod, node: bindings.__setitem__(pod.name, node)
+    if disable_chain:
+        sched._chain_quickcheck = lambda fwk, batch: False
+    for n in _nodes():
+        sched.on_node_add(n)
+    for p in pods:
+        sched.on_pod_add(p)
+    outs = sched.schedule_pending()
+    placements = {o.pod.name: o.node for o in outs}
+    return placements, sched
+
+
+def test_chain_matches_direct_multi_batch():
+    for seed in (5, 17):
+        rng = random.Random(seed)
+        spec = _mixed_pods(40, rng)
+        got, s_chain = _run([p for p in spec], batch_size=8)
+        rng = random.Random(seed)
+        spec2 = _mixed_pods(40, rng)
+        want, s_direct = _run([p for p in spec2], batch_size=8, disable_chain=True)
+        assert s_chain.metrics.get("chain_batches", 0) >= 2, s_chain.metrics
+        assert got == want, {
+            k: (got[k], want[k]) for k in got if got.get(k) != want.get(k)
+        }
+
+
+def test_chain_survives_bind_confirmations():
+    """FakeCluster-style confirmation events (assumed-pod adds) must not
+    break the chain (they are capacity no-ops)."""
+    rng = random.Random(3)
+    pods = _mixed_pods(24, rng)
+    conf = cfg.SchedulerConfiguration(batch_size=8)
+    sched = Scheduler(configuration=conf)
+
+    def sink(pod, node):
+        import copy
+
+        bound = copy.copy(pod)
+        bound.node_name = node
+        sched.on_pod_add(bound)  # the informer confirmation
+
+    sched.binding_sink = sink
+    for n in _nodes():
+        sched.on_node_add(n)
+    for p in pods:
+        sched.on_pod_add(p)
+    outs = sched.schedule_pending()
+    assert all(o.node for o in outs)
+    assert sched.metrics.get("chain_batches", 0) >= 2, sched.metrics
+
+
+def test_chain_breaks_on_external_event_and_recovers():
+    rng = random.Random(9)
+    conf = cfg.SchedulerConfiguration(batch_size=8)
+    sched = Scheduler(configuration=conf)
+    sched.binding_sink = lambda pod, node: None
+    for n in _nodes():
+        sched.on_node_add(n)
+    for p in _mixed_pods(16, rng):
+        sched.on_pod_add(p)
+    sched.schedule_pending()
+    # external assigned pod lands → chain must invalidate...
+    sched.on_pod_add(
+        Pod(
+            name="ext",
+            node_name="n0",
+            labels={"grp": "g0"},
+            containers=[Container(requests={"cpu": "500m"})],
+        )
+    )
+    # ...and the next drain must still schedule correctly (anti-affinity
+    # against the external pod's group on n0)
+    g0 = Pod(
+        name="after",
+        labels={"grp": "g0"},
+        affinity=Affinity(
+            pod_anti_affinity=PodAntiAffinity(
+                required_during_scheduling_ignored_during_execution=(
+                    PodAffinityTerm(
+                        topology_key="kubernetes.io/hostname",
+                        label_selector=LabelSelector(match_labels={"grp": "g0"}),
+                    ),
+                )
+            )
+        ),
+        containers=[Container(requests={"cpu": "100m"})],
+    )
+    sched.on_pod_add(g0)
+    outs = sched.schedule_pending()
+    by = {o.pod.name: o for o in outs}
+    assert by["after"].node is not None and by["after"].node != "n0"

@@ -229,7 +229,10 @@ def test_fast_committer_sees_scan_path_commits():
     )
     outs = sched.schedule_pending()
     assert outs[0].node is not None
-    assert sched.metrics["scan_batches"] >= 1
+    assert (
+        sched.metrics["scan_batches"] + sched.metrics.get("chain_batches", 0)
+        >= 1
+    )
     # drain C: plain pod (fast path again) — 600m no longer fits anywhere;
     # a stale committer would wrongly place it on the scan batch's node
     sched.on_pod_add(

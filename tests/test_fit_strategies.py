@@ -1,0 +1,127 @@
+"""NodeResourcesFit scoring strategies: MostAllocated and
+RequestedToCapacityRatio must steer placement on the batched device path
+exactly like the host oracle (noderesources/most_allocated.go,
+requested_to_capacity_ratio.go:32).
+"""
+
+import pytest
+
+from kubernetes_tpu.api.resource import Resource
+from kubernetes_tpu.api.types import Container, Node, Pod
+from kubernetes_tpu.framework import config as cfg
+from kubernetes_tpu.oracle.scores import broken_linear
+from kubernetes_tpu.scheduler import Scheduler
+
+
+def _sched(strategy: str, shape=None):
+    pc = {"scoringStrategy": {"type": strategy}}
+    if shape is not None:
+        pc["scoringStrategy"]["requestedToCapacityRatio"] = {"shape": shape}
+    profile = cfg.Profile(plugin_config={"NodeResourcesFit": pc})
+    sched = Scheduler(configuration=cfg.SchedulerConfiguration(profiles=[profile]))
+    bindings = {}
+    sched.binding_sink = lambda pod, node: bindings.__setitem__(pod.name, node)
+    return sched, bindings
+
+
+def _add_nodes(sched):
+    # n0 pre-loaded (less free), n1 empty
+    sched.on_node_add(
+        Node(
+            name="n0",
+            labels={"kubernetes.io/hostname": "n0"},
+            capacity=Resource.from_map({"cpu": "4", "memory": "8Gi"}),
+        )
+    )
+    sched.on_node_add(
+        Node(
+            name="n1",
+            labels={"kubernetes.io/hostname": "n1"},
+            capacity=Resource.from_map({"cpu": "4", "memory": "8Gi"}),
+        )
+    )
+    sched.on_pod_add(
+        Pod(
+            name="preload",
+            node_name="n0",
+            containers=[Container(requests={"cpu": "2", "memory": "4Gi"})],
+        )
+    )
+
+
+def test_most_allocated_packs():
+    """MostAllocated (bin packing) prefers the fuller node."""
+    sched, bindings = _sched("MostAllocated")
+    _add_nodes(sched)
+    sched.on_pod_add(
+        Pod(name="p", containers=[Container(requests={"cpu": "500m", "memory": "512Mi"})])
+    )
+    outs = sched.schedule_pending()
+    assert outs[0].node == "n0", outs[0]
+    assert bindings["p"] == "n0"
+
+
+def test_least_allocated_spreads():
+    sched, bindings = _sched("LeastAllocated")
+    _add_nodes(sched)
+    sched.on_pod_add(
+        Pod(name="p", containers=[Container(requests={"cpu": "500m", "memory": "512Mi"})])
+    )
+    outs = sched.schedule_pending()
+    assert outs[0].node == "n1", outs[0]
+
+
+def test_rtcr_shape_packs():
+    """An ascending shape (score grows with utilization) bin-packs."""
+    shape = [
+        {"utilization": 0, "score": 0},
+        {"utilization": 100, "score": 10},
+    ]
+    sched, bindings = _sched("RequestedToCapacityRatio", shape=shape)
+    _add_nodes(sched)
+    sched.on_pod_add(
+        Pod(name="p", containers=[Container(requests={"cpu": "500m", "memory": "512Mi"})])
+    )
+    outs = sched.schedule_pending()
+    assert outs[0].node == "n0", outs[0]
+
+
+def test_rtcr_shape_spreads():
+    """A descending shape prefers emptier nodes."""
+    shape = [
+        {"utilization": 0, "score": 10},
+        {"utilization": 100, "score": 0},
+    ]
+    sched, bindings = _sched("RequestedToCapacityRatio", shape=shape)
+    _add_nodes(sched)
+    sched.on_pod_add(
+        Pod(name="p", containers=[Container(requests={"cpu": "500m", "memory": "512Mi"})])
+    )
+    outs = sched.schedule_pending()
+    assert outs[0].node == "n1", outs[0]
+
+
+def test_broken_linear_matches_reference_semantics():
+    pts = ((0, 0), (50, 80), (100, 100))
+    assert broken_linear(pts, -5) == 0
+    assert broken_linear(pts, 0) == 0
+    assert broken_linear(pts, 25) == 40
+    assert broken_linear(pts, 50) == 80
+    assert broken_linear(pts, 75) == 90
+    assert broken_linear(pts, 100) == 100
+    assert broken_linear(pts, 150) == 100
+
+
+def test_unsupported_resource_spec_rejected():
+    profile = cfg.Profile(
+        plugin_config={
+            "NodeResourcesFit": {
+                "scoringStrategy": {
+                    "type": "MostAllocated",
+                    "resources": [{"name": "nvidia.com/gpu", "weight": 1}],
+                }
+            }
+        }
+    )
+    with pytest.raises(ValueError):
+        Scheduler(configuration=cfg.SchedulerConfiguration(profiles=[profile]))

@@ -1,0 +1,181 @@
+"""Plugin-surface parity: custom QueueSort ordering and PreFilterResult
+node-name narrowing (interface.go:837, node_affinity.go:123-173)."""
+
+from kubernetes_tpu.api.resource import Resource
+from kubernetes_tpu.api.types import (
+    Affinity,
+    Container,
+    Node,
+    NodeAffinity as NodeAffinitySpec,
+    NodeSelector,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    Pod,
+)
+from kubernetes_tpu.framework import config as cfg
+from kubernetes_tpu.framework.interface import Code, QueueSortPlugin
+from kubernetes_tpu.framework.registry import default_registry
+from kubernetes_tpu.scheduler import Scheduler
+
+
+def _nodes(n=4):
+    return [
+        Node(
+            name=f"n{i}",
+            labels={"kubernetes.io/hostname": f"n{i}"},
+            capacity=Resource.from_map({"cpu": "4", "memory": "8Gi"}),
+        )
+        for i in range(n)
+    ]
+
+
+class NameDescSort(QueueSortPlugin):
+    """Orders the activeQ by pod name DESCENDING — the opposite of any
+    priority/FIFO default, so ordering effects are unambiguous."""
+
+    name = "NameDescSort"
+
+    def less(self, a, b) -> bool:
+        return a.pod.name > b.pod.name
+
+
+def test_custom_queue_sort_orders_pops():
+    reg = default_registry()
+    reg.register("NameDescSort", lambda args, handle: NameDescSort(args, handle))
+    profile = cfg.Profile(
+        plugins=cfg.Plugins(
+            queue_sort=cfg.PluginSet(
+                enabled=[cfg.PluginRef("NameDescSort")],
+                disabled=[cfg.PluginRef("PrioritySort")],
+            )
+        )
+    )
+    conf = cfg.SchedulerConfiguration(profiles=[profile], batch_size=2)
+    sched = Scheduler(configuration=conf, registry=reg)
+    order = []
+    sched.binding_sink = lambda pod, node: order.append(pod.name)
+    for n in _nodes():
+        sched.on_node_add(n)
+    for name in ["a", "c", "b", "d"]:
+        sched.on_pod_add(
+            Pod(name=name, containers=[Container(requests={"cpu": "100m"})])
+        )
+    sched.schedule_pending()
+    assert order == ["d", "c", "b", "a"], order
+
+
+def test_mismatched_queue_sort_rejected():
+    reg = default_registry()
+    reg.register("NameDescSort", lambda args, handle: NameDescSort(args, handle))
+    p1 = cfg.Profile(scheduler_name="a")
+    p2 = cfg.Profile(
+        scheduler_name="b",
+        plugins=cfg.Plugins(
+            queue_sort=cfg.PluginSet(
+                enabled=[cfg.PluginRef("NameDescSort")],
+                disabled=[cfg.PluginRef("PrioritySort")],
+            )
+        ),
+    )
+    import pytest
+
+    with pytest.raises(ValueError):
+        Scheduler(
+            configuration=cfg.SchedulerConfiguration(profiles=[p1, p2]),
+            registry=reg,
+        )
+
+
+def _name_affinity(*names, per_term=None):
+    terms = []
+    if per_term:
+        for vals in per_term:
+            terms.append(
+                NodeSelectorTerm(
+                    match_fields=(
+                        NodeSelectorRequirement("metadata.name", "In", tuple(vals)),
+                    )
+                )
+            )
+    else:
+        terms.append(
+            NodeSelectorTerm(
+                match_fields=(
+                    NodeSelectorRequirement("metadata.name", "In", tuple(names)),
+                )
+            )
+        )
+    return Affinity(
+        node_affinity=NodeAffinitySpec(
+            required_during_scheduling_ignored_during_execution=NodeSelector(
+                tuple(terms)
+            )
+        )
+    )
+
+
+def test_node_name_narrowing_places_on_named_node():
+    sched = Scheduler()
+    bindings = {}
+    sched.binding_sink = lambda pod, node: bindings.__setitem__(pod.name, node)
+    for n in _nodes():
+        sched.on_node_add(n)
+    sched.on_pod_add(
+        Pod(
+            name="pinned",
+            affinity=_name_affinity("n2"),
+            containers=[Container(requests={"cpu": "100m"})],
+        )
+    )
+    outs = sched.schedule_pending()
+    assert outs[0].node == "n2"
+
+
+def test_conflicting_name_fields_rejected_unresolvable():
+    """Two In-requirements on metadata.name within ONE term with disjoint
+    values ⇒ empty PreFilterResult ⇒ UnschedulableAndUnresolvable before
+    Filter (node_affinity.go:166)."""
+    sched = Scheduler()
+    sched.binding_sink = lambda pod, node: None
+    for n in _nodes():
+        sched.on_node_add(n)
+    term = NodeSelectorTerm(
+        match_fields=(
+            NodeSelectorRequirement("metadata.name", "In", ("n1",)),
+            NodeSelectorRequirement("metadata.name", "In", ("n2",)),
+        )
+    )
+    aff = Affinity(
+        node_affinity=NodeAffinitySpec(
+            required_during_scheduling_ignored_during_execution=NodeSelector(
+                (term,)
+            )
+        )
+    )
+    sched.on_pod_add(
+        Pod(
+            name="conflict",
+            affinity=aff,
+            containers=[Container(requests={"cpu": "100m"})],
+        )
+    )
+    outs = sched.schedule_pending()
+    assert outs[0].node is None
+    assert outs[0].status.code == Code.UNSCHEDULABLE_AND_UNRESOLVABLE
+
+
+def test_or_terms_union_node_names():
+    sched = Scheduler()
+    bindings = {}
+    sched.binding_sink = lambda pod, node: bindings.__setitem__(pod.name, node)
+    for n in _nodes():
+        sched.on_node_add(n)
+    sched.on_pod_add(
+        Pod(
+            name="u",
+            affinity=_name_affinity(per_term=[["n1"], ["n3"]]),
+            containers=[Container(requests={"cpu": "100m"})],
+        )
+    )
+    outs = sched.schedule_pending()
+    assert outs[0].node in ("n1", "n3")
