@@ -139,13 +139,29 @@ class HTTPExtender(Extender):
         }
 
     def filter(self, pod, node_names):
-        result = self._post(
-            self.spec.filter_verb,
-            {"pod": self._pod_payload(pod), "nodenames": list(node_names)},
-        )
+        """extender.go:149-293: a nodeCacheCapable extender exchanges bare
+        node NAMES; a non-capable one exchanges full NodeList payloads and
+        answers with a NodeList."""
+        if self.spec.node_cache_capable:
+            args = {"pod": self._pod_payload(pod), "nodenames": list(node_names)}
+        else:
+            args = {
+                "pod": self._pod_payload(pod),
+                "nodes": {
+                    "items": [{"metadata": {"name": n}} for n in node_names]
+                },
+            }
+        result = self._post(self.spec.filter_verb, args)
         if result.get("error"):
             raise ExtenderError(f"extender {self.name}: {result['error']}")
-        feasible = list(result.get("nodenames") or [])
+        if self.spec.node_cache_capable:
+            feasible = list(result.get("nodenames") or [])
+        else:
+            feasible = [
+                name
+                for item in (result.get("nodes") or {}).get("items", [])
+                if (name := item.get("metadata", {}).get("name"))
+            ]
         failed = dict(result.get("failedNodes") or {})
         unresolvable = dict(result.get("failedAndUnresolvableNodes") or {})
         return feasible, failed, unresolvable

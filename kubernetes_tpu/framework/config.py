@@ -129,6 +129,17 @@ class SchedulerConfiguration:
     pod_initial_backoff_seconds: float = 1.0
     pod_max_backoff_seconds: float = 10.0
     batch_size: int = 512  # TPU extension: gang batch width
+    # Bit-compat knobs (SURVEY §7 "decision-identical tie-breaking"):
+    # full-width evaluation is the TPU-native default; these opt into the
+    # reference's sampling + randomized-tie semantics.
+    #   reference_sampling_compat: apply numFeasibleNodesToFind's adaptive
+    #     formula even when percentageOfNodesToScore is 0 (the reference
+    #     always samples; our default is full width).
+    #   tie_break_seed: seeded uniform tie-break among max-score nodes (the
+    #     deterministic analogue of selectHost's reservoir sampling); None
+    #     keeps first-max-in-node-order.
+    reference_sampling_compat: bool = False
+    tie_break_seed: Optional[int] = None
     # component-base/featuregate tier (pkg/features/kube_features.go) —
     # only the scheduler-relevant gates exist
     feature_gates: Dict[str, bool] = field(

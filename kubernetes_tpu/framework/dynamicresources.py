@@ -128,21 +128,35 @@ class DynamicResources(
         ``taken`` accumulates devices granted earlier in this pod's own
         allocation so claims don't double-book."""
         results: List[dra.DeviceRequestAllocationResult] = []
+        granted: List[Tuple[str, str, str]] = []
+
+        def fail() -> None:
+            for key in granted:  # give back this claim's partial grants
+                taken.discard(key)
+
         for req in claim.requests:
             device_class = self.handle.get_device_class(req.device_class_name)
             if device_class is None:
+                fail()
                 return None
             found: List[dra.DeviceRequestAllocationResult] = []
             want = req.count if req.allocation_mode == dra.ALLOCATION_MODE_EXACT else None
+            ok = True
             for sl in node_slices:
                 for dev in sl.devices:
                     key = (sl.driver, sl.pool, dev.name)
-                    if key in taken:
-                        continue
                     attrs = dev.attr_map()
                     if not device_class.admits(attrs):
                         continue
                     if not all(s.matches(attrs) for s in req.selectors):
+                        continue
+                    if key in taken:
+                        if want is None:
+                            # AllocationMode=All requires EVERY matching
+                            # device allocatable (structured/allocator.go:
+                            # 530-552) — one in use fails the node
+                            ok = False
+                            break
                         continue
                     found.append(
                         dra.DeviceRequestAllocationResult(
@@ -153,15 +167,15 @@ class DynamicResources(
                         )
                     )
                     taken.add(key)
+                    granted.append(key)
                     if want is not None and len(found) >= want:
                         break
-                if want is not None and len(found) >= want:
+                if not ok or (want is not None and len(found) >= want):
                     break
-            if want is not None and len(found) < want:
-                for r in found:  # give back partial grants
-                    taken.discard((r.driver, r.pool, r.device))
-                return None
-            if want is None and not found:
+            if not ok or (want is not None and len(found) < want) or (
+                want is None and not found
+            ):
+                fail()
                 return None
             results.extend(found)
         return dra.AllocationResult(results=tuple(results), node_name=node_name)

@@ -417,9 +417,17 @@ class DefaultPreemption(PostFilterPlugin, EnqueueExtensions):
         )
 
     def post_filter(self, state, pod, filtered_node_status):
-        # filtered_node_status (the per-node Diagnosis) narrows candidates
-        # when available; the evaluator re-derives them otherwise.
-        return self.evaluator.preempt(pod)
+        # The batched path pre-computes a device-narrowed candidate
+        # shortlist (ops/preemption.py via _batched_preemption_narrow);
+        # without one the evaluator derives candidates itself.
+        potential = state.read(("preemption_potential", pod.uid))
+        if potential is not None and not potential:
+            # the device mask proved no node can host the pod even after
+            # removing every lower-priority victim
+            return "", Status.unschedulable(
+                "preemption is not helpful for scheduling", plugin=self.name
+            )
+        return self.evaluator.preempt(pod, shortlist=potential)
 
     def events_to_register(self):
         # Victim deletion is what unblocks the nominated preemptor.

@@ -83,14 +83,43 @@ class Evaluator:
 
     # ----- entry point ------------------------------------------------------
 
-    def preempt(self, pod: Pod, potential_nodes: Optional[Sequence[str]] = None) -> Tuple[Optional[str], Status]:
+    def preempt(
+        self,
+        pod: Pod,
+        potential_nodes: Optional[Sequence[str]] = None,
+        shortlist: Optional[set] = None,
+    ) -> Tuple[Optional[str], Status]:
         """Returns (nominated_node_name, status).  nominated "" with an
-        unschedulable status means "clear any existing nomination"."""
+        unschedulable status means "clear any existing nomination".
+        ``shortlist`` bounds the potential-node walk (device narrow)."""
         state = self.handle.oracle_state()
 
         ok, msg = self.pod_eligible(pod, state)
         if not ok:
             return None, Status.unschedulable(msg, plugin=self.plugin_name)
+
+        # Resource-only fast fit: when the pod carries no spread/affinity/
+        # port constraints, no existing pod's required anti-affinity can
+        # match it, and no host filters apply, every _fits re-check inside
+        # the reprieve loop reduces to request arithmetic — the state-wide
+        # interpod/spread scans (the dry-run's dominant cost) are provably
+        # no-ops.  Static node filters were already verified by
+        # potential_nodes/the device narrow.
+        self._fast_fit = (
+            not pod.topology_spread_constraints
+            and not (
+                pod.affinity
+                and (pod.affinity.pod_affinity or pod.affinity.pod_anti_affinity)
+            )
+            and not pod.host_ports()
+            and not any(
+                p.affinity is not None
+                and p.affinity.pod_anti_affinity is not None
+                and p.affinity.pod_anti_affinity.required_during_scheduling_ignored_during_execution
+                for ns in state.nodes.values()
+                for p in ns.pods
+            )
+        )
 
         # Host-backed Filter plugins (volumebinding class) must judge the
         # dry-run too — otherwise preemption evicts victims on nodes the
@@ -110,7 +139,7 @@ class Evaluator:
                 self._hf_fwk, self._hf_state = fwk, cs
 
         if potential_nodes is None:
-            potential_nodes = self.potential_nodes(pod, state)
+            potential_nodes = self.potential_nodes(pod, state, shortlist)
         if not potential_nodes:
             # Preemption can't help anywhere: clear stale nomination.
             return "", Status.unschedulable(
@@ -196,12 +225,24 @@ class Evaluator:
         num = max(n * self.percentage // 100, self.min_candidates)
         return 0, min(num, n)
 
-    def potential_nodes(self, pod: Pod, state: OracleState) -> List[str]:
+    def potential_nodes(
+        self,
+        pod: Pod,
+        state: OracleState,
+        shortlist: Optional[set] = None,
+    ) -> List[str]:
         """Nodes where removing lower-priority pods COULD make the pod
         schedulable: has victims, and passes every filter no pod removal can
-        fix (NodesForStatusCode(Unschedulable), preemption.go:216-230)."""
+        fix (NodesForStatusCode(Unschedulable), preemption.go:216-230).
+
+        ``shortlist`` is the device narrow's superset-safe candidate set
+        (ops/preemption.py via the scheduler's batched dispatch); the walk
+        keeps state.nodes iteration order either way so candidate
+        truncation stays deterministic."""
         out = []
         for name, ns in state.nodes.items():
+            if shortlist is not None and name not in shortlist:
+                continue
             if not any(p.priority < pod.priority for p in ns.pods):
                 continue
             if OF.filter_node_name(pod, ns):
@@ -302,6 +343,12 @@ class Evaluator:
             for np in self.handle.nominator.pods_for_node(ns.node.name)
             if np.priority >= pod.priority and np.uid != pod.uid
         ]
+        if (
+            getattr(self, "_fast_fit", False)
+            and not nominated
+            and self._hf_fwk is None
+        ):
+            return not OF.filter_node_resources(pod, ns)
         for np in nominated:
             ns.add_pod(np)
         try:

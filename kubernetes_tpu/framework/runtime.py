@@ -76,6 +76,7 @@ class Framework:
         feature_gates=None,
     ):
         self.profile_name = profile.scheduler_name
+        self.percentage_of_nodes_to_score = profile.percentage_of_nodes_to_score
         self.handle = handle
         self._expanded = cfg.expand_profile(profile, feature_gates)
         self._instances: Dict[str, Plugin] = {}

@@ -525,8 +525,22 @@ def gang_schedule(
     d_cap: int = 8,
     extra_score=None,
     fit_strategy: tuple = DEFAULT_FIT_STRATEGY,
+    sample_k=None,
+    sample_start=None,
+    tie_key=None,
+    attempt_base=None,
 ):
     """Scan the batch in order; each pod sees all prior in-batch placements.
+
+    Bit-compat sampling mode (schedule_one.go:588-699,870-917): when
+    sample_k (traced scalar) is given, each pod's Filter result is cut to
+    the first sample_k feasible nodes in rotation order from the carried
+    start index (nextStartNodeIndex semantics — the carry advances by the
+    number of nodes "visited" per pod and is returned in the tallies dict
+    under "sample_start").  When tie_key (a jax PRNG key) is given,
+    max-score ties break by a per-attempt seeded hash instead of
+    first-index — the deterministic, device-reproducible analogue of
+    selectHost's reservoir sampling (the host oracle draws the same hash).
 
     extra_score (optional i64 [P, N]) carries host-plugin Score
     contributions, already normalized and weighted (run_host_scores) — the
@@ -561,6 +575,8 @@ def gang_schedule(
         num_pods=dc.num_pods,
         assigned=jnp.full((P,), ABSENT, I32),
     )
+    if sample_k is not None:
+        init["sample_start"] = jnp.asarray(sample_start, I32)
 
     def step(state, p):
         assigned = state["assigned"]
@@ -710,6 +726,23 @@ def gang_schedule(
         else:
             m_interpod = true_n
         feas = mask
+        if sample_k is not None:
+            # adaptive-sampling cut: keep the first sample_k feasible nodes
+            # in rotation order from the carried start index
+            nv = jnp.maximum(dc.n_valid_nodes, 1)
+            start = state["sample_start"]
+            idx = jnp.arange(N, dtype=I32)
+            rank = jnp.where(idx < nv, (idx - start) % nv, N - 1)
+            rot = jnp.zeros((N,), bool).at[rank].set(feas & (idx < nv))
+            cum = jnp.cumsum(rot.astype(I32))
+            keep_rot = rot & (cum <= sample_k)
+            feas = keep_rot[rank] & feas
+            total_feas = cum[N - 1]
+            processed = jnp.where(
+                total_feas >= sample_k,
+                jnp.sum((cum < sample_k).astype(I32)) + 1,
+                nv,
+            )
         n_feas = jnp.sum(feas.astype(I32))
 
         # ---------------- failure diagnosis ----------------
@@ -844,14 +877,22 @@ def gang_schedule(
             total_score += extra_score[p]
 
         neg = jnp.iinfo(jnp.int64).min
-        ranked = jnp.where(feas, total_score, neg)
+        if tie_key is not None:
+            # seeded uniform tie-break: lexicographic (score, hash) argmax
+            # — every max-score node equally likely, deterministic per
+            # (seed, attempt) (selectHost reservoir analogue)
+            k_p = jax.random.fold_in(tie_key, attempt_base + p)
+            h = jax.random.bits(k_p, (N,), dtype=jnp.uint32).astype(I64)
+            ranked = jnp.where(feas, total_score * (1 << 33) + h, neg)
+        else:
+            ranked = jnp.where(feas, total_score, neg)
         choice = jnp.argmax(ranked).astype(I32)
         choice = jnp.where(n_feas > 0, choice, ABSENT)
 
         # ---------------- commit ----------------
         commit = choice >= 0
         onehot_n = (jnp.arange(N, dtype=I32) == choice) & commit
-        state = dict(
+        new_state = dict(
             requested=state["requested"]
             + onehot_n[:, None].astype(I32) * db.requests[p][None, :Rn],
             nonzero=state["nonzero"]
@@ -859,7 +900,17 @@ def gang_schedule(
             num_pods=state["num_pods"] + onehot_n.astype(I32),
             assigned=state["assigned"].at[p].set(choice),
         )
-        return state, (choice, n_feas, reason_counts)
+        if sample_k is not None:
+            # nextStartNodeIndex advances by nodes visited, per attempt
+            # (schedule_one.go:625), padded batch rows included like the
+            # reference's no-op cycles would be skipped: only real pods
+            # advance the rotation
+            new_state["sample_start"] = jnp.where(
+                db.valid[p],
+                (state["sample_start"] + processed) % nv,
+                state["sample_start"],
+            ).astype(I32)
+        return new_state, (choice, n_feas, reason_counts)
 
     state, (chosen, n_feas, reason_counts) = jax.lax.scan(
         step, init, jnp.arange(P, dtype=I32)
@@ -867,11 +918,14 @@ def gang_schedule(
     # Final node tallies let the caller chain batches without a host round
     # trip: feed them back as the next DeviceCluster's requested/nonzero/
     # num_pods (the across-batch analogue of the assume cache).
-    return chosen, n_feas, reason_counts, {
+    tallies = {
         "requested": state["requested"],
         "nonzero": state["nonzero"],
         "num_pods": state["num_pods"],
     }
+    if sample_k is not None:
+        tallies["sample_start"] = state["sample_start"]
+    return chosen, n_feas, reason_counts, tallies
 
 
 @functools.partial(
@@ -911,6 +965,10 @@ def gang_run(
     d_cap: int = 8,
     extra_score=None,
     fit_strategy: tuple = DEFAULT_FIT_STRATEGY,
+    sample_k=None,
+    sample_start=None,
+    tie_key=None,
+    attempt_base=None,
 ):
     """Fused precompute + scan: ONE device dispatch per batch."""
     g = precompute(
@@ -942,6 +1000,10 @@ def gang_run(
         d_cap=d_cap,
         extra_score=extra_score,
         fit_strategy=fit_strategy,
+        sample_k=sample_k,
+        sample_start=sample_start,
+        tie_key=tie_key,
+        attempt_base=attempt_base,
     )
 
 

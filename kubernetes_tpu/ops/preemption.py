@@ -1,0 +1,87 @@
+"""Device-assisted preemption narrowing.
+
+ONE dispatch computes, for every pod that failed its scheduling attempt,
+the per-node mask of PLAUSIBLE preemption candidates — the batched front of
+DryRunPreemption (preemption.go:548).  A node survives for pod p iff:
+
+  * the victim-independent filters pass (NodesForStatusCode(Unschedulable)
+    semantics: unschedulable/name/taints/node-affinity — what no victim
+    removal can fix);
+  * the node carries at least one strictly-lower-priority victim;
+  * p FITS after removing every lower-priority pod — the dry-run's most
+    optimistic state (remove-all, default_preemption.go:140), so the mask
+    is a strict SUPERSET of true candidates: narrowing is sound.
+
+The host reprieve loop (framework/preemption.py dry_run) then runs the
+exact reference semantics (inter-pod/spread re-filtering, PDB classes,
+highest-priority-first reprieve) on the shortlisted nodes only.
+
+Victim removal totals are factored by DISTINCT preemptor priority (usually
+a handful of PriorityClasses): per group, a segment-sum over placed pods
+yields the per-node requests that remain — O(G·E) scatter work instead of
+a P×E×N contraction.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from kubernetes_tpu.ops import filters as F
+from kubernetes_tpu.ops.common import DeviceBatch, DeviceCluster, I32
+
+
+@jax.jit
+def narrow_candidates(
+    dc: DeviceCluster,
+    db: DeviceBatch,
+    victim_node,  # i32 [E]   placed-pod node index (<0 pads)
+    victim_prio,  # i32 [E]   placed-pod priority
+    victim_req,   # i32 [E,R] placed-pod request rows
+    prio_groups,  # i32 [G]   distinct preemptor priorities (pad: INT32_MIN)
+    pod_group,    # i32 [P]   index into prio_groups per batch pod
+):
+    """bool [P, N]: nodes worth dry-running per failed pod."""
+    N = dc.node_valid.shape[0]
+    Rn = dc.allocatable.shape[1]
+
+    static = (
+        dc.node_valid[None, :]
+        & db.valid[:, None]
+        & F.mask_node_name(dc, db)
+        & F.mask_unschedulable(dc, db)
+        & F.mask_taints(dc, db)
+        & F.mask_node_affinity(dc, db)
+    )  # [P, N]
+
+    valid = victim_node >= 0
+    seg = jnp.where(valid, victim_node, N)  # dump row N
+
+    def per_group(threshold):
+        lower = (victim_prio < threshold) & valid  # victims that go
+        keep = (~lower & valid).astype(I32)
+        kept_req = jax.vmap(
+            lambda col: jax.ops.segment_sum(col * keep, seg, num_segments=N + 1)
+        )(victim_req.T).T[:N]  # [N, R]
+        kept_cnt = jax.ops.segment_sum(keep, seg, num_segments=N + 1)[:N]
+        victim_here = (
+            jax.ops.segment_sum(lower.astype(I32), seg, num_segments=N + 1)[:N]
+            > 0
+        )
+        return kept_req, kept_cnt, victim_here
+
+    kept_req_g, kept_cnt_g, victim_g = jax.vmap(per_group)(prio_groups)
+
+    gid = jnp.clip(pod_group, 0, prio_groups.shape[0] - 1)
+    kept_req = kept_req_g[gid]  # [P, N, R]
+    kept_cnt = kept_cnt_g[gid]  # [P, N]
+    has_victim = victim_g[gid]  # [P, N]
+
+    req = db.requests[:, :Rn]  # [P, R]
+    fits_cnt = kept_cnt + 1 <= dc.allowed_pods[None, :]
+    avail = dc.allocatable[None, :, :] - kept_req
+    fits_res = jnp.all(req[:, None, :] <= avail, axis=2) | jnp.all(
+        req == 0, axis=1
+    )[:, None]
+
+    return static & has_victim & fits_cnt & fits_res

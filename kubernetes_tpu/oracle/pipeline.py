@@ -41,6 +41,27 @@ class FitResult:
     feasible: List[str]
     # node name → list of reasons (Diagnosis.NodeToStatusMap analogue)
     reasons: Dict[str, List[str]] = field(default_factory=dict)
+    # nodes visited before the sampling cutoff (drives nextStartNodeIndex,
+    # schedule_one.go:625)
+    processed: int = 0
+
+
+MIN_FEASIBLE_NODES_TO_FIND = 100  # schedule_one.go minFeasibleNodesToFind
+
+
+def num_feasible_nodes_to_find(percentage: int, num_all: int) -> int:
+    """numFeasibleNodesToFind (schedule_one.go:673-699): adaptive percentage
+    `50 - nodes/125` (floor 5%) when the configured percentage is 0."""
+    if num_all < MIN_FEASIBLE_NODES_TO_FIND:
+        return num_all
+    if percentage == 0:
+        percentage = 50 - num_all // 125
+        if percentage < 5:
+            percentage = 5
+    if percentage >= 100:
+        return num_all
+    num = num_all * percentage // 100
+    return max(num, MIN_FEASIBLE_NODES_TO_FIND)
 
 
 ALL_FILTERS = frozenset(
@@ -62,12 +83,19 @@ def feasible_nodes(
     state: OracleState,
     enabled: frozenset = ALL_FILTERS,
     allowed: Optional[frozenset] = None,
+    sample_k: Optional[int] = None,
+    start_index: int = 0,
 ) -> FitResult:
     """Filter plugins in the reference's iteration shape (every node, all
     reasons collected).  ``enabled`` limits evaluation to a profile's
     enabled plugin set (kernel names); ``allowed`` is the PreFilterResult
     node-name narrowing (findNodesThatFitPod evaluates only those,
-    schedule_one.go:478-486)."""
+    schedule_one.go:478-486).
+
+    ``sample_k``/``start_index`` reproduce findNodesThatPassFilters'
+    adaptive sampling (:588-669): nodes are visited in rotation order from
+    start_index and the walk stops once sample_k feasible nodes are found;
+    FitResult.processed reports how many nodes were visited."""
     spread_counts = (
         F.spread_pair_counts(pod, state) if "PodTopologySpread" in enabled else None
     )
@@ -87,7 +115,14 @@ def feasible_nodes(
     check_resources = "NodeResourcesFit" in enabled
     feasible: List[str] = []
     reasons: Dict[str, List[str]] = {}
-    for name, ns in state.nodes.items():
+    names = list(state.nodes)
+    if sample_k is not None and names:
+        start = start_index % len(names)
+        names = names[start:] + names[:start]
+    processed = 0
+    for name in names:
+        ns = state.nodes[name]
+        processed += 1
         if allowed is not None and name not in allowed:
             continue
         rs: List[str] = []
@@ -101,7 +136,9 @@ def feasible_nodes(
             reasons[name] = rs
         else:
             feasible.append(name)
-    return FitResult(feasible=feasible, reasons=reasons)
+            if sample_k is not None and len(feasible) >= sample_k:
+                break
+    return FitResult(feasible=feasible, reasons=reasons, processed=processed)
 
 
 def prioritize(

@@ -58,17 +58,57 @@ def batch_shardings(mesh: Mesh, db: DeviceBatch) -> DeviceBatch:
     return jax.tree_util.tree_map(spec_for, db)
 
 
+# DeviceCluster fields whose leading axis is the NODE axis — these shard
+# over the mesh's 'nodes' dimension.  Placed-pod ([E]), term ([M]) and vocab
+# ([V]) tensors replicate: they are the quadratic operands every node shard
+# reads in full (the all-gather-free layout; sharding THEM would turn every
+# selector evaluation into a collective).
+_NODE_MAJOR_FIELDS = frozenset(
+    {
+        "allocatable",
+        "requested",
+        "nonzero_req",
+        "num_pods",
+        "allowed_pods",
+        "node_labels",
+        "taint_key",
+        "taint_val",
+        "taint_effect",
+        "unschedulable",
+        "node_valid",
+        "used_ppk",
+        "used_ip",
+        "used_wild",
+        "img_sizes",
+    }
+)
+
+
 def cluster_shardings(mesh: Mesh, dc: DeviceCluster) -> DeviceCluster:
-    """Sharding pytree for a DeviceCluster: replicated (nodes axis of the
-    mesh shards node-major tensors when sized >1)."""
+    """Sharding pytree for a DeviceCluster: node-major tensors are
+    partitioned over the mesh's 'nodes' axis (dim 0); everything else
+    (placed pods, terms, vocab side-tables, scalars) replicates.  XLA's
+    partitioner inserts the all-gathers/reductions where full-width
+    normalize/argmax passes need them (SURVEY §2.4)."""
     n_nodes_axis = mesh.shape["nodes"]
+    from dataclasses import fields, replace
 
-    def spec_for(x):
-        if n_nodes_axis > 1 and getattr(x, "ndim", 0) >= 1:
-            return _shard(mesh, P(None))
-        return _shard(mesh, P())
-
-    return jax.tree_util.tree_map(spec_for, dc)
+    specs = {}
+    for f in fields(DeviceCluster):
+        x = getattr(dc, f.name)
+        if (
+            n_nodes_axis > 1
+            and f.name in _NODE_MAJOR_FIELDS
+            and getattr(x, "ndim", 0) >= 1
+            and x.shape[0] % n_nodes_axis == 0
+        ):
+            spec = _shard(mesh, P("nodes", *([None] * (x.ndim - 1))))
+        elif f.name == "term_table":
+            spec = jax.tree_util.tree_map(lambda _: _shard(mesh, P()), x)
+        else:
+            spec = _shard(mesh, P())
+        specs[f.name] = spec
+    return replace(dc, **specs)
 
 
 def place_batch(mesh: Mesh, db: DeviceBatch) -> DeviceBatch:

@@ -154,6 +154,7 @@ class Handle:
     def framework_for(self, pod: Pod):
         return self._s.profiles.get(pod.scheduler_name)
 
+
     def list_extenders(self):
         return list(self._s.extenders)
 
@@ -735,8 +736,10 @@ class Scheduler:
                 and not len(self.nominator)
                 and self.cache.n_term_pods == 0
                 and self.cache.n_port_pods == 0
-                # the signature committer assumes the default fit scoring
+                # the signature committer assumes the default fit scoring,
+                # full-width evaluation, and first-max tie-break
                 and fwk.fit_strategy() == gang.DEFAULT_FIT_STRATEGY
+                and not self._sampling_active(fwk)
             ):
                 t_fast = time.perf_counter()
                 fast = self._try_fast_schedule(
@@ -811,8 +814,14 @@ class Scheduler:
                 )
 
         # 2. one fused device dispatch (the whole Filter→Score→Select loop)
+        sample_k, tie_key, attempt_base = self._sampling_args(fwk)
+        sample_start = (
+            jnp.asarray(getattr(self, "_next_start_node_index", 0), I32)
+            if sample_k is not None
+            else None
+        )
         t_gang = time.perf_counter()
-        chosen, n_feas, reason_counts, _ = gang.gang_run(
+        chosen, n_feas, reason_counts, tallies = gang.gang_run(
             dc,
             db,
             hostname_key,
@@ -829,10 +838,22 @@ class Scheduler:
             nom_req=nom_req,
             extra_score=extra_score,
             fit_strategy=fwk.fit_strategy(),
+            sample_k=sample_k,
+            sample_start=sample_start,
+            tie_key=tie_key,
+            attempt_base=attempt_base,
             **tables,
         )
         both = jax.device_get(jnp.stack([chosen, n_feas]))
         chosen, n_feas = both[0], both[1]
+        if sample_k is not None:
+            self._next_start_node_index = int(
+                jax.device_get(tallies["sample_start"])
+            )
+        if tie_key is not None or sample_k is not None:
+            self._attempt_counter = (
+                getattr(self, "_attempt_counter", 0) + len(batch)
+            )
         self.prom.recorder.observe(
             self.prom.gang_dispatch_duration,
             time.perf_counter() - t_gang,
@@ -874,6 +895,12 @@ class Scheduler:
         node_names = self.mirror.nodes.names
         n_nodes = len(self.cache.real_nodes())
         counts = None  # fetched lazily — only failures read it
+        if fwk.has_post_filter():
+            failed = [
+                qp for i, qp in enumerate(batch) if int(chosen[i]) < 0
+            ]
+            if failed:
+                self._batched_preemption_narrow(fwk, state, failed)
         for i, qp in enumerate(batch):
             self.metrics["schedule_attempts"] += 1
             idx = int(chosen[i])
@@ -933,6 +960,10 @@ class Scheduler:
         (no extenders/host-filter/host-score involvement, not a fast-path
         candidate, mirror already initialized)."""
         if self.extenders or self.mirror.nodes is None:
+            return False
+        # bit-compat sampling threads a rotation cursor through every
+        # attempt — the direct path owns that state
+        if self._sampling_active(fwk):
             return False
         # the device append doesn't splice node port-usage rows, so pods
         # with host ports must take the direct path (which resyncs the
@@ -1372,6 +1403,12 @@ class Scheduler:
         pod = qp.pod
         state = CycleState()
         self.metrics["schedule_attempts"] += 1
+        # bit-compat tie-break: one hash index per pod ATTEMPT, consumed up
+        # front so early failures keep the sequence aligned with the gang
+        # path (which advances by batch length, failures included)
+        attempt = getattr(self, "_attempt_counter", 0)
+        if self.config.tie_break_seed is not None:
+            self._attempt_counter = attempt + 1
 
         pf_failures = fwk.run_pre_filter(state, [pod])
         if pf_failures:
@@ -1382,12 +1419,66 @@ class Scheduler:
         st = self.oracle_view()
         n_nodes = len(st.nodes)
         allowed = state.read(("pre_filter_result", pod.uid))
-        fit = feasible_nodes(
-            pod,
-            st,
-            enabled=fwk.device_enabled(),
-            allowed=frozenset(allowed) if allowed is not None else None,
-        )
+        sample_k = None
+        if self._sampling_active(fwk):
+            from kubernetes_tpu.oracle.pipeline import num_feasible_nodes_to_find
+
+            pct = fwk.percentage_of_nodes_to_score
+            if pct is None:
+                pct = self.config.percentage_of_nodes_to_score
+            if pct > 0 or self.config.reference_sampling_compat:
+                k = num_feasible_nodes_to_find(pct, n_nodes)
+                if k < n_nodes:
+                    sample_k = k
+        # RunFilterPluginsWithNominatedPods (runtime/framework.go:973):
+        # nominated preemptors of >= priority count as present on their
+        # nominated node during feasibility
+        added = []
+        for node, np_ in self.nominator.entries():
+            if (
+                np_.uid != pod.uid
+                and np_.priority >= pod.priority
+                and node in st.nodes
+            ):
+                st.nodes[node].add_pod(np_)
+                added.append((node, np_))
+        try:
+            fit = feasible_nodes(
+                pod,
+                st,
+                enabled=fwk.device_enabled(),
+                allowed=frozenset(allowed) if allowed is not None else None,
+                sample_k=sample_k,
+                start_index=getattr(self, "_next_start_node_index", 0),
+            )
+        finally:
+            for node, np_ in added:
+                st.nodes[node].remove_pod(np_)
+        if added and fit.feasible:
+            # the reference's SECOND pass (runtime/framework.go:973): a node
+            # that only passed BECAUSE of a nominated pod (e.g. required
+            # affinity to it) must also pass without — the nomination may
+            # never materialize there
+            nominated_nodes = {n for n, _ in added}
+            recheck = [n for n in fit.feasible if n in nominated_nodes]
+            if recheck:
+                second = feasible_nodes(
+                    pod,
+                    st,
+                    enabled=fwk.device_enabled(),
+                    allowed=frozenset(recheck),
+                )
+                ok2 = set(second.feasible)
+                dropped = [n for n in recheck if n not in ok2]
+                fit.feasible = [n for n in fit.feasible if n not in dropped]
+                for n in dropped:
+                    fit.reasons.setdefault(n, []).append(
+                        "node(s) only feasible with unbound nominated pods"
+                    )
+        if sample_k is not None:
+            self._next_start_node_index = (
+                getattr(self, "_next_start_node_index", 0) + fit.processed
+            ) % max(n_nodes, 1)
         feasible = fit.feasible
         diag: Dict[str, int] = {}
         for rs in fit.reasons.values():
@@ -1467,7 +1558,21 @@ class Scheduler:
                 if n in totals:
                     totals[n] += s * ext.weight
 
-        node = select_host(totals) if totals else feasible[0]
+        if self.config.tie_break_seed is not None and totals:
+            # same seeded-hash rule as the device pipeline (gang tie_key):
+            # lexicographic (score, hash) max over the oracle's node order
+            if getattr(self, "_tie_key", None) is None:
+                self._tie_key = jax.random.PRNGKey(self.config.tie_break_seed)
+            k_p = jax.random.fold_in(self._tie_key, attempt)
+            import numpy as np
+
+            h = np.asarray(
+                jax.random.bits(k_p, (n_nodes,), dtype=jnp.uint32)
+            )
+            idx_of = {n: i for i, n in enumerate(st.nodes)}
+            node = max(totals, key=lambda n: (totals[n], int(h[idx_of[n]])))
+        else:
+            node = select_host(totals) if totals else feasible[0]
         binder = next(
             (
                 e
@@ -1482,9 +1587,15 @@ class Scheduler:
             def binder_override(pod, node_name, _ext=binder):
                 try:
                     _ext.bind(pod, node_name)
-                    # the extender performed the API write; mirror it into
-                    # the fake/real store like DefaultBinder would
-                    self.binding_sink(pod, node_name)
+                    # The extender performed the API write itself — against
+                    # a real apiserver a second binding POST would conflict.
+                    # Only in-proc stores that opt in (the FakeCluster test
+                    # pattern, whose "API" IS the sink) get mirrored.
+                    sink_self = getattr(self.binding_sink, "__self__", None)
+                    if getattr(self.binding_sink, "mirror_extender_binds", False) or getattr(
+                        sink_self, "mirror_extender_binds", False
+                    ):
+                        self.binding_sink(pod, node_name)
                 except ExtenderError as e:
                     return Status.error(str(e))
                 return Status.success()
@@ -1552,6 +1663,42 @@ class Scheduler:
                         plugin_sets[i].add(s.plugin)
         return jnp.asarray(mask), diags, plugin_sets
 
+    def _sampling_args(self, fwk):
+        """(sample_k, tie_key, attempt_base) device args for the bit-compat
+        sampling/tie-break mode, or (None, None, None) when full-width
+        first-max (the TPU-native default) applies."""
+        from kubernetes_tpu.oracle.pipeline import num_feasible_nodes_to_find
+
+        pct = fwk.percentage_of_nodes_to_score
+        if pct is None:
+            pct = self.config.percentage_of_nodes_to_score
+        sample_k = None
+        if pct > 0 or self.config.reference_sampling_compat:
+            n_valid = len(self.cache.real_nodes())
+            k = num_feasible_nodes_to_find(pct, n_valid)
+            if k < n_valid:
+                sample_k = jnp.asarray(k, I32)
+        tie_key = None
+        if self.config.tie_break_seed is not None:
+            if getattr(self, "_tie_key", None) is None:
+                self._tie_key = jax.random.PRNGKey(self.config.tie_break_seed)
+            tie_key = self._tie_key
+        if sample_k is None and tie_key is None:
+            return None, None, None
+        return sample_k, tie_key, jnp.asarray(
+            getattr(self, "_attempt_counter", 0), I32
+        )
+
+    def _sampling_active(self, fwk) -> bool:
+        pct = fwk.percentage_of_nodes_to_score
+        if pct is None:
+            pct = self.config.percentage_of_nodes_to_score
+        return (
+            pct > 0
+            or self.config.reference_sampling_compat
+            or self.config.tie_break_seed is not None
+        )
+
     @staticmethod
     def _normalizing_score_plugins(fwk):
         """Enabled host Score plugins that OVERRIDE normalize — their
@@ -1565,6 +1712,95 @@ class Scheduler:
             if fwk.score_weights.get(p.name, 0)
             and type(p).normalize is not ScorePlugin.normalize
         ]
+
+    def _batched_preemption_narrow(self, fwk, state, failed) -> None:
+        """ONE device dispatch shortlisting preemption candidates for every
+        failed pod of a batch (ops/preemption.narrow_candidates — the
+        batched front of DryRunPreemption, preemption.go:548).  Shortlists
+        land in the CycleState under ("preemption_potential", uid);
+        DefaultPreemption passes them into the evaluator.  Best-effort: on
+        any precondition failure the evaluator's host walk runs unassisted."""
+        import numpy as np
+
+        from kubernetes_tpu.ops import preemption as ops_preemption
+        from kubernetes_tpu.snapshot.schema import ResourceLanes
+
+        with self._mu:
+            if self.mirror.nodes is None or not failed:
+                return
+            try:
+                vocab = self.mirror.vocab
+                self.mirror.update(self.cache, self.namespace_labels)
+                nt = self.mirror.nodes
+                dc = self._static_device_cluster()
+                pods = [qp.pod for qp in failed]
+                # sticky bucket: retry rounds with shrinking failure sets
+                # must not each compile a new narrow shape
+                self._p_cap_max = max(
+                    self._p_cap_max, bucket_cap(len(pods), 1)
+                )
+                pb = pack_pod_batch(
+                    pods,
+                    vocab,
+                    k_cap=nt.k_cap,
+                    p_cap=self._p_cap_max,
+                    namespace_labels=self.namespace_labels,
+                )
+                placed = self.cache.placed_pods()
+                lanes = ResourceLanes(vocab)
+                R = nt.allocatable.shape[1]
+                E = bucket_cap(max(len(placed), 1))
+                vnode = np.full(E, -1, np.int32)
+                vprio = np.zeros(E, np.int32)
+                vreq = np.zeros((E, R), np.int32)
+                for i, p in enumerate(placed):
+                    idx = nt.name_to_idx.get(p.node_name)
+                    if idx is None:
+                        continue
+                    vnode[i] = idx
+                    vprio[i] = p.priority
+                    vreq[i] = lanes.request_row(p.compute_requests(), R)
+                distinct = sorted({p.priority for p in pods})
+                G = bucket_cap(len(distinct), 1)
+                groups = np.full(G, np.iinfo(np.int32).min, np.int32)
+                groups[: len(distinct)] = distinct
+                gidx = {pr: i for i, pr in enumerate(distinct)}
+                pod_group = np.zeros(pb.valid.shape[0], np.int32)
+                for i, p in enumerate(pods):
+                    pod_group[i] = gidx[p.priority]
+                tree = {
+                    "vnode": vnode,
+                    "vprio": vprio,
+                    "vreq": vreq,
+                    "groups": groups,
+                    "pg": pod_group,
+                }
+                from kubernetes_tpu.ops import wire
+
+                t = wire.device_put_packed(tree)
+                masks = np.asarray(
+                    jax.device_get(
+                        ops_preemption.narrow_candidates(
+                            dc,
+                            DeviceBatch.from_host(pb),
+                            t["vnode"],
+                            t["vprio"],
+                            t["vreq"],
+                            t["groups"],
+                            t["pg"],
+                        )
+                    )
+                )
+                names = nt.names
+                for i, qp in enumerate(failed):
+                    short = {
+                        names[j]
+                        for j in np.nonzero(masks[i])[0]
+                        if j < len(names)
+                    }
+                    state.write(("preemption_potential", qp.pod.uid), short)
+            except Exception:  # noqa: BLE001 — narrowing is best-effort
+                return
 
     def _host_score_matrix(self, fwk, state, pods, p_cap: int):
         """[p_cap, N] i64: Σ weight·normalized host-plugin scores per

@@ -208,6 +208,11 @@ class FakeCluster:
 
     # ----- binding subresource ----------------------------------------------
 
+    # The in-proc store is where extender binds must ALSO be mirrored (a
+    # real deployment's extender writes the binding itself and the watch
+    # delivers it; see Scheduler binder_override).
+    mirror_extender_binds = True
+
     def bind(self, pod: Pod, node_name: str) -> None:
         """POST pods/{name}/binding: CAS-sets nodeName, rejects doubles."""
         stored = self.pods.get(pod.uid)

@@ -205,13 +205,28 @@ class _ExtenderHandler(BaseHTTPRequestHandler):
         length = int(self.headers["Content-Length"])
         args = json.loads(self.rfile.read(length))
         if self.path.endswith("/filter"):
-            names = [n for n in args["nodenames"] if n.endswith("-2")]
-            resp = {
-                "nodenames": names,
-                "failedNodes": {
-                    n: "wrong suffix" for n in args["nodenames"] if n not in names
-                },
-            }
+            if "nodenames" in args:  # nodeCacheCapable form
+                all_names = args["nodenames"]
+                names = [n for n in all_names if n.endswith("-2")]
+                resp = {
+                    "nodenames": names,
+                    "failedNodes": {
+                        n: "wrong suffix" for n in all_names if n not in names
+                    },
+                }
+            else:  # full NodeList form (extender.go non-cache-capable)
+                all_names = [
+                    i["metadata"]["name"] for i in args["nodes"]["items"]
+                ]
+                names = [n for n in all_names if n.endswith("-2")]
+                resp = {
+                    "nodes": {
+                        "items": [{"metadata": {"name": n}} for n in names]
+                    },
+                    "failedNodes": {
+                        n: "wrong suffix" for n in all_names if n not in names
+                    },
+                }
         elif self.path.endswith("/prioritize"):
             resp = [{"host": n, "score": 7} for n in args["nodenames"]]
         else:
@@ -237,6 +252,7 @@ def test_http_extender_round_trip():
             filter_verb="filter",
             prioritize_verb="prioritize",
             weight=2,
+            node_cache_capable=True,
         )
         api = FakeCluster()
         sched = Scheduler(
@@ -245,6 +261,32 @@ def test_http_extender_round_trip():
         api.connect(sched)
         assert len(sched.extenders) == 1
         assert isinstance(sched.extenders[0], HTTPExtender)
+        for n in ("node-1", "node-2", "node-3"):
+            api.create_node(make_node(n))
+        api.create_pod(make_pod("p1"))
+        outcomes = sched.schedule_pending()
+        assert outcomes[0].node == "node-2"
+    finally:
+        server.shutdown()
+
+
+def test_http_extender_nodelist_protocol():
+    """A non-nodeCacheCapable extender exchanges full NodeList payloads
+    (extender.go:149-293)."""
+    server = HTTPServer(("127.0.0.1", 0), _ExtenderHandler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        spec = ExtenderSpec(
+            url_prefix=f"http://127.0.0.1:{server.server_port}",
+            filter_verb="filter",
+            node_cache_capable=False,
+        )
+        api = FakeCluster()
+        sched = Scheduler(
+            configuration=SchedulerConfiguration(batch_size=8, extenders=[spec])
+        )
+        api.connect(sched)
         for n in ("node-1", "node-2", "node-3"):
             api.create_node(make_node(n))
         api.create_pod(make_pod("p1"))

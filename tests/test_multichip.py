@@ -81,6 +81,34 @@ def test_mesh_4x2_identical(problem, single_device_decisions):
     assert (n_feas == ref_feas).all()
 
 
+def test_node_axis_sharding_is_real():
+    """With a nodes axis > 1, node-major snapshot tensors must actually be
+    PARTITIONED across devices (each shard holds N/axis rows), not
+    replicated (the round-2 P(None) no-op)."""
+    from jax.sharding import PartitionSpec as P
+
+    dc, db, hostname_key, v_cap, tables = _problem()
+    mesh = make_mesh(8, pods_axis=2)  # 2×4: nodes axis = 4
+    dcs = place_cluster(mesh, dc)
+    spec = dcs.allocatable.sharding.spec
+    assert spec in (P("nodes"), P("nodes", None)), spec
+    n = dc.allocatable.shape[0]
+    shard_rows = {
+        s.data.shape[0] for s in dcs.allocatable.addressable_shards
+    }
+    assert shard_rows == {n // 4}, shard_rows
+    # placed-pod operands replicate (every node shard reads them in full)
+    assert dcs.epod_labels.sharding.spec in (P(), P(None, None)), (
+        dcs.epod_labels.sharding.spec
+    )
+    # and the sharded run still matches the single-device decisions
+    dbs = place_batch(mesh, db)
+    chosen, n_feas, _, _ = gang.gang_run(dcs, dbs, hostname_key, v_cap, **tables)
+    ref, ref_feas, _, _ = gang.gang_run(dc, db, hostname_key, v_cap, **tables)
+    assert (jax.device_get(chosen) == jax.device_get(ref)).all()
+    assert (jax.device_get(n_feas) == jax.device_get(ref_feas)).all()
+
+
 def test_dryrun_multichip_inproc():
     """The driver gate: must run green under the virtual-CPU backend."""
     import os

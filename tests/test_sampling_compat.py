@@ -1,0 +1,128 @@
+"""Bit-compat sampling/tie-break mode (schedule_one.go:588-699, 870-917).
+
+In compat mode the batched pipeline must reproduce, pod for pod, a serial
+reference-shaped loop that (a) cuts each Filter pass to
+numFeasibleNodesToFind feasible nodes in rotation order from the carried
+nextStartNodeIndex, and (b) breaks max-score ties with the shared seeded
+hash.  The default mode stays full-width first-max.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api.resource import Resource
+from kubernetes_tpu.api.types import Container, Node, Pod
+from kubernetes_tpu.framework import config as cfg
+from kubernetes_tpu.oracle.pipeline import (
+    feasible_nodes,
+    num_feasible_nodes_to_find,
+    prioritize,
+)
+from kubernetes_tpu.oracle.state import OracleState
+from kubernetes_tpu.scheduler import Scheduler
+
+N_NODES = 140  # above MIN_FEASIBLE_NODES_TO_FIND so sampling engages
+SEED = 1234
+
+
+def _nodes():
+    return [
+        Node(
+            name=f"n{i}",
+            labels={"kubernetes.io/hostname": f"n{i}"},
+            capacity=Resource.from_map({"cpu": "8", "memory": "16Gi"}),
+        )
+        for i in range(N_NODES)
+    ]
+
+
+def _pods(n):
+    return [
+        Pod(
+            name=f"p{i}",
+            containers=[Container(requests={"cpu": "100m", "memory": "64Mi"})],
+        )
+        for i in range(n)
+    ]
+
+
+def test_num_feasible_nodes_to_find_formula():
+    # reference examples: below the floor everything is visited
+    assert num_feasible_nodes_to_find(0, 50) == 50
+    # adaptive: 50 - 5000/125 = 10% of 5000 = 500
+    assert num_feasible_nodes_to_find(0, 5000) == 500
+    # floor 5%: 50 - 10000/125 = -30 → 5% of 10000 = 500
+    assert num_feasible_nodes_to_find(0, 10000) == 500
+    # min 100 nodes
+    assert num_feasible_nodes_to_find(10, 140) == 100
+    assert num_feasible_nodes_to_find(100, 140) == 140
+
+
+def _serial_reference(pods, pct):
+    """The reference semantics, one pod at a time, using the oracle and the
+    SAME seeded-hash tie rule as the device."""
+    state = OracleState.build(_nodes())
+    key = jax.random.PRNGKey(SEED)
+    start = 0
+    attempt = 0
+    out = []
+    for pod in pods:
+        k = num_feasible_nodes_to_find(pct, N_NODES)
+        fit = feasible_nodes(
+            pod, state, sample_k=k if k < N_NODES else None, start_index=start
+        )
+        start = (start + fit.processed) % N_NODES
+        totals = prioritize(pod, state, fit.feasible)
+        k_p = jax.random.fold_in(key, attempt)
+        attempt += 1
+        h = np.asarray(jax.random.bits(k_p, (N_NODES,), dtype=jnp.uint32))
+        idx_of = {n: i for i, n in enumerate(state.nodes)}
+        node = (
+            max(totals, key=lambda n: (totals[n], int(h[idx_of[n]])))
+            if totals
+            else None
+        )
+        out.append(node)
+        if node is not None:
+            pod.node_name = node
+            state.place(pod)
+    return out
+
+
+@pytest.mark.parametrize("pct", [0, 80])
+def test_batched_compat_matches_serial_reference(pct):
+    conf = cfg.SchedulerConfiguration(
+        batch_size=16,
+        percentage_of_nodes_to_score=pct,
+        reference_sampling_compat=True,
+        tie_break_seed=SEED,
+    )
+    sched = Scheduler(configuration=conf)
+    bindings = {}
+    sched.binding_sink = lambda pod, node: bindings.__setitem__(pod.name, node)
+    for n in _nodes():
+        sched.on_node_add(n)
+    pods = _pods(48)
+    for p in pods:
+        sched.on_pod_add(p)
+    outs = sched.schedule_pending()
+    got = {o.pod.name: o.node for o in outs}
+
+    want_list = _serial_reference(_pods(48), pct)
+    want = {f"p{i}": n for i, n in enumerate(want_list)}
+    assert got == want, {
+        k: (got[k], want[k]) for k in got if got.get(k) != want.get(k)
+    }
+
+
+def test_default_mode_is_full_width_first_max():
+    sched = Scheduler()
+    sched.binding_sink = lambda pod, node: None
+    for n in _nodes():
+        sched.on_node_add(n)
+    sched.on_pod_add(_pods(1)[0])
+    outs = sched.schedule_pending()
+    # identical empty nodes, no sampling/tie seed → first node wins
+    assert outs[0].node == "n0"
