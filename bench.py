@@ -243,6 +243,99 @@ def bench_spread(n_nodes, n_pods):
     return _run_workload(_basic_nodes(n_nodes, zones=8), pods)
 
 
+def bench_density_churn(n_nodes=5000, n_pods=10000, waves=10):
+    """Config 5: density replay with CHURN during scheduling
+    (SchedulingWithMixedChurn, performance-config.yaml:769, floor 265
+    pods/s): pods arrive in waves while bound pods are deleted, nodes are
+    added, and node labels change between waves — the informer event mix
+    the snapshot delta protocol must absorb without repack storms."""
+    import random as _random
+
+    from kubernetes_tpu.api.resource import Resource
+    from kubernetes_tpu.api.types import Container, Node, Pod
+    from kubernetes_tpu.scheduler import Scheduler
+
+    rng = _random.Random(11)
+    sched = Scheduler()
+    bound = {}
+    sched.binding_sink = lambda pod, node: bound.__setitem__(pod.uid, (pod, node))
+    sched.mirror.e_cap_hint = n_pods + 512
+    nodes = _basic_nodes(n_nodes)
+    for n in nodes:
+        sched.on_node_add(n)
+
+    def mk(i):
+        return Pod(
+            name=f"d-{i}",
+            labels={"app": f"app-{i % 10}"},
+            containers=[
+                Container(
+                    name="c",
+                    requests={
+                        "cpu": f"{rng.choice([100, 250, 500])}m",
+                        "memory": f"{rng.choice([128, 256, 512])}Mi",
+                    },
+                )
+            ],
+        )
+
+    # warm at final shapes
+    for i in range(600):
+        sched.on_pod_add(mk(i))
+    _drain(sched)
+
+    per_wave = (n_pods - 600) // (waves + 1)
+    next_id = 600
+    extra_nodes = 0
+    t0 = time.perf_counter()
+    base_scheduled = sched.metrics["scheduled"]
+    for w in range(-1, waves):
+        if w == 0:
+            # the warm-up wave (w == -1) compiled the churn-path shapes
+            # (node adds, chain restarts); measure from here
+            t0 = time.perf_counter()
+            base_scheduled = sched.metrics["scheduled"]
+        # churn: delete bound pods, add nodes, flip labels
+        victims = rng.sample(sorted(bound), min(50, len(bound)))
+        for uid in victims:
+            pod, node = bound.pop(uid)
+            import copy
+
+            dead = copy.copy(pod)
+            dead.node_name = node
+            sched.on_pod_delete(dead)
+        for _ in range(3):
+            extra_nodes += 1
+            sched.on_node_add(
+                Node(
+                    name=f"churn-node-{extra_nodes}",
+                    labels={
+                        "topology.kubernetes.io/zone": f"zone-{extra_nodes % 3}",
+                        "kubernetes.io/hostname": f"churn-node-{extra_nodes}",
+                    },
+                    capacity=Resource.from_map(
+                        {"cpu": "8", "memory": "32Gi", "pods": 110}
+                    ),
+                )
+            )
+        # constant label VALUE: unbounded fresh values would grow the vocab
+        # every wave and cross v_cap buckets mid-run (recompiles)
+        n0 = nodes[rng.randrange(len(nodes))]
+        upd = Node(
+            name=n0.name,
+            labels={**n0.labels, "churn": "true"},
+            capacity=n0.capacity,
+        )
+        sched.on_node_update(n0, upd)
+        for i in range(per_wave):
+            sched.on_pod_add(mk(next_id))
+            next_id += 1
+        _drain(sched)
+    dt = time.perf_counter() - t0
+    ok = sched.metrics["scheduled"] - base_scheduled
+    return ok, max(dt, 1e-9), sched
+
+
 def bench_preemption(n_nodes=500):
     """PreemptionBasic shape (performance-config.yaml:641, floor 18 pods/s):
     nodes pre-filled with low-priority victims; high-priority pods must
@@ -336,6 +429,14 @@ def main():
         okp, dtp, _ = bench_preemption(500)
         configs["preemption_500n"] = round(okp / dtp, 1)
         print(f"# preemption: {okp} pods in {dtp:.2f}s", file=sys.stderr)
+        ok5, dt5, s5 = bench_density_churn(5000, 10000)
+        configs["config5_density_churn_5000n_10000p"] = round(ok5 / dt5, 1)
+        print(
+            f"# config5 density+churn: {ok5} pods in {dt5:.2f}s "
+            f"(fast={s5.metrics['fast_batches']} chain={s5.metrics.get('chain_batches', 0)} "
+            f"scan={s5.metrics['scan_batches']})",
+            file=sys.stderr,
+        )
 
     print(
         json.dumps(

@@ -1,0 +1,5 @@
+"""``python -m kubernetes_tpu`` — the kube-scheduler binary analogue."""
+
+from kubernetes_tpu.server import main
+
+raise SystemExit(main())

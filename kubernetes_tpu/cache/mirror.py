@@ -189,8 +189,29 @@ class SnapshotMirror:
             # new label VALUES (e.g. from pending pods) outran the packed
             # parsed-int table — Gt/Lt selector eval would read stale rows
             or len(self.vocab.label_vals) > self.nodes.val_ints.shape[0]
-            or set(names) != set(self.nodes.name_to_idx)
         )
+        if not need_full:
+            known = set(self.nodes.name_to_idx)
+            current = set(names)
+            if known - current:
+                # node REMOVALS compact slots via a full repack (rare)
+                need_full = True
+            else:
+                # pure node ADDITIONS within capacity append rows in place
+                # — the common churn case must not trigger repack storms
+                for cn in real:
+                    if cn.node.name in known:
+                        continue
+                    slot = len(self.nodes.name_to_idx)
+                    if not write_node_row(
+                        self.nodes, slot, cn.node, self.vocab
+                    ):
+                        need_full = True
+                        break
+                    # static_generation intentionally NOT advanced here:
+                    # the dirty-row loop below must still see pending
+                    # updates of OTHER nodes (it advances the watermark
+                    # once at the end)
         if need_full:
             self._force_full = False
             self._full_pack(cache, namespace_labels)

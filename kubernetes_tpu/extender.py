@@ -167,10 +167,16 @@ class HTTPExtender(Extender):
         return feasible, failed, unresolvable
 
     def prioritize(self, pod, node_names):
-        result = self._post(
-            self.spec.prioritize_verb,
-            {"pod": self._pod_payload(pod), "nodenames": list(node_names)},
-        )
+        if self.spec.node_cache_capable:
+            args = {"pod": self._pod_payload(pod), "nodenames": list(node_names)}
+        else:  # same NodeList split as Filter (extender.go Prioritize)
+            args = {
+                "pod": self._pod_payload(pod),
+                "nodes": {
+                    "items": [{"metadata": {"name": n}} for n in node_names]
+                },
+            }
+        result = self._post(self.spec.prioritize_verb, args)
         out: Dict[str, int] = {}
         for entry in result or []:
             out[entry.get("host", "")] = int(entry.get("score", 0))

@@ -1068,12 +1068,34 @@ class Scheduler:
             E = cdc.epod_node.shape[0]
             M = cdc.term_pod.shape[0]
             if ch["e"] + P > E or ch["m"] + P * AT > M:
-                # cursor overflow (PAD-gap waste): a host resync compacts —
-                # settle the pipeline and retry once from host state
+                # cursor overflow: compact AND grow the host axes (the
+                # append-only host path never enlarges them on its own),
+                # then restart the chain once from the repacked state
                 self._chain = None
                 if not can_restart:
                     return "flush"
-                return None
+                self.mirror._m_cap_max = max(
+                    self.mirror._m_cap_max,
+                    bucket_cap(max((ch["m"] + P * AT) * 2, 1), 1),
+                )
+                self.mirror.e_cap_hint = max(
+                    self.mirror.e_cap_hint, ch["e"] + 2 * P
+                )
+                self.mirror._epod_slots = None  # full existing repack
+                self.mirror._existing_version = -1
+                dc = self._dc_cache.sync(self.mirror, vocab)
+                self._dc_cache.invalidate()
+                ch = {
+                    "dc": dc,
+                    "e": self.mirror.e_used,
+                    "m": self.mirror.m_used,
+                    "epoch": epoch,
+                }
+                cdc = ch["dc"]
+                E = cdc.epod_node.shape[0]
+                M = cdc.term_pod.shape[0]
+                if ch["e"] + P > E or ch["m"] + P * AT > M:
+                    return None  # genuinely beyond capacity — direct path
             self.prom.recorder.observe(
                 self.prom.snapshot_pack_duration, time.perf_counter() - t_pack
             )
@@ -1237,15 +1259,28 @@ class Scheduler:
     def _static_device_cluster(self) -> DeviceCluster:
         """DeviceCluster cached across batches for STATIC reads only
         (labels/taints/allocatable/images) — usage-only churn (generation)
-        does NOT invalidate it, so steady-state batches upload nothing."""
+        does NOT invalidate it, so steady-state batches upload nothing.
+
+        The placed-pod tensors are replaced by an EMPTY pack: every consumer
+        of this cluster (fastpath static_eval, preemption narrowing) reads
+        node-static fields only, and the placed-pod payload dominates the
+        re-upload cost under node churn."""
+        from kubernetes_tpu.snapshot.schema import pack_existing_pods
+
         key = (
             self.mirror.static_generation,
             self.mirror._full_packs,
             len(self.mirror.vocab.label_vals),
         )
         if getattr(self, "_static_dc_key", None) != key:
+            empty = pack_existing_pods(
+                [],
+                self.mirror.nodes.name_to_idx,
+                self.mirror.vocab,
+                k_cap=self.mirror.nodes.k_cap,
+            )
             self._static_dc = DeviceCluster.from_host(
-                self.mirror.nodes, self.mirror.existing, self.mirror.vocab
+                self.mirror.nodes, empty, self.mirror.vocab
             )
             self._static_dc_key = key
         return self._static_dc

@@ -1,0 +1,354 @@
+"""Server wrapper: process entrypoint, serving, leader election, debugger.
+
+The operational tier of cmd/kube-scheduler/app/server.go:163-318 rebuilt
+around the embeddable Scheduler:
+
+  * ``SchedulerServer`` — owns the scheduling loop thread, an HTTP mux
+    serving /healthz, /readyz (handler-sync gated, server.go:202-211),
+    /metrics (Prometheus text exposition) and /configz;
+  * ``LeaseElector`` — Lease-based leader election
+    (client-go/tools/leaderelection/leaderelection.go:116 semantics:
+    LeaseDuration/RenewDeadline/RetryPeriod over a CAS'd lease record);
+    only the leader runs scheduling cycles, a lost lease stops them;
+  * ``CacheDebugger`` — SIGUSR2 dump of cache + queue and a comparer
+    against the informer ground truth (backend/cache/debugger).
+"""
+
+from __future__ import annotations
+
+import json
+import signal
+import threading
+import time
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+from kubernetes_tpu.scheduler import Scheduler
+
+
+# ---------------------------------------------------------------------------
+# Leader election (Lease objects + CAS)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class LeaseRecord:
+    """coordination.k8s.io/v1 Lease spec fields the elector uses."""
+
+    holder: str = ""
+    acquire_time: float = 0.0
+    renew_time: float = 0.0
+    lease_duration_s: float = 15.0
+    resource_version: int = 0
+
+
+class LeaseStore:
+    """In-proc lease registry with optimistic-concurrency updates — the
+    resourcelock.LeaseLock analogue (a real client would CAS through the
+    apiserver; FakeCluster embeds one of these)."""
+
+    def __init__(self) -> None:
+        self._leases: Dict[str, LeaseRecord] = {}
+        self._mu = threading.Lock()
+
+    def get(self, name: str) -> Optional[LeaseRecord]:
+        with self._mu:
+            rec = self._leases.get(name)
+            return None if rec is None else LeaseRecord(**rec.__dict__)
+
+    def update(self, name: str, rec: LeaseRecord) -> bool:
+        """CAS on resource_version (GuaranteedUpdate, etcd3/store.go)."""
+        with self._mu:
+            cur = self._leases.get(name)
+            cur_rv = cur.resource_version if cur is not None else 0
+            if rec.resource_version != cur_rv:
+                return False
+            stored = LeaseRecord(**rec.__dict__)
+            stored.resource_version = cur_rv + 1
+            self._leases[name] = stored
+            return True
+
+
+class LeaseElector:
+    """leaderelection.LeaderElector: acquire → renew loop → on lost, stop.
+
+    tryAcquireOrRenew semantics: take the lease when empty, expired, or
+    already ours; renewals CAS the renew_time."""
+
+    def __init__(
+        self,
+        store: LeaseStore,
+        identity: str,
+        lease_name: str = "kube-scheduler",
+        lease_duration_s: float = 15.0,
+        retry_period_s: float = 2.0,
+        clock=time.monotonic,
+    ):
+        self.store = store
+        self.identity = identity
+        self.lease_name = lease_name
+        self.lease_duration_s = lease_duration_s
+        self.retry_period_s = retry_period_s
+        self.clock = clock
+
+    def try_acquire_or_renew(self) -> bool:
+        now = self.clock()
+        rec = self.store.get(self.lease_name)
+        if rec is None:
+            rec = LeaseRecord()
+        expired = (
+            not rec.holder
+            or now >= rec.renew_time + rec.lease_duration_s
+        )
+        if rec.holder != self.identity and not expired:
+            return False
+        if rec.holder != self.identity:
+            rec.holder = self.identity
+            rec.acquire_time = now
+        rec.renew_time = now
+        rec.lease_duration_s = self.lease_duration_s
+        return self.store.update(self.lease_name, rec)
+
+    def is_leader(self) -> bool:
+        rec = self.store.get(self.lease_name)
+        return (
+            rec is not None
+            and rec.holder == self.identity
+            and self.clock() < rec.renew_time + rec.lease_duration_s
+        )
+
+    def release(self) -> None:
+        rec = self.store.get(self.lease_name)
+        if rec is not None and rec.holder == self.identity:
+            rec.holder = ""
+            self.store.update(self.lease_name, rec)
+
+
+# ---------------------------------------------------------------------------
+# Cache debugger (backend/cache/debugger)
+# ---------------------------------------------------------------------------
+
+
+class CacheDebugger:
+    """Dump + compare on demand (SIGUSR2 in the reference,
+    debugger.go:37-59)."""
+
+    def __init__(self, scheduler: Scheduler, ground_truth=None):
+        self.sched = scheduler
+        # informer ground truth: () -> (node_names, {pod_uid: node_name});
+        # FakeCluster supplies one, a real client would list the apiserver
+        self.ground_truth = ground_truth
+
+    def dump(self) -> str:
+        with self.sched._mu:
+            lines: List[str] = ["== cache dump =="]
+            for cn in self.sched.cache.real_nodes():
+                lines.append(
+                    f"node {cn.node.name}: pods={sorted(p.name for p in cn.pods.values())} "
+                    f"requested_cpu={cn.requested.milli_cpu}m"
+                )
+            lines.append(
+                f"assumed: {sorted(self.sched.cache.assumed)}"
+            )
+            lines.append("== queue dump ==")
+            for q, n in self.sched.queue.stats().items():
+                lines.append(f"{q}: {n}")
+            return "\n".join(lines)
+
+    def compare(self) -> List[str]:
+        """Cache vs informer ground truth (comparer.go): lists what the
+        cache has that the API doesn't, and vice versa."""
+        if self.ground_truth is None:
+            return []
+        api_nodes, api_pods = self.ground_truth()
+        problems: List[str] = []
+        with self.sched._mu:
+            cache_nodes = {cn.node.name for cn in self.sched.cache.real_nodes()}
+            missing = set(api_nodes) - cache_nodes
+            extra = cache_nodes - set(api_nodes)
+            if missing:
+                problems.append(f"cache is missing nodes: {sorted(missing)}")
+            if extra:
+                problems.append(f"cache has ghost nodes: {sorted(extra)}")
+            cache_pods = {
+                uid: ps.pod.node_name
+                for uid, ps in self.sched.cache.pod_states.items()
+                if uid not in self.sched.cache.assumed
+            }
+            for uid, node in api_pods.items():
+                if uid in cache_pods and cache_pods[uid] != node:
+                    problems.append(
+                        f"pod {uid}: cache says {cache_pods[uid]}, API says {node}"
+                    )
+            for uid in set(cache_pods) - set(api_pods):
+                problems.append(f"cache has ghost pod {uid}")
+        return problems
+
+    def install_signal_handler(self) -> None:
+        signal.signal(
+            signal.SIGUSR2,
+            lambda *_: print(self.dump() + "\n" + "\n".join(self.compare())),
+        )
+
+
+# ---------------------------------------------------------------------------
+# HTTP serving + run loop
+# ---------------------------------------------------------------------------
+
+
+class SchedulerServer:
+    """The kube-scheduler process body (app/server.go Run): healthz/readyz +
+    metrics serving, leader election gate, scheduling loop."""
+
+    def __init__(
+        self,
+        scheduler: Scheduler,
+        elector: Optional[LeaseElector] = None,
+        port: int = 0,
+        poll_interval_s: float = 0.02,
+        ground_truth=None,
+    ):
+        self.sched = scheduler
+        self.elector = elector
+        self.poll_interval_s = poll_interval_s
+        self.debugger = CacheDebugger(scheduler, ground_truth)
+        self._stop = threading.Event()
+        self._synced = threading.Event()
+        self._loop_thread: Optional[threading.Thread] = None
+        self.cycles = 0
+
+        srv = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def _send(self, code: int, body: str, ctype="text/plain"):
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):  # noqa: N802 — stdlib handler name
+                if self.path == "/healthz":
+                    self._send(200, "ok")
+                elif self.path == "/readyz":
+                    # WaitForHandlersSync gate (server.go:202-211)
+                    if srv._synced.is_set():
+                        self._send(200, "ok")
+                    else:
+                        self._send(500, "informers not synced")
+                elif self.path == "/metrics":
+                    self._send(
+                        200,
+                        srv.sched.expose_metrics(),
+                        ctype="text/plain; version=0.0.4",
+                    )
+                elif self.path == "/configz":
+                    self._send(
+                        200,
+                        json.dumps(
+                            {
+                                "batchSize": srv.sched.config.batch_size,
+                                "parallelism": srv.sched.config.parallelism,
+                                "profiles": [
+                                    p.scheduler_name
+                                    for p in srv.sched.config.profiles
+                                ],
+                            }
+                        ),
+                        ctype="application/json",
+                    )
+                elif self.path == "/debug/cache":
+                    self._send(
+                        200,
+                        srv.debugger.dump()
+                        + "\n"
+                        + "\n".join(srv.debugger.compare()),
+                    )
+                else:
+                    self._send(404, "not found")
+
+            def log_message(self, *a):  # quiet
+                pass
+
+        self.http = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self.http.server_port
+        self._http_thread = threading.Thread(
+            target=self.http.serve_forever, daemon=True
+        )
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        self._http_thread.start()
+        self._synced.set()  # in-proc informers are synchronous
+        self._loop_thread = threading.Thread(target=self._run_loop, daemon=True)
+        self._loop_thread.start()
+
+    def _run_loop(self) -> None:
+        while not self._stop.is_set():
+            if self.elector is not None:
+                if not self.elector.try_acquire_or_renew():
+                    self._stop.wait(self.elector.retry_period_s)
+                    continue
+            try:
+                outs = self.sched.schedule_pending()
+                if outs:
+                    self.cycles += 1
+            except Exception:  # noqa: BLE001 — loop must survive
+                pass
+            self._stop.wait(self.poll_interval_s)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._loop_thread is not None:
+            self._loop_thread.join(timeout=5)
+        if self.elector is not None:
+            self.elector.release()
+        self.http.shutdown()
+
+    def is_leading(self) -> bool:
+        return self.elector is None or self.elector.is_leader()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """cmd/kube-scheduler entrypoint: --config file → run loop + serving."""
+    import argparse
+
+    from kubernetes_tpu.framework.config import load_config
+    from kubernetes_tpu.testing.fake_cluster import FakeCluster
+
+    ap = argparse.ArgumentParser(prog="kubernetes-tpu-scheduler")
+    ap.add_argument("--config", help="KubeSchedulerConfiguration YAML")
+    ap.add_argument("--port", type=int, default=10259)
+    ap.add_argument(
+        "--leader-elect", action="store_true", default=False
+    )
+    args = ap.parse_args(argv)
+
+    conf = load_config(args.config) if args.config else None
+    sched = Scheduler(configuration=conf)
+    # without a real client tier the process serves an in-proc cluster
+    # (the FakeCluster source) — a deployment embeds its own ClusterSource
+    api = FakeCluster()
+    api.connect(sched)
+    elector = None
+    if args.leader_elect:
+        elector = LeaseElector(api.lease_store, identity=f"pid-{id(sched)}")
+    server = SchedulerServer(
+        sched, elector=elector, port=args.port, ground_truth=api.ground_truth
+    )
+    server.debugger.install_signal_handler()
+    server.start()
+    print(f"serving on 127.0.0.1:{server.port}")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -275,7 +275,10 @@ def pack_nodes(
         num_pods=np.zeros(N, dtype=np.int32),
         allowed_pods=np.zeros(N, dtype=np.int32),
         label_vals=np.full((N, K), ABSENT, dtype=np.int32),
-        val_ints=np.asarray(vocab.val_ints(), dtype=np.int32),
+        # bucket-padded: an unbucketed table would change shape on EVERY
+        # new label value (e.g. each added node's hostname), recompiling
+        # every consumer of the cluster snapshot
+        val_ints=_padded_val_ints(vocab),
         taint_key=np.full((N, T), PAD, dtype=np.int32),
         taint_val=np.full((N, T), PAD, dtype=np.int32),
         taint_effect=np.full((N, T), PAD, dtype=np.int32),
@@ -289,6 +292,19 @@ def pack_nodes(
     for i, node in enumerate(nodes[:N]):
         write_node_row(nt, i, node, vocab)
     return nt
+
+
+def _padded_val_ints(vocab: Vocab) -> np.ndarray:
+    """label-val id → parsed int, padded to the value-vocab bucket (new
+    values within the bucket get INT_INVALID rows until the next pack —
+    the mirror's val-growth check forces that pack before Gt/Lt reads)."""
+    from kubernetes_tpu.snapshot.interner import INT_INVALID
+
+    raw = np.asarray(vocab.val_ints(), dtype=np.int32)
+    cap = bucket_cap(max(len(raw), 1))
+    out = np.full(cap, INT_INVALID, dtype=np.int32)
+    out[: len(raw)] = raw
+    return out
 
 
 def write_node_row(nt: NodeTensors, i: int, node: Node, vocab: Vocab) -> bool:
@@ -311,9 +327,15 @@ def write_node_row(nt: NodeTensors, i: int, node: Node, vocab: Vocab) -> bool:
     ):
         fits = False
     if len(vocab.label_vals) > nt.val_ints.shape[0]:
-        # new label VALUE ids outrun the packed parsed-int table — Gt/Lt
-        # selector evaluation would read stale entries
+        # new label VALUE ids outrun the packed parsed-int table's BUCKET —
+        # Gt/Lt selector evaluation would read stale entries
         fits = False
+    else:
+        # in-place refresh of parsed ints for values interned since the
+        # pack (within the bucket) — keeps incremental node adds cheap
+        ints = vocab.val_ints()
+        if len(ints) <= nt.val_ints.shape[0]:
+            nt.val_ints[: len(ints)] = ints
     T = nt.taint_key.shape[1]
     if len(node.taints) > T:
         fits = False

@@ -81,6 +81,22 @@ class FakeCluster:
         self.device_classes = _ObjectStore(self)
         self._pv_controller = pv_controller
         self.provisioned: List[str] = []  # PV names the fake provisioner made
+        # coordination.k8s.io Lease objects (leader election, server.py)
+        from kubernetes_tpu.server import LeaseStore
+
+        self.lease_store = LeaseStore()
+
+    def ground_truth(self):
+        """(node_names, {pod_uid: node_name}) — the informer view the cache
+        debugger compares against (backend/cache/debugger/comparer.go)."""
+        return (
+            list(self.nodes),
+            {
+                uid: p.node_name
+                for uid, p in self.pods.items()
+                if p.node_name
+            },
+        )
 
     def _next_rv(self) -> int:
         self._rv += 1
