@@ -71,11 +71,18 @@ def run_serial(state, pending):
     return out
 
 
-@pytest.mark.parametrize("seed", [31, 32, 33, 34])
-def test_gang_matches_serial_oracle(seed):
+@pytest.mark.parametrize(
+    "seed,n_nodes,n_placed,n_pending",
+    # small tier + the wider randomized sweep (VERDICT r2 task 6 — the
+    # breadth tier of schedule_one_test.go)
+    [(31, 10, 20, 20), (32, 10, 20, 20), (33, 10, 20, 20), (34, 10, 20, 20),
+     (101, 40, 80, 120), (202, 40, 80, 120), (303, 40, 80, 120),
+     (404, 40, 80, 120), (505, 40, 80, 120), (606, 40, 80, 120)],
+)
+def test_gang_matches_serial_oracle(seed, n_nodes, n_placed, n_pending):
     rng = random.Random(seed)
-    nodes, placed = make_cluster(rng, 10, 20)
-    pending = [make_pod(rng, f"pend-{i}") for i in range(20)]
+    nodes, placed = make_cluster(rng, n_nodes, n_placed)
+    pending = [make_pod(rng, f"pend-{i}") for i in range(n_pending)]
 
     state_g = OracleState.build(nodes, placed, namespace_labels=NS_LABELS)
     got = run_gang(state_g, pending)
@@ -114,3 +121,46 @@ def test_gang_resource_competition():
     assert got == want
     # 4×1.5cpu onto 4+2 cpu: two on big, one on small, one unschedulable
     assert got.count("big") == 2 and got.count("small") == 1 and got.count(None) == 1
+
+
+def test_scheduler_drain_matches_serial_across_batches():
+    """END-TO-END parity: a multi-batch pipelined drain (chain path, bucket
+    growth mid-drain) lands every pod exactly where one-pod-at-a-time serial
+    scheduling would."""
+    from kubernetes_tpu.framework import config as cfg
+    from kubernetes_tpu.scheduler import Scheduler
+
+    rng = random.Random(77)
+    nodes, placed = make_cluster(rng, 30, 40)
+    pending = [make_pod(rng, f"dr-{i}") for i in range(90)]
+    # equal priorities: the queue pops PrioritySort order (priority desc,
+    # then arrival), and preemption must stay out of a pure-placement
+    # parity check — with priority 0 queue order == list order
+    for p in pending:
+        p.priority = 0
+
+    conf = cfg.SchedulerConfiguration(batch_size=16)
+    sched = Scheduler(configuration=conf, namespace_labels=NS_LABELS)
+    bindings = {}
+    sched.binding_sink = lambda pod, node: bindings.__setitem__(pod.name, node)
+    for n in nodes:
+        sched.on_node_add(n)
+    for p in placed:
+        sched.on_pod_add(p)
+    import copy
+
+    for p in pending:
+        sched.on_pod_add(copy.deepcopy(p))
+    outs = sched.schedule_pending()
+    got = {o.pod.name: o.node for o in outs}
+    # the async binding path must have landed exactly the recorded outcomes
+    assert bindings == {k: v for k, v in got.items() if v is not None}
+
+    state_s = OracleState.build(nodes, placed, namespace_labels=NS_LABELS)
+    want_list = run_serial(state_s, [copy.deepcopy(p) for p in pending])
+    want = {p.name: n for p, n in zip(pending, want_list)}
+    assert got == want, {
+        k: (got.get(k), want.get(k))
+        for k in set(got) | set(want)
+        if got.get(k) != want.get(k)
+    }
