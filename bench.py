@@ -56,8 +56,10 @@ def _run_workload(nodes, pods, warm=None):
     sched, _ = _mk_sched()
     # capacity planning: pre-size the placed-pod axes so the device
     # pipeline compiles once (the e_cap_hint mechanism schedule_pending
-    # uses; here the full workload size is known up front)
-    sched.mirror.e_cap_hint = len(pods) + 64
+    # uses; here the full workload size is known up front).  Must DOMINATE
+    # schedule_pending's own pods+queue+batch_size estimate or the bucket
+    # grows between the warm and timed drains (a mid-measurement recompile).
+    sched.mirror.e_cap_hint = len(pods) + sched.config.batch_size + 128
     for n in nodes:
         sched.on_node_add(n)
     if warm is None:
@@ -259,7 +261,7 @@ def bench_density_churn(n_nodes=5000, n_pods=10000, waves=10):
     sched = Scheduler()
     bound = {}
     sched.binding_sink = lambda pod, node: bound.__setitem__(pod.uid, (pod, node))
-    sched.mirror.e_cap_hint = n_pods + 512
+    sched.mirror.e_cap_hint = n_pods + sched.config.batch_size + 128
     nodes = _basic_nodes(n_nodes)
     for n in nodes:
         sched.on_node_add(n)
