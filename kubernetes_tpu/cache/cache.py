@@ -146,7 +146,10 @@ class Cache:
         to the node it just failed on."""
         if pod.uid in self.pod_states:
             raise CacheError(f"pod {pod.key} already assumed/added")
-        assumed = copy.copy(pod)
+        # shallow copy without __reduce_ex__ dispatch (copy.copy costs ~5×
+        # on dataclasses; this runs once per scheduled pod)
+        assumed = object.__new__(type(pod))
+        assumed.__dict__.update(pod.__dict__)
         assumed.node_name = node_name
         cn = self.nodes.setdefault(node_name, CachedNode(node=None))
         cn.add_pod(assumed)

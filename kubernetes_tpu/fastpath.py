@@ -51,7 +51,7 @@ def signature_key(pod: Pod, lanes: ResourceLanes, n_lanes: int):
     if pod.host_ports() or pod.nominated_node_name:
         return None
     req = pod.compute_requests()
-    row = tuple(int(x) for x in lanes.request_row(req, n_lanes))
+    row = tuple(lanes.request_row(req, n_lanes).tolist())
     nz = req.non_zero_defaulted()
     node_aff = pod.affinity.node_affinity if pod.affinity is not None else None
     return (

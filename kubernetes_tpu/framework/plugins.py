@@ -61,6 +61,12 @@ class PrioritySort(QueueSortPlugin):
             return pa > pb
         return a.timestamp < b.timestamp
 
+    def sort_key(self, qp):
+        """Optional QueueSort protocol: a totally-ordered tuple consistent
+        with less() — lets the activeQ heap compare at C speed instead of
+        going through a Python comparator per sift step."""
+        return (-qp.pod.priority, qp.timestamp)
+
 
 class SchedulingGates(PreEnqueuePlugin, EnqueueExtensions):
     """schedulinggates/scheduling_gates.go:48 — gated pods never enqueue."""

