@@ -529,6 +529,7 @@ def gang_schedule(
     sample_start=None,
     tie_key=None,
     attempt_base=None,
+    wave_slots=None,
 ):
     """Scan the batch in order; each pod sees all prior in-batch placements.
 
@@ -553,6 +554,22 @@ def gang_schedule(
     (RunFilterPluginsWithNominatedPods, runtime/framework.go:973: nominated
     pods with priority >= the evaluated pod count as present).
 
+    wave_slots (optional i32 [W, S], -1 pads) activates WAVE COMMIT
+    (SURVEY §7 "intra-batch conflicts"): each row lists consecutive batch
+    pods whose spread/inter-pod/port constraint domains provably cannot
+    interact (the host builder's conservative spec check).  The heavy
+    state-dependent tensors (the [C,N,J]/[AT,N,J] contractions against
+    already-placed peers) are then refreshed ONCE per wave — vectorized
+    over the wave's pods via vmap of the same `heavy_parts` the per-pod
+    scan uses — while the cheap state-dependent pieces (resource fit,
+    scores, normalization over the live feasible set, argmax, commit)
+    still run strictly in batch order in an inner scan.  Decisions are
+    sequential-identical by construction: within a wave the frozen
+    tensors equal what a per-pod recompute would produce (no peer in the
+    wave can change them), and everything that CAN change mid-wave is
+    recomputed per pod.  Requires sample_k/tie_key None and no port
+    conflicts inside a wave (the builder guarantees it).
+
     Returns (chosen [P] i32 node index or -1, n_feasible [P] i32).
     """
     P, N = g.static_mask.shape
@@ -561,6 +578,8 @@ def gang_schedule(
     C = g.sp_dv.shape[1]
     AT = g.ip_dv.shape[1]
     Kd2 = g.ip_key_cols.shape[0]
+    if wave_slots is not None and (sample_k is not None or tie_key is not None):
+        raise ValueError("wave mode is incompatible with sampling/tie-break")
 
     # Nominated-pod node charge matrix, built once outside the scan: per-step
     # work is a tiny [G]·[G,N] contraction instead of a segment scatter.
@@ -578,57 +597,38 @@ def gang_schedule(
     if sample_k is not None:
         init["sample_start"] = jnp.asarray(sample_start, I32)
 
-    def step(state, p):
-        assigned = state["assigned"]
+    true_n = jnp.ones((N,), bool)
+
+    def peer_view(assigned):
+        """Shared per-state tensors describing already-placed batch peers."""
         assigned_valid = assigned >= 0  # [J]
         a_clip = jnp.clip(assigned, 0, N - 1)
-        av = assigned_valid[None, :]
         # [J, N] node-identity of each assigned batch peer — shared by the
         # port-conflict check and the hostname-topology spread counts.
         eqJ = (a_clip[:, None] == jnp.arange(N, dtype=I32)[None, :]) & (
             assigned_valid[:, None]
         )
+        return assigned_valid, eqJ
 
-        # ---------------- dynamic filters ----------------
-        req = db.requests[p]  # [Rp]
-        mask = g.static_mask[p]
-        true_n = jnp.ones((N,), bool)
-        m_fit = true_n
-        if check_fit:
-            nom_cnt = 0
-            nom_delta = 0
-            if nom_node is not None:
-                gate = (nom_prio >= db.priority[p]).astype(I32)  # [G]
-                nom_cnt = jnp.einsum("g,gn->n", gate, nom_oh)
-                nom_delta = jnp.einsum(
-                    "gr,gn->nr", nom_req * gate[:, None], nom_oh
-                )  # [N, Rn]
-            fits = state["num_pods"] + nom_cnt + 1 <= dc.allowed_pods
-            all_zero = jnp.all(req == 0)
-            avail = dc.allocatable - state["requested"] - nom_delta  # [N, Rn]
-            if Rp > Rn:
-                avail = jnp.concatenate(
-                    [avail, jnp.zeros((N, Rp - Rn), I32)], axis=1
-                )
-            conflict = req[None, :] > avail  # [N, Rp]
-            # extended-resource lanes only count when actually requested
-            scalar_lane = jnp.arange(Rp) >= N_FIXED_LANES
-            conflict = conflict & (~scalar_lane | (req > 0))[None, :]
-            lane_ok = ~jnp.any(conflict, axis=1)
-            m_fit = fits & (all_zero | lane_ok)
-            mask = mask & m_fit
-
+    def heavy_parts(p, assigned_valid, eqJ):
+        """State-dependent tensors whose value cannot change while no
+        INTERACTING peer commits: spread/inter-pod masks, count rows, and
+        port conflicts.  The per-pod scan calls this every step; the wave
+        path calls it once per wave (vmapped over the wave's pods)."""
+        av = assigned_valid[None, :]
         m_portb = true_n
         if g.port_b.shape[1]:
             port_conf = jnp.any(g.port_b[p][:, None] & eqJ, axis=0)
             m_portb = ~port_conf
-            mask = mask & m_portb
 
-        # ---------------- spread (hard) ----------------
         if C:
             dv = g.sp_dv[p]  # [C, N]
-            dv_at = jnp.take_along_axis(dv, a_clip[None, :], axis=1)  # [C, J]
-            te_at = jnp.take_along_axis(g.sp_te[p], a_clip[None, :], axis=1)
+            # value-at-assigned-node via one-hot matmul instead of a gather
+            # (TPU gathers serialize; einsum rides the MXU).  Invalid peers
+            # produce 0 rows — every consumer is gated on av/bm.
+            eqJ_i = eqJ.astype(I32)
+            dv_at = jnp.einsum("cn,jn->cj", dv, eqJ_i)  # [C, J]
+            te_at = jnp.einsum("cn,jn->cj", g.sp_te[p].astype(I32), eqJ_i) > 0
             bm = g.sp_bmatch[p] & av  # [C, J]
             # Same-domain indicator of each node vs each assigned peer's
             # node, as a fused dense compare (dv space): [C, N, J].
@@ -656,14 +656,29 @@ def gang_schedule(
                 ~g.sp_dom_pres[p] | (skew <= db.tsc_max_skew[p][:, None])
             )
             m_spread = jnp.all(~g.sp_hard[p][:, None] | c_ok, axis=0)
-            mask = mask & m_spread
+            # score-side counts (wave-frozen too): _spread_cnt
+            dyn_host = jnp.einsum("cj,jn->cn", bm.astype(I32), eqJ_i)
+            cg_at = (
+                jnp.einsum(
+                    "cn,jn->cj", g.sp_counting[p].astype(I32), eqJ_i
+                )
+                > 0
+            )
+            dyn_dom = jnp.sum(
+                (eq_dom & (bm & cg_at)[:, None, :]).astype(I32), axis=2
+            )
+            sp_cnt = jnp.where(
+                g.sp_is_host[p][:, None],
+                g.sp_node_cnt[p] + dyn_host,
+                g.sp_sc_dom[p] + dyn_dom,
+            )  # [C, N]
         else:
             m_spread = true_n
+            sp_cnt = jnp.zeros((C, N), I32)
 
-        # ---------------- inter-pod (hard) ----------------
         if AT:
             ip_dv = g.ip_dv[p]  # [AT, N]
-            ip_dv_at = jnp.take_along_axis(ip_dv, a_clip[None, :], axis=1)
+            ip_dv_at = jnp.einsum("tn,jn->tj", ip_dv, eqJ.astype(I32))
             ip_eq = (
                 (ip_dv[:, :, None] >= 0)
                 & (ip_dv_at[:, None, :] >= 0)
@@ -694,12 +709,15 @@ def gang_schedule(
             # full [P, AT, N] domain tensor each step.  dv_ju[j, u] = the
             # topology value at j's assigned node for j's term u.
             m_jp = g.ip_bmatch[:, :, p] & assigned_valid[:, None]  # [J, AT]
-            cols_at_a = jnp.take_along_axis(
-                g.ip_key_cols, a_clip[None, :], axis=1
+            cols_at_a = jnp.einsum(
+                "kn,jn->kj", g.ip_key_cols, eqJ.astype(I32)
             )  # [Kd2, J]
             ki = g.ip_key_idx  # [J, AT]
             ki_clip = jnp.clip(ki, 0, Kd2 - 1)
-            dv_ju = jnp.take_along_axis(cols_at_a.T, ki_clip, axis=1)  # [J, AT]
+            ki_oh = (
+                ki_clip[:, :, None] == jnp.arange(Kd2, dtype=I32)[None, None, :]
+            ).astype(I32)  # [J, AT, Kd2]
+            dv_ju = jnp.einsum("jk,juk->ju", cols_at_a.T, ki_oh)  # [J, AT]
             term_live = m_jp & (ki >= 0) & (dv_ju >= 0)
             g_anti = (term_live & g.ip_is_anti).reshape(-1)  # [J·AT]
             w_sym = jnp.where(term_live, g.ip_sym_w, 0).astype(I32).reshape(-1)
@@ -722,9 +740,64 @@ def gang_schedule(
                     eqk.astype(I32),
                 )
             m_interpod = ~g.ip_viol_existing[p] & ~viol2 & ok3 & ~viol_b
-            mask = mask & m_interpod
+            pref = jnp.sum(
+                jnp.where(
+                    topo_present,
+                    ip_total.astype(I64) * g.ip_pref_w[p][:, None],
+                    0,
+                ),
+                axis=0,
+            )
+            ip_raw = g.ip_sym[p] + pref + sym_b.astype(I64)
         else:
             m_interpod = true_n
+            ip_raw = g.ip_sym[p]
+        return dict(
+            m_portb=m_portb,
+            m_spread=m_spread,
+            sp_cnt=sp_cnt,
+            m_interpod=m_interpod,
+            ip_raw=ip_raw,
+        )
+
+    def step(state, p):
+        assigned_valid, eqJ = peer_view(state["assigned"])
+        hv = heavy_parts(p, assigned_valid, eqJ)
+        return cheap_body(state, p, hv, jnp.asarray(True))
+
+    def cheap_body(state, p, hv, active):
+        # ---------------- dynamic filters ----------------
+        req = db.requests[p]  # [Rp]
+        mask = g.static_mask[p] & hv["m_portb"]
+        m_fit = true_n
+        if check_fit:
+            nom_cnt = 0
+            nom_delta = 0
+            if nom_node is not None:
+                gate = (nom_prio >= db.priority[p]).astype(I32)  # [G]
+                nom_cnt = jnp.einsum("g,gn->n", gate, nom_oh)
+                nom_delta = jnp.einsum(
+                    "gr,gn->nr", nom_req * gate[:, None], nom_oh
+                )  # [N, Rn]
+            fits = state["num_pods"] + nom_cnt + 1 <= dc.allowed_pods
+            all_zero = jnp.all(req == 0)
+            avail = dc.allocatable - state["requested"] - nom_delta  # [N, Rn]
+            if Rp > Rn:
+                avail = jnp.concatenate(
+                    [avail, jnp.zeros((N, Rp - Rn), I32)], axis=1
+                )
+            conflict = req[None, :] > avail  # [N, Rp]
+            # extended-resource lanes only count when actually requested
+            scalar_lane = jnp.arange(Rp) >= N_FIXED_LANES
+            conflict = conflict & (~scalar_lane | (req > 0))[None, :]
+            lane_ok = ~jnp.any(conflict, axis=1)
+            m_fit = fits & (all_zero | lane_ok)
+            mask = mask & m_fit
+
+        m_portb = hv["m_portb"]
+        m_spread = hv["m_spread"]
+        m_interpod = hv["m_interpod"]
+        mask = mask & m_spread & m_interpod
         feas = mask
         if sample_k is not None:
             # adaptive-sampling cut: keep the first sample_k feasible nodes
@@ -832,24 +905,16 @@ def gang_schedule(
         )
 
         # InterPodAffinity: static symmetric + incoming preferred (with batch
-        # contributions) + symmetric from batch-assigned pods' terms.
-        if AT:
-            pref = jnp.sum(
-                jnp.where(
-                    topo_present,
-                    ip_total.astype(I64) * g.ip_pref_w[p][:, None],
-                    0,
-                ),
-                axis=0,
-            )
-            ip_raw = g.ip_sym[p] + pref + sym_b.astype(I64)
-        else:
-            ip_raw = g.ip_sym[p]
+        # contributions) + symmetric from batch-assigned pods' terms —
+        # wave-frozen in hv (see heavy_parts).
+        ip_raw = hv["ip_raw"]
 
-        # PodTopologySpread score
+        # PodTopologySpread score: the count rows are wave-frozen; the
+        # log-weight normalization depends on the LIVE feasible set, so it
+        # runs here per pod.
         if C:
-            sp_raw, sp_valid = _spread_score(
-                dc, db, g, p, feas, dv, dv_at, bm, eqJ, a_clip, d_cap
+            sp_raw, sp_valid = _spread_raw(
+                dc, db, g, p, feas, hv["sp_cnt"], d_cap
             )
         else:
             sp_raw = jnp.zeros((N,), I64)
@@ -887,7 +952,8 @@ def gang_schedule(
         else:
             ranked = jnp.where(feas, total_score, neg)
         choice = jnp.argmax(ranked).astype(I32)
-        choice = jnp.where(n_feas > 0, choice, ABSENT)
+        choice = jnp.where((n_feas > 0) & active, choice, ABSENT)
+        n_feas = jnp.where(active, n_feas, 0)
 
         # ---------------- commit ----------------
         commit = choice >= 0
@@ -898,7 +964,10 @@ def gang_schedule(
             nonzero=state["nonzero"]
             + onehot_n[:, None].astype(I32) * db.nonzero_req[p][None, :],
             num_pods=state["num_pods"] + onehot_n.astype(I32),
-            assigned=state["assigned"].at[p].set(choice),
+            # inactive (wave-pad) slots must not clobber row p's assignment
+            assigned=state["assigned"]
+            .at[p]
+            .set(jnp.where(active, choice, state["assigned"][p])),
         )
         if sample_k is not None:
             # nextStartNodeIndex advances by nodes visited, per attempt
@@ -912,9 +981,52 @@ def gang_schedule(
             ).astype(I32)
         return new_state, (choice, n_feas, reason_counts)
 
-    state, (chosen, n_feas, reason_counts) = jax.lax.scan(
-        step, init, jnp.arange(P, dtype=I32)
-    )
+    if wave_slots is None:
+        state, (chosen, n_feas, reason_counts) = jax.lax.scan(
+            step, init, jnp.arange(P, dtype=I32)
+        )
+    else:
+        W, S = wave_slots.shape
+
+        def wave_step(state, slots):
+            # one heavy refresh for the whole wave, vectorized over slots
+            assigned_valid, eqJ = peer_view(state["assigned"])
+            pc = jnp.clip(slots, 0, P - 1)
+            hv_w = jax.vmap(
+                lambda p: heavy_parts(p, assigned_valid, eqJ)
+            )(pc)
+
+            def slot_step(st, s):
+                p = pc[s]
+                hv = jax.tree_util.tree_map(lambda a: a[s], hv_w)
+                active = (slots[s] >= 0) & db.valid[p]
+                return cheap_body(st, p, hv, active)
+
+            st, outs = jax.lax.scan(
+                slot_step, state, jnp.arange(S, dtype=I32)
+            )
+            return st, outs
+
+        state, (ch_w, nf_w, rc_w) = jax.lax.scan(wave_step, init, wave_slots)
+        # scatter [W, S] slot outputs back to batch order; pads → dump row
+        flat = wave_slots.reshape(-1)
+        idx = jnp.where(flat >= 0, flat, P)
+        chosen = (
+            jnp.full((P + 1,), ABSENT, I32)
+            .at[idx]
+            .set(ch_w.reshape(-1).astype(I32))[:P]
+        )
+        n_feas = (
+            jnp.zeros((P + 1,), I32)
+            .at[idx]
+            .set(nf_w.reshape(-1).astype(I32))[:P]
+        )
+        n_diag = rc_w.shape[-1]
+        reason_counts = (
+            jnp.zeros((P + 1, n_diag), I32)
+            .at[idx]
+            .set(rc_w.reshape(-1, n_diag).astype(I32))[:P]
+        )
     # Final node tallies let the caller chain batches without a host round
     # trip: feed them back as the next DeviceCluster's requested/nonzero/
     # num_pods (the across-batch analogue of the assume cache).
@@ -969,6 +1081,7 @@ def gang_run(
     sample_start=None,
     tie_key=None,
     attempt_base=None,
+    wave_slots=None,
 ):
     """Fused precompute + scan: ONE device dispatch per batch."""
     g = precompute(
@@ -1004,12 +1117,17 @@ def gang_run(
         sample_start=sample_start,
         tie_key=tie_key,
         attempt_base=attempt_base,
+        wave_slots=wave_slots,
     )
 
 
-def _spread_score(dc, db, g, p, feas, dv, dv_at, bm, eqJ, a_clip, d_cap):
-    """ScheduleAnyway scoring for one pod given current batch placements
-    (podtopologyspread/scoring.go, fixed-point log weights).
+def _spread_raw(dc, db, g, p, feas, cnt, d_cap):
+    """ScheduleAnyway scoring for one pod (podtopologyspread/scoring.go,
+    fixed-point log weights), given the per-constraint count rows ``cnt``
+    [C, N] (static existing-pod counts + batch contributions — computed in
+    heavy_parts; hostname constraints count per assigned node directly, the
+    ungated path, domain constraints are gated by the score-counting mask
+    at the assigned node).
 
     The per-domain machinery of the original formulation is replaced by
     dense equivalents:
@@ -1019,14 +1137,12 @@ def _spread_score(dc, db, g, p, feas, dv, dv_at, bm, eqJ, a_clip, d_cap):
         where(pair_pres, ., 0) gate was a no-op at every consumed node;
       * the count of domains containing counted nodes uses the host-built
         compact domain ids (g.sp_cdv, batch_tables()) as a [C, N, d_cap]
-        compare+reduce;
-      * hostname-topology counts use the [J, N] assigned-node identity
-        (eqJ) as an i32 matmul, non-host domain counts reuse the filter's
-        [C, N, J] same-domain compare gated by the score-counting mask.
+        compare+reduce.
+    This half stays per pod in the scan: ``counted`` (and so the
+    topologyNormalizingWeight) depends on the LIVE feasible set.
     """
     soft = g.sp_soft[p]  # [C]
     has_soft = jnp.any(soft)
-    C, N = dv.shape
 
     ignored = feas & ~g.sp_all_keys[p]
     counted = feas & g.sp_all_keys[p]  # filtered, non-ignored
@@ -1039,26 +1155,6 @@ def _spread_score(dc, db, g, p, feas, dv, dv_at, bm, eqJ, a_clip, d_cap):
     n_dom = jnp.sum(jnp.any(dom_hit, axis=1).astype(I32), axis=1)  # [C]
     size = jnp.where(g.sp_is_host[p], n_counted, n_dom)  # [C]
     w_fx = dc.log_tab[jnp.clip(size, 0, dc.log_tab.shape[0] - 1)]  # [C] i64
-
-    # batch contributions: hostname constraints count per assigned node
-    # directly (ungated), domain constraints are gated by the score-counting
-    # mask at the assigned node (scoring.go: only counted nodes contribute).
-    dyn_host = jnp.einsum(
-        "cj,jn->cn", bm.astype(I32), eqJ.astype(I32)
-    )  # [C, N]
-    cg_at = jnp.take_along_axis(g.sp_counting[p], a_clip[None, :], axis=1)
-    eq_dom = (
-        (dv[:, :, None] >= 0)
-        & (dv_at[:, None, :] >= 0)
-        & (dv[:, :, None] == dv_at[:, None, :])
-    )
-    dyn_dom = jnp.sum((eq_dom & (bm & cg_at)[:, None, :]).astype(I32), axis=2)
-
-    cnt = jnp.where(
-        g.sp_is_host[p][:, None],
-        g.sp_node_cnt[p] + dyn_host,
-        g.sp_sc_dom[p] + dyn_dom,
-    )  # [C, N]
 
     contrib_fx = cnt.astype(I64) * w_fx[:, None] + (
         (db.tsc_max_skew[p].astype(I64) - 1)[:, None] << _FX

@@ -920,6 +920,14 @@ class Scheduler:
             if sample_k is not None
             else None
         )
+        wave_slots = None
+        if sample_k is None and tie_key is None:
+            ws = self._build_wave_slots(pods)
+            if ws is not None:
+                wave_slots = jnp.asarray(ws)
+                self.metrics["wave_batches"] = (
+                    self.metrics.get("wave_batches", 0) + 1
+                )
         t_gang = time.perf_counter()
         chosen, n_feas, reason_counts, tallies = gang.gang_run(
             dc,
@@ -942,6 +950,7 @@ class Scheduler:
             sample_start=sample_start,
             tie_key=tie_key,
             attempt_base=attempt_base,
+            wave_slots=wave_slots,
             **tables,
         )
         both = jax.device_get(jnp.stack([chosen, n_feas]))
@@ -1127,6 +1136,46 @@ class Scheduler:
                 self.prom.snapshot_pack_duration, time.perf_counter() - t0
             )
 
+    def _build_wave_slots(self, pods):
+        """np [W, S] wave matrix for the gang scan's wave-commit mode, or
+        None when the batch is too interactive to profit (waves would
+        average < 4 pods).  See kubernetes_tpu.waves."""
+        import numpy as np
+
+        from kubernetes_tpu.waves import WaveBuilder
+
+        if len(pods) < 16:
+            return None
+        builder = getattr(self, "_wave_builder", None)
+        if builder is None:
+            builder = self._wave_builder = WaveBuilder()
+        runs = builder.build(pods)
+        if len(runs) * 4 > len(pods):
+            return None
+        # Sticky (W, S): every distinct wave-matrix shape is a fresh XLA
+        # compile of the whole pipeline (~25s) — partial final batches and
+        # drifting run lengths must reuse the steady-state shape.  Extra
+        # all-pad rows/slots are masked inner iterations, far cheaper than
+        # a recompile.
+        S = bucket_cap(max(1, -(-len(pods) // len(runs))), 4)
+        S = self._wave_S = max(getattr(self, "_wave_S", 4), S)
+        rows = []
+        for r in runs:
+            for i in range(0, len(r), S):
+                rows.append(r[i : i + S])
+        W = bucket_cap(len(rows), 1)
+        W = self._wave_W = max(getattr(self, "_wave_W", 1), W)
+        # Joint cap: independently-sticky W and S can multiply (one batch
+        # of short runs pins W high, a later long-run batch pins S high);
+        # a W·S area far above the batch would make every wave dispatch
+        # scan mostly pad slots — fall back to the classic scan instead.
+        if W * S > 4 * bucket_cap(len(pods), 1):
+            return None
+        slots = np.full((W, S), -1, np.int32)
+        for w, row in enumerate(rows):
+            slots[w, : len(row)] = row
+        return slots
+
     def _batch_signature_keys(self, batch):
         """signature_key per pod, memoized ON the pod object (spec updates
         arrive as new Pod objects, the compute_requests memo pattern) so the
@@ -1307,6 +1356,13 @@ class Scheduler:
                 fwk.score_weights.get(n, 0) for n in gang.WEIGHT_ORDER
             )
             fit_strategy = fwk.fit_strategy()
+            wave_slots = None
+            ws = self._build_wave_slots(pods)
+            if ws is not None:
+                wave_slots = jnp.asarray(ws)
+                self.metrics["wave_batches"] = (
+                    self.metrics.get("wave_batches", 0) + 1
+                )
             t0 = time.perf_counter()
             dc2, results, reasons = chain_ops.chain_dispatch(
                 ch["dc"],
@@ -1326,6 +1382,7 @@ class Scheduler:
                 nom_req=nom_req,
                 append_terms=append_terms,
                 fit_strategy=fit_strategy,
+                wave_slots=wave_slots,
                 **tables,
             )
             self._chain = {

@@ -1,0 +1,230 @@
+"""Host-side wave partitioning for the gang scan's wave-commit mode.
+
+SURVEY §7 "intra-batch conflicts": the reference schedules strictly one
+pod at a time, so batched evaluation must be sequential-equivalent.  The
+gang scan achieves that with one scan step per pod — but the step's
+expensive pieces (spread/inter-pod contractions against already-placed
+peers) only CHANGE when a pod whose labels/terms interact with a later
+pod commits.  A *wave* is a maximal CONTIGUOUS run of batch pods that
+provably cannot interact through spread selectors, affinity/anti-affinity
+terms, or host ports; within a wave the expensive tensors are frozen and
+only the cheap state (resources, scores, normalization) evolves pod by
+pod.  Contiguity preserves commit order, so decisions stay bit-identical
+to the serial scan (classic-vs-wave bit parity property-tested in
+tests/test_waves.py).
+
+The interaction predicate is CONSERVATIVE (may declare interaction where
+none exists — only costs wave length, never correctness):
+
+  * pod A's spread constraint interacts with pod B when they share a
+    namespace and the constraint's selector matches B's labels
+    (podtopologyspread counts same-namespace pods only,
+    filtering.go:236-310);
+  * pod A's affinity/anti term interacts with B when the term's namespace
+    set admits B (a namespaceSelector conservatively admits everything)
+    and its label selector matches B's labels
+    (interpodaffinity/filtering.go:306-365) — checked in BOTH directions
+    because placed pods' terms also constrain newcomers (symmetry);
+  * any two pods that both request host ports interact (the port-conflict
+    pair check, nodeports).
+
+Pods collapse into *interaction groups* (identical namespace + labels +
+constraint signature); pair decisions are memoized per group pair, so
+partitioning a batch is O(P · distinct-groups) with dict lookups.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from kubernetes_tpu.api.types import LabelSelector, Pod
+
+# selector ops the host matcher understands; anything else → conservative
+_MATCH_ANY = object()
+
+
+def _selector_sig(sel: Optional[LabelSelector]):
+    if sel is None:
+        return None
+    return (
+        tuple(sorted((sel.match_labels or {}).items())),
+        tuple(
+            (e.key, e.operator, tuple(e.values or ()))
+            for e in (sel.match_expressions or ())
+        ),
+    )
+
+
+def _selector_matches(sel: Optional[LabelSelector], labels: Dict[str, str]) -> bool:
+    """LabelSelector match; unknown operators match conservatively."""
+    if sel is None:
+        # a nil selector matches nothing (labels.Nothing()) in spread
+        # counting; the callers that mean "everything" pass empty selector
+        return False
+    for k, v in (sel.match_labels or {}).items():
+        if labels.get(k) != v:
+            return False
+    for e in sel.match_expressions or ():
+        op = e.operator
+        if op == "In":
+            if labels.get(e.key) not in (e.values or ()):
+                return False
+        elif op == "NotIn":
+            if e.key in labels and labels[e.key] in (e.values or ()):
+                return False
+        elif op == "Exists":
+            if e.key not in labels:
+                return False
+        elif op == "DoesNotExist":
+            if e.key in labels:
+                return False
+        else:  # unknown op: conservative
+            return True
+    return True
+
+
+class _Probe:
+    """One selector-with-namespace-scope an interacting pod would match."""
+
+    __slots__ = ("sel", "ns_any", "namespaces")
+
+    def __init__(self, sel, ns_any: bool, namespaces: Tuple[str, ...]):
+        self.sel = sel
+        self.ns_any = ns_any
+        self.namespaces = namespaces
+
+    def admits(self, pod: Pod) -> bool:
+        if not self.ns_any and pod.namespace not in self.namespaces:
+            return False
+        return _selector_matches(self.sel, pod.labels)
+
+
+def _pod_probes(pod: Pod) -> List[_Probe]:
+    probes: List[_Probe] = []
+    for c in pod.topology_spread_constraints:
+        probes.append(_Probe(c.label_selector, False, (pod.namespace,)))
+    aff = pod.affinity
+    terms = []
+    if aff is not None:
+        for grp in (aff.pod_affinity, aff.pod_anti_affinity):
+            if grp is None:
+                continue
+            terms.extend(
+                grp.required_during_scheduling_ignored_during_execution or ()
+            )
+            for wt in (
+                grp.preferred_during_scheduling_ignored_during_execution or ()
+            ):
+                terms.append(wt.pod_affinity_term)
+    for t in terms:
+        if getattr(t, "namespace_selector", None) is not None:
+            probes.append(_Probe(t.label_selector, True, ()))
+        else:
+            nss = tuple(t.namespaces or ()) or (pod.namespace,)
+            probes.append(_Probe(t.label_selector, False, nss))
+    return probes
+
+
+def _group_key(pod: Pod):
+    """Pods with equal keys behave identically in the interaction test."""
+    aff_sig: tuple = ()
+    if pod.affinity is not None:
+        parts = []
+        for grp in (pod.affinity.pod_affinity, pod.affinity.pod_anti_affinity):
+            if grp is None:
+                parts.append(None)
+                continue
+            sig = []
+            for t in (
+                grp.required_during_scheduling_ignored_during_execution or ()
+            ):
+                sig.append(
+                    (
+                        _selector_sig(t.label_selector),
+                        tuple(t.namespaces or ()),
+                        t.namespace_selector is not None,
+                    )
+                )
+            for wt in (
+                grp.preferred_during_scheduling_ignored_during_execution or ()
+            ):
+                t = wt.pod_affinity_term
+                sig.append(
+                    (
+                        _selector_sig(t.label_selector),
+                        tuple(t.namespaces or ()),
+                        t.namespace_selector is not None,
+                    )
+                )
+            parts.append(tuple(sig))
+        aff_sig = tuple(parts)
+    return (
+        pod.namespace,
+        tuple(sorted(pod.labels.items())),
+        tuple(
+            (_selector_sig(c.label_selector),) for c in pod.topology_spread_constraints
+        ),
+        aff_sig,
+        bool(pod.host_ports()),
+    )
+
+
+class WaveBuilder:
+    """Partitions batches into waves, memoizing group-pair interactions
+    across batches (steady-state drains see the same few groups)."""
+
+    def __init__(self) -> None:
+        self._pair: Dict[Tuple, bool] = {}
+        self._probes: Dict[Tuple, List[_Probe]] = {}
+
+    def _interacts(self, ka, pa: Pod, kb, pb: Pod) -> bool:
+        key = (ka, kb)
+        hit = self._pair.get(key)
+        if hit is not None:
+            return hit
+        if pa.host_ports() and pb.host_ports():
+            out = True
+        else:
+            probes_a = self._probes.setdefault(ka, _pod_probes(pa))
+            probes_b = self._probes.setdefault(kb, _pod_probes(pb))
+            out = any(p.admits(pb) for p in probes_a) or any(
+                p.admits(pa) for p in probes_b
+            )
+        self._pair[key] = out
+        self._pair[(kb, ka)] = out
+        if len(self._pair) > 65536:
+            self._pair.clear()
+        if len(self._probes) > 4096:
+            self._probes.clear()
+        return out
+
+    def build(self, pods: Sequence[Pod]) -> List[List[int]]:
+        """Contiguous runs of mutually non-interacting pods, in order.
+        The incoming pod is tested against the current wave's DISTINCT
+        group keys only (group members are interchangeable for the
+        predicate), so a uniform batch costs O(P) lookups, not O(P²)."""
+        waves: List[List[int]] = []
+        cur: List[int] = []
+        cur_distinct: Dict[Tuple, Pod] = {}
+        keys = [self._key_of(p) for p in pods]
+        for i, pod in enumerate(pods):
+            ki = keys[i]
+            if any(
+                self._interacts(ki, pod, kj, rep)
+                for kj, rep in cur_distinct.items()
+            ):
+                waves.append(cur)
+                cur, cur_distinct = [], {}
+            cur.append(i)
+            cur_distinct.setdefault(ki, pod)
+        if cur:
+            waves.append(cur)
+        return waves
+
+    @staticmethod
+    def _key_of(pod: Pod):
+        d = pod.__dict__
+        memo = d.get("_wave_key_memo")
+        if memo is None:
+            memo = d["_wave_key_memo"] = _group_key(pod)
+        return memo

@@ -1,0 +1,308 @@
+"""Wave-commit mode: builder predicate tests + classic-vs-wave bit parity.
+
+The wave path's whole correctness story is "frozen heavy tensors cannot
+differ from a per-pod recompute because no wave peer interacts" — so the
+load-bearing test is bit-identical decisions between the classic per-pod
+scan and the wave scan on randomized mixed workloads (spread, inter-pod
+affinity/anti-affinity, plain resource pods, taints/affinity statics).
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api.resource import Resource
+from kubernetes_tpu.api.types import (
+    Affinity,
+    Container,
+    LabelSelector,
+    Node,
+    Pod,
+    PodAffinity,
+    PodAffinityTerm,
+    PodAntiAffinity,
+    TopologySpreadConstraint,
+)
+from kubernetes_tpu.waves import WaveBuilder
+
+
+def _plain(i, labels=None):
+    return Pod(
+        name=f"p{i}",
+        labels=labels or {},
+        containers=[Container(name="c", requests={"cpu": "100m", "memory": "64Mi"})],
+    )
+
+
+def _spread(i, app, key="topology.kubernetes.io/zone"):
+    return Pod(
+        name=f"s{i}",
+        labels={"app": app},
+        topology_spread_constraints=(
+            TopologySpreadConstraint(
+                max_skew=2,
+                topology_key=key,
+                when_unsatisfiable="DoNotSchedule",
+                label_selector=LabelSelector(match_labels={"app": app}),
+            ),
+        ),
+        containers=[Container(name="c", requests={"cpu": "100m", "memory": "64Mi"})],
+    )
+
+
+def _anti(i, group):
+    return Pod(
+        name=f"a{i}",
+        labels={"g": group},
+        affinity=Affinity(
+            pod_anti_affinity=PodAntiAffinity(
+                required_during_scheduling_ignored_during_execution=(
+                    PodAffinityTerm(
+                        topology_key="kubernetes.io/hostname",
+                        label_selector=LabelSelector(match_labels={"g": group}),
+                    ),
+                )
+            )
+        ),
+        containers=[Container(name="c", requests={"cpu": "50m", "memory": "32Mi"})],
+    )
+
+
+class TestWaveBuilder:
+    def test_same_spread_app_interacts(self):
+        b = WaveBuilder()
+        runs = b.build([_spread(0, "x"), _spread(1, "x")])
+        assert runs == [[0], [1]]
+
+    def test_distinct_apps_share_wave(self):
+        b = WaveBuilder()
+        runs = b.build([_spread(i, f"app{i}") for i in range(8)])
+        assert runs == [list(range(8))]
+
+    def test_plain_pod_matching_selector_interacts(self):
+        # a resource-only pod whose labels match a spread selector must
+        # break the wave (it changes the spread counts)
+        b = WaveBuilder()
+        runs = b.build([_spread(0, "x"), _plain(1, labels={"app": "x"})])
+        assert runs == [[0], [1]]
+
+    def test_plain_pods_never_interact(self):
+        b = WaveBuilder()
+        runs = b.build([_plain(i, labels={"app": f"a{i % 3}"}) for i in range(16)])
+        assert runs == [list(range(16))]
+
+    def test_anti_affinity_self_group_interacts(self):
+        b = WaveBuilder()
+        runs = b.build([_anti(0, "solo"), _anti(1, "solo"), _anti(2, "other")])
+        # pod 1 interacts with pod 0 (same group); pod 2 joins the new wave
+        assert runs == [[0], [1, 2]]
+
+    def test_affinity_probe_both_directions(self):
+        # B carries no terms, but A's term matches B's labels -> interact
+        b = WaveBuilder()
+        a = _anti(0, "g1")
+        victim = _plain(1, labels={"g": "g1"})
+        assert b.build([a, victim]) == [[0], [1]]
+        # and the reverse order too (B placed first, A's term matches it)
+        b2 = WaveBuilder()
+        assert b2.build([victim, a]) == [[0], [1]]
+
+    def test_namespace_scoping(self):
+        # same selector, different namespaces: spread counts are
+        # namespace-scoped so they must NOT interact
+        b = WaveBuilder()
+        p0 = _spread(0, "x")
+        p1 = Pod(
+            name="other-ns",
+            namespace="team-b",
+            labels={"app": "x"},
+            containers=[Container(name="c", requests={"cpu": "100m"})],
+        )
+        assert b.build([p0, p1]) == [[0, 1]]
+
+    def test_host_port_pods_interact(self):
+        from kubernetes_tpu.api.types import ContainerPort
+
+        def port_pod(i):
+            return Pod(
+                name=f"hp{i}",
+                containers=[
+                    Container(
+                        name="c",
+                        requests={"cpu": "1m"},
+                        ports=(ContainerPort(container_port=80, host_port=8080),),
+                    )
+                ],
+            )
+
+        b = WaveBuilder()
+        assert b.build([port_pod(0), port_pod(1)]) == [[0], [1]]
+
+
+# ---------------------------------------------------------------------------
+# classic-vs-wave bit parity on the device pipeline
+# ---------------------------------------------------------------------------
+
+
+def _run_both(nodes, pods):
+    import jax
+    import jax.numpy as jnp
+
+    from kubernetes_tpu.ops import gang
+    from kubernetes_tpu.ops.common import DeviceBatch, DeviceCluster
+    from kubernetes_tpu.oracle.scores import HOSTNAME_LABEL
+    from kubernetes_tpu.snapshot.interner import Vocab
+    from kubernetes_tpu.snapshot.schema import (
+        bucket_cap,
+        pack_existing_pods,
+        pack_nodes,
+        pack_pod_batch,
+    )
+
+    vocab = Vocab()
+    for p in pods:
+        for k, v in p.labels.items():
+            vocab.intern_label(k, v)
+    nt = pack_nodes(nodes, vocab)
+    pb = pack_pod_batch(pods, vocab, k_cap=nt.k_cap, p_cap=bucket_cap(len(pods), 1))
+    ep = pack_existing_pods([], nt.name_to_idx, vocab, k_cap=nt.k_cap)
+    dc = DeviceCluster.from_host(nt, ep, vocab)
+    db = DeviceBatch.from_host(pb)
+    hid = vocab.label_keys.lookup(HOSTNAME_LABEL)
+    hk = jnp.asarray(hid, jnp.int32)
+    v_cap = bucket_cap(len(vocab.label_vals))
+    tables = gang.batch_tables(
+        pb.tsc_topo_key, pb.aff_topo_key, nt.label_vals, int(hid)
+    )
+    kw = dict(
+        has_interpod=bool((pb.aff_kind >= 0).any()),
+        has_spread=bool((pb.tsc_topo_key >= 0).any()),
+        has_ports=False,
+        has_images=False,
+    )
+    classic = gang.gang_run(dc, db, hk, v_cap, **kw, **tables)
+
+    runs = WaveBuilder().build(pods)
+    S = bucket_cap(max(1, -(-len(pods) // len(runs))), 4)
+    rows = []
+    for r in runs:
+        for i in range(0, len(r), S):
+            rows.append(r[i : i + S])
+    W = bucket_cap(len(rows), 1)
+    slots = np.full((W, S), -1, np.int32)
+    for w, row in enumerate(rows):
+        slots[w, : len(row)] = row
+    waved = gang.gang_run(
+        dc, db, hk, v_cap, **kw, wave_slots=jnp.asarray(slots), **tables
+    )
+    out = []
+    for res in (classic, waved):
+        chosen, n_feas, rc, _ = res
+        out.append(
+            (
+                np.asarray(jax.device_get(chosen)),
+                np.asarray(jax.device_get(n_feas)),
+                np.asarray(jax.device_get(rc)),
+            )
+        )
+    return out
+
+
+def _mixed_workload(rng, n_pods):
+    pods = []
+    for i in range(n_pods):
+        kind = rng.random()
+        if kind < 0.35:
+            pods.append(_spread(i, f"app{rng.randrange(6)}"))
+        elif kind < 0.55:
+            pods.append(_anti(i, f"g{rng.randrange(6)}"))
+        elif kind < 0.7:
+            # required affinity to a group (exercises escape + aff_ok)
+            grp = f"g{rng.randrange(6)}"
+            pods.append(
+                Pod(
+                    name=f"f{i}",
+                    labels={"g": grp},
+                    affinity=Affinity(
+                        pod_affinity=PodAffinity(
+                            required_during_scheduling_ignored_during_execution=(
+                                PodAffinityTerm(
+                                    topology_key="topology.kubernetes.io/zone",
+                                    label_selector=LabelSelector(
+                                        match_labels={"g": grp}
+                                    ),
+                                ),
+                            )
+                        )
+                    ),
+                    containers=[
+                        Container(name="c", requests={"cpu": "100m"})
+                    ],
+                )
+            )
+        else:
+            pods.append(_plain(i, labels={"app": f"app{rng.randrange(6)}"}))
+    return pods
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_classic_vs_wave_bit_parity(seed):
+    rng = random.Random(seed)
+    n_nodes = rng.choice([24, 40])
+    nodes = [
+        Node(
+            name=f"n{i}",
+            labels={
+                "topology.kubernetes.io/zone": f"z{i % 3}",
+                "kubernetes.io/hostname": f"n{i}",
+            },
+            capacity=Resource.from_map({"cpu": "4", "memory": "8Gi", "pods": 20}),
+        )
+        for i in range(n_nodes)
+    ]
+    pods = _mixed_workload(rng, rng.choice([24, 48]))
+    (c_ch, c_nf, c_rc), (w_ch, w_nf, w_rc) = _run_both(nodes, pods)
+    assert (c_ch == w_ch).all(), f"chosen diverged: {c_ch} vs {w_ch}"
+    assert (c_nf == w_nf).all()
+    assert (c_rc == w_rc).all()
+
+
+def test_wave_scheduler_drain_matches_serial_oracle():
+    """End-to-end: a drain whose batches take the wave path must produce
+    the same placements as pod-at-a-time serial scheduling."""
+    from kubernetes_tpu.scheduler import Scheduler
+
+    rng = random.Random(7)
+    nodes = [
+        Node(
+            name=f"n{i}",
+            labels={
+                "topology.kubernetes.io/zone": f"z{i % 3}",
+                "kubernetes.io/hostname": f"n{i}",
+            },
+            capacity=Resource.from_map({"cpu": "4", "memory": "8Gi", "pods": 30}),
+        )
+        for i in range(20)
+    ]
+    pods = _mixed_workload(rng, 60)
+
+    def run(batch_size):
+        from kubernetes_tpu.framework.config import SchedulerConfiguration
+
+        cfg = SchedulerConfiguration()
+        cfg.batch_size = batch_size
+        s = Scheduler(configuration=cfg)
+        got = {}
+        s.binding_sink = lambda pod, node: got.__setitem__(pod.name, node)
+        for n in nodes:
+            s.on_node_add(n)
+        for p in pods:
+            s.on_pod_add(p)
+        s.schedule_pending()
+        return got, s
+
+    batched, s_b = run(64)
+    serial, _ = run(1)
+    assert batched == serial
+    assert s_b.metrics.get("wave_batches", 0) >= 1, s_b.metrics
