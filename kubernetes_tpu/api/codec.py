@@ -1,0 +1,110 @@
+"""Wire codec: API dataclasses ↔ JSON-safe dicts.
+
+The reference's wire format is generated protobuf/JSON marshalers per type
+(staging/src/k8s.io/api, apimachinery runtime.Scheme).  Here one generic
+codec walks the dataclass type hints recursively — every scheduler-relevant
+type (Pod, Node, affinity trees, Resource) round-trips through plain JSON
+for the HTTP list/watch tier (client/api_server.py, client/client.py).
+
+Conventions:
+  * dataclasses → {"field": value, ...} (fields at defaults are kept —
+    the codec prioritizes fidelity over wire size);
+  * Tuple[X, ...] / List[X] → JSON arrays, Optional[X] → value or null;
+  * Dict/Mapping str→str/int pass through;
+  * memoized derived state on Pod (underscore keys) never serializes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import typing
+from typing import Any, Dict, Optional, get_args, get_origin, get_type_hints
+
+from kubernetes_tpu.api import types as T
+from kubernetes_tpu.api.resource import Resource
+
+_HINTS_CACHE: Dict[type, Dict[str, Any]] = {}
+
+
+def _hints(cls) -> Dict[str, Any]:
+    h = _HINTS_CACHE.get(cls)
+    if h is None:
+        h = _HINTS_CACHE[cls] = get_type_hints(cls)
+    return h
+
+
+def to_wire(obj: Any) -> Any:
+    """Dataclass tree → JSON-safe structure."""
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    if isinstance(obj, (list, tuple)):
+        return [to_wire(x) for x in obj]
+    if isinstance(obj, dict):
+        return {str(k): to_wire(v) for k, v in obj.items()}
+    if dataclasses.is_dataclass(obj):
+        out = {}
+        for f in dataclasses.fields(obj):
+            out[f.name] = to_wire(getattr(obj, f.name))
+        return out
+    raise TypeError(f"to_wire: unsupported {type(obj)!r}")
+
+
+def _from_wire_typed(value: Any, hint: Any) -> Any:
+    if value is None:
+        return None
+    origin = get_origin(hint)
+    if origin is typing.Union:  # Optional[X]
+        args = [a for a in get_args(hint) if a is not type(None)]
+        # Optional[X] or unions of primitives (str | int | float)
+        if len(args) == 1:
+            return _from_wire_typed(value, args[0])
+        return value
+    if origin in (tuple, list):
+        args = get_args(hint)
+        elem = args[0] if args else Any
+        seq = [_from_wire_typed(v, elem) for v in value]
+        return tuple(seq) if origin is tuple else seq
+    if origin in (dict, typing.Mapping) or hint in (dict,):
+        args = get_args(hint)
+        vt = args[1] if len(args) == 2 else Any
+        return {k: _from_wire_typed(v, vt) for k, v in value.items()}
+    if dataclasses.is_dataclass(hint):
+        return from_wire(value, hint)
+    if hint in (int, float, str, bool):
+        return hint(value)
+    # typing.Any / unparameterized Mapping values
+    return value
+
+
+def from_wire(data: Dict[str, Any], cls) -> Any:
+    """JSON structure → dataclass instance of ``cls``."""
+    hints = _hints(cls)
+    kwargs = {}
+    for f in dataclasses.fields(cls):
+        if f.name not in data:
+            continue
+        kwargs[f.name] = _from_wire_typed(data[f.name], hints[f.name])
+    return cls(**kwargs)
+
+
+# kind registry for the watch stream's typed envelopes
+KINDS = {
+    "Pod": T.Pod,
+    "Node": T.Node,
+    "Resource": Resource,
+    "PodDisruptionBudget": T.PodDisruptionBudget,
+}
+
+
+def encode(obj: Any) -> Dict[str, Any]:
+    kind = type(obj).__name__
+    if kind not in KINDS:
+        raise TypeError(f"encode: unregistered kind {kind}")
+    return {"kind": kind, "object": to_wire(obj)}
+
+
+def decode(envelope: Dict[str, Any]) -> Any:
+    cls = KINDS.get(envelope.get("kind"))
+    if cls is None:
+        raise TypeError(f"decode: unregistered kind {envelope.get('kind')!r}")
+    return from_wire(envelope["object"], cls)

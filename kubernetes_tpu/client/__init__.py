@@ -1,0 +1,11 @@
+"""Client tier: HTTP list/watch API server + reflector-based client.
+
+The wire half of SURVEY §2.4 — apiserver ↔ clients speak list + watch
+(client-go reflector semantics) over HTTP; the scheduler consumes the
+stream through RemoteClusterSource exactly like the in-proc FakeCluster.
+"""
+
+from kubernetes_tpu.client.api_server import ApiServer
+from kubernetes_tpu.client.client import ApiClient, Reflector, RemoteClusterSource
+
+__all__ = ["ApiServer", "ApiClient", "Reflector", "RemoteClusterSource"]

@@ -1,0 +1,262 @@
+"""HTTP API server: list + watch over a FakeCluster store.
+
+The serving half of the reference's storage stack, shrunk to the
+scheduler-relevant surface:
+
+  * per-resource WATCH CACHE — a sliding window of (rv, type, object)
+    events (apiserver/pkg/storage/cacher: watch_cache.go's rolling window)
+    so watchers resume from a resourceVersion without hitting the store;
+    a request older than the window gets 410 Gone, triggering the
+    client's relist (reflector.go:340);
+  * GET  /api/v1/{nodes,pods}                  → {"resourceVersion", "items"}
+  * GET  /api/v1/{res}?watch=1&resourceVersion=N → chunked JSON-lines stream
+  * POST /api/v1/{nodes,pods}                  → create
+  * PUT  /api/v1/nodes/{name}                  → update
+  * DELETE /api/v1/{res}/{key}                 → delete
+  * POST /api/v1/pods/{uid}/binding            → the binding subresource
+    (registry/core/pod/storage/storage.go:169 assignPod)
+  * PATCH /api/v1/pods/{uid}/status            → nominatedNodeName patches
+
+Writes go through the wrapped FakeCluster so its watch fan-out, PV
+controller, and binding semantics stay authoritative; this server records
+the fan-out into the watch cache and serves it over the wire.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from collections import deque
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Deque, Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, unquote, urlparse
+
+from kubernetes_tpu.api.codec import decode, encode
+from kubernetes_tpu.api.types import Node, Pod
+
+WATCH_WINDOW = 4096  # events kept per resource (watch_cache.go capacity)
+
+
+class _WatchCache:
+    """Sliding window of events with a condition for long-polling."""
+
+    def __init__(self, window: int = WATCH_WINDOW):
+        self.events: Deque[Tuple[int, str, dict]] = deque(maxlen=window)
+        self.rv = 0
+        self.cond = threading.Condition()
+
+    def record(self, event_type: str, envelope: dict) -> int:
+        with self.cond:
+            self.rv += 1
+            self.events.append((self.rv, event_type, envelope))
+            self.cond.notify_all()
+            return self.rv
+
+    def since(self, rv: int, timeout: float) -> Optional[List[Tuple[int, str, dict]]]:
+        """Events with rv' > rv; None ⇒ rv fell out of the window (410)."""
+        with self.cond:
+            if self.events and rv < self.events[0][0] - 1:
+                return None  # compacted away → 410 Gone
+            out = [e for e in self.events if e[0] > rv]
+            if out:
+                return out
+            self.cond.wait(timeout)
+            if self.events and rv < self.events[0][0] - 1:
+                return None
+            return [e for e in self.events if e[0] > rv]
+
+
+class ApiServer:
+    def __init__(self, api, host: str = "127.0.0.1", port: int = 0):
+        self.api = api
+        self._mu = threading.Lock()
+        self.caches: Dict[str, _WatchCache] = {
+            "nodes": _WatchCache(),
+            "pods": _WatchCache(),
+        }
+        # subscribe to the store's fan-out so every mutation (from any
+        # client, or in-proc drivers) lands in the watch caches
+        api.watch_nodes(
+            lambda n: self._record("nodes", "ADDED", n),
+            lambda old, new: self._record("nodes", "MODIFIED", new),
+            lambda n: self._record("nodes", "DELETED", n),
+        )
+        api.watch_pods(
+            lambda p: self._record("pods", "ADDED", p),
+            lambda old, new: self._record("pods", "MODIFIED", new),
+            lambda p: self._record("pods", "DELETED", p),
+        )
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # noqa: D401 — quiet
+                pass
+
+            def _json(self, code: int, payload) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802
+                u = urlparse(self.path)
+                parts = [p for p in u.path.split("/") if p]
+                q = parse_qs(u.query)
+                if len(parts) == 3 and parts[:2] == ["api", "v1"]:
+                    res = parts[2]
+                    if res not in server.caches:
+                        return self._json(404, {"error": "unknown resource"})
+                    if q.get("watch", ["0"])[0] in ("1", "true"):
+                        return self._watch(res, int(q.get("resourceVersion", ["0"])[0]))
+                    return self._json(200, server.list_payload(res))
+                if parts == ["healthz"]:
+                    return self._json(200, {"ok": True})
+                return self._json(404, {"error": "not found"})
+
+            def _watch(self, res: str, rv: int) -> None:
+                cache = server.caches[res]
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+
+                def chunk(payload: dict) -> bool:
+                    data = (json.dumps(payload) + "\n").encode()
+                    try:
+                        self.wfile.write(hex(len(data))[2:].encode() + b"\r\n")
+                        self.wfile.write(data + b"\r\n")
+                        self.wfile.flush()
+                        return True
+                    except (BrokenPipeError, ConnectionError, OSError):
+                        return False
+
+                while True:
+                    events = cache.since(rv, timeout=0.5)
+                    if events is None:
+                        chunk({"type": "ERROR", "code": 410})
+                        break
+                    if not events:
+                        if not chunk({"type": "BOOKMARK", "rv": rv}):
+                            return
+                        continue
+                    ok = True
+                    for erv, etype, envelope in events:
+                        rv = erv
+                        ok = chunk({"type": etype, "rv": erv, "object": envelope})
+                        if not ok:
+                            return
+                try:
+                    self.wfile.write(b"0\r\n\r\n")
+                except OSError:
+                    pass
+
+            def do_POST(self):  # noqa: N802
+                parts = [p for p in urlparse(self.path).path.split("/") if p]
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                if len(parts) == 3 and parts[2] == "nodes":
+                    server.api.create_node(decode(body))
+                    return self._json(201, {"ok": True})
+                if len(parts) == 3 and parts[2] == "pods":
+                    server.api.create_pod(decode(body))
+                    return self._json(201, {"ok": True})
+                if len(parts) == 5 and parts[2] == "pods" and parts[4] == "binding":
+                    uid = unquote(parts[3])
+                    # check-and-bind under the server lock: concurrent
+                    # binding POSTs (two active schedulers) must serialize,
+                    # and store-level failures translate to API statuses
+                    # like assignPod's CAS conflict (storage.go:254)
+                    with server._mu:
+                        pod = server.api.pods.get(uid)
+                        if pod is None:
+                            return self._json(
+                                404, {"error": f"pod {uid} not found"}
+                            )
+                        if server.api.bindings.get(uid):
+                            return self._json(409, {"error": "pod already bound"})
+                        try:
+                            server.api.bind(pod, body["node"])
+                        except RuntimeError as e:
+                            return self._json(409, {"error": str(e)})
+                        except KeyError as e:
+                            return self._json(404, {"error": str(e)})
+                    return self._json(201, {"ok": True})
+                return self._json(404, {"error": "not found"})
+
+            def do_PUT(self):  # noqa: N802
+                parts = [p for p in urlparse(self.path).path.split("/") if p]
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                if len(parts) == 4 and parts[2] == "nodes":
+                    server.api.update_node(decode(body))
+                    return self._json(200, {"ok": True})
+                return self._json(404, {"error": "not found"})
+
+            def do_PATCH(self):  # noqa: N802
+                parts = [p for p in urlparse(self.path).path.split("/") if p]
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                if len(parts) == 5 and parts[2] == "pods" and parts[4] == "status":
+                    uid = unquote(parts[3])
+                    pod = server.api.pods.get(uid)
+                    if pod is None:
+                        return self._json(404, {"error": "not found"})
+                    if "nominatedNodeName" in body:
+                        # never mutate the store's instance directly — the
+                        # store computes its own old/new delta for handlers
+                        import copy as _copy
+
+                        patched = _copy.copy(pod)
+                        patched.nominated_node_name = body["nominatedNodeName"]
+                        server.api.patch_pod_status(patched)
+                    return self._json(200, {"ok": True})
+                return self._json(404, {"error": "not found"})
+
+            def do_DELETE(self):  # noqa: N802
+                parts = [p for p in urlparse(self.path).path.split("/") if p]
+                if len(parts) == 4 and parts[2] == "pods":
+                    server.api.delete_pod(unquote(parts[3]))
+                    return self._json(200, {"ok": True})
+                if len(parts) == 4 and parts[2] == "nodes":
+                    server.api.delete_node(unquote(parts[3]))
+                    return self._json(200, {"ok": True})
+                return self._json(404, {"error": "not found"})
+
+        self.http = ThreadingHTTPServer((host, port), Handler)
+        self.http.daemon_threads = True
+        self.port = self.http.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    # ----- store access -----------------------------------------------------
+
+    def _record(self, res: str, etype: str, obj) -> None:
+        self.caches[res].record(etype, encode(obj))
+
+    def list_payload(self, res: str) -> dict:
+        """Consistent list: snapshot + the rv of the last event applied
+        (reflector lists at this rv, then watches from it)."""
+        cache = self.caches[res]
+        with cache.cond:
+            if res == "nodes":
+                items = [encode(n) for n in self.api.nodes.values()]
+            else:
+                items = [encode(p) for p in self.api.pods.values()]
+            return {"resourceVersion": cache.rv, "items": items}
+
+    # ----- lifecycle --------------------------------------------------------
+
+    def start(self) -> "ApiServer":
+        self._thread = threading.Thread(
+            target=self.http.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.http.shutdown()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

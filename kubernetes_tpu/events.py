@@ -1,0 +1,152 @@
+"""Kubernetes Events: recorder + broadcaster.
+
+The reference emits Events through client-go's events machinery — an
+EventBroadcaster started by the server (cmd/kube-scheduler/app/server.go:179)
+fans recorded events out to sinks, and each profile gets its own recorder
+(pkg/scheduler/profile/profile.go:86).  The scheduler emits:
+
+  * ``Scheduled``        (Normal)  on successful binding
+    (schedule_one.go bindingCycle tail);
+  * ``FailedScheduling`` (Warning) with the FitError message
+    (schedule_one.go:1020 handleSchedulingFailure);
+  * ``Preempted``        (Normal)  on each evicted victim
+    (framework/preemption/preemption.go:395 prepareCandidate).
+
+The broadcaster here is synchronous fan-out with the events correlator's
+visible behavior (events/event_broadcaster.go): identical (object, reason,
+action, note) tuples within a series aggregate into one Event with a
+bumped ``count`` instead of growing the sink unboundedly.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+TYPE_NORMAL = "Normal"
+TYPE_WARNING = "Warning"
+
+
+@dataclass
+class ObjectRef:
+    kind: str
+    namespace: str
+    name: str
+    uid: str = ""
+
+    @classmethod
+    def for_pod(cls, pod) -> "ObjectRef":
+        return cls("Pod", pod.namespace, pod.name, pod.uid)
+
+
+@dataclass
+class Event:
+    regarding: ObjectRef
+    event_type: str  # Normal / Warning
+    reason: str  # Scheduled / FailedScheduling / Preempted / ...
+    action: str
+    note: str
+    reporting_controller: str = "default-scheduler"
+    related: Optional[ObjectRef] = None
+    count: int = 1
+    first_timestamp: float = field(default_factory=time.time)
+    last_timestamp: float = field(default_factory=time.time)
+
+    @property
+    def key(self) -> Tuple:
+        return (
+            self.regarding.uid or f"{self.regarding.namespace}/{self.regarding.name}",
+            self.event_type,
+            self.reason,
+            self.action,
+            self.note,
+            # per-controller series: two profiles emitting the same tuple
+            # must not aggregate into each other's Event
+            self.reporting_controller,
+        )
+
+
+class EventBroadcaster:
+    """Fan-out + correlation.  Sinks are callables ``sink(event)`` invoked
+    under the broadcaster lock; a FakeCluster registers its event store
+    here, a real client would register an API-writing sink."""
+
+    def __init__(self, clock: Callable[[], float] = time.time):
+        self._sinks: List[Callable[[Event], None]] = []
+        self._series: Dict[Tuple, Event] = {}
+        self._mu = threading.Lock()
+        self._clock = clock
+        self.started = False
+
+    def start_recording_to_sink(self, sink: Callable[[Event], None]) -> None:
+        with self._mu:
+            self._sinks.append(sink)
+            self.started = True
+
+    def new_recorder(self, reporting_controller: str) -> "EventRecorder":
+        """One recorder per profile (profile.go:86 NewRecorderFactory)."""
+        return EventRecorder(self, reporting_controller)
+
+    def emit(self, event: Event) -> None:
+        with self._mu:
+            prior = self._series.get(event.key)
+            if prior is not None:
+                prior.count += 1
+                prior.last_timestamp = self._clock()
+                event = prior
+            else:
+                # stamp with the broadcaster's clock (the dataclass default
+                # is wall-clock; tests inject a fake clock here)
+                event.first_timestamp = event.last_timestamp = self._clock()
+                if len(self._series) > 4096:
+                    self._series.clear()
+                self._series[event.key] = event
+            for sink in self._sinks:
+                sink(event)
+
+    def shutdown(self) -> None:
+        with self._mu:
+            self._sinks.clear()
+            self.started = False
+
+
+class EventRecorder:
+    """events.EventRecorder analogue: Eventf(regarding, related, type,
+    reason, action, note)."""
+
+    def __init__(self, broadcaster: EventBroadcaster, reporting_controller: str):
+        self._b = broadcaster
+        self.reporting_controller = reporting_controller
+
+    def eventf(
+        self,
+        regarding: ObjectRef,
+        event_type: str,
+        reason: str,
+        action: str,
+        note: str,
+        related: Optional[ObjectRef] = None,
+    ) -> None:
+        self._b.emit(
+            Event(
+                regarding=regarding,
+                event_type=event_type,
+                reason=reason,
+                action=action,
+                note=note,
+                related=related,
+                reporting_controller=self.reporting_controller,
+            )
+        )
+
+
+class NullRecorder(EventRecorder):
+    """Default when no broadcaster is wired (unit tests, bare Scheduler)."""
+
+    def __init__(self):  # noqa: D401 — no broadcaster
+        self.reporting_controller = "default-scheduler"
+
+    def eventf(self, *a, **kw) -> None:  # noqa: D401
+        pass

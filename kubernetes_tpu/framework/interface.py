@@ -119,8 +119,16 @@ class CycleState:
         return (pod_uid, plugin) in self.skip_score_plugins
 
     def clone(self) -> "CycleState":
+        """cycle_state.go Clone: values providing their own clone() are
+        deep-cloned (the reference calls StateData.Clone per entry); plain
+        values are shared — plugins mutating stored state in AddPod/
+        RemovePod extensions must store clonable objects, or the
+        preemption dry-run's per-node isolation leaks across candidates."""
         cs = CycleState()
-        cs._data = dict(self._data)
+        cs._data = {
+            k: (v.clone() if hasattr(v, "clone") else v)
+            for k, v in self._data.items()
+        }
         cs.skip_filter_plugins = set(self.skip_filter_plugins)
         cs.skip_score_plugins = set(self.skip_score_plugins)
         return cs
@@ -152,6 +160,28 @@ class QueueSortPlugin(Plugin):
         raise NotImplementedError
 
 
+class PreFilterExtensions:
+    """interface.go:443-520 PreFilterExtensions: incremental updates to a
+    plugin's per-cycle PreFilter state when the evaluated cluster view is
+    hypothetically modified — nominated pods counted as placed
+    (RunFilterPluginsWithNominatedPods, runtime/framework.go:973) and
+    preemption dry-run victim removal/reprieve (preemption.go:548)."""
+
+    def add_pod(
+        self, state: CycleState, pod_to_schedule: Pod, pod_to_add: Pod, node_state
+    ) -> Status:
+        return Status.success()
+
+    def remove_pod(
+        self,
+        state: CycleState,
+        pod_to_schedule: Pod,
+        pod_to_remove: Pod,
+        node_state,
+    ) -> Status:
+        return Status.success()
+
+
 class PreFilterPlugin(Plugin):
     def pre_filter(self, state: CycleState, pod: Pod) -> Status:
         """Per-pod PreFilter (interface.go RunPreFilterPlugins semantics):
@@ -164,6 +194,11 @@ class PreFilterPlugin(Plugin):
         node-name set the pod could EVER land on; None = all nodes.  The
         runtime intersects results across plugins; an empty intersection
         rejects the pod UnschedulableAndUnresolvable before Filter."""
+        return None
+
+    def pre_filter_extensions(self) -> Optional[PreFilterExtensions]:
+        """interface.go PreFilterExtensions(): nil when the plugin's cycle
+        state needs no incremental maintenance."""
         return None
 
 

@@ -278,14 +278,16 @@ class NodeResourcesFit(DevicePluginMixin, FilterPlugin, ScorePlugin, EnqueueExte
             {"name": "cpu", "weight": 1},
             {"name": "memory", "weight": 1},
         ]
-        for r in res:
-            if r.get("name") not in ("cpu", "memory"):
-                raise ValueError(
-                    "scoringStrategy.resources supports cpu/memory "
-                    f"(got {r.get('name')!r})"
-                )
         w = {r["name"]: int(r.get("weight", 1)) for r in res}
         self.fit_res_weights = (w.get("cpu", 0), w.get("memory", 0))
+        # The device fit-score kernel computes over the cpu/memory lanes;
+        # strategies weighing ephemeral-storage or extended resources
+        # (resource_allocation.go:37-115 accepts any resource) score
+        # host-side instead: device_score=False routes affected pods
+        # through the exact one-pod oracle cycle (fit_scorer), matching
+        # the reference bit for bit.  Filtering handles every lane on
+        # device either way.
+        self.device_score = all(name in ("cpu", "memory") for name in w)
         scale = 100 // self.MAX_CUSTOM_PRIORITY_SCORE
         raw_shape = ss.get("requestedToCapacityRatio", {}).get(
             "shape",

@@ -77,9 +77,12 @@ class Evaluator:
         self.handle = handle
         self.percentage = percentage
         self.min_candidates = min_candidates
-        # host-filter context for the current preempt() call
+        # host-filter / prefilter-extension context for the current
+        # preempt() call
         self._hf_fwk = None
         self._hf_state = None
+        self._ext_fwk = None
+        self._ext_state = None
 
     # ----- entry point ------------------------------------------------------
 
@@ -124,10 +127,15 @@ class Evaluator:
         # Host-backed Filter plugins (volumebinding class) must judge the
         # dry-run too — otherwise preemption evicts victims on nodes the
         # pod's volumes can never bind to.  PreFilter runs once here; the
-        # per-node veto happens inside _fits.
+        # per-node veto happens inside _fits.  Plugins with PreFilter
+        # extensions (interface.go:443-520) additionally get AddPod/
+        # RemovePod notifications as the dry-run mutates its working copy.
         self._hf_fwk = self._hf_state = None
+        self._ext_fwk = self._ext_state = None
         fwk = getattr(self.handle, "framework_for", lambda p: None)(pod)
-        if fwk is not None and fwk.has_host_filters():
+        if fwk is not None and (
+            fwk.has_host_filters() or fwk.has_pre_filter_extensions()
+        ):
             cs = CycleState()
             failures = fwk.run_pre_filter(cs, [pod])
             if failures:
@@ -135,8 +143,10 @@ class Evaluator:
                     "preemption is not helpful for scheduling",
                     plugin=self.plugin_name,
                 )
-            if fwk.active_host_filters(cs, [pod]):
+            if fwk.has_host_filters() and fwk.active_host_filters(cs, [pod]):
                 self._hf_fwk, self._hf_state = fwk, cs
+            if fwk.has_pre_filter_extensions():
+                self._ext_fwk, self._ext_state = fwk, cs
 
         if potential_nodes is None:
             potential_nodes = self.potential_nodes(pod, state, shortlist)
@@ -304,8 +314,27 @@ class Evaluator:
         if not potential:
             return None
 
+        ext = self._ext_fwk
+        # Per-candidate CycleState isolation (DryRunPreemption clones the
+        # state per node, preemption.go:548): extension AddPod/RemovePod
+        # mutations on node A must not leak into node B's evaluation.
+        base_cs = self._ext_state if self._ext_state is not None else self._hf_state
+        prev_hf, prev_ext = self._hf_state, self._ext_state
+        if base_cs is not None:
+            node_cs = base_cs.clone()
+            if self._hf_state is not None:
+                self._hf_state = node_cs
+            if self._ext_state is not None:
+                self._ext_state = node_cs
         state.nodes[node_name] = work
         try:
+            if ext is not None:
+                # RemovePod extension per removed victim (preemption.go:548
+                # DryRunPreemption → RunPreFilterExtensionRemovePod)
+                for v in potential:
+                    ext.run_pre_filter_extension_remove_pod(
+                        self._ext_state, pod, v, work
+                    )
             if not self._fits(pod, work, state):
                 return None
             potential.sort(key=_importance_key)
@@ -315,9 +344,17 @@ class Evaluator:
 
             def reprieve(v: Pod) -> bool:
                 work.add_pod(v)
+                if ext is not None:
+                    ext.run_pre_filter_extension_add_pod(
+                        self._ext_state, pod, v, work
+                    )
                 if self._fits(pod, work, state):
                     return True
                 work.remove_pod(v)
+                if ext is not None:
+                    ext.run_pre_filter_extension_remove_pod(
+                        self._ext_state, pod, v, work
+                    )
                 victims.append(v)
                 return False
 
@@ -333,6 +370,7 @@ class Evaluator:
             return Victims(pods=victims, num_pdb_violations=num_violating)
         finally:
             state.nodes[node_name] = orig
+            self._hf_state, self._ext_state = prev_hf, prev_ext
 
     def _fits(self, pod: Pod, ns: NodeState, state: OracleState) -> bool:
         """RunFilterPluginsWithNominatedPods for one node: all default
@@ -351,6 +389,10 @@ class Evaluator:
             return not OF.filter_node_resources(pod, ns)
         for np in nominated:
             ns.add_pod(np)
+            if self._ext_fwk is not None:
+                self._ext_fwk.run_pre_filter_extension_add_pod(
+                    self._ext_state, pod, np, ns
+                )
         try:
             if OF.filter_node_name(pod, ns):
                 return False
@@ -378,6 +420,10 @@ class Evaluator:
         finally:
             for np in nominated:
                 ns.remove_pod(np)
+                if self._ext_fwk is not None:
+                    self._ext_fwk.run_pre_filter_extension_remove_pod(
+                        self._ext_state, pod, np, ns
+                    )
 
     def _split_pdb_violations(
         self, victims: Sequence[Pod], pdbs: Sequence[PodDisruptionBudget]
@@ -438,12 +484,26 @@ class Evaluator:
     # ----- preparation (preemption.go:349 prepareCandidate) -----------------
 
     def prepare_candidate(self, pod: Pod, c: Candidate) -> None:
+        from kubernetes_tpu import events as ev
+
+        recorder = getattr(self.handle, "recorder_for", lambda p: ev.NullRecorder())(
+            pod
+        )
         for victim in c.victims.pods:
             wp = self.handle.get_waiting_pod(victim.uid)
             if wp is not None:
                 wp.reject("preempted")
             else:
                 self.handle.delete_pod(victim)
+            # victim eviction event (preemption.go:395 Preempted)
+            recorder.eventf(
+                ev.ObjectRef.for_pod(victim),
+                ev.TYPE_NORMAL,
+                "Preempted",
+                "Preempting",
+                f"Preempted by pod {pod.uid} on node {c.name}",
+                related=ev.ObjectRef.for_pod(pod),
+            )
         # Lower-priority pods nominated here may no longer fit: clear their
         # nominations and reactivate them.
         demoted = [

@@ -137,6 +137,11 @@ class Framework:
             inst.fit_res_weights,
         )
 
+    def plugin_instance(self, name: str):
+        """The enabled plugin instance by name, or None (keeps callers off
+        the private _instances map)."""
+        return self._instances.get(name)
+
     def host_filter_plugins(self) -> List[FilterPlugin]:
         """Enabled Filter plugins with NO device kernel (the host-veto set)."""
         return [
@@ -241,6 +246,55 @@ class Framework:
                     state.write(("pre_filter_result", pod.uid), allowed)
         self._observe_point("PreFilter", not failures, time.perf_counter() - t0)
         return failures
+
+    def has_pre_filter_extensions(self) -> bool:
+        return any(
+            isinstance(p, PreFilterPlugin)
+            and p.pre_filter_extensions() is not None
+            for p in self._by_point.get("preFilter", [])
+        )
+
+    def run_pre_filter_extension_add_pod(
+        self, state: CycleState, pod: Pod, pod_to_add: Pod, node_state
+    ) -> Status:
+        """RunPreFilterExtensionAddPod (runtime/framework.go:743): notify
+        every non-skipped PreFilter plugin with extensions that
+        ``pod_to_add`` is hypothetically placed on ``node_state``."""
+        for p in self._by_point.get("preFilter", []):
+            if not isinstance(p, PreFilterPlugin):
+                continue
+            if state.is_filter_skipped(pod.uid, p.name):
+                continue
+            ext = p.pre_filter_extensions()
+            if ext is None:
+                continue
+            s = ext.add_pod(state, pod, pod_to_add, node_state)
+            if not s.ok:
+                if not s.plugin:
+                    s.plugin = p.name
+                return s
+        return Status.success()
+
+    def run_pre_filter_extension_remove_pod(
+        self, state: CycleState, pod: Pod, pod_to_remove: Pod, node_state
+    ) -> Status:
+        """RunPreFilterExtensionRemovePod (runtime/framework.go:770) — the
+        preemption dry-run's victim-removal notification
+        (preemption.go:548 DryRunPreemption)."""
+        for p in self._by_point.get("preFilter", []):
+            if not isinstance(p, PreFilterPlugin):
+                continue
+            if state.is_filter_skipped(pod.uid, p.name):
+                continue
+            ext = p.pre_filter_extensions()
+            if ext is None:
+                continue
+            s = ext.remove_pod(state, pod, pod_to_remove, node_state)
+            if not s.ok:
+                if not s.plugin:
+                    s.plugin = p.name
+                return s
+        return Status.success()
 
     def run_host_filters(self, state: CycleState, pod: Pod, node_state) -> Status:
         """Host-backed Filter plugins as a per-(pod, node) veto — the path

@@ -44,6 +44,9 @@ class FitResult:
     # nodes visited before the sampling cutoff (drives nextStartNodeIndex,
     # schedule_one.go:625)
     processed: int = 0
+    # size of the node list actually walked (PreFilterResult-narrowed) —
+    # the modulus for nextStartNodeIndex advancement
+    n_considered: int = 0
 
 
 MIN_FEASIBLE_NODES_TO_FIND = 100  # schedule_one.go minFeasibleNodesToFind
@@ -85,17 +88,23 @@ def feasible_nodes(
     allowed: Optional[frozenset] = None,
     sample_k: Optional[int] = None,
     start_index: int = 0,
+    sample_pct: Optional[int] = None,
 ) -> FitResult:
     """Filter plugins in the reference's iteration shape (every node, all
     reasons collected).  ``enabled`` limits evaluation to a profile's
     enabled plugin set (kernel names); ``allowed`` is the PreFilterResult
-    node-name narrowing (findNodesThatFitPod evaluates only those,
-    schedule_one.go:478-486).
+    node-name narrowing — applied BEFORE sampling, like the reference
+    (findNodesThatFitPod narrows the node list first, then
+    findNodesThatPassFilters sizes numFeasibleNodesToFind and the
+    nextStartNodeIndex rotation over the narrowed list,
+    schedule_one.go:478-486,588-669).
 
-    ``sample_k``/``start_index`` reproduce findNodesThatPassFilters'
-    adaptive sampling (:588-669): nodes are visited in rotation order from
-    start_index and the walk stops once sample_k feasible nodes are found;
-    FitResult.processed reports how many nodes were visited."""
+    ``sample_k``/``start_index`` reproduce the adaptive sampling: nodes
+    are visited in rotation order from start_index and the walk stops once
+    sample_k feasible nodes are found; FitResult.processed reports how
+    many nodes were visited.  ``sample_pct`` instead derives sample_k from
+    the NARROWED list length (the correct sizing when combined with
+    ``allowed``); it overrides sample_k."""
     spread_counts = (
         F.spread_pair_counts(pod, state) if "PodTopologySpread" in enabled else None
     )
@@ -116,6 +125,12 @@ def feasible_nodes(
     feasible: List[str] = []
     reasons: Dict[str, List[str]] = {}
     names = list(state.nodes)
+    if allowed is not None:
+        names = [n for n in names if n in allowed]
+    n_considered = len(names)
+    if sample_pct is not None:
+        k = num_feasible_nodes_to_find(sample_pct, n_considered)
+        sample_k = k if k < n_considered else None
     if sample_k is not None and names:
         start = start_index % len(names)
         names = names[start:] + names[:start]
@@ -123,8 +138,6 @@ def feasible_nodes(
     for name in names:
         ns = state.nodes[name]
         processed += 1
-        if allowed is not None and name not in allowed:
-            continue
         rs: List[str] = []
         for _, fn in checks:
             r = fn(ns)
@@ -138,7 +151,12 @@ def feasible_nodes(
             feasible.append(name)
             if sample_k is not None and len(feasible) >= sample_k:
                 break
-    return FitResult(feasible=feasible, reasons=reasons, processed=processed)
+    return FitResult(
+        feasible=feasible,
+        reasons=reasons,
+        processed=processed,
+        n_considered=n_considered,
+    )
 
 
 def prioritize(

@@ -173,6 +173,12 @@ class Handle:
         with self._s._mu:
             self._s.queue.activate(pods)
 
+    def recorder_for(self, pod: Pod):
+        """The profile's event recorder (framework.Handle EventRecorder)."""
+        from kubernetes_tpu.events import NullRecorder
+
+        return self._s.recorders.get(pod.scheduler_name) or NullRecorder()
+
 
 class Scheduler:
     def __init__(
@@ -183,6 +189,7 @@ class Scheduler:
         namespace_labels: Optional[Dict[str, Dict[str, str]]] = None,
         clock=time.monotonic,
         extenders=None,
+        event_broadcaster=None,
     ):
         self.config = configuration or cfg.SchedulerConfiguration()
         self.config.validate()
@@ -237,6 +244,19 @@ class Scheduler:
         self.pv_writer = lambda pv: None
         self.pvc_writer = lambda pvc: None
         self.claim_writer = lambda claim: None
+
+        # Event recorders, one per profile (profile.go:86) — NullRecorder
+        # when no broadcaster is wired (bare unit-test Schedulers).
+        from kubernetes_tpu.events import NullRecorder
+
+        self.event_broadcaster = event_broadcaster
+        self.recorders: Dict[str, object] = {}
+        for p in self.config.profiles:
+            self.recorders[p.scheduler_name] = (
+                event_broadcaster.new_recorder(p.scheduler_name)
+                if event_broadcaster is not None
+                else NullRecorder()
+            )
 
         handle = Handle(self)
         reg = registry or default_registry()
@@ -611,9 +631,13 @@ class Scheduler:
                     # pipelined: keep up to two batches in flight so the
                     # harvest of batch k overlaps k+1's device compute AND
                     # k+2's dispatch (the async result copy finishes before
-                    # the blocking fetch)
+                    # the blocking fetch).  With Reserve/Permit plugins in
+                    # play a commit can realistically fail (and forget), so
+                    # harvest eagerly — one batch in flight — to keep the
+                    # optimism window close to the reference's (a forget is
+                    # visible to the very next scheduling cycle).
                     pending.append(rec)
-                    flush(2)
+                    flush(1 if fwk.has_reserve_or_permit() else 2)
                     continue
                 if rec == "handled":
                     continue
@@ -1090,6 +1114,11 @@ class Scheduler:
             if fwk.score_weights.get(p.name, 0) and any(
                 p.score_relevant(qp.pod) for qp in batch
             ):
+                return False
+        # one-pod-only score plugins (normalize overrides, extended-resource
+        # fit strategies) force the direct path's split routing
+        for p in self._normalizing_score_plugins(fwk):
+            if any(p.score_relevant(qp.pod) for qp in batch):
                 return False
         # a batch the signature fast path can commit is cheaper there —
         # the keys computed here are memoized for _try_fast_schedule so the
@@ -1675,6 +1704,7 @@ class Scheduler:
                 ]
                 for np_ in added:
                     ns.add_pod(np_)
+                    fwk.run_pre_filter_extension_add_pod(state, pod, np_, ns)
                 try:
                     fit = feasible_nodes(
                         pod,
@@ -1685,6 +1715,9 @@ class Scheduler:
                 finally:
                     for np_ in added:
                         ns.remove_pod(np_)
+                        fwk.run_pre_filter_extension_remove_pod(
+                            state, pod, np_, ns
+                        )
                 ok = bool(fit.feasible)
                 if ok and added:
                     second = feasible_nodes(
@@ -1761,20 +1794,19 @@ class Scheduler:
         st = self.oracle_view()
         n_nodes = len(st.nodes)
         allowed = state.read(("pre_filter_result", pod.uid))
-        sample_k = None
+        # sample sizing happens INSIDE feasible_nodes over the
+        # PreFilterResult-narrowed list (schedule_one.go narrows first)
+        sample_pct = None
         if self._sampling_active(fwk):
-            from kubernetes_tpu.oracle.pipeline import num_feasible_nodes_to_find
-
             pct = fwk.percentage_of_nodes_to_score
             if pct is None:
                 pct = self.config.percentage_of_nodes_to_score
             if pct > 0 or self.config.reference_sampling_compat:
-                k = num_feasible_nodes_to_find(pct, n_nodes)
-                if k < n_nodes:
-                    sample_k = k
+                sample_pct = pct
         # RunFilterPluginsWithNominatedPods (runtime/framework.go:973):
         # nominated preemptors of >= priority count as present on their
-        # nominated node during feasibility
+        # nominated node during feasibility; PreFilter extensions keep
+        # plugin cycle state in step (interface.go:443-520)
         added = []
         for node, np_ in self.nominator.entries():
             if (
@@ -1783,6 +1815,9 @@ class Scheduler:
                 and node in st.nodes
             ):
                 st.nodes[node].add_pod(np_)
+                fwk.run_pre_filter_extension_add_pod(
+                    state, pod, np_, st.nodes[node]
+                )
                 added.append((node, np_))
         try:
             fit = feasible_nodes(
@@ -1790,12 +1825,15 @@ class Scheduler:
                 st,
                 enabled=fwk.device_enabled(),
                 allowed=frozenset(allowed) if allowed is not None else None,
-                sample_k=sample_k,
+                sample_pct=sample_pct,
                 start_index=getattr(self, "_next_start_node_index", 0),
             )
         finally:
             for node, np_ in added:
                 st.nodes[node].remove_pod(np_)
+                fwk.run_pre_filter_extension_remove_pod(
+                    state, pod, np_, st.nodes[node]
+                )
         if added and fit.feasible:
             # the reference's SECOND pass (runtime/framework.go:973): a node
             # that only passed BECAUSE of a nominated pod (e.g. required
@@ -1817,10 +1855,12 @@ class Scheduler:
                     fit.reasons.setdefault(n, []).append(
                         "node(s) only feasible with unbound nominated pods"
                     )
-        if sample_k is not None:
+        if sample_pct is not None:
+            # advance the rotation modulo the NARROWED list length, like
+            # findNodesThatPassFilters (schedule_one.go:625)
             self._next_start_node_index = (
                 getattr(self, "_next_start_node_index", 0) + fit.processed
-            ) % max(n_nodes, 1)
+            ) % max(fit.n_considered, 1)
         feasible = fit.feasible
         diag: Dict[str, int] = {}
         for rs in fit.reasons.values():
@@ -1865,7 +1905,7 @@ class Scheduler:
                 )
             ]
 
-        fit_inst = fwk._instances.get("NodeResourcesFit")
+        fit_inst = fwk.plugin_instance("NodeResourcesFit")
         fit_scorer = (
             (lambda pod_, ns_: fit_inst.score(state, pod_, ns_))
             if fit_inst is not None
@@ -2005,16 +2045,58 @@ class Scheduler:
         diags: List[Dict[str, int]] = [dict() for _ in pods]
         plugin_sets: List[set] = [set() for _ in pods]
         for i, pod in enumerate(pods):
-            for j, ns in enumerate(node_states):
-                if ns is None or not nt.valid[j]:
-                    continue
-                s = fwk.run_host_filters(state, pod, ns)
-                if not s.ok:
-                    mask[i, j] = False
-                    reason = s.merge_reason() or s.plugin
-                    diags[i][reason] = diags[i].get(reason, 0) + 1
-                    if s.plugin:
-                        plugin_sets[i].add(s.plugin)
+            # RunFilterPluginsWithNominatedPods (runtime:973) for the host
+            # veto pass: nominated preemptors of >= priority count as
+            # present on their node, with PreFilter AddPod extensions.
+            added = []
+            if len(self.nominator):
+                for node, np_ in self.nominator.entries():
+                    if np_.uid != pod.uid and np_.priority >= pod.priority:
+                        ns0 = st.nodes.get(node)
+                        if ns0 is not None:
+                            ns0.add_pod(np_)
+                            fwk.run_pre_filter_extension_add_pod(
+                                state, pod, np_, ns0
+                            )
+                            added.append((ns0, np_))
+            try:
+                for j, ns in enumerate(node_states):
+                    if ns is None or not nt.valid[j]:
+                        continue
+                    s = fwk.run_host_filters(state, pod, ns)
+                    if not s.ok:
+                        mask[i, j] = False
+                        reason = s.merge_reason() or s.plugin
+                        diags[i][reason] = diags[i].get(reason, 0) + 1
+                        if s.plugin:
+                            plugin_sets[i].add(s.plugin)
+            finally:
+                for ns0, np_ in added:
+                    ns0.remove_pod(np_)
+                    fwk.run_pre_filter_extension_remove_pod(
+                        state, pod, np_, ns0
+                    )
+            if added:
+                # the reference's SECOND pass (runtime:973): a node that
+                # passed only BECAUSE of an unbound nominated pod must also
+                # pass without it — re-check passing nodes that carried
+                # nominated adds now that the state is back to neutral
+                nom_nodes = {ns0.node.name for ns0, _ in added}
+                for j, ns in enumerate(node_states):
+                    if (
+                        ns is None
+                        or not nt.valid[j]
+                        or not mask[i, j]
+                        or ns.node.name not in nom_nodes
+                    ):
+                        continue
+                    s = fwk.run_host_filters(state, pod, ns)
+                    if not s.ok:
+                        mask[i, j] = False
+                        reason = "node(s) only feasible with unbound nominated pods"
+                        diags[i][reason] = diags[i].get(reason, 0) + 1
+                        if s.plugin:
+                            plugin_sets[i].add(s.plugin)
         return jnp.asarray(mask), diags, plugin_sets
 
     def _sampling_args(self, fwk):
@@ -2057,15 +2139,27 @@ class Scheduler:
     def _normalizing_score_plugins(fwk):
         """Enabled host Score plugins that OVERRIDE normalize — their
         scores depend on the feasible set, which only the one-pod oracle
-        cycle knows (see the routing in _schedule_batch)."""
+        cycle knows (see the routing in _schedule_batch).  Also includes
+        NodeResourcesFit when its scoringStrategy weighs resources beyond
+        the device kernel's cpu/memory lanes (device_score=False): its
+        score evolves with every in-batch commit, so only the one-pod
+        cycle (whose fit_scorer recomputes per attempt) is exact."""
         from kubernetes_tpu.framework.interface import ScorePlugin
 
-        return [
+        out = [
             p
             for p in fwk.host_score_plugins()
             if fwk.score_weights.get(p.name, 0)
             and type(p).normalize is not ScorePlugin.normalize
         ]
+        fit = fwk.plugin_instance("NodeResourcesFit")
+        if (
+            fit is not None
+            and not getattr(fit, "device_score", True)
+            and fwk.score_weights.get(fit.name, 0)
+        ):
+            out.append(fit)
+        return out
 
     def _batched_preemption_narrow(self, fwk, state, failed) -> None:
         """ONE device dispatch shortlisting preemption candidates for every
@@ -2385,6 +2479,15 @@ class Scheduler:
             self.nominator.delete(pod)
             self.metrics["scheduled"] += 1
         fwk.run_post_bind(state, pod, node_name)
+        from kubernetes_tpu import events as ev
+
+        self.recorders.get(pod.scheduler_name, ev.NullRecorder()).eventf(
+            ev.ObjectRef.for_pod(pod),
+            ev.TYPE_NORMAL,
+            "Scheduled",
+            "Binding",
+            f"Successfully assigned {pod.key} to {node_name}",
+        )
 
     def wait_for_bindings(self) -> None:
         """Barrier: block until every in-flight binding cycle completed and
@@ -2413,3 +2516,13 @@ class Scheduler:
             if plugins is None:
                 plugins = {status.plugin} if status.plugin else set()
             self.queue.add_unschedulable(qp, plugins)
+        from kubernetes_tpu import events as ev
+
+        pod = qp.pod
+        self.recorders.get(pod.scheduler_name, ev.NullRecorder()).eventf(
+            ev.ObjectRef.for_pod(pod),
+            ev.TYPE_WARNING,
+            "FailedScheduling",
+            "Scheduling",
+            "; ".join(status.reasons) or "scheduling failed",
+        )

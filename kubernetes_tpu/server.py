@@ -217,6 +217,7 @@ class SchedulerServer:
         self._synced = threading.Event()
         self._loop_thread: Optional[threading.Thread] = None
         self.cycles = 0
+        self.loop_errors = 0
 
         srv = self
 
@@ -297,7 +298,19 @@ class SchedulerServer:
                 if outs:
                     self.cycles += 1
             except Exception:  # noqa: BLE001 — loop must survive
-                pass
+                # a persistent failure (bad config/plugin) must be visible:
+                # log with traceback and count it on /metrics so the loop
+                # never becomes a silent busy-wait
+                import logging
+
+                logging.getLogger("kubernetes_tpu.server").exception(
+                    "scheduling cycle failed"
+                )
+                self.loop_errors += 1
+                try:
+                    self.sched.metrics["errors"] += 1
+                except Exception:  # noqa: BLE001
+                    pass
             self._stop.wait(self.poll_interval_s)
 
     def stop(self) -> None:
@@ -325,19 +338,47 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument(
         "--leader-elect", action="store_true", default=False
     )
+    ap.add_argument(
+        "--api-endpoint",
+        help="HTTP list/watch API endpoint (e.g. http://127.0.0.1:8001); "
+        "when omitted the process serves an in-proc FakeCluster",
+    )
     args = ap.parse_args(argv)
 
     conf = load_config(args.config) if args.config else None
-    sched = Scheduler(configuration=conf)
-    # without a real client tier the process serves an in-proc cluster
-    # (the FakeCluster source) — a deployment embeds its own ClusterSource
-    api = FakeCluster()
-    api.connect(sched)
+    # event broadcaster started before the scheduler runs
+    # (cmd/kube-scheduler/app/server.go:179)
+    from kubernetes_tpu.events import EventBroadcaster
+
+    broadcaster = EventBroadcaster()
+    sched = Scheduler(configuration=conf, event_broadcaster=broadcaster)
+    ground_truth = None
     elector = None
-    if args.leader_elect:
-        elector = LeaseElector(api.lease_store, identity=f"pid-{id(sched)}")
+    if args.api_endpoint:
+        if args.leader_elect:
+            # Lease objects are not served over the HTTP tier yet —
+            # failing loudly beats two replicas silently running
+            # active-active and racing on bindings.
+            ap.error(
+                "--leader-elect is not supported with --api-endpoint "
+                "(the HTTP tier does not serve Lease objects yet)"
+            )
+        # real wire tier: reflector-based list/watch client
+        from kubernetes_tpu.client import RemoteClusterSource
+
+        source = RemoteClusterSource(args.api_endpoint)
+        source.connect(sched)
+        source.start()
+        source.wait_for_sync()
+    else:
+        # in-proc cluster (the FakeCluster source)
+        api = FakeCluster()
+        api.connect(sched)
+        ground_truth = api.ground_truth
+        if args.leader_elect:
+            elector = LeaseElector(api.lease_store, identity=f"pid-{id(sched)}")
     server = SchedulerServer(
-        sched, elector=elector, port=args.port, ground_truth=api.ground_truth
+        sched, elector=elector, port=args.port, ground_truth=ground_truth
     )
     server.debugger.install_signal_handler()
     server.start()

@@ -69,6 +69,7 @@ class FakeCluster:
         self._pod_handlers: List[tuple] = []
         self.bindings: Dict[str, str] = {}  # pod uid → node name
         self.evictions: List[str] = []  # uids deleted via preemption
+        self.events: List[object] = []  # recorded Events (events.k8s.io)
         self._rv = 0
         self.pvs = _ObjectStore(self)
         self.pvcs = _ObjectStore(self)
@@ -295,10 +296,27 @@ class FakeCluster:
     def create_capacity(self, c: st.CSIStorageCapacity) -> None:
         self.capacities.create(c)
 
+    # ----- events API (events.k8s.io store) ---------------------------------
+
+    def record_event(self, event) -> None:
+        """Event sink: aggregated events keep object identity, so the
+        store dedups on the correlator key like the API's series would."""
+        if event not in self.events:
+            self.events.append(event)
+
+    def list_events(self, reason: Optional[str] = None) -> List[object]:
+        return [e for e in self.events if reason is None or e.reason == reason]
+
     # ----- wiring -----------------------------------------------------------
 
     def connect(self, scheduler) -> None:
         """Attach a Scheduler's event handlers (addAllEventHandlers)."""
+        # events API sink: the scheduler's broadcaster (when wired) lands
+        # Events here like the real events.k8s.io API would store them
+        if getattr(scheduler, "event_broadcaster", None) is not None:
+            scheduler.event_broadcaster.start_recording_to_sink(
+                self.record_event
+            )
         self.watch_nodes(
             scheduler.on_node_add, scheduler.on_node_update, scheduler.on_node_delete
         )

@@ -1,0 +1,114 @@
+"""Events recorder/broadcaster: Scheduled / FailedScheduling / Preempted
+events must reach the cluster's event store (profile.go:86 recorder per
+profile, server.go:179 broadcaster, preemption.go:395 victim events)."""
+
+from kubernetes_tpu.api.resource import Resource
+from kubernetes_tpu.api.types import Container, Node, Pod
+from kubernetes_tpu.events import EventBroadcaster
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.testing.fake_cluster import FakeCluster
+
+
+def _mk():
+    now = [1000.0]
+    api = FakeCluster()
+    sched = Scheduler(
+        event_broadcaster=EventBroadcaster(clock=lambda: now[0]),
+        clock=lambda: now[0],
+    )
+    api.connect(sched)
+    return api, sched, now
+
+
+def test_scheduled_event_on_bind():
+    api, sched, _ = _mk()
+    api.create_node(
+        Node(
+            name="n0",
+            labels={"kubernetes.io/hostname": "n0"},
+            capacity=Resource.from_map({"cpu": "4", "memory": "8Gi"}),
+        )
+    )
+    api.create_pod(
+        Pod(name="p0", containers=[Container(requests={"cpu": "100m"})])
+    )
+    sched.schedule_pending()
+    evs = api.list_events("Scheduled")
+    assert len(evs) == 1
+    assert evs[0].event_type == "Normal"
+    assert "default/p0" in evs[0].note and "n0" in evs[0].note
+    assert evs[0].regarding.name == "p0"
+
+
+def test_failed_scheduling_event_carries_fit_error():
+    api, sched, _ = _mk()
+    api.create_node(
+        Node(
+            name="n0",
+            labels={"kubernetes.io/hostname": "n0"},
+            capacity=Resource.from_map({"cpu": "1", "memory": "1Gi"}),
+        )
+    )
+    api.create_pod(
+        Pod(name="huge", containers=[Container(requests={"cpu": "64"})])
+    )
+    sched.schedule_pending()
+    evs = api.list_events("FailedScheduling")
+    assert len(evs) == 1
+    assert evs[0].event_type == "Warning"
+    assert "0/1 nodes are available" in evs[0].note
+    assert "insufficient resources" in evs[0].note
+
+
+def test_failed_scheduling_aggregates_retries():
+    api, sched, now = _mk()
+    api.create_node(
+        Node(
+            name="n0",
+            labels={"kubernetes.io/hostname": "n0"},
+            capacity=Resource.from_map({"cpu": "1", "memory": "1Gi"}),
+        )
+    )
+    api.create_pod(
+        Pod(name="huge", containers=[Container(requests={"cpu": "64"})])
+    )
+    for _ in range(3):
+        sched.schedule_pending()
+        now[0] += 400  # past the unschedulable-timeout flush
+    evs = api.list_events("FailedScheduling")
+    assert len(evs) == 1  # correlated series, not one event per retry
+    assert evs[0].count >= 2
+
+
+def test_preempted_event_on_victim():
+    api, sched, now = _mk()
+    api.create_node(
+        Node(
+            name="n0",
+            labels={"kubernetes.io/hostname": "n0"},
+            capacity=Resource.from_map({"cpu": "4", "memory": "8Gi"}),
+        )
+    )
+    api.create_pod(
+        Pod(
+            name="victim",
+            node_name="n0",
+            priority=0,
+            containers=[Container(requests={"cpu": "3500m"})],
+        )
+    )
+    api.create_pod(
+        Pod(
+            name="hi",
+            priority=100,
+            containers=[Container(requests={"cpu": "3"})],
+        )
+    )
+    sched.schedule_pending()
+    evs = api.list_events("Preempted")
+    assert len(evs) == 1
+    assert evs[0].regarding.name == "victim"
+    assert "n0" in evs[0].note
+    assert evs[0].related is not None and evs[0].related.name == "hi"
+    # the preemptor also got a FailedScheduling for the attempt
+    assert api.list_events("FailedScheduling")

@@ -112,7 +112,9 @@ def test_broken_linear_matches_reference_semantics():
     assert broken_linear(pts, 150) == 100
 
 
-def test_unsupported_resource_spec_rejected():
+def test_extended_resource_spec_scored_host_side():
+    """resources beyond cpu/memory are accepted (resource_allocation.go
+    handles arbitrary resources) and flip the plugin to host scoring."""
     profile = cfg.Profile(
         plugin_config={
             "NodeResourcesFit": {
@@ -123,5 +125,107 @@ def test_unsupported_resource_spec_rejected():
             }
         }
     )
-    with pytest.raises(ValueError):
-        Scheduler(configuration=cfg.SchedulerConfiguration(profiles=[profile]))
+    sched = Scheduler(configuration=cfg.SchedulerConfiguration(profiles=[profile]))
+    inst = next(iter(sched.profiles.values()))._instances["NodeResourcesFit"]
+    assert inst.device_score is False
+
+
+class TestExtendedResourceScoring:
+    """scoringStrategy.resources beyond cpu/memory
+    (resource_allocation.go:37-115 scores arbitrary resources, including
+    scalars); such configs route scoring through the exact host path."""
+
+    def _gpu_sched(self, strategy="MostAllocated"):
+        pc = {
+            "scoringStrategy": {
+                "type": strategy,
+                "resources": [{"name": "example.com/gpu", "weight": 5}],
+            }
+        }
+        profile = cfg.Profile(plugin_config={"NodeResourcesFit": pc})
+        sched = Scheduler(
+            configuration=cfg.SchedulerConfiguration(profiles=[profile])
+        )
+        bindings = {}
+        sched.binding_sink = lambda pod, node: bindings.__setitem__(
+            pod.name, node
+        )
+        return sched, bindings
+
+    def test_extended_resource_config_accepted(self):
+        sched, _ = self._gpu_sched()
+        inst = next(iter(sched.profiles.values()))._instances["NodeResourcesFit"]
+        assert inst.device_score is False
+        assert ("example.com/gpu", 5) in inst.fit_resources
+
+    def test_most_allocated_packs_onto_fuller_gpu_node(self):
+        sched, bindings = self._gpu_sched("MostAllocated")
+        for name, used in (("g0", 6), ("g1", 1)):
+            sched.on_node_add(
+                Node(
+                    name=name,
+                    labels={"kubernetes.io/hostname": name},
+                    capacity=Resource.from_map(
+                        {"cpu": "16", "memory": "64Gi", "example.com/gpu": 8}
+                    ),
+                )
+            )
+            for v in range(used):
+                sched.on_pod_add(
+                    Pod(
+                        name=f"f-{name}-{v}",
+                        node_name=name,
+                        containers=[
+                            Container(requests={"example.com/gpu": 1})
+                        ],
+                    )
+                )
+        sched.on_pod_add(
+            Pod(
+                name="want-gpu",
+                containers=[
+                    Container(
+                        requests={
+                            "cpu": "100m",
+                            "memory": "64Mi",
+                            "example.com/gpu": 1,
+                        }
+                    )
+                ],
+            )
+        )
+        outs = sched.schedule_pending()
+        assert bindings["want-gpu"] == "g0", outs  # MostAllocated packs
+
+    def test_least_allocated_spreads_off_fuller_gpu_node(self):
+        sched, bindings = self._gpu_sched("LeastAllocated")
+        for name, used in (("g0", 6), ("g1", 1)):
+            sched.on_node_add(
+                Node(
+                    name=name,
+                    labels={"kubernetes.io/hostname": name},
+                    capacity=Resource.from_map(
+                        {"cpu": "16", "memory": "64Gi", "example.com/gpu": 8}
+                    ),
+                )
+            )
+            for v in range(used):
+                sched.on_pod_add(
+                    Pod(
+                        name=f"f-{name}-{v}",
+                        node_name=name,
+                        containers=[
+                            Container(requests={"example.com/gpu": 1})
+                        ],
+                    )
+                )
+        sched.on_pod_add(
+            Pod(
+                name="want-gpu",
+                containers=[
+                    Container(requests={"example.com/gpu": 1})
+                ],
+            )
+        )
+        sched.schedule_pending()
+        assert bindings["want-gpu"] == "g1"

@@ -1,0 +1,196 @@
+"""PreFilter AddPod/RemovePod extensions (interface.go:443-520).
+
+An out-of-tree stateful plugin keeps per-cycle counts in CycleState; the
+scheduler must notify it when the evaluated view is hypothetically
+modified: nominated pods counted as placed (runtime/framework.go:973) and
+preemption dry-run victim removal/reprieve (preemption.go:548).  The
+plugin here enforces "at most ``cap`` pods matching label team=x per
+node" purely through its extension-maintained counts, so wrong/missing
+notifications change scheduling outcomes visibly.
+"""
+
+from kubernetes_tpu.api.resource import Resource
+from kubernetes_tpu.api.types import Container, Node, Pod
+from kubernetes_tpu.framework import config as cfg
+from kubernetes_tpu.framework.interface import (
+    CycleState,
+    FilterPlugin,
+    PreFilterExtensions,
+    PreFilterPlugin,
+    Status,
+)
+from kubernetes_tpu.scheduler import Scheduler
+
+
+class _Counts:
+    """Clonable per-cycle state (CycleState.clone calls .clone())."""
+
+    def __init__(self, per_node=None):
+        self.per_node = dict(per_node or {})
+
+    def clone(self):
+        return _Counts(self.per_node)
+
+
+class TeamQuota(PreFilterPlugin, FilterPlugin, PreFilterExtensions):
+    """Max ``cap`` team=x pods per node, counted via extensions only."""
+
+    name = "TeamQuota"
+    calls: list
+
+    def __init__(self, args=None, handle=None, cap=1):
+        self.args = args or {}
+        self.handle = handle
+        self.cap = cap
+        type(self).calls = []
+
+    @staticmethod
+    def _team(pod):
+        return pod.labels.get("team")
+
+    def pre_filter(self, state: CycleState, pod: Pod) -> Status:
+        # seed counts from currently placed pods
+        counts = {}
+        st = self.handle.oracle_state()
+        for ns in st.nodes.values():
+            c = sum(1 for p in ns.pods if self._team(p) == "x")
+            if c:
+                counts[ns.node.name] = c
+        state.write(("team_counts", pod.uid), _Counts(counts))
+        return Status.success()
+
+    def pre_filter_extensions(self):
+        return self
+
+    def add_pod(self, state, pod_to_schedule, pod_to_add, node_state) -> Status:
+        type(self).calls.append(("add", pod_to_add.name, node_state.node.name))
+        if self._team(pod_to_add) == "x":
+            c = state.read(("team_counts", pod_to_schedule.uid))
+            if c is not None:
+                name = node_state.node.name
+                c.per_node[name] = c.per_node.get(name, 0) + 1
+        return Status.success()
+
+    def remove_pod(self, state, pod_to_schedule, pod_to_remove, node_state) -> Status:
+        type(self).calls.append(
+            ("remove", pod_to_remove.name, node_state.node.name)
+        )
+        if self._team(pod_to_remove) == "x":
+            c = state.read(("team_counts", pod_to_schedule.uid))
+            if c is not None:
+                name = node_state.node.name
+                c.per_node[name] = c.per_node.get(name, 0) - 1
+        return Status.success()
+
+    def maybe_relevant(self, pod: Pod) -> bool:
+        return self._team(pod) == "x"
+
+    def filter(self, state: CycleState, pod: Pod, node_state) -> Status:
+        if self._team(pod) != "x":
+            return Status.success()
+        c = state.read(("team_counts", pod.uid))
+        n = c.per_node.get(node_state.node.name, 0) if c else 0
+        if n >= self.cap:
+            return Status.unschedulable(
+                "team quota exhausted", plugin=self.name
+            )
+        return Status.success()
+
+
+def _mk(cap=1):
+    from kubernetes_tpu.framework.registry import default_registry
+
+    reg = default_registry()
+    reg.register("TeamQuota", lambda args, handle: TeamQuota(args, handle, cap=cap))
+    profile = cfg.Profile(
+        plugins=cfg.Plugins(
+            pre_filter=cfg.PluginSet(enabled=[cfg.PluginRef("TeamQuota")]),
+            filter=cfg.PluginSet(enabled=[cfg.PluginRef("TeamQuota")]),
+        )
+    )
+    now = [1000.0]
+    sched = Scheduler(
+        configuration=cfg.SchedulerConfiguration(profiles=[profile]),
+        registry=reg,
+        clock=lambda: now[0],
+    )
+    bindings = {}
+    sched.binding_sink = lambda pod, node: bindings.__setitem__(pod.name, node)
+    sched.pod_deleter = lambda pod: sched.on_pod_delete(pod)
+    return sched, bindings, now
+
+
+def _node(name, cpu="4"):
+    return Node(
+        name=name,
+        labels={"kubernetes.io/hostname": name},
+        capacity=Resource.from_map({"cpu": cpu, "memory": "8Gi"}),
+    )
+
+
+def test_preemption_dry_run_notifies_remove_and_reprieve():
+    """A team=x victim's removal must be visible to the quota plugin:
+    preempting onto the node is only deemed helpful because RemovePod
+    decremented the count."""
+    sched, bindings, now = _mk(cap=1)
+    sched.on_node_add(_node("n0"))
+    # occupy: one low-priority team=x pod filling the quota AND the cpu
+    sched.on_pod_add(
+        Pod(
+            name="victim",
+            node_name="n0",
+            priority=0,
+            labels={"team": "x"},
+            containers=[Container(requests={"cpu": "3500m"})],
+        )
+    )
+    sched.on_pod_add(
+        Pod(
+            name="hi",
+            priority=100,
+            labels={"team": "x"},
+            containers=[Container(requests={"cpu": "3"})],
+        )
+    )
+    outs = sched.schedule_pending()
+    assert outs[0].node is None
+    # preemption nominated n0 — possible only if the dry-run saw the
+    # victim's RemovePod (else the quota filter keeps rejecting n0)
+    assert outs[0].pod.nominated_node_name == "n0"
+    assert ("remove", "victim", "n0") in TeamQuota.calls
+    # TeamQuota registers no queueing hints, so the pod resurfaces via the
+    # unschedulable-timeout flush (scheduling_queue.go:63) — advance past it
+    now[0] += 400
+    sched.schedule_pending()
+    assert bindings.get("hi") == "n0"
+
+
+def test_nominated_pods_notify_add():
+    """A nominated preemptor of higher priority counts as placed during
+    another pod's feasibility check — via the AddPod extension."""
+    sched, bindings, now = _mk(cap=1)
+    sched.on_node_add(_node("n0"))
+    sched.on_node_add(_node("n1"))
+    # hi-prio preemptor nominated on n0 (registered directly)
+    nominated = Pod(
+        name="nominated",
+        priority=50,
+        labels={"team": "x"},
+        containers=[Container(requests={"cpu": "100m"})],
+    )
+    nominated.nominated_node_name = "n0"
+    sched.nominator.add(nominated, "n0")
+    TeamQuota.calls = []
+    # a lower-priority team=x pod: n0 is full (nominated counts), so it
+    # must land on n1 — only reachable through the AddPod notification
+    sched.on_pod_add(
+        Pod(
+            name="newcomer",
+            priority=0,
+            labels={"team": "x"},
+            containers=[Container(requests={"cpu": "100m"})],
+        )
+    )
+    outs = sched.schedule_pending()
+    assert bindings.get("newcomer") == "n1", (outs[0].status, TeamQuota.calls)
+    assert any(c[0] == "add" and c[1] == "nominated" for c in TeamQuota.calls)
