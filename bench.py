@@ -439,6 +439,23 @@ def main():
             f"scan={s5.metrics['scan_batches']})",
             file=sys.stderr,
         )
+        # config6: kubemark-style FULL-STACK sim — hollow nodes + churn
+        # through HTTP list/watch + reflector + SchedulerServer loop (the
+        # shape the reference measures with a real apiserver; its closest
+        # CI floor is SchedulingBasic 270 pods/s end to end)
+        from kubernetes_tpu.tools.kubemark import run_scale_sim
+
+        km = run_scale_sim(n_nodes=5000, n_pods=5000, churn_waves=4)
+        configs["config6_kubemark_http_5000n_5000p"] = round(km.pods_per_s, 1)
+        configs["config6_kubemark_p99_attempt_ms"] = round(
+            km.p99_attempt_s * 1000, 2
+        )
+        print(
+            f"# config6 kubemark(http): {km.pods_bound} pods in {km.wall_s:.2f}s "
+            f"(reg {km.n_nodes} nodes {km.registration_s:.1f}s, "
+            f"p99 attempt {km.p99_attempt_s * 1000:.2f} ms)",
+            file=sys.stderr,
+        )
 
     print(
         json.dumps(

@@ -176,8 +176,11 @@ class ApiServer:
                             return self._json(
                                 404, {"error": f"pod {uid} not found"}
                             )
-                        if server.api.bindings.get(uid):
-                            return self._json(409, {"error": "pod already bound"})
+                        # the store's CAS is the authority (assignPod,
+                        # storage.go:254): a conflicting node → 409, a
+                        # same-node rebind is idempotent — which makes the
+                        # client's transport-level POST retry safe when the
+                        # first attempt succeeded but the response was lost
                         try:
                             server.api.bind(pod, body["node"])
                         except RuntimeError as e:
@@ -226,8 +229,14 @@ class ApiServer:
                     return self._json(200, {"ok": True})
                 return self._json(404, {"error": "not found"})
 
-        self.http = ThreadingHTTPServer((host, port), Handler)
-        self.http.daemon_threads = True
+        class _Server(ThreadingHTTPServer):
+            # registration storms open many sockets faster than accept()
+            # drains them while the scheduler compiles — the default
+            # backlog of 5 RSTs the overflow
+            request_queue_size = 256
+            daemon_threads = True
+
+        self.http = _Server((host, port), Handler)
         self.port = self.http.server_address[1]
         self._thread: Optional[threading.Thread] = None
 
@@ -241,10 +250,11 @@ class ApiServer:
         (reflector lists at this rv, then watches from it)."""
         cache = self.caches[res]
         with cache.cond:
-            if res == "nodes":
-                items = [encode(n) for n in self.api.nodes.values()]
-            else:
-                items = [encode(p) for p in self.api.pods.values()]
+            # dict.copy() is atomic under the GIL — handler threads mutate
+            # the store concurrently and bare .values() iteration would
+            # raise "dictionary changed size during iteration"
+            store = self.api.nodes if res == "nodes" else self.api.pods
+            items = [encode(obj) for obj in store.copy().values()]
             return {"resourceVersion": cache.rv, "items": items}
 
     # ----- lifecycle --------------------------------------------------------

@@ -18,9 +18,11 @@ client-go's machinery mapped onto this build:
 
 from __future__ import annotations
 
+import http.client
 import json
 import threading
 import urllib.error
+import urllib.parse
 import urllib.request
 from typing import Callable, Dict, List, Optional
 from urllib.parse import quote
@@ -36,25 +38,55 @@ class ApiError(RuntimeError):
 
 
 class ApiClient:
-    """Thin REST client (the generated clientset analogue)."""
+    """Thin REST client (the generated clientset analogue).  Requests ride
+    a THREAD-LOCAL keep-alive connection — per-request TCP setup halves
+    full-stack throughput at kubemark scale (client-go pools HTTP/2
+    streams for the same reason)."""
 
     def __init__(self, endpoint: str, timeout: float = 10.0):
         self.endpoint = endpoint.rstrip("/")
         self.timeout = timeout
+        parsed = urllib.parse.urlparse(self.endpoint)
+        self._host = parsed.hostname or "127.0.0.1"
+        self._port = parsed.port or 80
+        self._local = threading.local()
+
+    def _conn(self, fresh: bool = False):
+        conn = getattr(self._local, "conn", None)
+        if conn is None or fresh:
+            if conn is not None:
+                conn.close()
+            conn = http.client.HTTPConnection(
+                self._host, self._port, timeout=self.timeout
+            )
+            self._local.conn = conn
+        return conn
 
     def _req(self, method: str, path: str, payload=None):
         data = json.dumps(payload).encode() if payload is not None else None
-        req = urllib.request.Request(
-            self.endpoint + path,
-            data=data,
-            method=method,
-            headers={"Content-Type": "application/json"},
-        )
-        try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                return json.loads(resp.read() or b"{}")
-        except urllib.error.HTTPError as e:
-            raise ApiError(e.code, e.read().decode(errors="replace")) from e
+        headers = {"Content-Type": "application/json"}
+        # Transport-level failures (keep-alive gone stale, backlog
+        # overflow RST during bursts) retry on a fresh connection with
+        # backoff — client-go's rest client does the same; API-level
+        # errors surface immediately.
+        last: Exception = RuntimeError("unreachable")
+        for attempt in range(4):
+            try:
+                conn = self._conn(fresh=attempt > 0)
+                conn.request(method, path, body=data, headers=headers)
+                resp = conn.getresponse()
+                body = resp.read() or b"{}"
+                if resp.status >= 400:
+                    raise ApiError(resp.status, body.decode(errors="replace"))
+                return json.loads(body)
+            except ApiError:
+                raise
+            except (ConnectionError, OSError, http.client.HTTPException) as e:
+                last = e
+                import time as _time
+
+                _time.sleep(0.05 * (2**attempt))
+        raise last
 
     # reads
     def list(self, resource: str) -> dict:

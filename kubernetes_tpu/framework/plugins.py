@@ -253,9 +253,9 @@ class NodeResourcesFit(DevicePluginMixin, FilterPlugin, ScorePlugin, EnqueueExte
     """noderesources/fit.go with all three scoring strategies
     (LeastAllocated default, MostAllocated, RequestedToCapacityRatio —
     requested_to_capacity_ratio.go:32).  Strategy parameters flow into the
-    device dispatch as static args (Framework.fit_strategy); resource specs
-    beyond cpu/memory are rejected up front rather than silently diverging
-    between the host and device paths."""
+    device dispatch as static args (Framework.fit_strategy); resource
+    specs beyond cpu/memory flip scoring to the exact host path
+    (device_score=False) instead of diverging on device."""
 
     name = "NodeResourcesFit"
     kernel = "NodeResourcesFit"

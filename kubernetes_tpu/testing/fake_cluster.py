@@ -220,6 +220,9 @@ class FakeCluster:
         pod = self.pods.pop(uid, None)
         if pod is None:
             return
+        # the binding ceases to exist with the pod — bindings is the
+        # CURRENTLY-bound set (the HTTP tier and benches read it as such)
+        self.bindings.pop(uid, None)
         for _, _, delete in self._pod_handlers:
             delete(pod)
 
@@ -300,8 +303,11 @@ class FakeCluster:
 
     def record_event(self, event) -> None:
         """Event sink: aggregated events keep object identity, so the
-        store dedups on the correlator key like the API's series would."""
-        if event not in self.events:
+        store dedups by identity in O(1) (the events stay referenced in
+        self.events, so ids are stable) like the API's series would."""
+        ids = self.__dict__.setdefault("_event_ids", set())
+        if id(event) not in ids:
+            ids.add(id(event))
             self.events.append(event)
 
     def list_events(self, reason: Optional[str] = None) -> List[object]:

@@ -1,0 +1,1 @@
+"""Operational tooling: the kubemark-style scale simulator."""

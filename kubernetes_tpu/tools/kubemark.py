@@ -1,0 +1,239 @@
+"""Kubemark-style scale simulation (SURVEY §4 tier 5).
+
+The reference's kubemark runs thousands of HOLLOW nodes — kubelets with
+mocked runtimes (cmd/kubemark/hollow-node.go, pkg/kubemark/
+hollow_kubelet.go:87) — against a real control plane, so cluster-scale
+behavior is measured without real machines.  This driver is the same
+shape for this build's control plane:
+
+    FakeCluster store ← ApiServer (HTTP list/watch)
+        ← RemoteClusterSource ← Scheduler ← SchedulerServer loop
+
+Hollow nodes register over HTTP from a thread pool (the registration
+storm), then driver threads churn pods — create waves, delete a fraction
+of bound pods — while the SchedulerServer's own loop schedules.
+Steady-state throughput and p99 attempt latency are scraped from the
+SERVED /metrics endpoint (not in-process state), exercising the whole
+observable surface.
+
+Run standalone:  python -m kubernetes_tpu.tools.kubemark --nodes 1000 --pods 2000
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import threading
+import time
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from kubernetes_tpu.api.resource import Resource
+from kubernetes_tpu.api.types import Container, Node, Pod
+
+
+@dataclass
+class ScaleSimResult:
+    n_nodes: int
+    pods_bound: int
+    wall_s: float
+    pods_per_s: float
+    p99_attempt_s: float
+    registration_s: float
+    loop_cycles: int
+
+
+def _parse_histogram_p99(metrics_text: str, name: str) -> float:
+    """Quantile from Prometheus text exposition bucket lines (the
+    histogram_quantile estimate over the aggregated label sets)."""
+    buckets: Dict[float, int] = {}
+    total = 0
+    for line in metrics_text.splitlines():
+        if not line.startswith(name):
+            continue
+        m = re.match(rf'{name}_bucket{{.*le="([^"]+)".*}} (\d+)', line)
+        if m:
+            le = float("inf") if m.group(1) == "+Inf" else float(m.group(1))
+            buckets[le] = buckets.get(le, 0) + int(m.group(2))
+        m = re.match(rf"{name}_count(?:{{.*}})? (\d+)", line)
+        if m:
+            total += int(m.group(1))
+    if not buckets or total == 0:
+        return 0.0
+    rank = 0.99 * total
+    prev_le, prev_cum = 0.0, 0
+    for le in sorted(buckets):
+        cum = buckets[le]
+        if cum >= rank:
+            if le == float("inf"):
+                return prev_le
+            frac = (rank - prev_cum) / max(cum - prev_cum, 1)
+            return prev_le + (le - prev_le) * frac
+        prev_le, prev_cum = le, cum
+    return prev_le
+
+
+def run_scale_sim(
+    n_nodes: int = 1000,
+    n_pods: int = 2000,
+    churn_waves: int = 4,
+    churn_deletes: int = 50,
+    registration_threads: int = 16,
+    timeout_s: float = 600.0,
+    progress=None,
+) -> ScaleSimResult:
+    from kubernetes_tpu.client import ApiClient, ApiServer, RemoteClusterSource
+    from kubernetes_tpu.events import EventBroadcaster
+    from kubernetes_tpu.scheduler import Scheduler
+    from kubernetes_tpu.server import SchedulerServer
+    from kubernetes_tpu.testing.fake_cluster import FakeCluster
+
+    api = FakeCluster(pv_controller=False)
+    apiserver = ApiServer(api).start()
+    endpoint = f"http://127.0.0.1:{apiserver.port}"
+
+    sched = Scheduler(event_broadcaster=EventBroadcaster())
+    sched.event_broadcaster.start_recording_to_sink(api.record_event)
+    # bigger drains per loop pass: pre-size the placed-pod axes once
+    sched.mirror.e_cap_hint = n_pods + sched.config.batch_size + 128
+    source = RemoteClusterSource(endpoint)
+    source.connect(sched)
+    source.start()
+    server = SchedulerServer(sched, poll_interval_s=0.005)
+    server.start()
+
+    def log(msg: str) -> None:
+        if progress:
+            progress(msg)
+
+    try:
+        # ---- hollow node registration storm -----------------------------
+        t_reg = time.perf_counter()
+        reg_client = ApiClient(endpoint)  # thread-local keep-alive per pool thread
+
+        def register(i: int) -> None:
+            reg_client.create_node(
+                Node(
+                    name=f"hollow-{i}",
+                    labels={
+                        "topology.kubernetes.io/zone": f"zone-{i % 3}",
+                        "kubernetes.io/hostname": f"hollow-{i}",
+                    },
+                    capacity=Resource.from_map(
+                        {"cpu": "8", "memory": "32Gi", "pods": 110}
+                    ),
+                )
+            )
+
+        with ThreadPoolExecutor(registration_threads) as ex:
+            list(ex.map(register, range(n_nodes)))
+        source.wait_for_sync()
+        registration_s = time.perf_counter() - t_reg
+        log(f"registered {n_nodes} hollow nodes in {registration_s:.1f}s")
+
+        # ---- pod churn ---------------------------------------------------
+        client = ApiClient(endpoint)
+        uid_counter = [0]
+        uid_lock = threading.Lock()
+
+        def mk_pod() -> Pod:
+            with uid_lock:
+                i = uid_counter[0]
+                uid_counter[0] += 1
+            return Pod(
+                name=f"load-{i}",
+                labels={"app": f"app-{i % 10}"},
+                containers=[
+                    Container(
+                        name="c",
+                        requests={"cpu": "100m", "memory": "128Mi"},
+                    )
+                ],
+            )
+
+        def create_many(k: int) -> None:
+            with ThreadPoolExecutor(registration_threads) as ex:
+                list(ex.map(lambda _: client.create_pod(mk_pod()), range(k)))
+
+        # warm wave (compile shapes) excluded from measurement
+        warm = min(max(sched.config.batch_size + 64, 256), n_pods // 2)
+        create_many(warm)
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline and len(api.bindings) < warm:
+            time.sleep(0.05)
+        log(f"warm phase: {len(api.bindings)} bound")
+
+        t0 = time.perf_counter()
+        bound_at_start = len(api.bindings)
+        remaining = n_pods - warm
+        per_wave = remaining // churn_waves
+        for w in range(churn_waves):
+            create_many(per_wave if w < churn_waves - 1 else remaining - per_wave * (churn_waves - 1))
+            # churn: delete some bound pods (capacity freed, watch events)
+            victims = list(api.bindings)[:churn_deletes]
+            for uid in victims:
+                try:
+                    client.delete_pod(uid)
+                except Exception:  # noqa: BLE001 — racing the scheduler
+                    pass
+            target = warm + per_wave * (w + 1) - churn_deletes * (w + 1)
+            while time.monotonic() < deadline and len(api.bindings) < target:
+                time.sleep(0.05)
+            log(f"wave {w}: {len(api.bindings)} bound")
+        # settle: all created pods either bound or deleted
+        expect = uid_counter[0] - churn_deletes * churn_waves
+        while time.monotonic() < deadline and len(api.bindings) < expect:
+            time.sleep(0.05)
+        wall = time.perf_counter() - t0
+        pods_bound = len(api.bindings) - bound_at_start
+
+        # ---- scrape the served /metrics ---------------------------------
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/metrics", timeout=10
+        ) as resp:
+            text = resp.read().decode()
+        p99 = _parse_histogram_p99(
+            text, "scheduler_scheduling_attempt_duration_seconds"
+        )
+        return ScaleSimResult(
+            n_nodes=n_nodes,
+            pods_bound=pods_bound,
+            wall_s=wall,
+            pods_per_s=pods_bound / max(wall, 1e-9),
+            p99_attempt_s=p99,
+            registration_s=registration_s,
+            loop_cycles=server.cycles,
+        )
+    finally:
+        server.stop()
+        source.stop()
+        apiserver.stop()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(prog="kubemark-sim")
+    ap.add_argument("--nodes", type=int, default=1000)
+    ap.add_argument("--pods", type=int, default=2000)
+    ap.add_argument("--waves", type=int, default=4)
+    args = ap.parse_args(argv)
+    res = run_scale_sim(args.nodes, args.pods, churn_waves=args.waves, progress=print)
+    print(
+        json.dumps(
+            {
+                "nodes": res.n_nodes,
+                "pods_bound": res.pods_bound,
+                "wall_s": round(res.wall_s, 2),
+                "pods_per_s": round(res.pods_per_s, 1),
+                "p99_attempt_s": round(res.p99_attempt_s, 4),
+                "registration_s": round(res.registration_s, 2),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,34 @@
+"""Kubemark-style scale sim: hollow nodes + pod churn through the HTTP
+client tier against the full SchedulerServer loop (SURVEY §4 tier 5)."""
+
+from kubernetes_tpu.tools.kubemark import _parse_histogram_p99, run_scale_sim
+
+
+def test_scale_sim_end_to_end():
+    res = run_scale_sim(
+        n_nodes=150, n_pods=300, churn_waves=2, churn_deletes=10, timeout_s=300
+    )
+    assert res.n_nodes == 150
+    # warm excluded; churn deleted 20 of the bound pods
+    assert res.pods_bound > 0
+    assert res.pods_per_s > 0
+    assert res.loop_cycles >= 1
+    # p99 scraped from the SERVED /metrics text, not in-process state
+    assert res.p99_attempt_s > 0
+
+
+def test_histogram_p99_parser():
+    text = "\n".join(
+        [
+            'scheduler_scheduling_attempt_duration_seconds_bucket{result="scheduled",le="0.001"} 0',
+            'scheduler_scheduling_attempt_duration_seconds_bucket{result="scheduled",le="0.01"} 90',
+            'scheduler_scheduling_attempt_duration_seconds_bucket{result="scheduled",le="0.1"} 100',
+            'scheduler_scheduling_attempt_duration_seconds_bucket{result="scheduled",le="+Inf"} 100',
+            'scheduler_scheduling_attempt_duration_seconds_sum{result="scheduled"} 1.0',
+            'scheduler_scheduling_attempt_duration_seconds_count{result="scheduled"} 100',
+        ]
+    )
+    p99 = _parse_histogram_p99(
+        text, "scheduler_scheduling_attempt_duration_seconds"
+    )
+    assert 0.01 < p99 <= 0.1
