@@ -91,6 +91,11 @@ class Cache:
         self.pod_version = 0
         self.n_term_pods = 0  # placed pods carrying (anti-)affinity terms
         self.n_port_pods = 0  # placed pods using host ports
+        # registry of the term-carrying placed pods themselves: the fast
+        # path's per-batch gate asks "could any placed term admit this
+        # pod" instead of disabling itself cluster-globally
+        self.term_pods: Dict[str, Pod] = {}
+        self.term_version = 0
 
     @staticmethod
     def _pod_flags(pod: Pod) -> Tuple[bool, bool]:
@@ -105,6 +110,11 @@ class Cache:
         has_terms, has_ports = self._pod_flags(pod)
         if has_terms:
             self.n_term_pods += sign
+            self.term_version += 1
+            if sign > 0:
+                self.term_pods[pod.uid] = pod
+            else:
+                self.term_pods.pop(pod.uid, None)
         if has_ports:
             self.n_port_pods += sign
 

@@ -848,9 +848,7 @@ class Scheduler:
             if (
                 not active_host
                 and not active_scores
-                and not len(self.nominator)
-                and self.cache.n_term_pods == 0
-                and self.cache.n_port_pods == 0
+                and self._fast_gate_ok(batch)
                 # the signature committer assumes the default fit scoring,
                 # full-width evaluation, and first-max tie-break
                 and fwk.fit_strategy() == gang.DEFAULT_FIT_STRATEGY
@@ -918,7 +916,7 @@ class Scheduler:
             host_diags = host_plugin_sets = None
             if active_host:
                 extra_mask, host_diags, host_plugin_sets = self._host_filter_mask(
-                    fwk, state, pods, p_cap
+                    fwk, state, pods, p_cap, db=db, enabled=enabled
                 )
 
             # 1b'. host-backed Score plugins → pre-weighted additive [P, N]
@@ -1124,9 +1122,7 @@ class Scheduler:
         # the keys computed here are memoized for _try_fast_schedule so the
         # per-pod signature work runs ONCE per batch, not twice
         if (
-            not len(self.nominator)
-            and self.cache.n_term_pods == 0
-            and self.cache.n_port_pods == 0
+            self._fast_gate_ok(batch)
             and fwk.fit_strategy() == gang.DEFAULT_FIT_STRATEGY
         ):
             keys = self._batch_signature_keys(batch)
@@ -1147,6 +1143,53 @@ class Scheduler:
             self._external_mutations,
             getattr(self, "_nonfast_commits", 0),
         )
+
+    def _fast_gate_ok(self, batch) -> bool:
+        """Per-batch fast-path eligibility, replacing the old cluster-global
+        gates: nominations and placed (anti-)affinity terms only poison the
+        pods they can actually touch.
+
+        * nominations count as present only for pods of priority <= the
+          nomination's (runtime:973): if every batch pod outranks every
+          nomination, the signature committer's capacity view is exact;
+        * a placed pod's required anti-affinity (and symmetric term score)
+          affects only newcomers its term selectors ADMIT — checked per
+          batch label-group against the cache's term-pod registry;
+        * placed host-port users never constrain port-FREE pods (and port
+          users are already signature-ineligible), so no port gate at all.
+        """
+        if len(self.nominator):
+            max_nom = max(p.priority for _, p in self.nominator.entries())
+            if any(qp.pod.priority <= max_nom for qp in batch):
+                return False
+        n_t = self.cache.n_term_pods
+        if n_t:
+            if n_t > 64:
+                # probe checks would cost more than the scan saves
+                return False
+            from kubernetes_tpu.waves import _pod_probes
+
+            key = self.cache.term_version
+            cached = getattr(self, "_term_probe_cache", None)
+            if cached is None or cached[0] != key:
+                probes = []
+                for p in self.cache.term_pods.values():
+                    probes.extend(_pod_probes(p))
+                cached = self._term_probe_cache = (key, probes)
+            probes = cached[1]
+            seen: Dict[tuple, bool] = {}
+            for qp in batch:
+                gk = (
+                    qp.pod.namespace,
+                    tuple(sorted(qp.pod.labels.items())),
+                )
+                hit = seen.get(gk)
+                if hit is None:
+                    hit = any(pr.admits(qp.pod) for pr in probes)
+                    seen[gk] = hit
+                if hit:
+                    return False
+        return True
 
     def _sync_mirror_external(self) -> None:
         """Repack the host mirror only when state the FAST path reads could
@@ -2024,9 +2067,16 @@ class Scheduler:
             nom_req[i] = req
         return jnp.asarray(nom_node), jnp.asarray(nom_prio), jnp.asarray(nom_req)
 
-    def _host_filter_mask(self, fwk, state, pods, p_cap: int):
+    def _host_filter_mask(self, fwk, state, pods, p_cap: int, db=None, enabled=None):
         """[p_cap, N] bool: True where host Filter plugins allow the pair
         (the post-device-veto path of runtime:861 for host-backed plugins).
+
+        The walk is NARROWED to nodes surviving the device static filters
+        (one static_eval dispatch): statically-dead nodes are rejected by
+        the device mask regardless, and the reference's per-node filter
+        chain early-exits before host plugins there too — so skipping them
+        both matches reason attribution and turns the O(pods × all-nodes)
+        plugin-call storm into O(pods × surviving-nodes).
 
         Also returns per-pod failure detail for Diagnosis fidelity
         (types.go:367): ``diags[i]`` maps reason-string → node count and
@@ -2042,6 +2092,22 @@ class Scheduler:
             st.nodes.get(nt.names[j]) if j < len(nt.names) else None
             for j in range(n_cap)
         ]
+        candidates = None
+        if db is not None and len(pods) * n_cap >= 4096:
+            try:
+                from kubernetes_tpu.ops import fastpath as ops_fp
+
+                res = ops_fp.static_eval(
+                    self._static_device_cluster(),
+                    db,
+                    enabled=enabled
+                    if enabled is not None
+                    else fwk.device_enabled(),
+                    has_images=False,
+                )
+                candidates = np.asarray(jax.device_get(res["mask"]))
+            except Exception:  # noqa: BLE001 — narrowing is best-effort
+                candidates = None
         diags: List[Dict[str, int]] = [dict() for _ in pods]
         plugin_sets: List[set] = [set() for _ in pods]
         for i, pod in enumerate(pods):
@@ -2063,6 +2129,8 @@ class Scheduler:
                 for j, ns in enumerate(node_states):
                     if ns is None or not nt.valid[j]:
                         continue
+                    if candidates is not None and not candidates[i, j]:
+                        continue  # statically dead — device mask rejects it
                     s = fwk.run_host_filters(state, pod, ns)
                     if not s.ok:
                         mask[i, j] = False
@@ -2088,6 +2156,7 @@ class Scheduler:
                         or not nt.valid[j]
                         or not mask[i, j]
                         or ns.node.name not in nom_nodes
+                        or (candidates is not None and not candidates[i, j])
                     ):
                         continue
                     s = fwk.run_host_filters(state, pod, ns)

@@ -1,0 +1,168 @@
+"""Per-batch fast-path eligibility: nominations and placed term pods only
+poison the pods they can actually touch (round-3 weak #7) — one gang pod
+in a big plain drain must NOT degrade every batch to the scan path."""
+
+import random
+
+from kubernetes_tpu.api.resource import Resource
+from kubernetes_tpu.api.types import (
+    Affinity,
+    Container,
+    LabelSelector,
+    Node,
+    Pod,
+    PodAffinityTerm,
+    PodAntiAffinity,
+)
+from kubernetes_tpu.scheduler import Scheduler
+
+
+def _nodes(n):
+    return [
+        Node(
+            name=f"n{i}",
+            labels={
+                "topology.kubernetes.io/zone": f"z{i % 3}",
+                "kubernetes.io/hostname": f"n{i}",
+            },
+            capacity=Resource.from_map({"cpu": "8", "memory": "32Gi", "pods": 110}),
+        )
+        for i in range(n)
+    ]
+
+
+def _plain(i):
+    return Pod(
+        name=f"p{i}",
+        labels={"app": f"app-{i % 5}"},
+        containers=[Container(name="c", requests={"cpu": "100m", "memory": "64Mi"})],
+    )
+
+
+def _anti_pod(name, group="solo", node_name=""):
+    return Pod(
+        name=name,
+        labels={"g": group},
+        node_name=node_name,
+        affinity=Affinity(
+            pod_anti_affinity=PodAntiAffinity(
+                required_during_scheduling_ignored_during_execution=(
+                    PodAffinityTerm(
+                        topology_key="kubernetes.io/hostname",
+                        label_selector=LabelSelector(match_labels={"g": group}),
+                    ),
+                )
+            )
+        ),
+        containers=[Container(name="c", requests={"cpu": "50m"})],
+    )
+
+
+def _mk():
+    sched = Scheduler()
+    bindings = {}
+    sched.binding_sink = lambda pod, node: bindings.__setitem__(pod.name, node)
+    for n in _nodes(20):
+        sched.on_node_add(n)
+    return sched, bindings
+
+
+def test_placed_term_pod_does_not_poison_unrelated_batches():
+    sched, bindings = _mk()
+    # one placed gang pod with anti-affinity (the poison of round 3)
+    sched.on_pod_add(_anti_pod("gang", node_name="n0"))
+    assert sched.cache.n_term_pods == 1
+    for i in range(64):
+        sched.on_pod_add(_plain(i))
+    sched.schedule_pending()
+    assert len(bindings) == 64
+    assert sched.metrics["fast_batches"] >= 1, sched.metrics
+
+
+def test_term_matching_batch_pods_still_take_the_exact_path():
+    sched, bindings = _mk()
+    sched.on_pod_add(_anti_pod("gang", node_name="n0"))
+    # batch pods the placed term ADMITS (labels g=solo): the fast gate
+    # must refuse, and anti-affinity must be honored exactly
+    for i in range(4):
+        sched.on_pod_add(
+            Pod(
+                name=f"s{i}",
+                labels={"g": "solo"},
+                containers=[Container(name="c", requests={"cpu": "50m"})],
+            )
+        )
+    sched.schedule_pending()
+    assert sched.metrics["fast_batches"] == 0, sched.metrics
+    # n0 hosts the placed anti pod — no solo-labeled pod may land there
+    assert all(bindings[f"s{i}"] != "n0" for i in range(4)), bindings
+
+
+def test_low_priority_nomination_does_not_poison_higher_priority_batch():
+    sched, bindings = _mk()
+    nominated = Pod(
+        name="nom",
+        priority=0,
+        containers=[Container(name="c", requests={"cpu": "100m"})],
+    )
+    nominated.nominated_node_name = "n0"
+    sched.nominator.add(nominated, "n0")
+    for i in range(32):
+        p = _plain(i)
+        p.priority = 100  # outranks the nomination -> it never counts
+        sched.on_pod_add(p)
+    sched.schedule_pending()
+    assert len(bindings) == 32
+    assert sched.metrics["fast_batches"] >= 1, sched.metrics
+
+
+def test_equal_priority_nomination_poisons_the_batch():
+    sched, bindings = _mk()
+    nominated = Pod(
+        name="nom",
+        priority=50,
+        containers=[Container(name="c", requests={"cpu": "100m"})],
+    )
+    nominated.nominated_node_name = "n0"
+    sched.nominator.add(nominated, "n0")
+    for i in range(8):
+        p = _plain(i)
+        p.priority = 50  # nomination counts as present for these
+        sched.on_pod_add(p)
+    sched.schedule_pending()
+    assert len(bindings) == 8
+    assert sched.metrics["fast_batches"] == 0, sched.metrics
+
+
+def test_mixed_drain_decisions_match_serial():
+    """Decisions with the per-batch gate active must equal pod-at-a-time
+    scheduling on the same mixed workload."""
+    rng = random.Random(3)
+
+    def workload():
+        pods = [_anti_pod(f"g{i}", group=f"grp{i % 3}") for i in range(6)]
+        pods += [_plain(i) for i in range(40)]
+        rng.shuffle(pods)
+        return pods
+
+    def run(batch_size, pods):
+        from kubernetes_tpu.framework.config import SchedulerConfiguration
+
+        cfg = SchedulerConfiguration()
+        cfg.batch_size = batch_size
+        s = Scheduler(configuration=cfg)
+        got = {}
+        s.binding_sink = lambda pod, node: got.__setitem__(pod.name, node)
+        for n in _nodes(20):
+            s.on_node_add(n)
+        for p in pods:
+            s.on_pod_add(p)
+        s.schedule_pending()
+        return got
+
+    import copy
+
+    pods = workload()
+    batched = run(64, copy.deepcopy(pods))
+    serial = run(1, copy.deepcopy(pods))
+    assert batched == serial
