@@ -140,6 +140,11 @@ class SchedulerConfiguration:
     #     keeps first-max-in-node-order.
     reference_sampling_compat: bool = False
     tie_break_seed: Optional[int] = None
+    # Wave-commit mode for the gang scan ("off" | "on").  Off by default:
+    # benchmarked slower than the classic scan at every wave length on one
+    # v5e chip (see Scheduler._build_wave_slots); the kernel remains for
+    # experimentation and is bit-parity-tested against the classic scan.
+    wave_commit: str = "off"
     # component-base/featuregate tier (pkg/features/kube_features.go) —
     # only the scheduler-relevant gates exist
     feature_gates: Dict[str, bool] = field(
@@ -158,6 +163,8 @@ class SchedulerConfiguration:
             raise ValueError("podMaxBackoffSeconds < podInitialBackoffSeconds")
         if not 0 <= self.percentage_of_nodes_to_score <= 100:
             raise ValueError("percentageOfNodesToScore must be in [0, 100]")
+        if self.wave_commit not in ("off", "on"):
+            raise ValueError('waveCommit must be "off" or "on"')
 
 
 # ---------------------------------------------------------------------------
@@ -383,6 +390,7 @@ def load_config(source) -> SchedulerConfiguration:
         pod_initial_backoff_seconds=d.get("podInitialBackoffSeconds", 1.0),
         pod_max_backoff_seconds=d.get("podMaxBackoffSeconds", 10.0),
         batch_size=d.get("batchSize", 512),
+        wave_commit=d.get("waveCommit", "off"),
     )
     cfg.validate()
     return cfg

@@ -1210,14 +1210,25 @@ class Scheduler:
 
     def _build_wave_slots(self, pods):
         """np [W, S] wave matrix for the gang scan's wave-commit mode, or
-        None when the batch is too interactive to profit (waves would
-        average < 4 pods).  See kubernetes_tpu.waves."""
+        None when wave commit should not engage.  See kubernetes_tpu.waves.
+
+        Wave commit is OFF unless ``config.wave_commit == "on"``: measured
+        on one v5e chip it LOSES to the classic per-pod scan at every wave
+        length tried — 50-pod waves (anti-affinity, 50 groups) ran 968 vs
+        2263 pods/s and even 512-pod whole-batch waves (1000 groups) ran
+        107 vs 2346 pods/s — because the vmapped per-wave heavy refresh
+        does the same total contraction work as the serial scan but with
+        [S, N]-sized intermediates, and its data-dependent (W, S) shapes
+        recompile mid-drain (~28 s each).  The kernel stays available (and
+        bit-parity-tested, tests/test_waves.py) as the substrate for true
+        multi-pod-per-step commit experiments."""
+        if getattr(self.config, "wave_commit", "off") != "on":
+            return None
+        if len(pods) < 16:
+            return None
         import numpy as np
 
         from kubernetes_tpu.waves import WaveBuilder
-
-        if len(pods) < 16:
-            return None
         builder = getattr(self, "_wave_builder", None)
         if builder is None:
             builder = self._wave_builder = WaveBuilder()

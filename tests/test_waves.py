@@ -292,6 +292,7 @@ def test_wave_scheduler_drain_matches_serial_oracle():
 
         cfg = SchedulerConfiguration()
         cfg.batch_size = batch_size
+        cfg.wave_commit = "on"
         s = Scheduler(configuration=cfg)
         got = {}
         s.binding_sink = lambda pod, node: got.__setitem__(pod.name, node)
