@@ -390,7 +390,10 @@ def load_config(source) -> SchedulerConfiguration:
         pod_initial_backoff_seconds=d.get("podInitialBackoffSeconds", 1.0),
         pod_max_backoff_seconds=d.get("podMaxBackoffSeconds", 10.0),
         batch_size=d.get("batchSize", 512),
-        wave_commit=d.get("waveCommit", "off"),
+        # YAML 1.1 parses bare on/off as booleans — accept both spellings
+        wave_commit={True: "on", False: "off"}.get(
+            d.get("waveCommit", "off"), d.get("waveCommit", "off")
+        ),
     )
     cfg.validate()
     return cfg
