@@ -14,6 +14,8 @@ into float32/int32 lanes (see kubernetes_tpu/snapshot).
 
 from __future__ import annotations
 
+import functools
+import math
 import re
 from dataclasses import dataclass, field
 from typing import Dict, Mapping, Optional
@@ -61,9 +63,18 @@ def parse_quantity(s: str | int | float) -> float:
     """Parse a Kubernetes quantity string into a float of base units.
 
     Examples: "100m" → 0.1, "1Gi" → 1073741824, "2" → 2, "1e3" → 1000.
+
+    String parses are memoized: workloads repeat a handful of distinct
+    quantity strings across hundreds of thousands of pods, and the regex
+    parse dominates compute_requests on large drains.
     """
     if isinstance(s, (int, float)):
         return float(s)
+    return _parse_quantity_str(s)
+
+
+@functools.lru_cache(maxsize=8192)
+def _parse_quantity_str(s: str) -> float:
     s = s.strip()
     m = _QUANTITY_RE.match(s)
     if not m:
@@ -84,15 +95,11 @@ def parse_quantity(s: str | int | float) -> float:
 
 def parse_cpu_millis(s: str | int | float) -> int:
     """CPU quantity → integer millicores (ceil, as MilliValue does)."""
-    import math
-
     return int(math.ceil(parse_quantity(s) * 1000 - 1e-9))
 
 
 def parse_int_quantity(s: str | int | float) -> int:
     """Non-CPU quantity → integer base units (ceil)."""
-    import math
-
     return int(math.ceil(parse_quantity(s) - 1e-9))
 
 

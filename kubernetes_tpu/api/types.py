@@ -320,6 +320,17 @@ class Pod:
         self.__dict__["_req_memo"] = total
         return total
 
+    def non_zero_requests(self) -> Resource:
+        """compute_requests() with the spreading defaults floored in
+        (GetNonzeroRequests) — memoized like compute_requests: the cache
+        adds/removes it on every assume/bind/forget."""
+        cached = self.__dict__.get("_nzreq_memo")
+        if cached is not None:
+            return cached
+        total = self.compute_requests().non_zero_defaulted()
+        self.__dict__["_nzreq_memo"] = total
+        return total
+
     def host_ports(self) -> List[ContainerPort]:
         out = []
         for c in self.containers:

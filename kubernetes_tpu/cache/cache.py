@@ -49,9 +49,8 @@ class CachedNode:
     static_generation: int = 0
 
     def add_pod(self, pod: Pod) -> None:
-        req = pod.compute_requests()
-        self.requested.add(req)
-        self.non_zero_requested.add(req.non_zero_defaulted())
+        self.requested.add(pod.compute_requests())
+        self.non_zero_requested.add(pod.non_zero_requests())
         self.pods[pod.uid] = pod
         self.generation = next_generation()
 
@@ -59,9 +58,8 @@ class CachedNode:
         if pod.uid not in self.pods:
             return False
         old = self.pods.pop(pod.uid)
-        req = old.compute_requests()
-        self.requested.sub(req)
-        self.non_zero_requested.sub(req.non_zero_defaulted())
+        self.requested.sub(old.compute_requests())
+        self.non_zero_requested.sub(old.non_zero_requests())
         self.generation = next_generation()
         return True
 

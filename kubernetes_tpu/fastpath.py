@@ -37,6 +37,49 @@ from kubernetes_tpu.snapshot.schema import (
 MAX = 100  # MaxNodeScore
 
 
+def spec_key(pod: Pod):
+    """Content-addressed identity of every spec field signature_key reads —
+    pods stamped from the same template share one entry in the scheduler's
+    spec→signature cache, so the full computation (quantity parsing, lane
+    packing) runs once per distinct spec instead of once per pod.  Returns
+    None when a spec field is unhashable (custom mappings) — callers fall
+    back to the full computation."""
+    try:
+        out = (
+            tuple(
+                (
+                    c.name,
+                    tuple(sorted((c.requests or {}).items())),
+                    c.ports,
+                    c.restart_policy,
+                )
+                for c in pod.containers
+            ),
+            tuple(
+                (
+                    c.name,
+                    tuple(sorted((c.requests or {}).items())),
+                    c.ports,
+                    c.restart_policy,
+                )
+                for c in pod.init_containers
+            ),
+            tuple(sorted((pod.overhead or {}).items())),
+            pod.tolerations,
+            tuple(sorted(pod.node_selector.items())),
+            pod.affinity,
+            pod.images,
+            pod.node_name,
+            bool(pod.nominated_node_name),
+            bool(pod.topology_spread_constraints),
+            pod.host_network,
+        )
+        hash(out)  # selectors etc. hold dicts — probe before caching on it
+        return out
+    except TypeError:
+        return None
+
+
 def signature_key(pod: Pod, lanes: ResourceLanes, n_lanes: int):
     """Hashable identity of everything that affects a pod's row in the
     resource-only pipeline; None when the pod is not fast-path eligible
@@ -73,12 +116,10 @@ class Signature:
     all_zero: bool
     static_ok: np.ndarray  # bool [N]
     img: Optional[List[int]] = None  # i64 per node, None when unused
+    sid: int = -1  # row in the device sig_scan stack (scheduler-assigned)
     remaining: int = 0  # pods of this signature still unplaced
-    heap: Optional[list] = None
-    # last KNOWN true score per node — the lazy-heap invariant is "heap
-    # keys are never stale-LOW", so a commit only needs a fresh push when
-    # the node's score INCREASED (balanced-allocation can go up)
-    known: Optional[List[int]] = None
+    # NOTE: heap/known-score state lives on each FastCommitter (keyed by
+    # id(sig)) because Signature objects are shared across committers.
 
 
 class FastCommitter:
@@ -114,6 +155,20 @@ class FastCommitter:
         self.num_pods = [int(x) for x in nodes.num_pods.tolist()]
         self.allowed = [int(x) for x in nodes.allowed_pods.tolist()]
         self.touched: set = set()
+        # per-committer lazy-heap state, keyed id(sig): Signature objects
+        # are SHARED across committers (scheduler + shadow + diag), so the
+        # heaps must live here — a heap built against one committer's usage
+        # is stale-LOW for another's, which breaks the argmax
+        self._heaps: Dict[int, list] = {}
+        self._known: Dict[int, List[int]] = {}
+
+    def invalidate_heaps(self) -> None:
+        """Drop all per-signature heaps — required after the committer's
+        state advanced by REPLAY (device-batch harvests) rather than by its
+        own run(): replayed commits can RAISE node scores, which the lazy
+        heaps would otherwise never see."""
+        self._heaps.clear()
+        self._known.clear()
 
     # ----- integer score/feasibility — MUST match ops/gang.py scan step -----
 
@@ -205,7 +260,7 @@ class FastCommitter:
             total += self.w_bal * bal
         if self.w_img and sig.img is not None:
             total += self.w_img * np.asarray(sig.img, dtype=np.int64)
-        sig.known = total.tolist()
+        self._known[id(sig)] = total.tolist()
         idx = np.nonzero(sig.static_ok)[0]
         heap = list(zip((-total[idx]).tolist(), idx.tolist()))
         heapq.heapify(heap)
@@ -218,11 +273,12 @@ class FastCommitter:
             sig.remaining += 1
         active = {id(s): s for s in pod_sigs}
         choices: List[int] = []
+        heaps = self._heaps
         for sig in pod_sigs:
-            if sig.heap is None:
-                sig.heap = self._build_heap(sig)
-            heap = sig.heap
-            known = sig.known
+            heap = heaps.get(id(sig))
+            if heap is None:
+                heap = heaps[id(sig)] = self._build_heap(sig)
+            known = self._known[id(sig)]
             choice = -1
             while heap:
                 negsc, n = heap[0]
@@ -254,16 +310,18 @@ class FastCommitter:
             # healed by pop-time revalidation; only INCREASES need a fresh
             # push (and only into still-active heaps).
             for other in active.values():
+                oheap = heaps.get(id(other))
                 if (
                     other.remaining <= 0
-                    or other.heap is None
+                    or oheap is None
                     or not other.static_ok[n]
                 ):
                     continue
+                oknown = self._known[id(other)]
                 new = self.score_int(n, other)
-                if new > other.known[n]:
-                    heapq.heappush(other.heap, (-new, n))
-                other.known[n] = new
+                if new > oknown[n]:
+                    heapq.heappush(oheap, (-new, n))
+                oknown[n] = new
         return choices
 
     # ----- failure diagnosis (per signature, lazy) --------------------------

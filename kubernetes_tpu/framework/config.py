@@ -129,6 +129,14 @@ class SchedulerConfiguration:
     pod_initial_backoff_seconds: float = 1.0
     pod_max_backoff_seconds: float = 10.0
     batch_size: int = 512  # TPU extension: gang batch width
+    # TPU extension: fast-path batches EXTEND up to this many pods when the
+    # queue head stays signature-eligible — per-pod host cost is flat on
+    # the sig_scan path, so bigger batches amortize the device round trip.
+    fast_batch_max: int = 4096
+    # TPU extension: fast batches SMALLER than this with an idle pipeline
+    # commit on the host greedy (zero device round trips — the interactive
+    # case); larger or pipelined batches take the device sig_scan kernel.
+    fast_device_min: int = 1024
     # Bit-compat knobs (SURVEY §7 "decision-identical tie-breaking"):
     # full-width evaluation is the TPU-native default; these opt into the
     # reference's sampling + randomized-tie semantics.
@@ -390,6 +398,8 @@ def load_config(source) -> SchedulerConfiguration:
         pod_initial_backoff_seconds=d.get("podInitialBackoffSeconds", 1.0),
         pod_max_backoff_seconds=d.get("podMaxBackoffSeconds", 10.0),
         batch_size=d.get("batchSize", 512),
+        fast_batch_max=d.get("fastBatchMax", 4096),
+        fast_device_min=d.get("fastDeviceMin", 1024),
         # YAML 1.1 parses bare on/off as booleans — accept both spellings
         wave_commit={True: "on", False: "off"}.get(
             d.get("waveCommit", "off"), d.get("waveCommit", "off")

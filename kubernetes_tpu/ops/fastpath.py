@@ -25,6 +25,11 @@ import jax.numpy as jnp
 
 from kubernetes_tpu.ops import filters as F
 from kubernetes_tpu.ops import scores as S
+from kubernetes_tpu.snapshot.schema import LANE_CPU, LANE_MEM, N_FIXED_LANES
+
+MAX = 100  # MaxNodeScore
+I32 = jnp.int32
+I64 = jnp.int64
 
 
 @functools.partial(jax.jit, static_argnames=("enabled", "has_images"))
@@ -85,3 +90,122 @@ def static_eval(dc, db, enabled: frozenset, has_images: bool):
         "naff_raw": naff_raw,
         "img": img,
     }
+
+
+# ---------------------------------------------------------------------------
+# Device half of the COMMIT loop: the sequential-equivalent greedy as a
+# lax.scan over signature ids.
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("w_fit", "w_bal", "w_img", "check_fit"),
+    donate_argnames=("used", "nz0", "nz1", "num_pods"),
+)
+def sig_scan(
+    sig_ids,  # i32 [P]   per-pod signature id, -1 pads
+    sig_req,  # i64 [S, R] request row per signature
+    sig_nz,  # i64 [S, 2]  non-zero-defaulted cpu,mem per signature
+    sig_allzero,  # bool [S] request row entirely zero (fit check skipped)
+    sig_ok,  # bool [S, N] statics-feasible (node_valid & name & unsched
+    #                      & taints & node-affinity), from static_eval
+    sig_img,  # i64 [S, N] ImageLocality contribution (zeros when unused)
+    alloc,  # i64 [N, R]
+    allowed,  # i32 [N]
+    used,  # i64 [N, R]   — donated, evolves across batches
+    nz0,  # i64 [N]       — donated
+    nz1,  # i64 [N]       — donated
+    num_pods,  # i32 [N]  — donated
+    w_fit: int,
+    w_bal: int,
+    w_img: int,
+    check_fit: bool,
+):
+    """One device dispatch = one batch of the signature fast path.
+
+    Replays the reference's one-pod-at-a-time argmax commit
+    (schedule_one.go:65 ScheduleOne → selectHost first-max) as a lax.scan
+    whose carried state is the node usage tensors — the device-resident
+    analogue of kubernetes_tpu.fastpath.FastCommitter, bit-identical to it
+    (property-tested in tests/test_fastpath.py).  Per step: O(N) integer
+    score + masked argmax + one-hot commit; no [P, N] tensors exist and the
+    state never leaves HBM between batches.
+
+    Returns (choices i32 [P] — node index or -1, new_state tuple).
+    """
+    R = alloc.shape[1]
+    N = alloc.shape[0]
+    a0 = alloc[:, LANE_CPU]
+    a1 = alloc[:, LANE_MEM]
+    h0 = a0 > 0
+    h1 = a1 > 0
+    fit_w = h0.astype(I64) + h1.astype(I64)
+    den_bal = jnp.maximum(a0 * a1, 1)
+    ext_lane = jnp.arange(R) >= N_FIXED_LANES  # bool [R]
+    iota_n = jnp.arange(N, dtype=I32)
+
+    def step(carry, s):
+        used, nz0, nz1, num_pods = carry
+        active = s >= 0
+        sc = jnp.maximum(s, 0)
+        req = sig_req[sc]  # [R]
+        snz0 = sig_nz[sc, 0]
+        snz1 = sig_nz[sc, 1]
+        ok = sig_ok[sc]  # [N]
+
+        # ---- feasibility (fastpath.FastCommitter.feasible_int) ----
+        if check_fit:
+            fits_count = num_pods + 1 <= allowed
+            avail = alloc - used  # [N, R]
+            lane_ok = jnp.where(
+                (ext_lane & (req == 0))[None, :], True, req[None, :] <= avail
+            )
+            fits_lanes = jnp.where(
+                sig_allzero[sc], True, jnp.all(lane_ok, axis=1)
+            )
+            feas = ok & fits_count & fits_lanes
+        else:
+            feas = ok
+
+        # ---- integer score (fastpath.FastCommitter.score_int) ----
+        total = jnp.zeros((N,), I64)
+        if w_fit:
+            c0 = nz0 + snz0
+            c1 = nz1 + snz1
+            f0 = jnp.where(c0 > a0, 0, (a0 - c0) * MAX // jnp.maximum(a0, 1))
+            f1 = jnp.where(c1 > a1, 0, (a1 - c1) * MAX // jnp.maximum(a1, 1))
+            least = jnp.where(
+                fit_w > 0,
+                (jnp.where(h0, f0, 0) + jnp.where(h1, f1, 0))
+                // jnp.maximum(fit_w, 1),
+                0,
+            )
+            total = total + w_fit * least
+        if w_bal:
+            r0 = jnp.minimum(used[:, LANE_CPU] + req[LANE_CPU], a0)
+            r1 = jnp.minimum(used[:, LANE_MEM] + req[LANE_MEM], a1)
+            d = jnp.abs(r0 * a1 - r1 * a0)
+            bal = jnp.where(
+                h0 & h1, MAX - (50 * d + den_bal - 1) // den_bal, MAX
+            )
+            total = total + w_bal * bal
+        if w_img:
+            total = total + w_img * sig_img[sc]
+
+        # ---- first-max argmax over feasible nodes + one-hot commit ----
+        ranked = jnp.where(feas, total, -1)
+        choice = jnp.argmax(ranked).astype(I32)
+        any_feas = ranked[choice] >= 0
+        choice = jnp.where(active & any_feas, choice, -1)
+        onehot = iota_n == choice  # all-false when choice == -1
+        carry = (
+            used + onehot[:, None].astype(I64) * req[None, :],
+            nz0 + onehot.astype(I64) * snz0,
+            nz1 + onehot.astype(I64) * snz1,
+            num_pods + onehot.astype(I32),
+        )
+        return carry, choice
+
+    carry, choices = jax.lax.scan(step, (used, nz0, nz1, num_pods), sig_ids)
+    return choices, carry

@@ -297,6 +297,31 @@ class SchedulingQueue:
             out.append(qp)
         return out
 
+    def pop_batch_while(self, k, predicate) -> List[QueuedPodInfo]:
+        """Up to k MORE pods in QueueSort order, stopping (without popping)
+        at the first live entry the predicate rejects — the batch-extension
+        feed for dispatch paths whose per-pod cost is flat enough that
+        bigger batches amortize the device round trip.  Queue order is
+        preserved exactly: the rejected pod stays at the head for the next
+        pop_batch.  Call immediately after pop_batch (shares its backoff /
+        unschedulable flush)."""
+        out: List[QueuedPodInfo] = []
+        while len(out) < k and self._active:
+            _, eid, qp = self._active[0]
+            if not self._entry_live(qp, eid, "active"):
+                heapq.heappop(self._active)
+                continue
+            if not predicate(qp):
+                break
+            heapq.heappop(self._active)
+            del self._in_queue[qp.uid]
+            self._live.pop(qp.uid, None)
+            self._items.pop(qp.uid, None)
+            qp.attempts += 1
+            self._in_flight[qp.uid] = []
+            out.append(qp)
+        return out
+
     def pop(self) -> Optional[QueuedPodInfo]:
         batch = self.pop_batch(1)
         return batch[0] if batch else None

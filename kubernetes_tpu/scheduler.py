@@ -15,6 +15,7 @@ in kubernetes_tpu.testing; a real client would speak the same interface.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
@@ -45,6 +46,10 @@ from kubernetes_tpu.queue import SchedulingQueue
 from kubernetes_tpu.queue.nominator import Nominator
 from kubernetes_tpu.snapshot.interner import PAD
 from kubernetes_tpu.snapshot.schema import bucket_cap, pack_pod_batch
+
+logger = logging.getLogger(__name__)
+
+_MISSING = object()  # dict-miss sentinel (cached signature keys can be None)
 
 
 @dataclass
@@ -597,11 +602,15 @@ class Scheduler:
             )
         from collections import deque
 
-        pending: deque = deque()  # chained batches awaiting result harvest
+        pending: deque = deque()  # pipelined batches awaiting result harvest
 
         def flush(keep: int = 0) -> None:
             while len(pending) > keep:
-                outcomes.extend(self._finish_chained(pending.popleft()))
+                rec = pending.popleft()
+                if rec.get("kind") == "fast":
+                    outcomes.extend(self._finish_fast(rec))
+                else:
+                    outcomes.extend(self._finish_chained(rec))
 
         while True:
             with self._mu:
@@ -640,6 +649,30 @@ class Scheduler:
                     flush(1 if fwk.has_reserve_or_permit() else 2)
                     continue
                 if rec == "handled":
+                    continue
+                # pipelined fast path: same ≤2-in-flight discipline as the
+                # chain — the sig_scan kernel's state chains on device, so
+                # the harvest of batch k overlaps k+1's dispatch and the
+                # device link's round trip hides behind host work
+                frec = self._try_dispatch_fast(
+                    fwk,
+                    group,
+                    outcomes,
+                    chain_settled=not any(
+                        r.get("kind") != "fast" for r in pending
+                    ),
+                    pipeline_empty=not pending,
+                )
+                if frec == "flush":
+                    flush(0)
+                    frec = self._try_dispatch_fast(
+                        fwk, group, outcomes, chain_settled=True
+                    )
+                if isinstance(frec, dict):
+                    pending.append(frec)
+                    flush(1 if fwk.has_reserve_or_permit() else 2)
+                    continue
+                if frec == "handled":
                     continue
                 # direct path: settle the pipeline first — its commits must
                 # land before a non-chained dispatch reads host state — and
@@ -854,17 +887,12 @@ class Scheduler:
                 and fwk.fit_strategy() == gang.DEFAULT_FIT_STRATEGY
                 and not self._sampling_active(fwk)
             ):
-                t_fast = time.perf_counter()
                 fast = self._try_fast_schedule(
                     fwk, state, batch, enabled, weights, outcomes
                 )
                 if fast is not None:
-                    self.metrics["fast_batches"] += 1
-                    self.prom.recorder.observe(
-                        self.prom.gang_dispatch_duration,
-                        time.perf_counter() - t_fast,
-                        path="fast",
-                    )
+                    # fast_batches + gang_dispatch_duration(path=fast) are
+                    # both recorded inside the dispatch/harvest halves
                     trace.step("Fast-path commit done")
                     trace.log_if_long()
                     return fast
@@ -1191,6 +1219,71 @@ class Scheduler:
                     return False
         return True
 
+    def _fast_pod_predicate(self, fwk, group_name: str, known_rows=None):
+        """Per-pod closure mirroring _try_dispatch_fast's batch gates +
+        _fast_gate_ok + signature eligibility — the pop_batch_while feed
+        for fast-batch extension.  Pods it accepts are exactly the pods a
+        fresh batch through those gates would accept; with ``known_rows``
+        (the signature row cache) it additionally requires the pod's
+        signature to be already established as argmax-neutral, so the
+        extension can never force a post-pop bail-out."""
+        host_scores = [
+            p
+            for p in fwk.host_score_plugins()
+            if fwk.score_weights.get(p.name, 0)
+        ]
+        hf = fwk.host_filter_plugins()
+        ns_plugins = self._normalizing_score_plugins(fwk)
+        extenders = self.extenders
+        max_nom = None
+        if len(self.nominator):
+            max_nom = max(p.priority for _, p in self.nominator.entries())
+        probes = ()
+        if self.cache.n_term_pods:
+            cached = getattr(self, "_term_probe_cache", None)
+            # _fast_gate_ok just ran on the seed batch, so the cache is hot;
+            # if it somehow isn't, refuse to extend rather than skip probes
+            if cached is None or cached[0] != self.cache.term_version:
+                return lambda qp: False
+            probes = cached[1]
+        group_hit: Dict[tuple, bool] = {}
+        vocab = self.mirror.vocab
+        n_lanes = self.mirror.nodes.allocatable.shape[1]
+        params = (n_lanes, len(vocab.resources))
+        lanes_box: list = [None]
+
+        def elig(qp) -> bool:
+            p = qp.pod
+            if p.scheduler_name != group_name or p.nominated_node_name:
+                return False
+            if max_nom is not None and p.priority <= max_nom:
+                return False
+            if any(pl.maybe_relevant(p) for pl in hf):
+                return False
+            if any(e.is_interested(p) for e in extenders):
+                return False
+            if any(pl.score_relevant(p) for pl in ns_plugins):
+                return False
+            if any(pl.score_relevant(p) for pl in host_scores):
+                return False
+            if probes:
+                gk = (p.namespace, tuple(sorted(p.labels.items())))
+                hit = group_hit.get(gk)
+                if hit is None:
+                    hit = any(pr.admits(p) for pr in probes)
+                    group_hit[gk] = hit
+                if hit:
+                    return False
+            k = self._pod_sig_key(p, params, lanes_box)
+            if k is None:
+                return False
+            if known_rows is not None:
+                row = known_rows.get(k)
+                return row is not None and row["const_ok"]
+            return True
+
+        return elig
+
     def _sync_mirror_external(self) -> None:
         """Repack the host mirror only when state the FAST path reads could
         have moved: external mutations (node/pod informer events, forgets)
@@ -1259,29 +1352,49 @@ class Scheduler:
             slots[w, : len(row)] = row
         return slots
 
-    def _batch_signature_keys(self, batch):
-        """signature_key per pod, memoized ON the pod object (spec updates
-        arrive as new Pod objects, the compute_requests memo pattern) so the
-        chain quickcheck and the fast path share one computation.  Returns
-        the full key list, or None when any pod is ineligible."""
+    def _pod_sig_key(self, pod, params, lanes_box):
+        """signature_key for one pod, memoized twice over: ON the pod object
+        (spec updates arrive as new Pod objects, the compute_requests memo
+        pattern) and CONTENT-ADDRESSED by spec (pods stamped from one
+        template — the 100k-pod drain shape — share one computation)."""
+        d = pod.__dict__
+        memo = d.get("_sigkey_memo")
+        if memo is not None and memo[0] == params:
+            return memo[1]
         from kubernetes_tpu import fastpath as fp
-        from kubernetes_tpu.snapshot.schema import ResourceLanes
 
+        cache = getattr(self, "_speckey_cache", None)
+        if cache is None:
+            cache = self._speckey_cache = {}
+        sk = fp.spec_key(pod)
+        if sk is not None:
+            k = cache.get((params, sk), _MISSING)
+            if k is not _MISSING:
+                d["_sigkey_memo"] = (params, k)
+                return k
+        if lanes_box[0] is None:
+            from kubernetes_tpu.snapshot.schema import ResourceLanes
+
+            lanes_box[0] = ResourceLanes(self.mirror.vocab)
+        k = fp.signature_key(pod, lanes_box[0], params[0])
+        d["_sigkey_memo"] = (params, k)
+        if sk is not None:
+            if len(cache) > 65536:
+                cache.clear()
+            cache[(params, sk)] = k
+        return k
+
+    def _batch_signature_keys(self, batch):
+        """signature_key per pod via _pod_sig_key's two-level memo, shared
+        by the chain quickcheck, the fast gate, and batch extension.
+        Returns the full key list, or None when any pod is ineligible."""
         vocab = self.mirror.vocab
         n_lanes = self.mirror.nodes.allocatable.shape[1]
         params = (n_lanes, len(vocab.resources))
-        lanes = None
+        lanes_box: list = [None]
         keys = []
         for qp in batch:
-            d = qp.pod.__dict__
-            memo = d.get("_sigkey_memo")
-            if memo is not None and memo[0] == params:
-                k = memo[1]
-            else:
-                if lanes is None:
-                    lanes = ResourceLanes(vocab)
-                k = fp.signature_key(qp.pod, lanes, n_lanes)
-                d["_sigkey_memo"] = (params, k)
+            k = self._pod_sig_key(qp.pod, params, lanes_box)
             if k is None:
                 return None
             keys.append(k)
@@ -1584,25 +1697,41 @@ class Scheduler:
     def _try_fast_schedule(
         self, fwk, state, batch, enabled, weights, outcomes
     ) -> Optional[List[ScheduleOutcome]]:
-        """The signature fast path (ops/fastpath.py + fastpath.py).
+        """Synchronous signature fast path (the _schedule_batch fallback for
+        batches the pipelined loop didn't claim).
 
         Returns completed outcomes, or None when the batch isn't eligible
-        (ineligible pods, or static score rawss vary so normalization is
+        (ineligible pods, or static score raws vary so normalization is
         batch-state-dependent) — the caller falls back to the gang scan.
         """
-        import numpy as np
-
-        from kubernetes_tpu import fastpath as fp
-        from kubernetes_tpu.ops import fastpath as ops_fp
-
-        vocab = self.mirror.vocab
         keys = self._batch_signature_keys(batch)
         if keys is None:
             return None
+        rows = self._fast_sig_rows(fwk, batch, keys, enabled, weights)
+        if rows is None:
+            return None
+        rec = self._fast_dispatch(
+            fwk, state, batch, keys, enabled, weights, pipeline_empty=True
+        )
+        if rec is None:
+            return None
+        outcomes.extend(self._finish_fast(rec))
+        return outcomes
 
-        # Per-signature static results are cached across batches keyed on
-        # the static snapshot: steady-state batches reuse them and make
-        # ZERO device calls (signatures recur — bench workloads have ~10).
+    def _fast_sig_rows(self, fwk, batch, keys, enabled, weights):
+        """Per-signature static rows (masks + raw scores) for this batch,
+        cached across batches keyed on the static snapshot: steady-state
+        batches reuse them and make ZERO static_eval device calls
+        (signatures recur — bench workloads have ~10).  Returns the row
+        cache, or None when any signature's static score raws vary over its
+        feasible set (normalization would be batch-state-dependent — the
+        greedy's argmax-neutrality argument breaks, so the batch must take
+        the gang scan)."""
+        import numpy as np
+
+        from kubernetes_tpu.ops import fastpath as ops_fp
+
+        vocab = self.mirror.vocab
         dc_key = (
             self.mirror.static_generation,
             self.mirror._full_packs,
@@ -1627,7 +1756,10 @@ class Scheduler:
                 reps,
                 vocab,
                 k_cap=self.mirror.nodes.k_cap,
-                p_cap=bucket_cap(len(reps), 1),
+                # floor 16: the count of NEW signatures per batch is noisy
+                # (1 here, 2 there) and every distinct count would be a
+                # fresh static_eval compile — one [16, N] shape covers them
+                p_cap=bucket_cap(len(reps), 16),
             )
             db = DeviceBatch.from_host(pb)
             dc = self._static_device_cluster()
@@ -1636,11 +1768,50 @@ class Scheduler:
             )
             res = {k: np.asarray(v) for k, v in jax.device_get(res).items()}
             for k, s in order.items():
-                cache[k] = {name: res[name][s] for name in res}
+                row = {name: res[name][s] for name in res}
+                # Normalized static scores are argmax-neutral ONLY when
+                # their raws are constant over the feasible set (then every
+                # feasible node gets the same normalized value).
+                m = row["mask"]
+                const_ok = True
+                for w, raw in (
+                    (w_taint, row["taint_raw"]),
+                    (w_naff, row["naff_raw"]),
+                ):
+                    if not w:
+                        continue
+                    vals = raw[m]
+                    if vals.size and int(vals.min()) != int(vals.max()):
+                        const_ok = False
+                        break
+                row["const_ok"] = const_ok
+                cache[k] = row
+        if any(not cache[k]["const_ok"] for k in keys):
+            return None
+        return cache
 
-        # The committer (and its signature heaps) persists across batches:
-        # its state evolves exactly by the commits it made itself, so only
-        # EXTERNAL mutations or repacks force a rebuild.
+    def _fast_dispatch(
+        self, fwk, state, batch, keys, enabled, weights, pipeline_empty=True
+    ):
+        """Run one fast batch and return its pending record.
+
+        Hybrid committer: the persistent source of truth is a host
+        FastCommitter (holder["fc"]) that advances at every harvest — small
+        batches with an empty pipeline commit directly on it (zero device
+        round trips: the interactive/server-loop case), while large or
+        pipelined batches dispatch the sig_scan kernel with device-resident
+        chained state and START the async result copy (the bulk-drain case;
+        the round trip hides behind the next batch's host work).  Both
+        paths are bit-identical (property-tested, tests/test_fastpath.py);
+        only EXTERNAL mutations or repacks rebuild the lineage."""
+        import numpy as np
+
+        from kubernetes_tpu import fastpath as fp
+
+        from kubernetes_tpu.ops import fastpath as ops_fp
+
+        cache = self._sig_cache
+        check_fit = "NodeResourcesFit" in enabled
         fc_key = (
             self._external_mutations,
             getattr(self, "_nonfast_commits", 0),
@@ -1649,69 +1820,461 @@ class Scheduler:
             weights,
             fwk.profile_name,
         )
-        committer = getattr(self, "_fast_committer", None)
-        if committer is None or self._fc_key != fc_key:
-            committer = fp.FastCommitter(
-                self.mirror.nodes,
-                weights,
-                check_fit="NodeResourcesFit" in enabled,
-            )
-            self._fast_committer = committer
+        holder = getattr(self, "_fastdev", None)
+        if holder is None or self._fc_key != fc_key:
+            nt = self.mirror.nodes
+            holder = self._fastdev = {
+                "nt": nt,
+                "fc": fp.FastCommitter(nt, weights, check_fit=check_fit),
+                "dev": None,  # device state, materialized on demand
+                "alloc": None,
+                "allowed": None,
+                "stack": None,
+                "heaps_dirty": False,
+                "p_cap": 64,
+            }
+            if getattr(self, "fast_shadow_check", False):
+                # invariant-checking mode: a second host FastCommitter
+                # replays every batch and must bit-match the chosen path
+                holder["shadow"] = fp.FastCommitter(
+                    nt, weights, check_fit=check_fit
+                )
             self._fc_key = fc_key
             self._sig_objs: Dict[object, fp.Signature] = {}
+            self._sig_list: List[fp.Signature] = []
 
         sigs = self._sig_objs
         for k in keys:
             if k in sigs:
                 continue
             row = cache[k]
-            m = row["mask"]
-            # Normalized static scores are argmax-neutral ONLY when their
-            # raws are constant over the feasible set (then every feasible
-            # node gets the same normalized value).
-            for w, raw in ((w_taint, row["taint_raw"]), (w_naff, row["naff_raw"])):
-                if not w:
-                    continue
-                vals = raw[m]
-                if vals.size and int(vals.min()) != int(vals.max()):
-                    return None
             req_row, nz, *_ = k
             img_list = None
             if weights[6] and row["img"].any():
                 img_list = row["img"].tolist()
-            sigs[k] = fp.Signature(
+            sig = fp.Signature(
                 req_row=req_row,
                 nz0=nz[0],
                 nz1=nz[1],
                 all_zero=all(v == 0 for v in req_row),
-                static_ok=m,
+                static_ok=row["mask"],
                 img=img_list,
             )
+            sig.sid = len(self._sig_list)
+            sigs[k] = sig
+            self._sig_list.append(sig)
+            holder["stack"] = None  # new signature → restack
         pod_sigs = [sigs[k] for k in keys]
-        choices = committer.run(pod_sigs)
+        t0 = time.perf_counter()
+
+        # ---- host path: empty pipeline + small batch → the greedy answers
+        # locally in O(P · log N) with no device link involvement at all
+        if pipeline_empty and len(batch) < getattr(
+            self.config, "fast_device_min", 1024
+        ):
+            if holder["heaps_dirty"]:
+                # device-batch replays changed scores under the lazy heaps
+                holder["fc"].invalidate_heaps()
+                holder["heaps_dirty"] = False
+            choices = holder["fc"].run(pod_sigs)
+            holder["dev"] = None  # device copy (if any) is now stale
+            self.metrics["fast_batches"] += 1
+            return {
+                "kind": "fast",
+                "fwk": fwk,
+                "state": state,
+                "batch": batch,
+                "keys": keys,
+                "pod_sigs": pod_sigs,
+                "choices_host": choices,
+                "choices_dev": None,
+                "rows": cache,
+                "weights": weights,
+                "check_fit": check_fit,
+                "holder": holder,
+                "t0": t0,
+                "record_metrics": False,
+            }
+
+        # ---- device path: the greedy commit loop runs as a lax.scan over
+        # signature ids with the node-usage state resident in HBM
+        # (ops/fastpath.sig_scan) — one dispatch per batch, no [P, N]
+        # tensors, bit-identical to the host FastCommitter
+        if holder["stack"] is None:
+            holder["stack"] = self._stack_signatures(holder)
+        st = holder["stack"]
+        # p_cap quantized to three levels so the kernel compiles at most
+        # three shapes ever: small drains stay cheap on the test backend,
+        # and extended batches all share the fast_batch_max shape (pad
+        # steps are masked inner iterations, ~0.2µs each)
+        need = len(batch)
+        for level in (64, 512, getattr(self.config, "fast_batch_max", 4096)):
+            if need <= level:
+                need = level
+                break
+        else:
+            need = bucket_cap(need, 1)
+        p_cap = holder["p_cap"] = max(holder["p_cap"], need)
+        ids = np.full((p_cap,), -1, np.int32)
+        ids[: len(batch)] = [s.sid for s in pod_sigs]
+        w_img = weights[6] if st["any_img"] else 0
+        try:
+            if holder["dev"] is None:
+                # (re)materialize device state from the host committer —
+                # one upload per host→device transition, folded into this
+                # dispatch's async pipeline
+                fc = holder["fc"]
+                holder["alloc"] = jnp.asarray(
+                    np.asarray(fc.alloc_rows, np.int64)
+                )
+                holder["allowed"] = jnp.asarray(
+                    np.asarray(fc.allowed, np.int32)
+                )
+                holder["dev"] = (
+                    jnp.asarray(np.asarray(fc.used_rows, np.int64)),
+                    jnp.asarray(np.asarray(fc.nz0, np.int64)),
+                    jnp.asarray(np.asarray(fc.nz1, np.int64)),
+                    jnp.asarray(np.asarray(fc.num_pods, np.int32)),
+                )
+            used, nz0, nz1, num_pods = holder["dev"]
+            choices_dev, holder["dev"] = ops_fp.sig_scan(
+                jnp.asarray(ids),
+                st["req"],
+                st["nz"],
+                st["az"],
+                st["ok"],
+                st["img"],
+                holder["alloc"],
+                holder["allowed"],
+                used,
+                nz0,
+                nz1,
+                num_pods,
+                w_fit=weights[4],
+                w_bal=weights[5],
+                w_img=w_img,
+                check_fit=check_fit,
+            )
+            # start the device→host result copy NOW; by harvest time the
+            # data is local and the blocking fetch is cheap (the same
+            # latency-hiding discipline as the chained gang pipeline)
+            choices_dev.copy_to_host_async()
+        except Exception:
+            # the donated state buffers may be gone — drop the holder so the
+            # next fast batch rebuilds from the mirror, and let the caller
+            # error-requeue this batch
+            logger.exception("sig_scan dispatch failed; dropping fast state")
+            self._fastdev = None
+            return None
+        self.metrics["fast_batches"] += 1
+        return {
+            "kind": "fast",
+            "fwk": fwk,
+            "state": state,
+            "batch": batch,
+            "keys": keys,
+            "pod_sigs": pod_sigs,
+            "choices_host": None,
+            "choices_dev": choices_dev,
+            "rows": cache,
+            "weights": weights,
+            "check_fit": check_fit,
+            "holder": holder,
+            "t0": t0,
+            "record_metrics": False,
+        }
+
+    def _finish_fast(self, rec) -> List[ScheduleOutcome]:
+        """Harvest one fast batch: fetch the kernel's choices (device
+        records) or take the host greedy's, advance the host committer, and
+        walk the commits (assume → reserve/permit → async bind), diagnosing
+        unschedulable pods against the committer state."""
+        import numpy as np
+
+        fwk = rec["fwk"]
+        state = rec["state"]
+        batch = rec["batch"]
+        cache = rec["rows"]
+        weights = rec["weights"]
+        pod_sigs = rec["pod_sigs"]
+        holder = rec["holder"]
+        outcomes: List[ScheduleOutcome] = []
+        choices = rec["choices_host"]
+        if choices is None:
+            choices = jax.device_get(rec["choices_dev"])[: len(batch)].tolist()
+            # advance the host committer to the post-batch state by
+            # replaying the kernel's commits (pure host arithmetic — the
+            # device state never needs to come back over the link)
+            fc = holder["fc"]
+            rn = fc.rn
+            for sig, idx in zip(pod_sigs, choices):
+                if idx < 0:
+                    continue
+                used = fc.used_rows[idx]
+                for r, v in enumerate(sig.req_row):
+                    if r < rn:
+                        used[r] += v
+                fc.nz0[idx] += sig.nz0
+                fc.nz1[idx] += sig.nz1
+                fc.num_pods[idx] += 1
+            holder["heaps_dirty"] = True
+            shadow = holder.get("shadow")
+            if shadow is not None:
+                host_choices = shadow.run(pod_sigs)
+                if host_choices != choices:
+                    diffs = [
+                        (i, h, d)
+                        for i, (h, d) in enumerate(zip(host_choices, choices))
+                        if h != d
+                    ][:10]
+                    raise AssertionError(
+                        f"sig_scan diverged from host FastCommitter: {diffs}"
+                    )
+        elif holder.get("shadow") is not None:
+            shadow_choices = holder["shadow"].run(pod_sigs)
+            if shadow_choices != choices:
+                raise AssertionError("host fast path diverged from shadow")
+        self.prom.recorder.observe(
+            self.prom.gang_dispatch_duration,
+            time.perf_counter() - rec["t0"],
+            path="fast",
+        )
 
         node_names = self.mirror.nodes.names
-        node_valid = np.asarray(self.mirror.nodes.valid)
-        n_nodes = len(self.cache.real_nodes())
         diag_cache: Dict[int, Dict[str, int]] = {}
-        for qp, sig, k, idx in zip(batch, pod_sigs, keys, choices):
-            self.metrics["schedule_attempts"] += 1
-            if idx < 0:
-                diag = diag_cache.get(id(sig))
-                if diag is None:
-                    diag = committer.diagnose(sig, cache[k], node_valid)
-                    diag_cache[id(sig)] = diag
-                status = Status.unschedulable(fit_error_message(n_nodes, diag))
-                outcomes.append(
-                    self._post_filter_or_fail(
-                        fwk, state, qp, status, 0, diag, set(diag)
-                    )
-                )
+        node_valid = None
+        n_nodes = None
+        # The fast gate proved every host filter spec-irrelevant to every
+        # batch pod; when Reserve/Permit plugins are exactly those plugins
+        # (default registry: volumebinding/DRA), their walks are no-ops —
+        # skip them for the whole batch.
+        has_rp = (
+            fwk.has_reserve_or_permit()
+            and not fwk.reserve_permit_covered_by_host_filters()
+        )
+        lean = fwk.lean_bind_ok()
+        keys = rec["keys"]
+        n = len(batch)
+        self.metrics["schedule_attempts"] += n
+        i = 0
+        while i < n:
+            if choices[i] >= 0:
+                # commit the whole contiguous run of scheduled pods under
+                # ONE lock acquisition (in order — runs preserve the
+                # sequential-equivalent commit sequence)
+                with self._mu:
+                    while i < n and choices[i] >= 0:
+                        outcomes.append(
+                            self._commit_under_lock(
+                                fwk,
+                                state,
+                                batch[i],
+                                node_names[choices[i]],
+                                -1,
+                                None,
+                                has_rp,
+                                lean,
+                            )
+                        )
+                        i += 1
                 continue
+            qp, sig, k = batch[i], pod_sigs[i], keys[i]
+            i += 1
+            diag = diag_cache.get(id(sig))
+            if diag is None:
+                if node_valid is None:
+                    node_valid = np.asarray(self.mirror.nodes.valid)
+                    n_nodes = len(self.cache.real_nodes())
+                diag = holder["fc"].diagnose(sig, cache[k], node_valid)
+                diag_cache[id(sig)] = diag
+            status = Status.unschedulable(fit_error_message(n_nodes, diag))
             outcomes.append(
-                self._commit(fwk, state, qp, node_names[idx], -1, from_fast=True)
+                self._post_filter_or_fail(
+                    fwk, state, qp, status, 0, diag, set(diag)
+                )
             )
+        if rec["record_metrics"]:
+            self._record_batch_metrics(
+                fwk.profile_name,
+                batch,
+                outcomes,
+                time.perf_counter() - rec["t0"],
+            )
+            self._flush_binds()
         return outcomes
+
+
+    def _try_dispatch_fast(
+        self, fwk, batch, outcomes, chain_settled: bool, pipeline_empty: bool = True
+    ):
+        """Pipelined fast-path dispatch from the scheduling loop: run the
+        eligibility gates and PreFilter, dispatch the sig_scan kernel, and
+        return a pending record the loop harvests later — the fast-path
+        analogue of _try_dispatch_chained's ≤2-in-flight discipline, which
+        hides the device link's round-trip latency behind the next batch's
+        host work.  Returns the record, "handled" (nothing left), "flush"
+        (chain records must settle first — their commits move host state the
+        fast rebuild reads), or None (not eligible — direct path)."""
+        if self._sampling_active(fwk):
+            return None
+        if fwk.fit_strategy() != gang.DEFAULT_FIT_STRATEGY:
+            return None
+        if self.mirror.nodes is None:
+            # first batch of a fresh scheduler: pack the mirror now so the
+            # very first dispatch already takes the pipelined (and batch-
+            # extended) path — otherwise the steady-state batch shape only
+            # compiles after warm-up
+            with self._mu:
+                if self.mirror.nodes is None:
+                    self._repack_mirror()
+            if self.mirror.nodes is None:  # no nodes yet
+                return None
+        hf = fwk.host_filter_plugins()
+        ns_plugins = self._normalizing_score_plugins(fwk)
+        for qp in batch:
+            p = qp.pod
+            if p.nominated_node_name:
+                return None
+            if any(pl.maybe_relevant(p) for pl in hf):
+                return None
+            if any(e.is_interested(p) for e in self.extenders):
+                return None
+            if any(pl.score_relevant(p) for pl in ns_plugins):
+                return None
+        if not self._fast_gate_ok(batch):
+            return None
+        keys = self._batch_signature_keys(batch)
+        if keys is None:
+            return None
+        if not chain_settled:
+            return "flush"
+        # spec-level host-score probe on the SEED batch (extension pods are
+        # probed inside the predicate) — the pre-PreFilter equivalent of the
+        # sync path's Skip-state check: a pod whose spec is irrelevant Skips
+        # in PreScore by the stateful-plugin contract
+        for p in fwk.host_score_plugins():
+            if fwk.score_weights.get(p.name, 0) and any(
+                p.score_relevant(qp.pod) for qp in batch
+            ):
+                return None
+
+        with self._mu:
+            vocab = self.mirror.vocab
+            for qp in batch:
+                for k, v in qp.pod.labels.items():
+                    vocab.intern_label(k, v)
+            self._sync_mirror_external()
+            enabled = fwk.device_enabled()
+            weights = tuple(
+                fwk.score_weights.get(n, 0) for n in gang.WEIGHT_ORDER
+            )
+        # Establish the SEED batch's signature rows (and their argmax-
+        # neutrality verdicts) BEFORE extending: every bail-out must happen
+        # while the seed group is the only thing popped — extension pods
+        # would be lost to the direct-path fallback otherwise.
+        rows = self._fast_sig_rows(fwk, batch, keys, enabled, weights)
+        if rows is None:
+            return None
+
+        # Extend the batch from the queue head while pods stay eligible AND
+        # their signatures are already established as argmax-neutral: per-
+        # pod host cost is flat on the sig_scan path, so one big dispatch
+        # amortizes the device round trip over many more pods (queue order
+        # — and therefore decision sequence — is unchanged; a pod with a
+        # NOVEL signature stops the extension and seeds a later batch).
+        ext = getattr(self.config, "fast_batch_max", 4096) - len(batch)
+        if ext > 0:
+            elig = self._fast_pod_predicate(
+                fwk, batch[0].pod.scheduler_name, known_rows=rows
+            )
+            with self._mu:
+                extra = self.queue.pop_batch_while(ext, elig)
+            if extra:
+                with self._mu:
+                    for qp in extra:
+                        for k, v in qp.pod.labels.items():
+                            vocab.intern_label(k, v)
+                batch = batch + extra
+                keys = self._batch_signature_keys(batch)
+                assert keys is not None  # predicate guarantees eligibility
+
+        state = CycleState()
+        pods_all = [qp.pod for qp in batch]
+        # ---- point of commitment: PreFilter mutates outcomes/queue state,
+        # so every bail-out above happened first (the direct path must not
+        # replay it, and extension pods are already part of this batch);
+        # after this, the rare dispatch failure error-requeues the batch
+        with self._mu:
+            fwk.run_pre_score(state, pods_all, self.mirror.nodes.names)
+            pf_failures = fwk.run_pre_filter(state, pods_all)
+            if pf_failures:
+                live = []
+                for qp in batch:
+                    s = pf_failures.get(qp.pod.uid)
+                    if s is None:
+                        live.append(qp)
+                        continue
+                    self.metrics["schedule_attempts"] += 1
+                    outcomes.append(
+                        self._post_filter_or_fail(fwk, state, qp, s, 0)
+                    )
+                batch = live
+                if not batch:
+                    return "handled"
+                keys = self._batch_signature_keys(batch)
+        # fast commits happen outside the chain's device state — drop it
+        # (it restarts from the repacked mirror once the pipeline settles)
+        self._chain = None
+        rec = self._fast_dispatch(
+            fwk, state, batch, keys, enabled, weights, pipeline_empty
+        )
+        if rec is None:
+            # dispatch failure after pods (incl. extension) were popped and
+            # PreFilter ran: error-requeue the whole batch with backoff —
+            # the retry drains through whatever path is healthy then
+            s = Status.error("fast-path device dispatch failed; requeued")
+            for qp in batch:
+                self.metrics["schedule_attempts"] += 1
+                self._handle_failure(qp, s)
+                outcomes.append(ScheduleOutcome(qp.pod, None, s, 0))
+            return "handled"
+        rec["record_metrics"] = True
+        return rec
+
+
+    def _stack_signatures(self, holder):
+        """[S_cap, ...] stacked per-signature tensors for sig_scan; S_cap is
+        a pow2 bucket so signature-set growth rarely changes the shape."""
+        import numpy as np
+
+        sig_list = self._sig_list
+        n = holder["fc"].n
+        r = holder["fc"].rn
+        s_cap = bucket_cap(len(sig_list), 8)
+        req = np.zeros((s_cap, r), np.int64)
+        nz = np.zeros((s_cap, 2), np.int64)
+        az = np.zeros((s_cap,), bool)
+        ok = np.zeros((s_cap, n), bool)
+        img = np.zeros((s_cap, n), np.int64)
+        any_img = False
+        for i, sg in enumerate(sig_list):
+            row = np.asarray(sg.req_row, np.int64)
+            req[i, : row.shape[0]] = row
+            nz[i, 0] = sg.nz0
+            nz[i, 1] = sg.nz1
+            az[i] = sg.all_zero
+            ok[i] = sg.static_ok
+            if sg.img is not None:
+                img[i] = sg.img
+                any_img = True
+        return {
+            "req": jnp.asarray(req),
+            "nz": jnp.asarray(nz),
+            "az": jnp.asarray(az),
+            "ok": jnp.asarray(ok),
+            "img": jnp.asarray(img),
+            "any_img": any_img,
+        }
 
     def _schedule_one_nominated(self, fwk, qp) -> List[ScheduleOutcome]:
         """The nominated-node fast path (schedule_one.go:490-499): a pod
@@ -2440,37 +3003,54 @@ class Scheduler:
         schedule_pending returns (its end-of-drain barrier).
         ``binder_override`` replaces the in-tree bind plugins when a binder
         extender claims the pod (schedule_one.go extendersBinding)."""
-        pod = qp.pod
         has_rp = fwk.has_reserve_or_permit()
         with self._mu:
             if not from_fast:
                 # scan/extender-path commits advance cache state the fast
                 # committer didn't see — its cache key must change
                 self._nonfast_commits = getattr(self, "_nonfast_commits", 0) + 1
-            self.cache.assume_pod(pod, node_name)
-            ps = self.cache.pod_states.get(pod.uid)
-            assumed = ps.pod if ps is not None else pod
-            self._view_pod_added(assumed)
+            return self._commit_under_lock(
+                fwk, state, qp, node_name, n_feas, binder_override, has_rp
+            )
 
-            waited = False
-            if has_rp:
-                s = fwk.run_reserve(state, pod, node_name)
-                if not s.ok:
-                    self._external_mutations += 1  # committer state diverges
-                    self._view_pod_removed(assumed)
-                    self.cache.forget_pod(pod)
-                    self._handle_failure(qp, s)
-                    return ScheduleOutcome(pod, None, s, n_feas)
+    def _commit_under_lock(
+        self,
+        fwk,
+        state,
+        qp,
+        node_name,
+        n_feas,
+        binder_override,
+        has_rp,
+        lean: bool = False,
+    ) -> ScheduleOutcome:
+        """The _commit body with self._mu already held — lets the fast
+        harvest commit a whole run of pods under ONE lock acquisition."""
+        pod = qp.pod
+        self.cache.assume_pod(pod, node_name)
+        ps = self.cache.pod_states.get(pod.uid)
+        assumed = ps.pod if ps is not None else pod
+        self._view_pod_added(assumed)
 
-                s = fwk.run_permit(state, pod, node_name)
-                if s.rejected or s.code == Code.ERROR:
-                    fwk.run_unreserve(state, pod, node_name)
-                    self._external_mutations += 1  # committer state diverges
-                    self._view_pod_removed(assumed)
-                    self.cache.forget_pod(pod)
-                    self._handle_failure(qp, s)
-                    return ScheduleOutcome(pod, None, s, n_feas)
-                waited = s.code == Code.WAIT
+        waited = False
+        if has_rp:
+            s = fwk.run_reserve(state, pod, node_name)
+            if not s.ok:
+                self._external_mutations += 1  # committer state diverges
+                self._view_pod_removed(assumed)
+                self.cache.forget_pod(pod)
+                self._handle_failure(qp, s)
+                return ScheduleOutcome(pod, None, s, n_feas)
+
+            s = fwk.run_permit(state, pod, node_name)
+            if s.rejected or s.code == Code.ERROR:
+                fwk.run_unreserve(state, pod, node_name)
+                self._external_mutations += 1  # committer state diverges
+                self._view_pod_removed(assumed)
+                self.cache.forget_pod(pod)
+                self._handle_failure(qp, s)
+                return ScheduleOutcome(pod, None, s, n_feas)
+            waited = s.code == Code.WAIT
 
         outcome = ScheduleOutcome(
             pod,
@@ -2480,7 +3060,7 @@ class Scheduler:
             pod_attempts=qp.attempts,
             first_enqueue_time=qp.timestamp,
         )
-        args = (fwk, state, qp, node_name, waited, binder_override, outcome)
+        args = (fwk, state, qp, node_name, waited, binder_override, outcome, lean)
         if waited:
             # A Wait-ed pod's cycle can block on permit for its timeout —
             # it must not serialize behind (or ahead of) other pods' binds;
@@ -2505,10 +3085,14 @@ class Scheduler:
 
     def _flush_binds(self, chunk: int = 64) -> None:
         """Submit buffered binding cycles, chunked — called at batch end so
-        bindings still overlap the NEXT batch's device dispatch."""
+        bindings still overlap the NEXT batch's device dispatch.  The chunk
+        shrinks when the buffer is small relative to the worker pool so a
+        single (possibly extended) batch still spreads its binds across all
+        workers — one future per ~64 pods is only the ceiling."""
         buf = self._bind_buffer
         if not buf:
             return
+        chunk = min(chunk, max(1, -(-len(buf) // max(self.config.parallelism, 1))))
         self._bind_buffer = []
         self._ensure_bind_pool()
         for i in range(0, len(buf), chunk):
@@ -2518,40 +3102,101 @@ class Scheduler:
             )
 
     def _binding_chunk(self, part) -> None:
+        """One worker's buffered binding cycles.  Lean cycles (fast batches
+        with the default binder only) run their sink calls first and then
+        settle ALL their post-bind tails (queue.done / finish_binding /
+        nominator) under ONE lock acquisition — the tail work is pure
+        bookkeeping, so batching it shrinks per-pod lock traffic without
+        changing what any concurrent reader can observe mid-chunk."""
+        from kubernetes_tpu import events as ev
+
+        lean_ok = []
         for args in part:
-            self._binding_cycle(*args)
+            lean = args[7] if len(args) > 7 else False
+            if lean and not args[4] and args[5] is None:
+                fwk, state, qp, node_name = args[0], args[1], args[2], args[3]
+                try:
+                    s = fwk.run_bind_direct(state, qp.pod, node_name)
+                except Exception as e:  # noqa: BLE001 — surfaced as Status
+                    s = Status.error(f"binding cycle panicked: {e}")
+                if s.ok:
+                    lean_ok.append(args)
+                else:
+                    self._bind_fail(fwk, state, qp, node_name, args[6], s)
+            else:
+                self._binding_cycle(*args)
+        if not lean_ok:
+            return
+        with self._mu:
+            for fwk, state, qp, node_name, *_ in lean_ok:
+                pod = qp.pod
+                self.queue.done(pod.uid)
+                self.cache.finish_binding(pod)
+                self.nominator.delete(pod)
+            self.metrics["scheduled"] += len(lean_ok)
+        for fwk, state, qp, node_name, *_ in lean_ok:
+            pod = qp.pod
+            fwk.run_post_bind(state, pod, node_name)
+            rec = self.recorders.get(pod.scheduler_name)
+            if rec is not None:
+                rec.eventf(
+                    ev.ObjectRef.for_pod(pod),
+                    ev.TYPE_NORMAL,
+                    "Scheduled",
+                    "Binding",
+                    f"Successfully assigned {pod.key} to {node_name}",
+                )
+
+    def _bind_fail(self, fwk, state, qp, node_name, outcome, s) -> None:
+        """Bind-failure unwind: Unreserve + ForgetPod + requeue under the
+        cache lock (schedule_one.go:342-374), outcome patched in place."""
+        pod = qp.pod
+        with self._mu:
+            # The in-flight ledger is still intact here, so events that
+            # arrived during the attempt replay through add_unschedulable.
+            fwk.run_unreserve(state, pod, node_name)
+            self._external_mutations += 1  # committer state diverges
+            ps = self.cache.pod_states.get(pod.uid)
+            if ps is not None:
+                self._view_pod_removed(ps.pod)
+            self.cache.forget_pod(pod)
+            self._handle_failure(qp, s)
+        outcome.node = None
+        outcome.status = s
 
     def _binding_cycle(
-        self, fwk, state, qp, node_name, waited, binder_override, outcome
+        self,
+        fwk,
+        state,
+        qp,
+        node_name,
+        waited,
+        binder_override,
+        outcome,
+        lean: bool = False,
     ) -> None:
         """WaitOnPermit → PreBind → Bind → PostBind on a worker thread
         (schedule_one.go:263-340); failure unwinds via Unreserve + ForgetPod
-        + requeue under the cache lock (:342-374)."""
+        + requeue under the cache lock (:342-374).  ``lean`` (fast batches
+        whose gate proved PreBind irrelevant and whose only binder is the
+        default) collapses the walk to the direct sink call."""
         pod = qp.pod
         try:
-            s = fwk.wait_on_permit(pod) if waited else Status.success()
-            if s.ok:
-                s = fwk.run_pre_bind(state, pod, node_name)
-            if s.ok:
-                if binder_override is not None:
-                    s = binder_override(pod, node_name)
-                else:
-                    s = fwk.run_bind(state, pod, node_name)
+            if lean and not waited and binder_override is None:
+                s = fwk.run_bind_direct(state, pod, node_name)
+            else:
+                s = fwk.wait_on_permit(pod) if waited else Status.success()
+                if s.ok:
+                    s = fwk.run_pre_bind(state, pod, node_name)
+                if s.ok:
+                    if binder_override is not None:
+                        s = binder_override(pod, node_name)
+                    else:
+                        s = fwk.run_bind(state, pod, node_name)
         except Exception as e:  # noqa: BLE001 — surfaced as Status
             s = Status.error(f"binding cycle panicked: {e}")
         if not s.ok:
-            with self._mu:
-                # The in-flight ledger is still intact here, so events that
-                # arrived during the attempt replay through add_unschedulable.
-                fwk.run_unreserve(state, pod, node_name)
-                self._external_mutations += 1  # committer state diverges
-                ps = self.cache.pod_states.get(pod.uid)
-                if ps is not None:
-                    self._view_pod_removed(ps.pod)
-                self.cache.forget_pod(pod)
-                self._handle_failure(qp, s)
-            outcome.node = None
-            outcome.status = s
+            self._bind_fail(fwk, state, qp, node_name, outcome, s)
             return
         with self._mu:
             self.queue.done(pod.uid)

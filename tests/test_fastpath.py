@@ -240,3 +240,86 @@ def test_fast_committer_sees_scan_path_commits():
     )
     outs = sched.schedule_pending()
     assert outs[0].node is None, outs[0]
+
+
+@pytest.mark.parametrize("seed", [3, 4, 5])
+def test_sig_scan_matches_host_committer(seed):
+    """Shadow mode: the device sig_scan kernel's choices must bit-match the
+    host FastCommitter replaying the same batches on the same state."""
+    rng = random.Random(seed)
+    nodes = _mk_cluster(rng, 30)
+
+    def pods():
+        import copy
+
+        return [
+            copy.deepcopy(_mk_pod(random.Random(seed * 77 + i), i))
+            for i in range(90)
+        ]
+
+    cluster = FakeCluster()
+    sched = Scheduler()
+    sched.fast_shadow_check = True  # any divergence raises inside the drain
+    cluster.connect(sched)
+    for n in nodes:
+        cluster.create_node(n)
+    for p in pods():
+        cluster.create_pod(p)
+    sched.schedule_pending()
+    assert sched.metrics["fast_batches"] > 0, "fast path never engaged"
+
+
+def test_extension_stops_at_nonconst_signature_no_pod_loss():
+    """Interleave signatures whose static taint raws ARE and are NOT
+    constant over their feasible nodes (PreferNoSchedule on a subset of
+    nodes makes untolerated pods' taint score vary → scan path).  The
+    fast-batch extension must stop at such pods rather than pop them, and
+    every pod must drain exactly once through whichever path owns it."""
+    from kubernetes_tpu.api.types import Taint, Toleration
+    from kubernetes_tpu.scheduler import Scheduler
+
+    nodes = []
+    for i in range(12):
+        taints = (
+            (Taint(key="soft", value="x", effect="PreferNoSchedule"),)
+            if i % 3 == 0
+            else ()
+        )
+        nodes.append(
+            Node(
+                name=f"n{i}",
+                labels={"kubernetes.io/hostname": f"n{i}"},
+                capacity=Resource.from_map(
+                    {"cpu": "16", "memory": "64Gi", "pods": 60}
+                ),
+                taints=taints,
+            )
+        )
+    pods = []
+    for i in range(120):
+        tol = (
+            (Toleration(key="soft", operator="Equal", value="x"),)
+            if i % 4 != 0
+            else ()
+        )
+        pods.append(
+            Pod(
+                name=f"p{i:03d}",
+                tolerations=tol,
+                containers=[Container(name="c", requests={"cpu": "100m"})],
+            )
+        )
+    got = {}
+    sched = Scheduler()
+    sched.config.batch_size = 32  # several batches; extension crosses sigs
+    sched.binding_sink = lambda pod, node: got.__setitem__(pod.name, node)
+    for n in nodes:
+        sched.on_node_add(n)
+    for p in pods:
+        sched.on_pod_add(p)
+    outs = sched.schedule_pending()
+    assert len(got) == 120, f"lost pods: {len(got)}"
+    assert sorted(got) == sorted(p.name for p in pods)
+    assert len(sched.queue) == 0
+    # nothing stuck in the in-flight ledger
+    assert not sched.queue._in_flight, sched.queue._in_flight
