@@ -10,11 +10,15 @@ scheduler-relevant surface:
     client's relist (reflector.go:340);
   * GET  /api/v1/{nodes,pods}                  → {"resourceVersion", "items"}
   * GET  /api/v1/{res}?watch=1&resourceVersion=N → chunked JSON-lines stream
-  * POST /api/v1/{nodes,pods}                  → create
+  * POST /api/v1/{nodes,pods}                  → create (bare object, or
+    {"items": [...]} for a bulk create in one request)
   * PUT  /api/v1/nodes/{name}                  → update
   * DELETE /api/v1/{res}/{key}                 → delete
   * POST /api/v1/pods/{uid}/binding            → the binding subresource
     (registry/core/pod/storage/storage.go:169 assignPod)
+  * POST /api/v1/bindings                      → BULK bindings ({"items":
+    [{"uid","node"}]} → per-item results) — the batch-first extension of
+    the per-pod subresource
   * PATCH /api/v1/pods/{uid}/status            → nominatedNodeName patches
 
 Writes go through the wrapped FakeCluster so its watch fan-out, PV
@@ -38,21 +42,32 @@ WATCH_WINDOW = 4096  # events kept per resource (watch_cache.go capacity)
 
 
 class _WatchCache:
-    """Sliding window of events with a condition for long-polling."""
+    """Sliding window of events with a condition for long-polling.
+
+    Each event carries its WIRE BYTES (the JSON line), serialized once at
+    record time — every watcher of every stream writes the same bytes, so
+    per-watcher re-serialization would multiply encode cost by the watcher
+    count (cacher.go keeps one encoded object per event the same way)."""
 
     def __init__(self, window: int = WATCH_WINDOW):
-        self.events: Deque[Tuple[int, str, dict]] = deque(maxlen=window)
+        self.events: Deque[Tuple[int, bytes]] = deque(maxlen=window)  # (rv, wire line)
         self.rv = 0
         self.cond = threading.Condition()
 
     def record(self, event_type: str, envelope: dict) -> int:
         with self.cond:
             self.rv += 1
-            self.events.append((self.rv, event_type, envelope))
+            line = (
+                json.dumps(
+                    {"type": event_type, "rv": self.rv, "object": envelope}
+                )
+                + "\n"
+            ).encode()
+            self.events.append((self.rv, line))
             self.cond.notify_all()
             return self.rv
 
-    def since(self, rv: int, timeout: float) -> Optional[List[Tuple[int, str, dict]]]:
+    def since(self, rv: int, timeout: float) -> Optional[List[Tuple[int, bytes]]]:
         """Events with rv' > rv; None ⇒ rv fell out of the window (410)."""
         with self.cond:
             if self.events and rv < self.events[0][0] - 1:
@@ -90,6 +105,12 @@ class ApiServer:
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+            # Nagle + the peer's delayed ACK turns every multi-write
+            # response into a ~40ms stall on keep-alive connections —
+            # fatal for per-pod request rates (kube-apiserver serves
+            # HTTP/2 where this never applies).  StreamRequestHandler
+            # applies this to the connection socket.
+            disable_nagle_algorithm = True
 
             def log_message(self, fmt, *args):  # noqa: D401 — quiet
                 pass
@@ -124,8 +145,7 @@ class ApiServer:
                 self.send_header("Transfer-Encoding", "chunked")
                 self.end_headers()
 
-                def chunk(payload: dict) -> bool:
-                    data = (json.dumps(payload) + "\n").encode()
+                def chunk_raw(data: bytes) -> bool:
                     try:
                         self.wfile.write(hex(len(data))[2:].encode() + b"\r\n")
                         self.wfile.write(data + b"\r\n")
@@ -133,6 +153,9 @@ class ApiServer:
                         return True
                     except (BrokenPipeError, ConnectionError, OSError):
                         return False
+
+                def chunk(payload: dict) -> bool:
+                    return chunk_raw((json.dumps(payload) + "\n").encode())
 
                 while True:
                     events = cache.since(rv, timeout=0.5)
@@ -143,12 +166,12 @@ class ApiServer:
                         if not chunk({"type": "BOOKMARK", "rv": rv}):
                             return
                         continue
-                    ok = True
-                    for erv, etype, envelope in events:
-                        rv = erv
-                        ok = chunk({"type": etype, "rv": erv, "object": envelope})
-                        if not ok:
-                            return
+                    # coalesced emission: ONE chunked frame carries every
+                    # pending event's pre-serialized line — a burst of N
+                    # events costs one write+flush instead of N
+                    rv = events[-1][0]
+                    if not chunk_raw(b"".join(e[1] for e in events)):
+                        return
                 try:
                     self.wfile.write(b"0\r\n\r\n")
                 except OSError:
@@ -159,11 +182,43 @@ class ApiServer:
                 length = int(self.headers.get("Content-Length", 0))
                 body = json.loads(self.rfile.read(length) or b"{}")
                 if len(parts) == 3 and parts[2] == "nodes":
+                    if isinstance(body, dict) and "items" in body:
+                        for env in body["items"]:
+                            server.api.create_node(decode(env))
+                        return self._json(201, {"ok": True, "count": len(body["items"])})
                     server.api.create_node(decode(body))
                     return self._json(201, {"ok": True})
                 if len(parts) == 3 and parts[2] == "pods":
+                    if isinstance(body, dict) and "items" in body:
+                        for env in body["items"]:
+                            server.api.create_pod(decode(env))
+                        return self._json(201, {"ok": True, "count": len(body["items"])})
                     server.api.create_pod(decode(body))
                     return self._json(201, {"ok": True})
+                if len(parts) == 3 and parts[2] == "bindings":
+                    # BULK binding write: the per-pod binding subresource
+                    # semantics applied item-wise under the server lock —
+                    # the batch-first extension of assignPod
+                    # (storage.go:169); per-item statuses come back so the
+                    # scheduler can unwind exactly the pods that failed
+                    results = []
+                    with server._mu:
+                        for item in body.get("items", []):
+                            uid = item.get("uid")
+                            pod = server.api.pods.get(uid)
+                            if pod is None:
+                                results.append(
+                                    {"code": 404, "error": f"pod {uid} not found"}
+                                )
+                                continue
+                            try:
+                                server.api.bind(pod, item["node"])
+                                results.append(None)
+                            except RuntimeError as e:
+                                results.append({"code": 409, "error": str(e)})
+                            except KeyError as e:
+                                results.append({"code": 404, "error": str(e)})
+                    return self._json(200, {"results": results})
                 if len(parts) == 5 and parts[2] == "pods" and parts[4] == "binding":
                     uid = unquote(parts[3])
                     # check-and-bind under the server lock: concurrent
@@ -247,15 +302,23 @@ class ApiServer:
 
     def list_payload(self, res: str) -> dict:
         """Consistent list: snapshot + the rv of the last event applied
-        (reflector lists at this rv, then watches from it)."""
+        (reflector lists at this rv, then watches from it).  Only the
+        snapshot + rv capture happens under the watch-cache lock; encoding
+        10k objects there would stall every writer and watch fan-out for
+        the duration (replayed events are idempotent on the client, so an
+        event racing the encode is harmless)."""
         cache = self.caches[res]
         with cache.cond:
             # dict.copy() is atomic under the GIL — handler threads mutate
             # the store concurrently and bare .values() iteration would
             # raise "dictionary changed size during iteration"
             store = self.api.nodes if res == "nodes" else self.api.pods
-            items = [encode(obj) for obj in store.copy().values()]
-            return {"resourceVersion": cache.rv, "items": items}
+            snapshot = store.copy()
+            rv = cache.rv
+        return {
+            "resourceVersion": rv,
+            "items": [encode(obj) for obj in snapshot.values()],
+        }
 
     # ----- lifecycle --------------------------------------------------------
 

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import http.client
 import json
+import socket
 import threading
 import urllib.error
 import urllib.parse
@@ -58,6 +59,13 @@ class ApiClient:
                 conn.close()
             conn = http.client.HTTPConnection(
                 self._host, self._port, timeout=self.timeout
+            )
+            # Nagle + delayed-ACK stalls every header/body write pair by
+            # ~40ms — fatal for per-pod request rates (client-go rides
+            # HTTP/2 streams where this never applies)
+            conn.connect()
+            conn.sock.setsockopt(
+                socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
             )
             self._local.conn = conn
         return conn
@@ -108,12 +116,37 @@ class ApiClient:
     def delete_pod(self, uid: str) -> None:
         self._req("DELETE", f"/api/v1/pods/{quote(uid, safe='')}")
 
+    def create_nodes(self, nodes) -> None:
+        """Bulk node create — one request for the whole list."""
+        self._req("POST", "/api/v1/nodes", {"items": [encode(n) for n in nodes]})
+
+    def create_pods(self, pods) -> None:
+        """Bulk pod create — one request for the whole list."""
+        self._req("POST", "/api/v1/pods", {"items": [encode(p) for p in pods]})
+
     def bind(self, pod: Pod, node_name: str) -> None:
         self._req(
             "POST",
             f"/api/v1/pods/{quote(pod.uid, safe='')}/binding",
             {"node": node_name},
         )
+
+    def bind_many(self, items) -> List[Optional[str]]:
+        """Bulk bindings: items is [(pod, node_name), ...]; returns a
+        per-item error message or None, aligned with the input.  The
+        binding subresource is per-pod in the reference (storage.go:169) —
+        the batch-first rebuild extends it so a drain's worth of bindings
+        rides one request instead of one per pod."""
+        payload = {
+            "items": [
+                {"uid": pod.uid, "node": node} for pod, node in items
+            ]
+        }
+        out = self._req("POST", "/api/v1/bindings", payload)
+        return [
+            None if r is None else f"HTTP {r.get('code')}: {r.get('error')}"
+            for r in out.get("results", [None] * len(items))
+        ]
 
     def patch_pod_status(self, pod: Pod) -> None:
         self._req(
@@ -277,6 +310,7 @@ class RemoteClusterSource:
             ),
         ]
         scheduler.binding_sink = self.client.bind
+        scheduler.binding_sink_many = self.client.bind_many
         scheduler.pod_deleter = lambda pod: self.client.delete_pod(pod.uid)
         scheduler.status_patcher = self.client.patch_pod_status
 

@@ -53,6 +53,23 @@ _MISSING = object()  # dict-miss sentinel (cached signature keys can be None)
 
 
 @dataclass
+class _BindTask:
+    """One pod's buffered binding cycle (the goroutine-per-pod payload)."""
+
+    fwk: object
+    state: object
+    qp: object
+    node_name: str
+    waited: bool
+    binder_override: object
+    outcome: "ScheduleOutcome"
+    lean: bool = False
+
+    def lean_eligible(self) -> bool:
+        return self.lean and not self.waited and self.binder_override is None
+
+
+@dataclass
 class ScheduleOutcome:
     pod: Pod
     node: Optional[str]
@@ -206,6 +223,9 @@ class Scheduler:
             extenders or []
         )
         self.binding_sink = binding_sink or (lambda pod, node: None)
+        # optional BULK sink ([(pod, node)] → per-item error or None); the
+        # API tier installs it so a chunk's bindings ride one write
+        self.binding_sink_many = None
         self.pod_deleter = lambda pod: None  # victim eviction sink
         self.pdb_lister = lambda: []
         self.status_patcher = lambda pod: None  # pod status writes (nomination)
@@ -1710,9 +1730,7 @@ class Scheduler:
         rows = self._fast_sig_rows(fwk, batch, keys, enabled, weights)
         if rows is None:
             return None
-        rec = self._fast_dispatch(
-            fwk, state, batch, keys, enabled, weights, pipeline_empty=True
-        )
+        rec = self._fast_dispatch(fwk, state, batch, keys, enabled, weights)
         if rec is None:
             return None
         outcomes.extend(self._finish_fast(rec))
@@ -1790,9 +1808,17 @@ class Scheduler:
             return None
         return cache
 
-    def _fast_dispatch(
-        self, fwk, state, batch, keys, enabled, weights, pipeline_empty=True
-    ):
+    def _fast_key(self, fwk, enabled, weights):
+        return (
+            self._external_mutations,
+            getattr(self, "_nonfast_commits", 0),
+            self.mirror._full_packs,
+            enabled,
+            weights,
+            fwk.profile_name,
+        )
+
+    def _fast_dispatch(self, fwk, state, batch, keys, enabled, weights):
         """Run one fast batch and return its pending record.
 
         Hybrid committer: the persistent source of truth is a host
@@ -1812,14 +1838,7 @@ class Scheduler:
 
         cache = self._sig_cache
         check_fit = "NodeResourcesFit" in enabled
-        fc_key = (
-            self._external_mutations,
-            getattr(self, "_nonfast_commits", 0),
-            self.mirror._full_packs,
-            enabled,
-            weights,
-            fwk.profile_name,
-        )
+        fc_key = self._fast_key(fwk, enabled, weights)
         holder = getattr(self, "_fastdev", None)
         if holder is None or self._fc_key != fc_key:
             nt = self.mirror.nodes
@@ -1831,6 +1850,9 @@ class Scheduler:
                 "allowed": None,
                 "stack": None,
                 "heaps_dirty": False,
+                "dev_inflight": 0,  # unharvested device batches — the host
+                # committer lags exactly these, so the host path is legal
+                # only at zero
                 "p_cap": 64,
             }
             if getattr(self, "fast_shadow_check", False):
@@ -1867,9 +1889,11 @@ class Scheduler:
         pod_sigs = [sigs[k] for k in keys]
         t0 = time.perf_counter()
 
-        # ---- host path: empty pipeline + small batch → the greedy answers
-        # locally in O(P · log N) with no device link involvement at all
-        if pipeline_empty and len(batch) < getattr(
+        # ---- host path: no unharvested device batches + small batch →
+        # the greedy answers locally in O(P · log N) with no device link
+        # involvement at all (host records already advanced the committer
+        # at dispatch, so they may stay pending)
+        if holder["dev_inflight"] == 0 and len(batch) < getattr(
             self.config, "fast_device_min", 1024
         ):
             if holder["heaps_dirty"]:
@@ -1959,12 +1983,18 @@ class Scheduler:
             # data is local and the blocking fetch is cheap (the same
             # latency-hiding discipline as the chained gang pipeline)
             choices_dev.copy_to_host_async()
+            holder["dev_inflight"] += 1
         except Exception:
             # the donated state buffers may be gone — drop the holder so the
             # next fast batch rebuilds from the mirror, and let the caller
             # error-requeue this batch
             logger.exception("sig_scan dispatch failed; dropping fast state")
             self._fastdev = None
+            # the dropped lineage's commits live only in the CACHE; force
+            # the next _sync_mirror_external to repack from it, or the
+            # rebuilt committer would start from the drain-start mirror
+            # and double-book every node's capacity
+            self._external_mutations += 1
             return None
         self.metrics["fast_batches"] += 1
         return {
@@ -2002,6 +2032,7 @@ class Scheduler:
         choices = rec["choices_host"]
         if choices is None:
             choices = jax.device_get(rec["choices_dev"])[: len(batch)].tolist()
+            holder["dev_inflight"] -= 1
             # advance the host committer to the post-batch state by
             # replaying the kernel's commits (pure host arithmetic — the
             # device state never needs to come back over the link)
@@ -2148,6 +2179,19 @@ class Scheduler:
             return None
         if not chain_settled:
             return "flush"
+        # a lineage rebuild (external events moved the ground truth) must
+        # not happen under unharvested records: their commits reach the
+        # cache only at harvest, and a rebuild reads the mirror — settle
+        # the pipeline first, then rebuild on the retry
+        if not pipeline_empty:
+            enabled_probe = fwk.device_enabled()
+            weights_probe = tuple(
+                fwk.score_weights.get(n, 0) for n in gang.WEIGHT_ORDER
+            )
+            if getattr(self, "_fastdev", None) is None or self._fc_key != self._fast_key(
+                fwk, enabled_probe, weights_probe
+            ):
+                return "flush"
         # spec-level host-score probe on the SEED batch (extension pods are
         # probed inside the predicate) — the pre-PreFilter equivalent of the
         # sync path's Skip-state check: a pod whose spec is irrelevant Skips
@@ -2225,9 +2269,7 @@ class Scheduler:
         # fast commits happen outside the chain's device state — drop it
         # (it restarts from the repacked mirror once the pipeline settles)
         self._chain = None
-        rec = self._fast_dispatch(
-            fwk, state, batch, keys, enabled, weights, pipeline_empty
-        )
+        rec = self._fast_dispatch(fwk, state, batch, keys, enabled, weights)
         if rec is None:
             # dispatch failure after pods (incl. extension) were popped and
             # PreFilter ran: error-requeue the whole batch with backoff —
@@ -3060,20 +3102,22 @@ class Scheduler:
             pod_attempts=qp.attempts,
             first_enqueue_time=qp.timestamp,
         )
-        args = (fwk, state, qp, node_name, waited, binder_override, outcome, lean)
+        task = _BindTask(
+            fwk, state, qp, node_name, waited, binder_override, outcome, lean
+        )
         if waited:
             # A Wait-ed pod's cycle can block on permit for its timeout —
             # it must not serialize behind (or ahead of) other pods' binds;
             # it gets a dedicated worker like the reference's goroutine.
             self._ensure_bind_pool()
             self._inflight_binds.append(
-                self._bind_pool.submit(self._binding_cycle, *args)
+                self._bind_pool.submit(self._binding_cycle, task)
             )
         else:
             # Common case: buffer and submit in chunks at batch end — one
             # future per ~64 pods instead of per pod (submit + wakeup
             # overhead dominates when the bind sink is an in-proc store).
-            self._bind_buffer.append(args)
+            self._bind_buffer.append(task)
         return outcome
 
     def _ensure_bind_pool(self) -> None:
@@ -3101,7 +3145,7 @@ class Scheduler:
                 self._bind_pool.submit(self._binding_chunk, part)
             )
 
-    def _binding_chunk(self, part) -> None:
+    def _binding_chunk(self, part: List["_BindTask"]) -> None:
         """One worker's buffered binding cycles.  Lean cycles (fast batches
         with the default binder only) run their sink calls first and then
         settle ALL their post-bind tails (queue.done / finish_binding /
@@ -3111,32 +3155,53 @@ class Scheduler:
         from kubernetes_tpu import events as ev
 
         lean_ok = []
-        for args in part:
-            lean = args[7] if len(args) > 7 else False
-            if lean and not args[4] and args[5] is None:
-                fwk, state, qp, node_name = args[0], args[1], args[2], args[3]
+        lean_tasks = [t for t in part if t.lean_eligible()]
+        sink_many = getattr(self, "binding_sink_many", None)
+        if sink_many is not None and len(lean_tasks) > 1:
+            # BULK sink (the API tier's /bindings endpoint): the whole
+            # chunk's bindings ride one write; per-item errors unwind
+            # exactly the pods that failed
+            try:
+                errs = sink_many([(t.qp.pod, t.node_name) for t in lean_tasks])
+            except Exception as e:  # noqa: BLE001 — whole-batch failure
+                errs = [str(e)] * len(lean_tasks)
+            for t, err in zip(lean_tasks, errs):
+                if err is None:
+                    lean_ok.append(t)
+                else:
+                    self._bind_fail(
+                        t.fwk, t.state, t.qp, t.node_name, t.outcome,
+                        Status.error(err),
+                    )
+            lean_handled = set(map(id, lean_tasks))
+        else:
+            lean_handled = set()
+        for t in part:
+            if id(t) in lean_handled:
+                continue
+            if t.lean_eligible():
                 try:
-                    s = fwk.run_bind_direct(state, qp.pod, node_name)
+                    s = t.fwk.run_bind_direct(t.state, t.qp.pod, t.node_name)
                 except Exception as e:  # noqa: BLE001 — surfaced as Status
                     s = Status.error(f"binding cycle panicked: {e}")
                 if s.ok:
-                    lean_ok.append(args)
+                    lean_ok.append(t)
                 else:
-                    self._bind_fail(fwk, state, qp, node_name, args[6], s)
+                    self._bind_fail(t.fwk, t.state, t.qp, t.node_name, t.outcome, s)
             else:
-                self._binding_cycle(*args)
+                self._binding_cycle(t)
         if not lean_ok:
             return
         with self._mu:
-            for fwk, state, qp, node_name, *_ in lean_ok:
-                pod = qp.pod
+            for t in lean_ok:
+                pod = t.qp.pod
                 self.queue.done(pod.uid)
                 self.cache.finish_binding(pod)
                 self.nominator.delete(pod)
             self.metrics["scheduled"] += len(lean_ok)
-        for fwk, state, qp, node_name, *_ in lean_ok:
-            pod = qp.pod
-            fwk.run_post_bind(state, pod, node_name)
+        for t in lean_ok:
+            pod = t.qp.pod
+            t.fwk.run_post_bind(t.state, pod, t.node_name)
             rec = self.recorders.get(pod.scheduler_name)
             if rec is not None:
                 rec.eventf(
@@ -3144,7 +3209,7 @@ class Scheduler:
                     ev.TYPE_NORMAL,
                     "Scheduled",
                     "Binding",
-                    f"Successfully assigned {pod.key} to {node_name}",
+                    f"Successfully assigned {pod.key} to {t.node_name}",
                 )
 
     def _bind_fail(self, fwk, state, qp, node_name, outcome, s) -> None:
@@ -3164,25 +3229,17 @@ class Scheduler:
         outcome.node = None
         outcome.status = s
 
-    def _binding_cycle(
-        self,
-        fwk,
-        state,
-        qp,
-        node_name,
-        waited,
-        binder_override,
-        outcome,
-        lean: bool = False,
-    ) -> None:
+    def _binding_cycle(self, t: "_BindTask") -> None:
         """WaitOnPermit → PreBind → Bind → PostBind on a worker thread
         (schedule_one.go:263-340); failure unwinds via Unreserve + ForgetPod
-        + requeue under the cache lock (:342-374).  ``lean`` (fast batches
-        whose gate proved PreBind irrelevant and whose only binder is the
-        default) collapses the walk to the direct sink call."""
+        + requeue under the cache lock (:342-374).  A lean task (fast
+        batches whose gate proved PreBind irrelevant and whose only binder
+        is the default) collapses the walk to the direct sink call."""
+        fwk, state, qp, node_name = t.fwk, t.state, t.qp, t.node_name
+        waited, binder_override, outcome = t.waited, t.binder_override, t.outcome
         pod = qp.pod
         try:
-            if lean and not waited and binder_override is None:
+            if t.lean_eligible():
                 s = fwk.run_bind_direct(state, pod, node_name)
             else:
                 s = fwk.wait_on_permit(pod) if waited else Status.success()

@@ -168,6 +168,9 @@ def run_scale_sim(
 
         t0 = time.perf_counter()
         bound_at_start = len(api.bindings)
+        deleted = [0]  # SUCCESSFUL churn deletes — wait targets derive
+        # from this count, not the attempt count, so a delete racing the
+        # scheduler can't make the wait loops spin to timeout
         remaining = n_pods - warm
         per_wave = remaining // churn_waves
         for w in range(churn_waves):
@@ -177,16 +180,17 @@ def run_scale_sim(
             for uid in victims:
                 try:
                     client.delete_pod(uid)
+                    deleted[0] += 1
                 except Exception:  # noqa: BLE001 — racing the scheduler
                     pass
-            target = warm + per_wave * (w + 1) - churn_deletes * (w + 1)
+            target = warm + per_wave * (w + 1) - deleted[0]
             while time.monotonic() < deadline and len(api.bindings) < target:
-                time.sleep(0.05)
+                time.sleep(0.005)
             log(f"wave {w}: {len(api.bindings)} bound")
         # settle: all created pods either bound or deleted
-        expect = uid_counter[0] - churn_deletes * churn_waves
+        expect = uid_counter[0] - deleted[0]
         while time.monotonic() < deadline and len(api.bindings) < expect:
-            time.sleep(0.05)
+            time.sleep(0.005)
         wall = time.perf_counter() - t0
         pods_bound = len(api.bindings) - bound_at_start
 
