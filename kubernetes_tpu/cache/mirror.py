@@ -33,6 +33,7 @@ from kubernetes_tpu.snapshot.schema import (
     bucket_cap,
     pack_existing_pods,
     pack_nodes,
+    refresh_visit_rank,
     write_node_row,
 )
 
@@ -190,6 +191,7 @@ class SnapshotMirror:
             # parsed-int table — Gt/Lt selector eval would read stale rows
             or len(self.vocab.label_vals) > self.nodes.val_ints.shape[0]
         )
+        order_dirty = False  # membership/zone changes move visit ranks
         if not need_full:
             known = set(self.nodes.name_to_idx)
             current = set(names)
@@ -208,6 +210,7 @@ class SnapshotMirror:
                     ):
                         need_full = True
                         break
+                    order_dirty = True
                     # static_generation intentionally NOT advanced here:
                     # the dirty-row loop below must still see pending
                     # updates of OTHER nodes (it advances the watermark
@@ -224,9 +227,11 @@ class SnapshotMirror:
                 continue
             i = self.nodes.name_to_idx[cn.node.name]
             if cn.static_generation > self.static_generation:
-                # node OBJECT changed — rewrite the static row too
+                # node OBJECT changed — rewrite the static row too (a zone
+                # label could have moved, so the visit order refreshes)
                 if not write_node_row(self.nodes, i, cn.node, self.vocab):
                     self._force_full = True  # slot axis truncated
+                order_dirty = True
             self._write_usage_row(cn, i, lanes)
             if self._force_full:
                 break  # overflow: everything below is repacked anyway
@@ -240,6 +245,13 @@ class SnapshotMirror:
             self._force_full = False
             self._full_pack(cache, namespace_labels)
             return
+
+        if order_dirty:
+            refresh_visit_rank(
+                self.nodes,
+                [cn.node for cn in real],
+                [self.nodes.name_to_idx[n] for n in names],
+            )
 
         # Placed-pod tensors rebuild lazily via the `existing` property —
         # cache.pod_version (bumped on every pod add/remove/replace) is the

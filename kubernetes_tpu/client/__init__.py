@@ -6,6 +6,17 @@ stream through RemoteClusterSource exactly like the in-proc FakeCluster.
 """
 
 from kubernetes_tpu.client.api_server import ApiServer
-from kubernetes_tpu.client.client import ApiClient, Reflector, RemoteClusterSource
+from kubernetes_tpu.client.client import (
+    ApiClient,
+    Reflector,
+    RemoteClusterSource,
+    RemoteLeaseStore,
+)
 
-__all__ = ["ApiServer", "ApiClient", "Reflector", "RemoteClusterSource"]
+__all__ = [
+    "ApiServer",
+    "ApiClient",
+    "Reflector",
+    "RemoteClusterSource",
+    "RemoteLeaseStore",
+]

@@ -127,6 +127,13 @@ class ApiServer:
                 u = urlparse(self.path)
                 parts = [p for p in u.path.split("/") if p]
                 q = parse_qs(u.query)
+                if len(parts) == 4 and parts[:3] == ["api", "v1", "leases"]:
+                    from kubernetes_tpu.util.leases import lease_to_wire
+
+                    rec = server.api.lease_store.get(unquote(parts[3]))
+                    if rec is None:
+                        return self._json(404, {"error": "lease not found"})
+                    return self._json(200, lease_to_wire(rec))
                 if len(parts) == 3 and parts[:2] == ["api", "v1"]:
                     res = parts[2]
                     if res not in server.caches:
@@ -252,6 +259,18 @@ class ApiServer:
                 if len(parts) == 4 and parts[2] == "nodes":
                     server.api.update_node(decode(body))
                     return self._json(200, {"ok": True})
+                if len(parts) == 4 and parts[2] == "leases":
+                    # Lease CAS (resourcelock/leaselock.go over the wire):
+                    # stale resourceVersion → 409, the elector backs off
+                    from kubernetes_tpu.util.leases import lease_from_wire
+
+                    rec = lease_from_wire(body)
+                    if server.api.lease_store.update(unquote(parts[3]), rec):
+                        return self._json(
+                            200,
+                            {"ok": True, "resourceVersion": rec.resource_version + 1},
+                        )
+                    return self._json(409, {"error": "lease CAS conflict"})
                 return self._json(404, {"error": "not found"})
 
             def do_PATCH(self):  # noqa: N802

@@ -172,6 +172,45 @@ class ApiClient:
                 yield evt
 
 
+class RemoteLeaseStore:
+    """The LeaseStore get/update surface over the API server's
+    /api/v1/leases resource — what lets two real scheduler PROCESSES elect
+    through one control plane (resourcelock/leaselock.go's role).  CAS
+    conflicts (409) surface as update() → False; transport errors also
+    count as failed attempts so the elector just retries next period."""
+
+    def __init__(self, client: ApiClient):
+        self.client = client
+
+    def get(self, name: str):
+        from kubernetes_tpu.util.leases import lease_from_wire
+
+        try:
+            d = self.client._req(
+                "GET", f"/api/v1/leases/{quote(name, safe='')}"
+            )
+        except ApiError as e:
+            if e.code == 404:
+                return None
+            raise
+        return lease_from_wire(d)
+
+    def update(self, name: str, rec) -> bool:
+        from kubernetes_tpu.util.leases import lease_to_wire
+
+        try:
+            self.client._req(
+                "PUT",
+                f"/api/v1/leases/{quote(name, safe='')}",
+                lease_to_wire(rec),
+            )
+            return True
+        except ApiError as e:
+            if e.code == 409:
+                return False
+            raise
+
+
 def _key_of(obj) -> str:
     return obj.uid if isinstance(obj, Pod) else obj.name
 

@@ -102,6 +102,9 @@ class DeviceCluster:
     used_ip: Any  # i32 [N, U]
     used_wild: Any  # bool [N, U]
     img_sizes: Any  # i64 [N, IMG]
+    # zone-round-robin visit rank (node_tree.go order; -1 invalid) — the
+    # sampling-compat window/rotation and compat tie-breaks read this
+    visit_rank: Any  # i32 [N]
     # placed pods
     epod_node: Any  # i32 [E]
     epod_ns: Any  # i32 [E]
@@ -149,6 +152,7 @@ class DeviceCluster:
             used_ip=np.asarray(nt.used_ip, np.int32),
             used_wild=np.asarray(nt.used_wild, bool),
             img_sizes=np.asarray(nt.img_sizes, np.int64),
+            visit_rank=np.asarray(nt.visit_rank, np.int32),
             epod_node=np.asarray(ep.node_idx, np.int32),
             epod_ns=np.asarray(ep.ns_id, np.int32),
             epod_labels=np.asarray(ep.label_vals, np.int32),

@@ -801,15 +801,26 @@ def gang_schedule(
         feas = mask
         if sample_k is not None:
             # adaptive-sampling cut: keep the first sample_k feasible nodes
-            # in rotation order from the carried start index
+            # in ZONE-ROUND-ROBIN rotation order from the carried start
+            # index — dc.visit_rank is the nodeTree order
+            # (node_tree.go:119-143) that the reference's sampling,
+            # rotation, and tie-breaks all ride
             nv = jnp.maximum(dc.n_valid_nodes, 1)
             start = state["sample_start"]
-            idx = jnp.arange(N, dtype=I32)
-            rank = jnp.where(idx < nv, (idx - start) % nv, N - 1)
-            rot = jnp.zeros((N,), bool).at[rank].set(feas & (idx < nv))
+            vr = dc.visit_rank
+            valid_vr = vr >= 0
+            rank = jnp.where(valid_vr, (vr - start) % nv, N)
+            rot = (
+                jnp.zeros((N + 1,), bool)
+                .at[rank]
+                .set(feas & valid_vr, mode="drop")[:N]
+            )
             cum = jnp.cumsum(rot.astype(I32))
             keep_rot = rot & (cum <= sample_k)
-            feas = keep_rot[rank] & feas
+            feas = (
+                jnp.concatenate([keep_rot, jnp.zeros((1,), bool)])[rank]
+                & feas
+            )
             total_feas = cum[N - 1]
             processed = jnp.where(
                 total_feas >= sample_k,
@@ -949,9 +960,19 @@ def gang_schedule(
             k_p = jax.random.fold_in(tie_key, attempt_base + p)
             h = jax.random.bits(k_p, (N,), dtype=jnp.uint32).astype(I64)
             ranked = jnp.where(feas, total_score * (1 << 33) + h, neg)
+            choice = jnp.argmax(ranked).astype(I32)
+        elif sample_k is not None:
+            # compat first-max: among max-score nodes, pick the first in
+            # the zone-round-robin VISIT order (the reference appends
+            # feasible nodes in nodeTree walk order, so "first max" means
+            # first visited, not lowest packed slot)
+            ranked = jnp.where(feas, total_score, neg)
+            best = jnp.max(ranked)
+            tie_rank = jnp.where(feas & (ranked == best), rank, N + 1)
+            choice = jnp.argmin(tie_rank).astype(I32)
         else:
             ranked = jnp.where(feas, total_score, neg)
-        choice = jnp.argmax(ranked).astype(I32)
+            choice = jnp.argmax(ranked).astype(I32)
         choice = jnp.where((n_feas > 0) & active, choice, ABSENT)
         n_feas = jnp.where(active, n_feas, 0)
 

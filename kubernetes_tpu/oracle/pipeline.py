@@ -125,6 +125,16 @@ def feasible_nodes(
     feasible: List[str] = []
     reasons: Dict[str, List[str]] = {}
     names = list(state.nodes)
+    if sample_k is not None or sample_pct is not None:
+        # sampling-compat mode walks nodes in the reference's nodeTree
+        # order — zone round-robin (node_tree.go:119-143); the rotation
+        # below and first-max selection both ride this order
+        from kubernetes_tpu.util.nodetree import ZONE_LABEL, node_tree_order
+
+        order = node_tree_order(
+            [state.nodes[n].node.labels.get(ZONE_LABEL) for n in names]
+        )
+        names = [names[i] for i in order]
     if allowed is not None:
         names = [n for n in names if n in allowed]
     n_considered = len(names)

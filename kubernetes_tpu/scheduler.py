@@ -473,6 +473,13 @@ class Scheduler:
                 self.cache.update_pod(old, new)
             else:
                 self.cache.add_pod(new)
+                # the pod was assigned by SOMEONE ELSE (another scheduler —
+                # the HA standby case) while still sitting in our queue:
+                # the reference's unassigned-pod informer sees this
+                # transition as a delete from the scheduling queue
+                # (eventhandlers.go assignedPod split) — without it the
+                # standby would later pop and re-schedule a bound pod
+                self.queue.delete(new)
             action = ActionType(0)
             if old.labels != new.labels:
                 action |= ActionType.UPDATE_POD_LABEL
@@ -2797,8 +2804,11 @@ class Scheduler:
         if pct > 0 or self.config.reference_sampling_compat:
             n_valid = len(self.cache.real_nodes())
             k = num_feasible_nodes_to_find(pct, n_valid)
-            if k < n_valid:
-                sample_k = jnp.asarray(k, I32)
+            # k >= n visits every node, but compat mode still needs the
+            # kernel's VISIT-ORDER branch: the reference walks (and
+            # first-max tie-breaks) in nodeTree zone-round-robin order even
+            # when nothing is cut, so pass k = n rather than disabling
+            sample_k = jnp.asarray(min(k, n_valid), I32)
         tie_key = None
         if self.config.tie_break_seed is not None:
             if getattr(self, "_tie_key", None) is None:
@@ -3068,8 +3078,18 @@ class Scheduler:
     ) -> ScheduleOutcome:
         """The _commit body with self._mu already held — lets the fast
         harvest commit a whole run of pods under ONE lock acquisition."""
+        from kubernetes_tpu.cache.cache import CacheError
+
         pod = qp.pod
-        self.cache.assume_pod(pod, node_name)
+        try:
+            self.cache.assume_pod(pod, node_name)
+        except CacheError as e:
+            # the pod was assumed/added concurrently (an external binding
+            # raced our decision — the multi-scheduler window): fail THIS
+            # pod and let the event stream settle it; the drain continues
+            s = Status.error(f"assume failed: {e}")
+            self._handle_failure(qp, s)
+            return ScheduleOutcome(pod, None, s, n_feas)
         ps = self.cache.pod_states.get(pod.uid)
         assumed = ps.pod if ps is not None else pod
         self._view_pod_added(assumed)

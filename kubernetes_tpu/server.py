@@ -31,50 +31,21 @@ from kubernetes_tpu.scheduler import Scheduler
 # Leader election (Lease objects + CAS)
 # ---------------------------------------------------------------------------
 
-
-@dataclass
-class LeaseRecord:
-    """coordination.k8s.io/v1 Lease spec fields the elector uses."""
-
-    holder: str = ""
-    acquire_time: float = 0.0
-    renew_time: float = 0.0
-    lease_duration_s: float = 15.0
-    resource_version: int = 0
-
-
-class LeaseStore:
-    """In-proc lease registry with optimistic-concurrency updates — the
-    resourcelock.LeaseLock analogue (a real client would CAS through the
-    apiserver; FakeCluster embeds one of these)."""
-
-    def __init__(self) -> None:
-        self._leases: Dict[str, LeaseRecord] = {}
-        self._mu = threading.Lock()
-
-    def get(self, name: str) -> Optional[LeaseRecord]:
-        with self._mu:
-            rec = self._leases.get(name)
-            return None if rec is None else LeaseRecord(**rec.__dict__)
-
-    def update(self, name: str, rec: LeaseRecord) -> bool:
-        """CAS on resource_version (GuaranteedUpdate, etcd3/store.go)."""
-        with self._mu:
-            cur = self._leases.get(name)
-            cur_rv = cur.resource_version if cur is not None else 0
-            if rec.resource_version != cur_rv:
-                return False
-            stored = LeaseRecord(**rec.__dict__)
-            stored.resource_version = cur_rv + 1
-            self._leases[name] = stored
-            return True
+# LeaseRecord/LeaseStore live in util.leases (shared with the API tier's
+# /api/v1/leases resource and the HTTP RemoteLeaseStore); re-exported here
+# for the established import path.
+from kubernetes_tpu.util.leases import LeaseRecord, LeaseStore  # noqa: E402
 
 
 class LeaseElector:
     """leaderelection.LeaderElector: acquire → renew loop → on lost, stop.
 
-    tryAcquireOrRenew semantics: take the lease when empty, expired, or
-    already ours; renewals CAS the renew_time."""
+    tryAcquireOrRenew semantics (leaderelection.go:116): take the lease
+    when empty, expired, or already ours; renewals CAS the renew_time.
+    Expiry is judged against the LOCAL clock at which this elector last
+    OBSERVED the record's resourceVersion change — never against the
+    writer's timestamps — so two processes with skewed clocks still elect
+    correctly (the reference's observedRecord/observedTime discipline)."""
 
     def __init__(
         self,
@@ -91,15 +62,23 @@ class LeaseElector:
         self.lease_duration_s = lease_duration_s
         self.retry_period_s = retry_period_s
         self.clock = clock
+        self._observed_rv = -1
+        self._observed_time = 0.0
+
+    def _observe(self, rec: Optional[LeaseRecord]) -> None:
+        if rec is not None and rec.resource_version != self._observed_rv:
+            self._observed_rv = rec.resource_version
+            self._observed_time = self.clock()
 
     def try_acquire_or_renew(self) -> bool:
         now = self.clock()
         rec = self.store.get(self.lease_name)
+        self._observe(rec)
         if rec is None:
             rec = LeaseRecord()
         expired = (
             not rec.holder
-            or now >= rec.renew_time + rec.lease_duration_s
+            or now >= self._observed_time + rec.lease_duration_s
         )
         if rec.holder != self.identity and not expired:
             return False
@@ -108,14 +87,21 @@ class LeaseElector:
             rec.acquire_time = now
         rec.renew_time = now
         rec.lease_duration_s = self.lease_duration_s
-        return self.store.update(self.lease_name, rec)
+        ok = self.store.update(self.lease_name, rec)
+        if ok:
+            # our own write: observe it immediately (the next get() sees
+            # the bumped rv; counting renewal freshness from now is exact)
+            self._observed_rv = rec.resource_version + 1
+            self._observed_time = now
+        return ok
 
     def is_leader(self) -> bool:
         rec = self.store.get(self.lease_name)
+        self._observe(rec)
         return (
             rec is not None
             and rec.holder == self.identity
-            and self.clock() < rec.renew_time + rec.lease_duration_s
+            and self.clock() < self._observed_time + rec.lease_duration_s
         )
 
     def release(self) -> None:
@@ -216,6 +202,8 @@ class SchedulerServer:
         self._stop = threading.Event()
         self._synced = threading.Event()
         self._loop_thread: Optional[threading.Thread] = None
+        self._le_thread: Optional[threading.Thread] = None
+        self._is_leader = threading.Event()
         self.cycles = 0
         self.loop_errors = 0
 
@@ -284,15 +272,36 @@ class SchedulerServer:
     def start(self) -> None:
         self._http_thread.start()
         self._synced.set()  # in-proc informers are synchronous
+        if self.elector is not None:
+            self._le_thread = threading.Thread(
+                target=self._run_election, daemon=True
+            )
+            self._le_thread.start()
         self._loop_thread = threading.Thread(target=self._run_loop, daemon=True)
         self._loop_thread.start()
 
+    def _run_election(self) -> None:
+        """Dedicated renewal loop (the reference's leaderelection goroutine):
+        the lease renews every retry period INDEPENDENTLY of scheduling
+        cycles, so a long cycle (first jit compile, giant drain) cannot let
+        the lease lapse under an active leader; a lost lease clears the
+        flag and the scheduling loop stops at its next check."""
+        while not self._stop.is_set():
+            try:
+                acquired = self.elector.try_acquire_or_renew()
+            except Exception:  # noqa: BLE001 — remote store hiccup
+                acquired = False
+            if acquired:
+                self._is_leader.set()
+            else:
+                self._is_leader.clear()
+            self._stop.wait(self.elector.retry_period_s)
+
     def _run_loop(self) -> None:
         while not self._stop.is_set():
-            if self.elector is not None:
-                if not self.elector.try_acquire_or_renew():
-                    self._stop.wait(self.elector.retry_period_s)
-                    continue
+            if self.elector is not None and not self._is_leader.is_set():
+                self._stop.wait(self.elector.retry_period_s)
+                continue
             try:
                 outs = self.sched.schedule_pending()
                 if outs:
@@ -338,6 +347,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument(
         "--leader-elect", action="store_true", default=False
     )
+    ap.add_argument("--lease-duration", type=float, default=15.0)
+    ap.add_argument("--retry-period", type=float, default=2.0)
     ap.add_argument(
         "--api-endpoint",
         help="HTTP list/watch API endpoint (e.g. http://127.0.0.1:8001); "
@@ -355,34 +366,40 @@ def main(argv: Optional[List[str]] = None) -> int:
     ground_truth = None
     elector = None
     if args.api_endpoint:
-        if args.leader_elect:
-            # Lease objects are not served over the HTTP tier yet —
-            # failing loudly beats two replicas silently running
-            # active-active and racing on bindings.
-            ap.error(
-                "--leader-elect is not supported with --api-endpoint "
-                "(the HTTP tier does not serve Lease objects yet)"
-            )
         # real wire tier: reflector-based list/watch client
-        from kubernetes_tpu.client import RemoteClusterSource
+        from kubernetes_tpu.client import RemoteClusterSource, RemoteLeaseStore
 
         source = RemoteClusterSource(args.api_endpoint)
         source.connect(sched)
         source.start()
         source.wait_for_sync()
+        if args.leader_elect:
+            import os
+
+            elector = LeaseElector(
+                RemoteLeaseStore(source.client),
+                identity=f"pid-{os.getpid()}",
+                lease_duration_s=args.lease_duration,
+                retry_period_s=args.retry_period,
+            )
     else:
         # in-proc cluster (the FakeCluster source)
         api = FakeCluster()
         api.connect(sched)
         ground_truth = api.ground_truth
         if args.leader_elect:
-            elector = LeaseElector(api.lease_store, identity=f"pid-{id(sched)}")
+            elector = LeaseElector(
+                api.lease_store,
+                identity=f"pid-{id(sched)}",
+                lease_duration_s=args.lease_duration,
+                retry_period_s=args.retry_period,
+            )
     server = SchedulerServer(
         sched, elector=elector, port=args.port, ground_truth=ground_truth
     )
     server.debugger.install_signal_handler()
     server.start()
-    print(f"serving on 127.0.0.1:{server.port}")
+    print(f"serving on 127.0.0.1:{server.port}", flush=True)
     try:
         while True:
             time.sleep(3600)

@@ -218,6 +218,10 @@ class NodeTensors:
     used_wild: np.ndarray = None  # bool [N, U]
     # image id → size bytes present on node (NodeInfo.ImageStates)
     img_sizes: np.ndarray = None  # i64 [N, IMG]
+    # zone-round-robin visit rank (node_tree.go ordering; -1 invalid) —
+    # packed SLOTS stay stable for delta uploads, order-sensitive paths
+    # (sampling windows, rotation, compat tie-breaks) read this instead
+    visit_rank: np.ndarray = None  # i32 [N]
     names: List[str] = field(default_factory=list)
     name_to_idx: Dict[str, int] = field(default_factory=dict)
 
@@ -288,10 +292,31 @@ def pack_nodes(
         used_ip=np.full((N, 1), PAD, dtype=np.int32),
         used_wild=np.zeros((N, 1), dtype=bool),
         img_sizes=np.zeros((N, bucket_cap(len(vocab.images), 1)), dtype=np.int64),
+        visit_rank=np.full(N, -1, dtype=np.int32),
     )
     for i, node in enumerate(nodes[:N]):
         write_node_row(nt, i, node, vocab)
+    refresh_visit_rank(nt, nodes[:N])
     return nt
+
+
+def refresh_visit_rank(
+    nt: NodeTensors, nodes: Sequence[Node], slots: Optional[Sequence[int]] = None
+) -> None:
+    """Recompute the zone-round-robin visit ranks (node_tree.go:119-143
+    ordering; see kubernetes_tpu.util.nodetree).  ``slots[i]`` is node i's
+    packed row (defaults to 0..n-1, the fresh-pack layout); delta updates
+    pass the name_to_idx-resolved slots since removals leave holes."""
+    from kubernetes_tpu.util.nodetree import ZONE_LABEL, node_tree_order
+
+    nt.visit_rank[:] = -1
+    order = node_tree_order([n.labels.get(ZONE_LABEL) for n in nodes])
+    if slots is None:
+        for rank, i in enumerate(order):
+            nt.visit_rank[i] = rank
+    else:
+        for rank, i in enumerate(order):
+            nt.visit_rank[slots[i]] = rank
 
 
 def _padded_val_ints(vocab: Vocab) -> np.ndarray:

@@ -1,0 +1,74 @@
+"""Lease objects + CAS store (coordination.k8s.io/v1 over etcd3 semantics).
+
+The resourcelock.LeaseLock analogue (client-go/tools/leaderelection/
+resourcelock/leaselock.go): a named record with holder/renew metadata whose
+updates are optimistic-concurrency CAS'd on resourceVersion.  The in-proc
+``LeaseStore`` backs single-process deployments and the API server's
+``/api/v1/leases`` resource; ``kubernetes_tpu.client.RemoteLeaseStore``
+speaks the same get/update surface over HTTP so two real scheduler
+processes elect through one API server.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+
+@dataclass
+class LeaseRecord:
+    """coordination.k8s.io/v1 Lease spec fields the elector uses."""
+
+    holder: str = ""
+    acquire_time: float = 0.0
+    renew_time: float = 0.0
+    lease_duration_s: float = 15.0
+    resource_version: int = 0
+
+
+def lease_to_wire(rec: LeaseRecord) -> dict:
+    return {
+        "holder": rec.holder,
+        "acquireTime": rec.acquire_time,
+        "renewTime": rec.renew_time,
+        "leaseDurationSeconds": rec.lease_duration_s,
+        "resourceVersion": rec.resource_version,
+    }
+
+
+def lease_from_wire(d: dict) -> LeaseRecord:
+    return LeaseRecord(
+        holder=d.get("holder", ""),
+        acquire_time=d.get("acquireTime", 0.0),
+        renew_time=d.get("renewTime", 0.0),
+        lease_duration_s=d.get("leaseDurationSeconds", 15.0),
+        resource_version=d.get("resourceVersion", 0),
+    )
+
+
+class LeaseStore:
+    """In-proc lease registry with optimistic-concurrency updates — the
+    storage half of LeaseLock (a real client CASes through the apiserver;
+    FakeCluster embeds one of these and ApiServer serves it)."""
+
+    def __init__(self) -> None:
+        self._leases: Dict[str, LeaseRecord] = {}
+        self._mu = threading.Lock()
+
+    def get(self, name: str) -> Optional[LeaseRecord]:
+        with self._mu:
+            rec = self._leases.get(name)
+            return None if rec is None else LeaseRecord(**rec.__dict__)
+
+    def update(self, name: str, rec: LeaseRecord) -> bool:
+        """CAS on resource_version (GuaranteedUpdate, etcd3/store.go)."""
+        with self._mu:
+            cur = self._leases.get(name)
+            cur_rv = cur.resource_version if cur is not None else 0
+            if rec.resource_version != cur_rv:
+                return False
+            stored = LeaseRecord(**rec.__dict__)
+            stored.resource_version = cur_rv + 1
+            self._leases[name] = stored
+            return True

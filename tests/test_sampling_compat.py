@@ -126,3 +126,114 @@ def test_default_mode_is_full_width_first_max():
     outs = sched.schedule_pending()
     # identical empty nodes, no sampling/tie seed → first node wins
     assert outs[0].node == "n0"
+
+
+# ---------------------------------------------------------------------------
+# zone-interleaved node order (node_tree.go:119-143)
+# ---------------------------------------------------------------------------
+
+
+def _zoned_nodes(scale: int = 1):
+    """5 zones, insertion order grouped BY ZONE (maximally different from
+    the round-robin visit order), uneven zone sizes, mixed capacities so
+    scores differ across zones.  scale=1 → 140 nodes (sampling cuts);
+    scale shrinks below the 100-node feasibility floor to cover the
+    k >= n regime where nothing is cut but visit ORDER still governs."""
+    nodes = []
+    sizes = {
+        "za": 40 // scale,
+        "zb": 25 // scale,
+        "zc": 40 // scale,
+        "zd": 10 // scale,
+        "ze": 25 // scale,
+    }
+    i = 0
+    for zone, count in sizes.items():
+        for _ in range(count):
+            cpu = "8" if i % 3 else "4"
+            nodes.append(
+                Node(
+                    name=f"n{i:03d}",
+                    labels={
+                        "kubernetes.io/hostname": f"n{i:03d}",
+                        "topology.kubernetes.io/zone": zone,
+                    },
+                    capacity=Resource.from_map({"cpu": cpu, "memory": "16Gi"}),
+                )
+            )
+            i += 1
+    return nodes
+
+
+def _serial_reference_zoned(pods, pct, seed, scale=1):
+    """Reference semantics over zoned nodes: nodeTree visit order drives
+    the sampling window, the rotation, and (without a tie seed) first-max
+    — also when k >= n (nothing cut, order still reference-shaped)."""
+    state = OracleState.build(_zoned_nodes(scale))
+    n = len(state.nodes)
+    key = jax.random.PRNGKey(seed) if seed is not None else None
+    start = 0
+    attempt = 0
+    out = []
+    for pod in pods:
+        fit = feasible_nodes(
+            pod, state, sample_pct=pct, start_index=start
+        )
+        start = (start + fit.processed) % n
+        totals = prioritize(pod, state, fit.feasible)
+        if not totals:
+            out.append(None)
+            continue
+        if key is not None:
+            k_p = jax.random.fold_in(key, attempt)
+            h = np.asarray(jax.random.bits(k_p, (n,), dtype=jnp.uint32))
+            idx_of = {name: i for i, name in enumerate(state.nodes)}
+            node = max(totals, key=lambda m: (totals[m], int(h[idx_of[m]])))
+        else:
+            # first max in VISITED (nodeTree) order — totals preserves
+            # fit.feasible order
+            node = max(totals, key=lambda m: totals[m])
+        attempt += 1
+        out.append(node)
+        pod.node_name = node
+        state.place(pod)
+    return out
+
+
+@pytest.mark.parametrize(
+    "pct,seed,scale",
+    [
+        (0, SEED, 1),
+        (60, SEED, 1),
+        (60, None, 1),
+        # k >= n regime: a 68-node cluster sits under the 100-node floor,
+        # so nothing is cut — first-max must STILL follow nodeTree order
+        (0, None, 2),
+    ],
+)
+def test_multizone_compat_matches_nodetree_order(pct, seed, scale):
+    """≥3 zones: the batched device pipeline in sampling-compat mode must
+    bind exactly like the serial oracle visiting nodes in zone-round-robin
+    nodeTree order (insertion order is zone-GROUPED, so any packed-order
+    shortcut diverges immediately)."""
+    conf = cfg.SchedulerConfiguration(
+        batch_size=16,
+        percentage_of_nodes_to_score=pct,
+        reference_sampling_compat=True,
+        tie_break_seed=seed,
+    )
+    sched = Scheduler(configuration=conf)
+    sched.binding_sink = lambda pod, node: None
+    for node in _zoned_nodes(scale):
+        sched.on_node_add(node)
+    pods = _pods(40)
+    for p in pods:
+        sched.on_pod_add(p)
+    outs = sched.schedule_pending()
+    got = {o.pod.name: o.node for o in outs}
+
+    want_list = _serial_reference_zoned(_pods(40), pct, seed, scale)
+    want = {f"p{i}": node for i, node in enumerate(want_list)}
+    assert got == want, {
+        k: (got[k], want[k]) for k in got if got.get(k) != want.get(k)
+    }
