@@ -511,6 +511,21 @@ def main():
             file=sys.stderr,
         )
 
+    if full and os.environ.get("BENCH_PARITY", "1") != "0":
+        # north-star-scale decision-parity evidence (device fast pipeline
+        # vs host greedy at 10k nodes / 50k pods; compat mode vs serial
+        # oracle) — recorded as an artifact beside the bench result
+        from kubernetes_tpu.tools.paritycheck import run_checks
+
+        parity = run_checks()
+        with open("PARITY_r05.json", "w") as f:
+            json.dump(parity, f, indent=1)
+        configs["parity_total_diffs"] = parity["total_diffs"]
+        detail = ", ".join(
+            f"{k}={v['diffs']}" for k, v in parity["checks"].items()
+        )
+        print(f"# parity: {parity['total_diffs']} diffs ({detail})", file=sys.stderr)
+
     print(
         json.dumps(
             {

@@ -326,7 +326,10 @@ class Evaluator:
                 self._hf_state = node_cs
             if self._ext_state is not None:
                 self._ext_state = node_cs
+        from kubernetes_tpu.oracle.state import bump_pod_set_version
+
         state.nodes[node_name] = work
+        bump_pod_set_version()  # dict swap bypasses NodeState mutators
         try:
             if ext is not None:
                 # RemovePod extension per removed victim (preemption.go:548
@@ -370,6 +373,7 @@ class Evaluator:
             return Victims(pods=victims, num_pdb_violations=num_violating)
         finally:
             state.nodes[node_name] = orig
+            bump_pod_set_version()
             self._hf_state, self._ext_state = prev_hf, prev_ext
 
     def _fits(self, pod: Pod, ns: NodeState, state: OracleState) -> bool:

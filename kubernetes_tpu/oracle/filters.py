@@ -210,15 +210,16 @@ def filter_interpod_affinity(
 
     # 1. Existing pods' required anti-affinity terms matching the incoming pod
     #    forbid nodes in the same topology domain as the existing pod.
-    for ens in state.nodes.values():
-        for epod in ens.pods:
-            for term in _required_terms(epod, anti=True):
-                if not _term_matches_pod(term, pod, epod, state):
-                    continue
-                ev = ens.node.labels.get(term.topology_key)
-                nv = node.labels.get(term.topology_key)
-                if ev is not None and nv is not None and ev == nv:
-                    return REASON_EXISTING_ANTI
+    #    Walk only the placed pods that HAVE such terms (state-level cache,
+    #    the reference's precomputed existing-anti map, filtering.go:141).
+    for ens, epod, terms in state.anti_term_pods():
+        for term in terms:
+            if not _term_matches_pod(term, pod, epod, state):
+                continue
+            ev = ens.node.labels.get(term.topology_key)
+            nv = node.labels.get(term.topology_key)
+            if ev is not None and nv is not None and ev == nv:
+                return REASON_EXISTING_ANTI
 
     # 2. Incoming pod's required anti-affinity vs existing pods.
     for term in _required_terms(pod, anti=True):

@@ -14,6 +14,16 @@ from kubernetes_tpu.api.resource import Resource
 from kubernetes_tpu.api.types import Node, Pod
 
 
+_POD_SET_VERSION = [0]  # global NodeState mutation counter (cache key)
+
+
+def bump_pod_set_version() -> None:
+    """Invalidate pod-set-derived caches (anti_term_pods) after a
+    mutation that bypasses the NodeState mutators — e.g. preemption's
+    working-copy dict swap."""
+    _POD_SET_VERSION[0] += 1
+
+
 @dataclass
 class NodeState:
     """Per-node accounting mirroring framework.NodeInfo (types.go:585)."""
@@ -28,8 +38,10 @@ class NodeState:
         self.requested.add(req)
         self.non_zero_requested.add(req.non_zero_defaulted())
         self.pods.append(pod)
+        _POD_SET_VERSION[0] += 1
 
     def remove_pod(self, pod: Pod) -> bool:
+        _POD_SET_VERSION[0] += 1
         for i, p in enumerate(self.pods):
             if p.uid == pod.uid:
                 req = p.compute_requests()
@@ -69,6 +81,29 @@ class OracleState:
         ns = self.nodes.get(pod.node_name)
         if ns is not None:
             ns.remove_pod(pod)
+
+    def anti_term_pods(self):
+        """[(node_state, pod, required-anti-terms)] for every PLACED pod
+        that carries required anti-affinity — cached per pod-set version.
+        satisfyExistingPodsAntiAffinity walks exactly these (the reference
+        precomputes topologyToMatchedExistingAntiAffinityTerms the same
+        way, filtering.go:141); without the cache the serial oracle costs
+        O(nodes × placed) per (pod, node) check, which is unusable at
+        parity-evidence scale."""
+        from kubernetes_tpu.oracle.filters import _required_terms
+
+        version = _POD_SET_VERSION[0]
+        cached = getattr(self, "_anti_cache", None)
+        if cached is not None and cached[0] == version:
+            return cached[1]
+        out = []
+        for ns in self.nodes.values():
+            for epod in ns.pods:
+                terms = _required_terms(epod, anti=True)
+                if terms:
+                    out.append((ns, epod, terms))
+        self._anti_cache = (version, out)
+        return out
 
     def node_list(self) -> List[NodeState]:
         return list(self.nodes.values())

@@ -286,13 +286,27 @@ class SchedulerServer:
         cycles, so a long cycle (first jit compile, giant drain) cannot let
         the lease lapse under an active leader; a lost lease clears the
         flag and the scheduling loop stops at its next check."""
+        renew_deadline = self.elector.lease_duration_s * (2.0 / 3.0)
+        last_success = None
         while not self._stop.is_set():
             try:
                 acquired = self.elector.try_acquire_or_renew()
             except Exception:  # noqa: BLE001 — remote store hiccup
                 acquired = False
+            now = self.elector.clock()
             if acquired:
+                last_success = now
                 self._is_leader.set()
+            elif (
+                self._is_leader.is_set()
+                and last_success is not None
+                and now - last_success < renew_deadline
+            ):
+                # a held lease survives transient renew failures until the
+                # renew DEADLINE (leaderelection.go RenewDeadline) — one
+                # dropped request must not stall scheduling while no
+                # standby can legally take over anyway
+                pass
             else:
                 self._is_leader.clear()
             self._stop.wait(self.elector.retry_period_s)
@@ -326,6 +340,11 @@ class SchedulerServer:
         self._stop.set()
         if self._loop_thread is not None:
             self._loop_thread.join(timeout=5)
+        if self._le_thread is not None:
+            # settle the renewal loop BEFORE releasing, or a concurrent
+            # renew can defeat the release and strand the lease on this
+            # dead process for a full lease_duration
+            self._le_thread.join(timeout=5)
         if self.elector is not None:
             self.elector.release()
         self.http.shutdown()

@@ -1,0 +1,218 @@
+"""Bench-time decision-parity evidence at north-star scale → PARITY_r*.json.
+
+The flagship claim — "binding decisions identical to default-scheduler" —
+needs evidence at scales no CI-budget pytest run can afford.  This tool
+produces it once per bench run on the real device:
+
+  * CROSS-BATCH-SIZE identity at 10k nodes / 50k pods: the extended
+    device fast path (fastBatchMax=4096, sig_scan pipeline) against a
+    64-pod-batch drain (host-greedy committer) — completely different
+    machinery whose decisions must be bit-identical because both replay
+    the sequential one-pod-at-a-time argmax;
+  * SAMPLING-COMPAT vs the serial oracle at 2k nodes / 3k pods over
+    3 zones: the device kernel's nodeTree-ordered sampling window,
+    rotation cursor, and seeded tie-break against the scalar
+    reference-shaped loop (schedule_one semantics).
+
+Writes one JSON artifact {"checks": {...}, "total_diffs": N}; the driver
+records it next to BENCH_r*.json.  Run standalone:
+
+    python -m kubernetes_tpu.tools.paritycheck [--out PARITY.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import time
+from typing import Dict, List, Optional
+
+
+def _basic_nodes(n, zones=3):
+    from kubernetes_tpu.api.resource import Resource
+    from kubernetes_tpu.api.types import Node
+
+    return [
+        Node(
+            name=f"node-{i}",
+            labels={
+                "topology.kubernetes.io/zone": f"zone-{i % zones}",
+                "kubernetes.io/hostname": f"node-{i}",
+            },
+            capacity=Resource.from_map(
+                {"cpu": "8", "memory": "32Gi", "pods": 110}
+            ),
+        )
+        for i in range(n)
+    ]
+
+
+def _basic_pods(n, seed=4242):
+    from kubernetes_tpu.api.types import Container, Pod
+
+    rng = random.Random(seed)
+    return [
+        Pod(
+            name=f"pp-{i}",
+            labels={"app": f"app-{i % 16}"},
+            containers=[
+                Container(
+                    name="c",
+                    requests={
+                        "cpu": f"{rng.choice([100, 250, 500])}m",
+                        "memory": f"{rng.choice([128, 256, 512])}Mi",
+                    },
+                )
+            ],
+        )
+        for i in range(n)
+    ]
+
+
+def _drain(nodes, pods, **cfg_kw):
+    from kubernetes_tpu.framework.config import SchedulerConfiguration
+    from kubernetes_tpu.scheduler import Scheduler
+
+    cfg = SchedulerConfiguration()
+    for k, v in cfg_kw.items():
+        setattr(cfg, k, v)
+    s = Scheduler(configuration=cfg)
+    got: Dict[str, Optional[str]] = {}
+    s.binding_sink = lambda pod, node: got.__setitem__(pod.name, node)
+    s.mirror.e_cap_hint = len(pods) + cfg.batch_size + 128
+    for n in nodes:
+        s.on_node_add(n)
+    for p in pods:
+        s.on_pod_add(p)
+    outs = s.schedule_pending()
+    for o in outs:
+        got.setdefault(o.pod.name, o.node)
+    return got
+
+
+def _diff(a: Dict, b: Dict) -> List:
+    keys = set(a) | set(b)
+    return sorted(
+        (k, a.get(k), b.get(k)) for k in keys if a.get(k) != b.get(k)
+    )
+
+
+def check_cross_batch(n_nodes=10000, n_pods=50000) -> dict:
+    """Device sig_scan pipeline (4096-extended batches) vs host-greedy
+    64-pod batches — identical bindings at north-star scale."""
+    import copy
+
+    nodes = _basic_nodes(n_nodes)
+    pods = _basic_pods(n_pods)
+    t0 = time.perf_counter()
+    big = _drain(nodes, copy.deepcopy(pods))
+    small = _drain(
+        nodes, copy.deepcopy(pods), batch_size=64, fast_batch_max=64
+    )
+    diffs = _diff(big, small)
+    return {
+        "nodes": n_nodes,
+        "pods": n_pods,
+        "bound_a": sum(1 for v in big.values() if v),
+        "bound_b": sum(1 for v in small.values() if v),
+        "diffs": len(diffs),
+        "first_diffs": diffs[:5],
+        "wall_s": round(time.perf_counter() - t0, 1),
+    }
+
+
+def check_compat_vs_oracle(n_nodes=2000, n_pods=3000, seed=77) -> dict:
+    """Sampling-compat + seeded-tie device pipeline vs the serial oracle
+    (reference-shaped one-pod loop in nodeTree order)."""
+    import copy
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kubernetes_tpu.oracle.pipeline import (
+        feasible_nodes,
+        num_feasible_nodes_to_find,
+        prioritize,
+    )
+    from kubernetes_tpu.oracle.state import OracleState
+
+    nodes = _basic_nodes(n_nodes, zones=3)
+    pods = _basic_pods(n_pods, seed=seed)
+    t0 = time.perf_counter()
+    got = _drain(
+        nodes,
+        copy.deepcopy(pods),
+        reference_sampling_compat=True,
+        tie_break_seed=seed,
+    )
+
+    state = OracleState.build(nodes)
+    key = jax.random.PRNGKey(seed)
+    # one device call for ALL attempts' tie-break hashes: per-pod
+    # random.bits round trips cost ~100ms each over a remote device link
+    h_all = np.asarray(
+        jax.vmap(
+            lambda a: jax.random.bits(
+                jax.random.fold_in(key, a), (n_nodes,), dtype=jnp.uint32
+            )
+        )(jnp.arange(n_pods))
+    )
+    idx_of = {name: i for i, name in enumerate(state.nodes)}
+    start = 0
+    attempt = 0
+    want: Dict[str, Optional[str]] = {}
+    for pod in copy.deepcopy(pods):
+        fit = feasible_nodes(pod, state, sample_pct=0, start_index=start)
+        start = (start + fit.processed) % n_nodes
+        totals = prioritize(pod, state, fit.feasible)
+        if not totals:
+            want[pod.name] = None
+            continue
+        h = h_all[attempt]
+        attempt += 1
+        node = max(totals, key=lambda m: (totals[m], int(h[idx_of[m]])))
+        want[pod.name] = node
+        pod.node_name = node
+        state.place(pod)
+    diffs = _diff(got, want)
+    return {
+        "nodes": n_nodes,
+        "pods": n_pods,
+        "bound_device": sum(1 for v in got.values() if v),
+        "bound_oracle": sum(1 for v in want.values() if v),
+        "diffs": len(diffs),
+        "first_diffs": diffs[:5],
+        "wall_s": round(time.perf_counter() - t0, 1),
+    }
+
+
+def run_checks(ns_nodes=10000, ns_pods=50000) -> dict:
+    checks = {
+        "cross_batch_devfast_vs_hostgreedy": check_cross_batch(
+            ns_nodes, ns_pods
+        ),
+        "sampling_compat_vs_serial_oracle": check_compat_vs_oracle(),
+    }
+    return {
+        "checks": checks,
+        "total_diffs": sum(c["diffs"] for c in checks.values()),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="paritycheck")
+    ap.add_argument("--out", default="PARITY.json")
+    ap.add_argument("--ns-nodes", type=int, default=10000)
+    ap.add_argument("--ns-pods", type=int, default=50000)
+    args = ap.parse_args(argv)
+    result = run_checks(args.ns_nodes, args.ns_pods)
+    with open(args.out, "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps({"total_diffs": result["total_diffs"], "out": args.out}))
+    return 0 if result["total_diffs"] == 0 else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

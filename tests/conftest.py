@@ -7,6 +7,12 @@ initialization.  Real-TPU benchmarking happens in bench.py, not under pytest.
 
 import os
 
+# NOTE: do NOT enable the persistent XLA compile cache here — serializing
+# some chain-pipeline executables segfaults put_executable_and_time on
+# this jaxlib build even on the CPU backend (verified: the parity suite
+# dies mid-run with it on).  In-process jit caching still amortizes
+# compiles within one pytest invocation.
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")

@@ -4,6 +4,7 @@ leader, and killing the leader hands scheduling to the standby with every
 pod bound exactly once (leaderelection.go:116 + resourcelock/leaselock.go
 over the /api/v1/leases resource)."""
 
+import os
 import subprocess
 import sys
 import time
@@ -27,13 +28,17 @@ def _spawn(endpoint):
             "--port",
             "0",
             "--lease-duration",
-            "1.5",
+            "6",
             "--retry-period",
-            "0.2",
+            "0.5",
         ],
         stdout=subprocess.PIPE,
         stderr=subprocess.DEVNULL,
         text=True,
+        # the election/failover mechanics are backend-independent: pin the
+        # child schedulers to CPU so they neither compete with the test
+        # runner for the single device nor pay device-attach startup
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
     )
     # wait for "serving on 127.0.0.1:<port>"
     line = proc.stdout.readline()

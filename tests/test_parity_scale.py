@@ -20,13 +20,18 @@ through tests/gen.py's workload generator.
 """
 
 import copy
+import os
 import random
 
 import pytest
 
 from tests.gen import make_cluster, make_pod
 
-pytestmark = pytest.mark.slow
+# Default parametrization finishes in a CI-sized budget (<5 min on the
+# test backend); PARITY_FULL=1 restores the exhaustive seed sweep.
+# North-star-scale parity evidence lives in the bench-time artifact
+# (kubernetes_tpu/tools/paritycheck.py → PARITY_r*.json).
+FULL = os.environ.get("PARITY_FULL", "0") == "1"
 
 NS_LABELS = {
     "default": {"team": "core"},
@@ -82,7 +87,12 @@ def _workload(seed, n_nodes, n_placed, n_pending):
 
 @pytest.mark.parametrize(
     "seed,n_nodes,n_placed,n_pending",
-    [(1000 + s, 500, 300, 1000) for s in range(12)] + [(1100, 2000, 800, 3000)],
+    (
+        [(1000 + s, 500, 300, 1000) for s in range(12)]
+        + [(1100, 2000, 800, 3000)]
+    )
+    if FULL
+    else [(1000, 500, 300, 1000)],
 )
 def test_cross_batch_size_agreement_at_scale(seed, n_nodes, n_placed, n_pending):
     nodes, placed, pending = _workload(seed, n_nodes, n_placed, n_pending)
@@ -97,7 +107,7 @@ def test_cross_batch_size_agreement_at_scale(seed, n_nodes, n_placed, n_pending)
     )
 
 
-@pytest.mark.parametrize("seed", range(4))
+@pytest.mark.parametrize("seed", range(4) if FULL else range(1))
 def test_serial_anchored_parity(seed):
     from kubernetes_tpu.oracle.pipeline import schedule_one
     from kubernetes_tpu.oracle.state import OracleState
@@ -128,6 +138,12 @@ def test_serial_anchored_parity(seed):
     )
 
 
+@pytest.mark.skipif(
+    not FULL,
+    reason="compat parity is covered per-mechanism by test_sampling_compat "
+    "(incl. multizone nodeTree order) and at scale by the bench-time "
+    "PARITY artifact; the cross-batch compat sweep runs with PARITY_FULL=1",
+)
 @pytest.mark.parametrize("seed", range(3))
 def test_compat_mode_cross_batch_agreement(seed):
     """sampling-compat + seeded tie-break: the one-pod oracle path and the
@@ -148,6 +164,12 @@ def test_compat_mode_cross_batch_agreement(seed):
     )
 
 
+@pytest.mark.skipif(
+    not FULL,
+    reason="bucket-growth-mid-drain machinery is exercised by "
+    "test_chain/test_gang growth cases; the scale version runs with "
+    "PARITY_FULL=1",
+)
 def test_bucket_growth_mid_drain():
     """Node adds crossing the bucket boundary between batches must not
     change decisions vs scheduling against the final cluster serially
