@@ -518,7 +518,8 @@ def main():
         from kubernetes_tpu.tools.paritycheck import run_checks
 
         parity = run_checks()
-        with open("PARITY_r05.json", "w") as f:
+        parity_out = os.environ.get("BENCH_PARITY_OUT", "PARITY_r05.json")
+        with open(parity_out, "w") as f:
             json.dump(parity, f, indent=1)
         configs["parity_total_diffs"] = parity["total_diffs"]
         detail = ", ".join(

@@ -229,6 +229,11 @@ class Node:
     unschedulable: bool = False
     # image name → size bytes (NodeStatus.Images, for ImageLocality)
     images: Dict[str, int] = field(default_factory=dict)
+    # NodeStatus.conditions[Ready] + lastHeartbeatTime, collapsed to the
+    # two fields the node-lifecycle tier reads (kubelet heartbeats write
+    # them through the node status subresource)
+    ready: bool = True
+    last_heartbeat: float = 0.0
 
     def __post_init__(self):
         # kubelet defaults allocatable to capacity when no reservation.

@@ -278,18 +278,82 @@ class ApiServer:
                 length = int(self.headers.get("Content-Length", 0))
                 body = json.loads(self.rfile.read(length) or b"{}")
                 if len(parts) == 5 and parts[2] == "pods" and parts[4] == "status":
-                    uid = unquote(parts[3])
-                    pod = server.api.pods.get(uid)
-                    if pod is None:
-                        return self._json(404, {"error": "not found"})
-                    if "nominatedNodeName" in body:
-                        # never mutate the store's instance directly — the
-                        # store computes its own old/new delta for handlers
+                    # read-modify-write under the server lock: concurrent
+                    # status patches (nomination vs kubelet phase report)
+                    # must not resurrect each other's stale fields
+                    with server._mu:
+                        uid = unquote(parts[3])
+                        pod = server.api.pods.get(uid)
+                        if pod is None:
+                            return self._json(404, {"error": "not found"})
+                        if "nominatedNodeName" in body or "phase" in body:
+                            # never mutate the store's instance directly —
+                            # the store computes its own old/new delta
+                            import copy as _copy
+
+                            patched = _copy.copy(pod)
+                            if "nominatedNodeName" in body:
+                                patched.nominated_node_name = body[
+                                    "nominatedNodeName"
+                                ]
+                            if "phase" in body:
+                                patched.phase = body["phase"]
+                            server.api.patch_pod_status(patched)
+                    return self._json(200, {"ok": True})
+                if len(parts) == 5 and parts[2] == "nodes" and parts[4] == "status":
+                    # the kubelet heartbeat write (node status subresource):
+                    # Ready condition + lastHeartbeatTime — atomic RMW
+                    # under the server lock so a concurrent taint PUT is
+                    # never erased by a pre-taint copy
+                    with server._mu:
+                        name = unquote(parts[3])
+                        node = server.api.nodes.get(name)
+                        if node is None:
+                            return self._json(404, {"error": "not found"})
                         import copy as _copy
 
-                        patched = _copy.copy(pod)
-                        patched.nominated_node_name = body["nominatedNodeName"]
-                        server.api.patch_pod_status(patched)
+                        patched = _copy.copy(node)
+                        if "ready" in body:
+                            patched.ready = bool(body["ready"])
+                        if "lastHeartbeat" in body:
+                            patched.last_heartbeat = float(body["lastHeartbeat"])
+                        server.api.update_node(patched)
+                    return self._json(200, {"ok": True})
+                if len(parts) == 4 and parts[2] == "nodes":
+                    # ATOMIC taint/readiness patch — the node-lifecycle
+                    # controller's write shape.  Server-side RMW under the
+                    # lock: the controller's view may be stale, but only
+                    # the named taints/readiness change; heartbeats written
+                    # concurrently are preserved (nodes carry no
+                    # resourceVersion, so client-side full-object PUTs
+                    # would silently regress them)
+                    with server._mu:
+                        name = unquote(parts[3])
+                        node = server.api.nodes.get(name)
+                        if node is None:
+                            return self._json(404, {"error": "not found"})
+                        import copy as _copy
+
+                        from kubernetes_tpu.api.types import Taint
+
+                        patched = _copy.copy(node)
+                        remove = set(body.get("removeTaintKeys", []))
+                        taints = tuple(
+                            t for t in patched.taints if t.key not in remove
+                        )
+                        for t in body.get("addTaints", []):
+                            if not any(x.key == t["key"] for x in taints):
+                                taints = taints + (
+                                    Taint(
+                                        key=t["key"],
+                                        value=t.get("value", ""),
+                                        effect=t.get("effect", "NoSchedule"),
+                                    ),
+                                )
+                        patched.taints = taints
+                        if "ready" in body:
+                            patched.ready = bool(body["ready"])
+                        server.api.update_node(patched)
                     return self._json(200, {"ok": True})
                 return self._json(404, {"error": "not found"})
 

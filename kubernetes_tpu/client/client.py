@@ -155,6 +155,41 @@ class ApiClient:
             {"nominatedNodeName": pod.nominated_node_name},
         )
 
+    def patch_pod_phase(self, uid: str, phase: str) -> None:
+        """Pod phase write (the kubelet's status report, e.g. Running)."""
+        self._req(
+            "PATCH",
+            f"/api/v1/pods/{quote(uid, safe='')}/status",
+            {"phase": phase},
+        )
+
+    def patch_node_taints(
+        self, name: str, add=(), remove_keys=(), ready=None
+    ) -> None:
+        """Atomic server-side taint/readiness patch (the node-lifecycle
+        controller's write shape — full-object PUTs would race kubelet
+        heartbeats since nodes carry no resourceVersion)."""
+        body = {
+            "addTaints": [
+                {"key": t.key, "value": t.value, "effect": t.effect}
+                for t in add
+            ],
+            "removeTaintKeys": list(remove_keys),
+        }
+        if ready is not None:
+            body["ready"] = ready
+        self._req(
+            "PATCH", f"/api/v1/nodes/{quote(name, safe='')}", body
+        )
+
+    def patch_node_status(self, name: str, ready: bool, heartbeat: float) -> None:
+        """The kubelet heartbeat (node status subresource write)."""
+        self._req(
+            "PATCH",
+            f"/api/v1/nodes/{quote(name, safe='')}/status",
+            {"ready": ready, "lastHeartbeat": heartbeat},
+        )
+
     def watch_stream(self, resource: str, rv: int):
         """Yields decoded watch events; raises ApiError(410) on
         compaction, StopIteration/return on clean EOF."""

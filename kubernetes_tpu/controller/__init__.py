@@ -1,0 +1,9 @@
+"""Controller tier: the non-scheduler control loops this build ships.
+
+Only the loops that generate the scheduler's reactive events are in
+scope (SURVEY §1 L5b): node lifecycle (NotReady → taint → evict).
+"""
+
+from kubernetes_tpu.controller.node_lifecycle import NodeLifecycleController
+
+__all__ = ["NodeLifecycleController"]

@@ -1,0 +1,177 @@
+"""Node lifecycle controller: stale heartbeat → NotReady taint → evict.
+
+The slice of pkg/controller/nodelifecycle/node_lifecycle_controller.go
+that generates the scheduler's most important reactive events:
+
+  * a node whose lastHeartbeatTime is older than the GRACE period is
+    marked NotReady and tainted ``node.kubernetes.io/unreachable``
+    with NoExecute (the controller's monitorNodeHealth + the taint
+    manager's work, collapsed to one loop);
+  * NoExecute taint-based eviction: pods bound to an unreachable node
+    that don't tolerate the taint are DELETED (TaintManager's eviction;
+    a workload controller recreates them as pending, and the scheduler
+    places the replacements on healthy nodes);
+  * a node that heartbeats again gets the taint removed and Ready
+    restored.
+
+Runs against the HTTP API tier through its own client + reflectors, like
+a separate kube-controller-manager process would.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+from kubernetes_tpu.api.types import Node, Pod, Taint
+
+UNREACHABLE_TAINT_KEY = "node.kubernetes.io/unreachable"
+
+
+_UNREACHABLE_TAINT = Taint(
+    key=UNREACHABLE_TAINT_KEY, value="", effect="NoExecute"
+)
+
+
+def _tolerates_unreachable(pod: Pod) -> bool:
+    """ToleratesTaint over the NoExecute unreachable taint — the taint
+    manager's eviction predicate, via the shared Toleration semantics."""
+    return any(t.tolerates(_UNREACHABLE_TAINT) for t in pod.tolerations)
+
+
+class NodeLifecycleController:
+    """monitorNodeHealth + taint-based eviction against the API tier."""
+
+    def __init__(
+        self,
+        endpoint: str,
+        grace_s: float = 40.0,
+        tick_s: float = 1.0,
+        clock=time.time,
+    ):
+        from kubernetes_tpu.client import ApiClient, Reflector
+
+        self.client = ApiClient(endpoint)
+        self.grace_s = grace_s
+        self.tick_s = tick_s
+        self.clock = clock
+        self.nodes: Dict[str, Node] = {}
+        self.pods_by_node: Dict[str, Dict[str, Pod]] = {}
+        self._mu = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.tainted: set = set()
+        self.evicted = 0
+
+        def node_add(n: Node) -> None:
+            with self._mu:
+                self.nodes[n.name] = n
+
+        def node_update(old: Node, new: Node) -> None:
+            with self._mu:
+                self.nodes[new.name] = new
+
+        def node_delete(n: Node) -> None:
+            with self._mu:
+                self.nodes.pop(n.name, None)
+                self.tainted.discard(n.name)
+
+        def pod_add(p: Pod) -> None:
+            if p.node_name:
+                with self._mu:
+                    self.pods_by_node.setdefault(p.node_name, {})[p.uid] = p
+
+        def pod_update(old: Pod, new: Pod) -> None:
+            with self._mu:
+                if old.node_name and old.node_name != new.node_name:
+                    self.pods_by_node.get(old.node_name, {}).pop(old.uid, None)
+                if new.node_name:
+                    self.pods_by_node.setdefault(new.node_name, {})[new.uid] = new
+
+        def pod_delete(p: Pod) -> None:
+            if p.node_name:
+                with self._mu:
+                    self.pods_by_node.get(p.node_name, {}).pop(p.uid, None)
+
+        self._reflectors = [
+            Reflector(self.client, "nodes", node_add, node_update, node_delete),
+            Reflector(self.client, "pods", pod_add, pod_update, pod_delete),
+        ]
+
+    # ----- the loop --------------------------------------------------------
+
+    def _tick(self) -> None:
+        now = self.clock()
+        with self._mu:
+            nodes = list(self.nodes.values())
+        for node in nodes:
+            stale = (
+                node.last_heartbeat > 0
+                and now - node.last_heartbeat > self.grace_s
+            )
+            has_taint = any(
+                t.key == UNREACHABLE_TAINT_KEY for t in node.taints
+            )
+            if stale and not has_taint:
+                # NotReady: taint NoExecute + flip the Ready condition
+                # (monitorNodeHealth → markNodeAsReachable's inverse) via
+                # the ATOMIC taint patch — a full-object PUT from this
+                # possibly-stale view would regress concurrent heartbeats
+                try:
+                    self.client.patch_node_taints(
+                        node.name, add=[_UNREACHABLE_TAINT], ready=False
+                    )
+                    self.tainted.add(node.name)
+                except Exception:  # noqa: BLE001 — server hiccup: next tick
+                    continue
+                self._evict(node.name)
+            elif not stale and has_taint:
+                # kubelet came back: lift the taint, restore Ready
+                try:
+                    self.client.patch_node_taints(
+                        node.name,
+                        remove_keys=[UNREACHABLE_TAINT_KEY],
+                        ready=True,
+                    )
+                    self.tainted.discard(node.name)
+                except Exception:  # noqa: BLE001
+                    continue
+            elif stale:
+                # still down: keep evicting pods that landed or lingered
+                self._evict(node.name)
+
+    def _evict(self, node_name: str) -> None:
+        """NoExecute eviction: delete non-tolerating pods on the node."""
+        with self._mu:
+            pods = list(self.pods_by_node.get(node_name, {}).values())
+        for p in pods:
+            if _tolerates_unreachable(p):
+                continue
+            try:
+                self.client.delete_pod(p.uid)
+                self.evicted += 1
+            except Exception:  # noqa: BLE001 — already gone
+                pass
+
+    def start(self) -> "NodeLifecycleController":
+        for r in self._reflectors:
+            r.start()
+
+        def loop():
+            while not self._stop.wait(self.tick_s):
+                try:
+                    self._tick()
+                except Exception:  # noqa: BLE001 — controller must survive
+                    pass
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        for r in self._reflectors:
+            r.stop()

@@ -1,0 +1,8 @@
+"""Kubemark tier: hollow kubelets (mocked node agents) for scale testing.
+
+Reference: cmd/kubemark/hollow-node.go + pkg/kubemark/hollow_kubelet.go.
+"""
+
+from kubernetes_tpu.kubemark.hollow import HollowFleet, HollowKubelet
+
+__all__ = ["HollowFleet", "HollowKubelet"]

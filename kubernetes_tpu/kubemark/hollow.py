@@ -1,0 +1,133 @@
+"""Hollow kubelets: node agents with mocked runtimes (hollow_kubelet.go:87).
+
+A HollowKubelet does what the scheduler-relevant slice of a kubelet does,
+against the HTTP API tier:
+
+  * registers its Node;
+  * HEARTBEATS — periodic node-status writes (Ready condition +
+    lastHeartbeatTime) over the status subresource, the signal the
+    node-lifecycle controller watches;
+  * POD STATUS — pods bound to it get their phase patched to Running (a
+    real kubelet would start containers first; the hollow runtime reports
+    success immediately, like kubemark's mocked CRI).
+
+``HollowFleet`` runs many kubelets off ONE shared pods watcher and a
+small heartbeat thread pool — per-node watch streams would need thousands
+of sockets at kubemark scale, and the fan-in matches how hollow nodes
+share infrastructure in the reference's kubemark deployments.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from kubernetes_tpu.api.resource import Resource
+from kubernetes_tpu.api.types import Node
+
+
+class HollowKubelet:
+    """One hollow node's agent state (registration + heartbeat payload)."""
+
+    def __init__(self, name: str, node: Node):
+        self.name = name
+        self.node = node
+        self.alive = True  # stop_heartbeats() simulates a dead kubelet
+
+
+class HollowFleet:
+    """N hollow kubelets sharing one client, one pods watcher, and one
+    heartbeat loop."""
+
+    def __init__(
+        self,
+        endpoint: str,
+        heartbeat_interval_s: float = 10.0,
+        report_pod_status: bool = True,
+    ):
+        from kubernetes_tpu.client import ApiClient, Reflector
+
+        self.client = ApiClient(endpoint)
+        self.heartbeat_interval_s = heartbeat_interval_s
+        self.report_pod_status = report_pod_status
+        self.kubelets: Dict[str, HollowKubelet] = {}
+        self._stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
+        self._pods_reflector: Optional[Reflector] = None
+        self._reported: set = set()
+
+    # ----- registration ----------------------------------------------------
+
+    def register(self, nodes: List[Node]) -> None:
+        """Bulk-register hollow nodes and start agent loops for them."""
+        self.client.create_nodes(nodes)
+        self.adopt(nodes)
+
+    def adopt(self, nodes: List[Node]) -> None:
+        """Run agent loops for nodes registered elsewhere (e.g. by the
+        scale driver's per-node registration storm).  Server-side
+        last_heartbeat stays 0 (= never-stale to the lifecycle controller)
+        until the first beat, which the heartbeat loop sends immediately
+        on start()."""
+        for n in nodes:
+            self.kubelets[n.name] = HollowKubelet(n.name, n)
+
+    def start(self) -> "HollowFleet":
+        self._hb_thread = threading.Thread(target=self._heartbeat_loop, daemon=True)
+        self._hb_thread.start()
+        if self.report_pod_status:
+            from kubernetes_tpu.client import Reflector
+
+            self._pods_reflector = Reflector(
+                self.client,
+                "pods",
+                self._on_pod,
+                lambda old, new: self._on_pod(new),
+                lambda pod: self._reported.discard(pod.uid),
+            ).start()
+        return self
+
+    def _on_pod(self, pod) -> None:
+        """A pod bound to one of OUR nodes gets its status reported —
+        phase Running, exactly once (the hollow runtime 'starts' it)."""
+        if (
+            pod.node_name in self.kubelets
+            and self.kubelets[pod.node_name].alive
+            and pod.phase == "Pending"
+            and pod.uid not in self._reported
+        ):
+            self._reported.add(pod.uid)
+            try:
+                self.client.patch_pod_phase(pod.uid, "Running")
+            except Exception:  # noqa: BLE001 — pod may be gone already
+                self._reported.discard(pod.uid)
+
+    def _heartbeat_loop(self) -> None:
+        first = True
+        while first or not self._stop.wait(self.heartbeat_interval_s):
+            first = False  # beat immediately, then every interval
+            now = time.time()
+            for kl in list(self.kubelets.values()):
+                if not kl.alive:
+                    continue
+                try:
+                    self.client.patch_node_status(kl.name, True, now)
+                except Exception:  # noqa: BLE001 — server restarting
+                    pass
+
+    # ----- failure injection ----------------------------------------------
+
+    def stop_heartbeats(self, names: List[str]) -> None:
+        """Simulate dead kubelets: their nodes stop renewing Ready."""
+        for n in names:
+            kl = self.kubelets.get(n)
+            if kl is not None:
+                kl.alive = False
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=5)
+        if self._pods_reflector is not None:
+            self._pods_reflector.stop()

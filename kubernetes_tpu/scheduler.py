@@ -381,6 +381,22 @@ class Scheduler:
 
     def on_node_update(self, old: Node, new: Node) -> None:
       with self._mu:
+        import copy as _copy
+
+        probe = _copy.copy(old)
+        probe.ready = new.ready
+        probe.last_heartbeat = new.last_heartbeat
+        if probe == new:
+            # heartbeat-only update (Ready condition / lastHeartbeatTime):
+            # nothing the snapshot or queue reads moved — refresh the cache
+            # object without invalidating the device pipeline, or 5000
+            # kubelets heartbeating would repack the mirror continuously.
+            # (Full-equality probe, not a field allowlist: a change to ANY
+            # other Node field — present or future — takes the safe path.)
+            cn = self.cache.nodes.get(new.name)
+            if cn is not None and cn.node is not None:
+                cn.node = new
+                return
         self._invalidate_view()
         self._external_mutations += 1
         self.cache.update_node(new)
@@ -457,6 +473,31 @@ class Scheduler:
       with self._mu:
         if new.node_name:
             ps = self.cache.pod_states.get(new.uid)
+            if (
+                ps is not None
+                and ps.pod.node_name == new.node_name
+                # an ASSUMED pod's echo is the binding CONFIRMATION — it
+                # must take the full path (assumed → added transition)
+                and new.uid not in self.cache.assumed
+            ):
+                import copy as _copy
+
+                probe = _copy.copy(old)
+                probe.phase = new.phase
+                probe.start_time = new.start_time
+                probe.node_name = new.node_name
+                if probe == new:
+                    # STATUS-only update of a pod we already account on
+                    # that node (the kubelet's phase=Running report):
+                    # nothing packed in the snapshot reads phase/startTime
+                    # — swap the stored object without invalidating the
+                    # device pipeline, or every kubelet status report
+                    # would force a mirror repack mid-drain
+                    cn = self.cache.nodes.get(new.node_name)
+                    if cn is not None and new.uid in cn.pods:
+                        cn.pods[new.uid] = new
+                        ps.pod = new
+                        return
             confirmed = (
                 self._is_confirmation(new) and old.labels == new.labels
             )

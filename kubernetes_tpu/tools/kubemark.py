@@ -134,6 +134,22 @@ def run_scale_sim(
         registration_s = time.perf_counter() - t_reg
         log(f"registered {n_nodes} hollow nodes in {registration_s:.1f}s")
 
+        # hollow-kubelet tier (hollow_kubelet.go:87): heartbeats + pod
+        # status reports run for the WHOLE measured window, so the control
+        # plane carries the kubelet write load the reference's kubemark
+        # clusters generate (first beat immediate, then every 15s ≈ the
+        # upstream 10s on this sim's compressed wall time)
+        from kubernetes_tpu.kubemark import HollowFleet
+
+        fleet = HollowFleet(endpoint, heartbeat_interval_s=15.0)
+        fleet.adopt(
+            [
+                Node(name=f"hollow-{i}")
+                for i in range(n_nodes)
+            ]
+        )
+        fleet.start()
+
         # ---- pod churn ---------------------------------------------------
         client = ApiClient(endpoint)
         uid_counter = [0]
@@ -212,6 +228,10 @@ def run_scale_sim(
             loop_cycles=server.cycles,
         )
     finally:
+        try:
+            fleet.stop()
+        except NameError:
+            pass
         server.stop()
         source.stop()
         apiserver.stop()

@@ -1,0 +1,124 @@
+"""Hollow-kubelet + node-lifecycle tier (Missing #3): killing hollow
+nodes must produce NotReady taints and the scheduler must reschedule the
+replacement pods onto surviving nodes — the reactive path the reference
+exercises via hollow_kubelet.go + node_lifecycle_controller.go."""
+
+import time
+
+from kubernetes_tpu.api.resource import Resource
+from kubernetes_tpu.api.types import Container, Node, Pod
+from kubernetes_tpu.client import ApiClient, ApiServer, RemoteClusterSource
+from kubernetes_tpu.controller import NodeLifecycleController
+from kubernetes_tpu.controller.node_lifecycle import UNREACHABLE_TAINT_KEY
+from kubernetes_tpu.kubemark import HollowFleet
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.server import SchedulerServer
+from kubernetes_tpu.testing.fake_cluster import FakeCluster
+
+
+def _hollow_nodes(n):
+    return [
+        Node(
+            name=f"hollow-{i}",
+            labels={
+                "kubernetes.io/hostname": f"hollow-{i}",
+                "topology.kubernetes.io/zone": f"z{i % 2}",
+            },
+            capacity=Resource.from_map({"cpu": "8", "memory": "32Gi", "pods": 50}),
+        )
+        for i in range(n)
+    ]
+
+
+def _wait(cond, timeout=90.0, step=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(step)
+    return False
+
+
+def test_kubelet_death_taints_node_and_reschedules_pods():
+    api = FakeCluster(pv_controller=False)
+    apiserver = ApiServer(api).start()
+    endpoint = f"http://127.0.0.1:{apiserver.port}"
+
+    sched = Scheduler()
+    source = RemoteClusterSource(endpoint)
+    source.connect(sched)
+    source.start()
+    server = SchedulerServer(sched, poll_interval_s=0.005)
+    server.start()
+
+    fleet = HollowFleet(endpoint, heartbeat_interval_s=0.3)
+    ctrl = NodeLifecycleController(endpoint, grace_s=4.0, tick_s=0.3)
+    client = ApiClient(endpoint)
+    try:
+        fleet.register(_hollow_nodes(6))
+        fleet.start()
+        source.wait_for_sync()
+        ctrl.start()
+
+        # schedule a first wave; hollow kubelets must report them Running
+        client.create_pods(
+            [
+                Pod(name=f"w{i}", containers=[Container(requests={"cpu": "500m"})])
+                for i in range(18)
+            ]
+        )
+        assert _wait(lambda: len(api.bindings) == 18), len(api.bindings)
+        assert _wait(
+            lambda: sum(1 for p in api.pods.values() if p.phase == "Running") == 18
+        ), "hollow kubelets did not report pod status"
+
+        # kill two kubelets: their nodes must get the unreachable NoExecute
+        # taint and their pods must be EVICTED (deleted)
+        victims = {"hollow-0", "hollow-1"}
+        doomed = {u for u, n in api.bindings.items() if n in victims}
+        assert doomed, "no pods landed on the victims"
+        fleet.stop_heartbeats(sorted(victims))
+        assert _wait(
+            lambda: all(
+                any(
+                    t.key == UNREACHABLE_TAINT_KEY and t.effect == "NoExecute"
+                    for t in api.nodes[v].taints
+                )
+                for v in victims
+            )
+        ), "victim nodes never tainted"
+        assert _wait(lambda: not (doomed & set(api.pods))), "pods not evicted"
+
+        # the workload controller's role: recreate the evicted pods as
+        # pending — the scheduler must place every replacement on a LIVE
+        # node (the taint keeps them off the dead ones)
+        client.create_pods(
+            [
+                Pod(name=f"r{i}", containers=[Container(requests={"cpu": "500m"})])
+                for i in range(len(doomed))
+            ]
+        )
+        expected = 18 - len(doomed) + len(doomed)
+
+        def all_replaced():
+            bound = [n for u, n in api.bindings.items()]
+            return len(bound) == expected and not (set(bound) & victims)
+
+        assert _wait(all_replaced), (
+            f"replacements not rescheduled off dead nodes: {api.bindings}"
+        )
+
+        # recovery: revive one kubelet — the taint must lift
+        fleet.kubelets["hollow-0"].alive = True
+        assert _wait(
+            lambda: not any(
+                t.key == UNREACHABLE_TAINT_KEY
+                for t in api.nodes["hollow-0"].taints
+            )
+        ), "taint not lifted after kubelet recovery"
+    finally:
+        ctrl.stop()
+        fleet.stop()
+        server.stop()
+        source.stop()
+        apiserver.stop()
