@@ -188,20 +188,27 @@ class ApiServer:
                 parts = [p for p in urlparse(self.path).path.split("/") if p]
                 length = int(self.headers.get("Content-Length", 0))
                 body = json.loads(self.rfile.read(length) or b"{}")
-                if len(parts) == 3 and parts[2] == "nodes":
+                if len(parts) == 3 and parts[2] in ("nodes", "pods"):
+                    mk = (
+                        server._create_node
+                        if parts[2] == "nodes"
+                        else server._create_pod
+                    )
                     if isinstance(body, dict) and "items" in body:
+                        # bulk create: per-item results (null = created/
+                        # idempotent-ok) so conflicts inside a batch are
+                        # never silently reported as created
+                        results = []
                         for env in body["items"]:
-                            server.api.create_node(decode(env))
-                        return self._json(201, {"ok": True, "count": len(body["items"])})
-                    server.api.create_node(decode(body))
-                    return self._json(201, {"ok": True})
-                if len(parts) == 3 and parts[2] == "pods":
-                    if isinstance(body, dict) and "items" in body:
-                        for env in body["items"]:
-                            server.api.create_pod(decode(env))
-                        return self._json(201, {"ok": True, "count": len(body["items"])})
-                    server.api.create_pod(decode(body))
-                    return self._json(201, {"ok": True})
+                            code, payload = mk(decode(env))
+                            results.append(None if code < 400 else payload)
+                        n_err = sum(1 for r in results if r is not None)
+                        return self._json(
+                            207 if n_err else 201,
+                            {"ok": n_err == 0, "results": results},
+                        )
+                    code, payload = mk(decode(body))
+                    return self._json(code, payload)
                 if len(parts) == 3 and parts[2] == "bindings":
                     # BULK binding write: the per-pod binding subresource
                     # semantics applied item-wise under the server lock —
@@ -382,6 +389,49 @@ class ApiServer:
 
     def _record(self, res: str, etype: str, obj) -> None:
         self.caches[res].record(etype, encode(obj))
+
+    # Creates are IDEMPOTENT for replays of the same SPEC (the client's
+    # transport-level POST retry can re-send a create whose response was
+    # lost — by then the server may already have written status fields)
+    # and 409 AlreadyExists for conflicting specs — no duplicate ADDED
+    # event ever reaches the watchers.
+    @staticmethod
+    def _spec_wire(obj, status_fields):
+        d = dict(encode(obj))
+        body = d.get("object", d)
+        for f in status_fields:
+            body.pop(f, None)
+        return d
+
+    def _create_node(self, node):
+        status = ("ready", "lastHeartbeat", "last_heartbeat")
+        with self._mu:
+            cur = self.api.nodes.get(node.name)
+            if cur is not None:
+                if self._spec_wire(cur, status) == self._spec_wire(node, status):
+                    return 200, {"ok": True, "idempotent": True}
+                return 409, {"error": f"node {node.name} already exists"}
+            self.api.create_node(node)
+        return 201, {"ok": True}
+
+    def _create_pod(self, pod):
+        status = (
+            "nodeName",
+            "node_name",
+            "phase",
+            "nominatedNodeName",
+            "nominated_node_name",
+            "startTime",
+            "start_time",
+        )
+        with self._mu:
+            cur = self.api.pods.get(pod.uid)
+            if cur is not None:
+                if self._spec_wire(cur, status) == self._spec_wire(pod, status):
+                    return 200, {"ok": True, "idempotent": True}
+                return 409, {"error": f"pod {pod.uid} already exists"}
+            self.api.create_pod(pod)
+        return 201, {"ok": True}
 
     def list_payload(self, res: str) -> dict:
         """Consistent list: snapshot + the rv of the last event applied

@@ -117,12 +117,22 @@ class ApiClient:
         self._req("DELETE", f"/api/v1/pods/{quote(uid, safe='')}")
 
     def create_nodes(self, nodes) -> None:
-        """Bulk node create — one request for the whole list."""
-        self._req("POST", "/api/v1/nodes", {"items": [encode(n) for n in nodes]})
+        """Bulk node create — one request; raises on any per-item error."""
+        out = self._req(
+            "POST", "/api/v1/nodes", {"items": [encode(n) for n in nodes]}
+        )
+        errs = [r for r in out.get("results", []) if r is not None]
+        if errs:
+            raise ApiError(409, f"{len(errs)} bulk create conflicts: {errs[:3]}")
 
     def create_pods(self, pods) -> None:
-        """Bulk pod create — one request for the whole list."""
-        self._req("POST", "/api/v1/pods", {"items": [encode(p) for p in pods]})
+        """Bulk pod create — one request; raises on any per-item error."""
+        out = self._req(
+            "POST", "/api/v1/pods", {"items": [encode(p) for p in pods]}
+        )
+        errs = [r for r in out.get("results", []) if r is not None]
+        if errs:
+            raise ApiError(409, f"{len(errs)} bulk create conflicts: {errs[:3]}")
 
     def bind(self, pod: Pod, node_name: str) -> None:
         self._req(

@@ -80,9 +80,27 @@ class EventBroadcaster:
         self._clock = clock
         self.started = False
 
-    def start_recording_to_sink(self, sink: Callable[[Event], None]) -> None:
+    def start_recording_to_sink(self, sink: Callable[..., None]) -> None:
+        """Sinks receive ``sink(event, is_new)`` — a SNAPSHOT of the
+        aggregated event plus whether this key is new (False = an update to
+        a previously delivered series; an API-writing sink PATCHes instead
+        of POSTing).  Legacy single-argument sinks still work."""
+        import inspect
+
+        try:
+            params = [
+                p
+                for p in inspect.signature(sink).parameters.values()
+                if p.kind
+                in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD, p.VAR_POSITIONAL)
+            ]
+            two_arg = len(params) >= 2 or any(
+                p.kind == p.VAR_POSITIONAL for p in params
+            )
+        except (TypeError, ValueError):  # builtins/partials: assume legacy
+            two_arg = False
         with self._mu:
-            self._sinks.append(sink)
+            self._sinks.append((sink, two_arg))
             self.started = True
 
     def new_recorder(self, reporting_controller: str) -> "EventRecorder":
@@ -90,21 +108,39 @@ class EventBroadcaster:
         return EventRecorder(self, reporting_controller)
 
     def emit(self, event: Event) -> None:
+        import copy as _copy
+
         with self._mu:
             prior = self._series.get(event.key)
+            is_new = prior is None
             if prior is not None:
                 prior.count += 1
                 prior.last_timestamp = self._clock()
+                # LRU touch: repeats keep hot series resident
+                self._series.pop(event.key)
+                self._series[event.key] = prior
                 event = prior
             else:
                 # stamp with the broadcaster's clock (the dataclass default
                 # is wall-clock; tests inject a fake clock here)
                 event.first_timestamp = event.last_timestamp = self._clock()
-                if len(self._series) > 4096:
-                    self._series.clear()
+                while len(self._series) >= 4096:
+                    # evict the least-recently-touched series only — a
+                    # wholesale clear would reset every live series' count
+                    self._series.pop(next(iter(self._series)))
                 self._series[event.key] = event
-            for sink in self._sinks:
-                sink(event)
+            # sinks get a SNAPSHOT: the aggregated object keeps mutating on
+            # later repeats, and a sink buffering deliveries must not see
+            # counts from the future
+            snapshot = _copy.copy(event)
+            for sink, two_arg in self._sinks:
+                # arity resolved at registration (inspect.signature) — a
+                # TypeError raised inside a sink must propagate, not
+                # trigger a second invocation
+                if two_arg:
+                    sink(snapshot, is_new)
+                else:
+                    sink(snapshot)
 
     def shutdown(self) -> None:
         with self._mu:

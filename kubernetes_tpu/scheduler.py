@@ -2419,14 +2419,22 @@ class Scheduler:
                         enabled=fwk.device_enabled(),
                         allowed=frozenset({nom}),
                     )
+                    ok = bool(fit.feasible)
+                    # FIRST pass runs ALL Filter plugins — host-backed ones
+                    # included — with the nominated pods counted as present
+                    # (RunFilterPluginsWithNominatedPods, runtime:973): an
+                    # occupancy-sensitive host plugin must see them
+                    if ok and fwk.has_host_filters():
+                        ok = fwk.run_host_filters(state, pod, ns).ok
                 finally:
                     for np_ in added:
                         ns.remove_pod(np_)
                         fwk.run_pre_filter_extension_remove_pod(
                             state, pod, np_, ns
                         )
-                ok = bool(fit.feasible)
                 if ok and added:
+                    # second pass on the NEUTRAL state (a node feasible
+                    # only via an unbound nomination may never materialize)
                     second = feasible_nodes(
                         pod,
                         st,
@@ -2434,8 +2442,8 @@ class Scheduler:
                         allowed=frozenset({nom}),
                     )
                     ok = bool(second.feasible)
-            if ok and fwk.has_host_filters():
-                ok = fwk.run_host_filters(state, pod, ns).ok
+                    if ok and fwk.has_host_filters():
+                        ok = fwk.run_host_filters(state, pod, ns).ok
             if ok:
                 for ext in self.extenders:
                     if not ext.is_filter() or not ext.is_interested(pod):

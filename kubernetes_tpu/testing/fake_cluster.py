@@ -301,14 +301,21 @@ class FakeCluster:
 
     # ----- events API (events.k8s.io store) ---------------------------------
 
-    def record_event(self, event) -> None:
-        """Event sink: aggregated events keep object identity, so the
-        store dedups by identity in O(1) (the events stay referenced in
-        self.events, so ids are stable) like the API's series would."""
-        ids = self.__dict__.setdefault("_event_ids", set())
-        if id(event) not in ids:
-            ids.add(id(event))
+    def record_event(self, event, is_new: bool = True) -> None:
+        """Event sink (the API's events registry shape): a NEW series
+        appends; an update REPLACES the stored snapshot for its key, so
+        counts reflect the latest aggregation without double-posting."""
+        idx = self.__dict__.setdefault("_event_idx", {})
+        key = getattr(event, "key", None)
+        if key is None:
             self.events.append(event)
+            return
+        pos = idx.get(key)
+        if pos is None or is_new:
+            idx[key] = len(self.events)
+            self.events.append(event)
+        else:
+            self.events[pos] = event
 
     def list_events(self, reason: Optional[str] = None) -> List[object]:
         return [e for e in self.events if reason is None or e.reason == reason]

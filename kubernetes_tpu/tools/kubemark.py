@@ -109,6 +109,7 @@ def run_scale_sim(
         if progress:
             progress(msg)
 
+    fleet = None
     try:
         # ---- hollow node registration storm -----------------------------
         t_reg = time.perf_counter()
@@ -228,10 +229,8 @@ def run_scale_sim(
             loop_cycles=server.cycles,
         )
     finally:
-        try:
+        if fleet is not None:
             fleet.stop()
-        except NameError:
-            pass
         server.stop()
         source.stop()
         apiserver.stop()
