@@ -92,6 +92,14 @@ class Plugins:
     bind: PluginSet = field(default_factory=PluginSet)
     post_bind: PluginSet = field(default_factory=PluginSet)
 
+    def points(self):
+        """(wire name, PluginSet) pairs for every extension point —
+        derived from EXTENSION_POINTS/_SNAKE so a new point automatically
+        participates in validation and dump_config."""
+        return [("multiPoint", self.multi_point)] + [
+            (ep, getattr(self, _SNAKE[ep])) for ep in EXTENSION_POINTS
+        ]
+
 
 @dataclass
 class Extender:
@@ -116,6 +124,14 @@ class Profile:
     plugins: Plugins = field(default_factory=Plugins)
     plugin_config: Dict[str, dict] = field(default_factory=dict)
     percentage_of_nodes_to_score: Optional[int] = None
+
+
+API_VERSION = "kubescheduler.config.k8s.io/v1"
+SUPPORTED_API_VERSIONS = {
+    API_VERSION,
+    # v1beta3 reads convert to v1; for the modeled fields the shapes match
+    "kubescheduler.config.k8s.io/v1beta3",
+}
 
 
 @dataclass
@@ -160,11 +176,15 @@ class SchedulerConfiguration:
     )
 
     def validate(self) -> None:
+        """The apis/config/validation table, scaled to this build's
+        surface (validation.go ValidateKubeSchedulerConfiguration)."""
         names = [p.scheduler_name for p in self.profiles]
         if len(set(names)) != len(names):
             raise ValueError(f"duplicate profile names: {names}")
         if not self.profiles:
             raise ValueError("at least one profile required")
+        if self.parallelism <= 0:
+            raise ValueError("parallelism must be positive")
         if self.pod_initial_backoff_seconds <= 0:
             raise ValueError("podInitialBackoffSeconds must be positive")
         if self.pod_max_backoff_seconds < self.pod_initial_backoff_seconds:
@@ -173,6 +193,34 @@ class SchedulerConfiguration:
             raise ValueError("percentageOfNodesToScore must be in [0, 100]")
         if self.wave_commit not in ("off", "on"):
             raise ValueError('waveCommit must be "off" or "on"')
+        if self.batch_size <= 0:
+            raise ValueError("batchSize must be positive")
+        for p in self.profiles:
+            if not p.scheduler_name:
+                raise ValueError("profile schedulerName must be non-empty")
+            if p.percentage_of_nodes_to_score is not None and not (
+                0 <= p.percentage_of_nodes_to_score <= 100
+            ):
+                raise ValueError(
+                    "profile percentageOfNodesToScore must be in [0, 100]"
+                )
+            for point_name, plugin_set in p.plugins.points():
+                enabled = [r.name for r in plugin_set.enabled]
+                if len(set(enabled)) != len(enabled):
+                    raise ValueError(
+                        f"duplicate plugin in {point_name} enabled list: "
+                        f"{enabled}"
+                    )
+        binders = [e for e in self.extenders if e.bind_verb]
+        if len(binders) > 1:
+            raise ValueError("only one extender may implement bind")
+        for e in self.extenders:
+            if not e.url_prefix:
+                raise ValueError("extender urlPrefix is required")
+            if not 0 < e.weight:
+                raise ValueError("extender weight must be positive")
+            if e.ignorable and e.bind_verb:
+                raise ValueError("a binding extender cannot be ignorable")
 
 
 # ---------------------------------------------------------------------------
@@ -358,6 +406,16 @@ def load_config(source) -> SchedulerConfiguration:
     kind = d.get("kind", "KubeSchedulerConfiguration")
     if kind != "KubeSchedulerConfiguration":
         raise ValueError(f"unexpected kind {kind!r}")
+    # Versioned-kind tier (apis/config/scheme: v1 is served; v1beta3
+    # converts on read — its wire shape for the fields this build models
+    # is identical, so conversion is the identity here; unknown versions
+    # fail loudly instead of half-applying).
+    api_version = d.get("apiVersion", API_VERSION)
+    if api_version not in SUPPORTED_API_VERSIONS:
+        raise ValueError(
+            f"unsupported apiVersion {api_version!r} "
+            f"(supported: {sorted(SUPPORTED_API_VERSIONS)})"
+        )
 
     profiles = []
     for pd in d.get("profiles", [{}]):
@@ -404,6 +462,82 @@ def load_config(source) -> SchedulerConfiguration:
         wave_commit={True: "on", False: "off"}.get(
             d.get("waveCommit", "off"), d.get("waveCommit", "off")
         ),
+        reference_sampling_compat=d.get("referenceSamplingCompat", False),
+        tie_break_seed=d.get("tieBreakSeed"),
     )
+    if "featureGates" in d:
+        cfg.feature_gates = dict(DEFAULT_FEATURE_GATES)
+        cfg.feature_gates.update(d["featureGates"])
     cfg.validate()
     return cfg
+
+
+def dump_config(cfg: SchedulerConfiguration) -> dict:
+    """Serialize back to the v1 wire shape — load_config(dump_config(c))
+    round-trips (the write half of the conversion tier)."""
+
+    def plugin_set(ps: PluginSet):
+        out = {}
+        if ps.enabled:
+            out["enabled"] = [
+                {"name": r.name, **({"weight": r.weight} if r.weight else {})}
+                for r in ps.enabled
+            ]
+        if ps.disabled:
+            out["disabled"] = [{"name": r.name} for r in ps.disabled]
+        return out
+
+    profiles = []
+    for p in cfg.profiles:
+        pd = {"schedulerName": p.scheduler_name}
+        plugins = {
+            wire: plugin_set(ps)
+            for wire, ps in p.plugins.points()
+            if ps.enabled or ps.disabled
+        }
+        if plugins:
+            pd["plugins"] = plugins
+        if p.plugin_config:
+            pd["pluginConfig"] = [
+                {"name": name, "args": args}
+                for name, args in p.plugin_config.items()
+            ]
+        if p.percentage_of_nodes_to_score is not None:
+            pd["percentageOfNodesToScore"] = p.percentage_of_nodes_to_score
+        profiles.append(pd)
+    out = {
+        "apiVersion": API_VERSION,
+        "kind": "KubeSchedulerConfiguration",
+        "parallelism": cfg.parallelism,
+        "percentageOfNodesToScore": cfg.percentage_of_nodes_to_score,
+        "podInitialBackoffSeconds": cfg.pod_initial_backoff_seconds,
+        "podMaxBackoffSeconds": cfg.pod_max_backoff_seconds,
+        "batchSize": cfg.batch_size,
+        "fastBatchMax": cfg.fast_batch_max,
+        "fastDeviceMin": cfg.fast_device_min,
+        "waveCommit": cfg.wave_commit,
+        "referenceSamplingCompat": cfg.reference_sampling_compat,
+        "tieBreakSeed": cfg.tie_break_seed,
+        "featureGates": dict(cfg.feature_gates),
+        "profiles": profiles,
+    }
+    if cfg.extenders:
+        out["extenders"] = [
+            {
+                "urlPrefix": e.url_prefix,
+                "filterVerb": e.filter_verb,
+                "prioritizeVerb": e.prioritize_verb,
+                "bindVerb": e.bind_verb,
+                "preemptVerb": e.preempt_verb,
+                "weight": e.weight,
+                "enableHTTPS": e.enable_https,
+                "httpTimeout": e.http_timeout_s,
+                "nodeCacheCapable": e.node_cache_capable,
+                "ignorable": e.ignorable,
+                "managedResources": [
+                    {"name": n} for n in e.managed_resources
+                ],
+            }
+            for e in cfg.extenders
+        ]
+    return out

@@ -1,0 +1,246 @@
+"""Declarative bench workloads: a YAML op list compiled onto the
+scheduler (scheduler_perf.go:447-750's createNodes/createPods/churn/
+barrier ops, the way the reference defines every perf workload in
+performance-config.yaml instead of code).
+
+A workload is `{"name": ..., "ops": [...]}`; ops execute in order against
+one in-proc Scheduler:
+
+  op: createNodes    count, zones=3, cpu="8", memory="32Gi", pods=110,
+                     labels={...}                    (appends nodes)
+  op: createPods     count, cpuRequest(s), memoryRequest(s),
+                     labels={...}, apps=N (app label sharding),
+                     antiAffinityGroups=N (hostname anti-affinity),
+                     spreadApps=N + maxSkew (zone topology spread),
+                     collectMetrics: true            (measured region)
+  op: churn          deletePods=N (bound victims), createNodes=N
+  op: barrier        drain until every pending pod has an outcome
+  op: sleep          seconds
+
+Measurement follows scheduler_perf: only pods created by ops with
+``collectMetrics: true`` count toward throughput, and the reported
+wall time spans their barrier drains (util.go:367's collector skips
+warm-up ops).  Run a workload file:
+
+    python -m kubernetes_tpu.tools.workload_dsl my_workload.yaml
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import time
+from typing import Dict, List, Optional
+
+from kubernetes_tpu.api.resource import Resource
+from kubernetes_tpu.api.types import (
+    Affinity,
+    Container,
+    LabelSelector,
+    Node,
+    Pod,
+    PodAffinityTerm,
+    PodAntiAffinity,
+    TopologySpreadConstraint,
+)
+
+
+def _aslist(v) -> List:
+    return v if isinstance(v, list) else [v]
+
+
+class WorkloadRunner:
+    """Executes one op list against a fresh Scheduler."""
+
+    def __init__(self, spec: dict, seed: int = 42):
+        from kubernetes_tpu.scheduler import Scheduler
+
+        self.spec = spec
+        self.rng = random.Random(spec.get("seed", seed))
+        self.sched = Scheduler()
+        self.bound: Dict[str, str] = {}
+        self.sched.binding_sink = (
+            lambda pod, node: self.bound.__setitem__(pod.uid, node)
+        )
+        self._node_count = 0
+        self._pod_count = 0
+        self._measured_pods = 0
+        self._measured_wall = 0.0
+        self._pending_measured = False
+
+    # ----- ops --------------------------------------------------------------
+
+    def _op_create_nodes(self, op: dict) -> None:
+        zones = op.get("zones", 3)
+        caps = {
+            "cpu": str(op.get("cpu", "8")),
+            "memory": str(op.get("memory", "32Gi")),
+            "pods": op.get("pods", 110),
+        }
+        for _ in range(op["count"]):
+            i = self._node_count
+            self._node_count += 1
+            labels = {
+                "topology.kubernetes.io/zone": f"zone-{i % zones}",
+                "kubernetes.io/hostname": f"dsl-node-{i}",
+                **op.get("labels", {}),
+            }
+            self.sched.on_node_add(
+                Node(
+                    name=f"dsl-node-{i}",
+                    labels=labels,
+                    capacity=Resource.from_map(caps),
+                )
+            )
+
+    def _mk_pod(self, op: dict) -> Pod:
+        i = self._pod_count
+        self._pod_count += 1
+        labels = dict(op.get("labels", {}))
+        if op.get("apps"):
+            labels["app"] = f"app-{i % op['apps']}"
+        affinity = None
+        tsc = ()
+        if op.get("antiAffinityGroups"):
+            group = f"g{i % op['antiAffinityGroups']}"
+            labels["group"] = group
+            affinity = Affinity(
+                pod_anti_affinity=PodAntiAffinity(
+                    required_during_scheduling_ignored_during_execution=(
+                        PodAffinityTerm(
+                            topology_key="kubernetes.io/hostname",
+                            label_selector=LabelSelector(
+                                match_labels={"group": group}
+                            ),
+                        ),
+                    )
+                )
+            )
+        if op.get("spreadApps"):
+            app = f"sa{i % op['spreadApps']}"
+            labels["sapp"] = app
+            tsc = (
+                TopologySpreadConstraint(
+                    max_skew=op.get("maxSkew", 5),
+                    topology_key="topology.kubernetes.io/zone",
+                    when_unsatisfiable="DoNotSchedule",
+                    label_selector=LabelSelector(match_labels={"sapp": app}),
+                ),
+            )
+        return Pod(
+            name=f"dsl-pod-{i}",
+            labels=labels,
+            affinity=affinity,
+            topology_spread_constraints=tsc,
+            containers=[
+                Container(
+                    name="c",
+                    requests={
+                        "cpu": str(
+                            self.rng.choice(
+                                _aslist(op.get("cpuRequest", "100m"))
+                            )
+                        ),
+                        "memory": str(
+                            self.rng.choice(
+                                _aslist(op.get("memoryRequest", "128Mi"))
+                            )
+                        ),
+                    },
+                )
+            ],
+        )
+
+    def _op_create_pods(self, op: dict) -> None:
+        for _ in range(op["count"]):
+            self.sched.on_pod_add(self._mk_pod(op))
+        if op.get("collectMetrics"):
+            self._pending_measured = True
+            self._measured_pods += op["count"]
+
+    def _op_barrier(self, op: Optional[dict] = None) -> None:
+        t0 = time.perf_counter()
+        self.sched.schedule_pending()
+        if self._pending_measured:
+            self._measured_wall += time.perf_counter() - t0
+            self._pending_measured = False
+
+    def _op_churn(self, op: dict) -> None:
+        import copy
+
+        for uid in list(self.bound)[: op.get("deletePods", 0)]:
+            node = self.bound.pop(uid)
+            ps = self.sched.cache.pod_states.get(uid)
+            if ps is None:
+                continue
+            dead = copy.copy(ps.pod)
+            dead.node_name = node
+            self.sched.on_pod_delete(dead)
+        if op.get("createNodes"):
+            self._op_create_nodes(
+                {"count": op["createNodes"], **{k: v for k, v in op.items() if k != "op"}}
+            )
+
+    # ----- driver -----------------------------------------------------------
+
+    def run(self) -> dict:
+        for op in self.spec.get("ops", []):
+            kind = op["op"]
+            if kind == "createNodes":
+                self._op_create_nodes(op)
+            elif kind == "createPods":
+                self._op_create_pods(op)
+            elif kind == "barrier":
+                self._op_barrier(op)
+            elif kind == "churn":
+                self._op_churn(op)
+            elif kind == "sleep":
+                time.sleep(op.get("seconds", 0))
+            else:
+                raise ValueError(f"unknown op {kind!r}")
+        # implicit trailing barrier, like scheduler_perf's workload end
+        self._op_barrier()
+        wall = max(self._measured_wall, 1e-9)
+        return {
+            "name": self.spec.get("name", "workload"),
+            "nodes": self._node_count,
+            "pods_created": self._pod_count,
+            "pods_bound": len(self.bound),
+            "measured_pods": self._measured_pods,
+            "measured_wall_s": round(wall, 3),
+            "pods_per_s": round(self._measured_pods / wall, 1)
+            if self._measured_pods
+            else None,
+        }
+
+
+def run_workload(source, seed: int = 42) -> dict:
+    """source: YAML path / YAML string / dict."""
+    import os
+
+    if isinstance(source, dict):
+        spec = source
+    else:
+        import yaml
+
+        if isinstance(source, str) and os.path.exists(source):
+            with open(source) as f:
+                spec = yaml.safe_load(f)
+        else:
+            spec = yaml.safe_load(source)
+    return WorkloadRunner(spec, seed=seed).run()
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="workload-dsl")
+    ap.add_argument("workload", help="YAML workload file")
+    ap.add_argument("--seed", type=int, default=42)
+    args = ap.parse_args(argv)
+    print(json.dumps(run_workload(args.workload, seed=args.seed)))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -97,3 +97,165 @@ def test_events_to_register_surface():
     evs = fwk.events_to_register()
     assert "NodeResourcesFit" in evs
     assert "SchedulingGates" in evs
+
+
+# ---------------------------------------------------------------------------
+# versioned-kind tier: v1 round-trip + validation rejections (Missing #6)
+# ---------------------------------------------------------------------------
+
+
+def test_v1_round_trip():
+    from kubernetes_tpu.framework.config import dump_config, load_config
+
+    src = {
+        "apiVersion": "kubescheduler.config.k8s.io/v1",
+        "kind": "KubeSchedulerConfiguration",
+        "parallelism": 8,
+        "percentageOfNodesToScore": 50,
+        "podInitialBackoffSeconds": 0.5,
+        "podMaxBackoffSeconds": 5.0,
+        "batchSize": 128,
+        "referenceSamplingCompat": True,
+        "tieBreakSeed": 1234,
+        "featureGates": {"DynamicResourceAllocation": True},
+        "profiles": [
+            {
+                "schedulerName": "default-scheduler",
+                "plugins": {
+                    "score": {
+                        "enabled": [{"name": "NodeResourcesFit", "weight": 5}],
+                        "disabled": [{"name": "ImageLocality"}],
+                    }
+                },
+                "pluginConfig": [
+                    {
+                        "name": "NodeResourcesFit",
+                        "args": {
+                            "scoringStrategy": {"type": "MostAllocated"}
+                        },
+                    }
+                ],
+            },
+            {"schedulerName": "batch-scheduler"},
+        ],
+        "extenders": [
+            {
+                "urlPrefix": "http://127.0.0.1:9999/ext",
+                "filterVerb": "filter",
+                "weight": 2,
+            }
+        ],
+    }
+    cfg = load_config(dict(src))
+    wire = dump_config(cfg)
+    cfg2 = load_config(wire)
+    # round-trip fixed point: dumping again is byte-identical
+    assert dump_config(cfg2) == wire
+    assert cfg2.parallelism == 8
+    assert [p.scheduler_name for p in cfg2.profiles] == [
+        "default-scheduler",
+        "batch-scheduler",
+    ]
+    assert cfg2.extenders[0].url_prefix == "http://127.0.0.1:9999/ext"
+    assert (
+        cfg2.profiles[0]
+        .plugin_config["NodeResourcesFit"]["scoringStrategy"]["type"]
+        == "MostAllocated"
+    )
+    # the bit-compat knobs round-trip — losing them would silently change
+    # placement decisions on reload
+    assert cfg2.reference_sampling_compat is True
+    assert cfg2.tie_break_seed == 1234
+    assert cfg2.feature_gates["DynamicResourceAllocation"] is True
+
+
+def test_v1beta3_reads_convert():
+    from kubernetes_tpu.framework.config import load_config
+
+    cfg = load_config(
+        {
+            "apiVersion": "kubescheduler.config.k8s.io/v1beta3",
+            "kind": "KubeSchedulerConfiguration",
+            "parallelism": 4,
+        }
+    )
+    assert cfg.parallelism == 4
+
+
+@pytest.mark.parametrize(
+    "mutation,msg",
+    [
+        ({"apiVersion": "kubescheduler.config.k8s.io/v9"}, "unsupported apiVersion"),
+        ({"kind": "SchedulerPolicy"}, "unexpected kind"),
+        ({"parallelism": 0}, "parallelism"),
+        ({"percentageOfNodesToScore": 101}, "percentageOfNodesToScore"),
+        ({"podInitialBackoffSeconds": 0}, "podInitialBackoffSeconds"),
+        ({"batchSize": -1}, "batchSize"),
+        (
+            {
+                "profiles": [
+                    {"schedulerName": "a"},
+                    {"schedulerName": "a"},
+                ]
+            },
+            "duplicate profile names",
+        ),
+        ({"profiles": [{"schedulerName": ""}]}, "schedulerName"),
+        (
+            {
+                "profiles": [
+                    {
+                        "plugins": {
+                            "score": {
+                                "enabled": [
+                                    {"name": "NodeResourcesFit"},
+                                    {"name": "NodeResourcesFit"},
+                                ]
+                            }
+                        }
+                    }
+                ]
+            },
+            "duplicate plugin",
+        ),
+        (
+            {"extenders": [{"filterVerb": "filter"}]},
+            "urlPrefix",
+        ),
+        (
+            {"extenders": [{"urlPrefix": "http://x", "weight": 0}]},
+            "weight",
+        ),
+        (
+            {
+                "extenders": [
+                    {"urlPrefix": "http://x", "bindVerb": "bind"},
+                    {"urlPrefix": "http://y", "bindVerb": "bind"},
+                ]
+            },
+            "one extender",
+        ),
+        (
+            {
+                "extenders": [
+                    {
+                        "urlPrefix": "http://x",
+                        "bindVerb": "bind",
+                        "ignorable": True,
+                    }
+                ]
+            },
+            "ignorable",
+        ),
+    ],
+)
+def test_v1_validation_rejections(mutation, msg):
+    from kubernetes_tpu.framework.config import load_config
+
+    base = {
+        "apiVersion": "kubescheduler.config.k8s.io/v1",
+        "kind": "KubeSchedulerConfiguration",
+    }
+    base.update(mutation)
+    with pytest.raises(ValueError, match=msg):
+        load_config(base)

@@ -1,0 +1,71 @@
+"""Declarative workload DSL (scheduler_perf.go:447-750's op list): new
+bench workloads are data, not code."""
+
+from kubernetes_tpu.tools.workload_dsl import run_workload
+
+YAML = """
+name: mini-mixed
+ops:
+  - op: createNodes
+    count: 20
+    zones: 4
+    cpu: "8"
+    memory: 16Gi
+  - op: createPods          # warm-up, NOT measured
+    count: 30
+    cpuRequest: [100m, 250m]
+  - op: barrier
+  - op: createPods
+    count: 60
+    apps: 6
+    spreadApps: 4
+    maxSkew: 3
+    collectMetrics: true
+  - op: barrier
+  - op: churn
+    deletePods: 10
+    createNodes: 2
+  - op: createPods
+    count: 40
+    antiAffinityGroups: 8
+    collectMetrics: true
+  - op: barrier
+"""
+
+
+def test_yaml_workload_executes_and_measures():
+    out = run_workload(YAML)
+    assert out["name"] == "mini-mixed"
+    assert out["nodes"] == 22  # 20 + 2 churn-added
+    assert out["pods_created"] == 130
+    # 10 bound pods were churned away
+    assert out["pods_bound"] == 120
+    # only the collectMetrics ops count toward throughput
+    assert out["measured_pods"] == 100
+    assert out["pods_per_s"] is not None and out["pods_per_s"] > 0
+
+
+def test_unknown_op_rejected():
+    import pytest
+
+    with pytest.raises(ValueError, match="unknown op"):
+        run_workload({"ops": [{"op": "frobnicate"}]})
+
+
+def test_anti_affinity_groups_respected():
+    out = run_workload(
+        {
+            "ops": [
+                {"op": "createNodes", "count": 12},
+                {
+                    "op": "createPods",
+                    "count": 24,
+                    "antiAffinityGroups": 2,
+                    "collectMetrics": True,
+                },
+                {"op": "barrier"},
+            ]
+        }
+    )
+    # 2 groups x 12 hostname-exclusive nodes = 24 placeable
+    assert out["pods_bound"] == 24
