@@ -11,6 +11,8 @@ from kubernetes_tpu.client.client import (
     Reflector,
     RemoteClusterSource,
     RemoteLeaseStore,
+    SharedInformer,
+    pods_by_node_indexer,
 )
 
 __all__ = [
@@ -19,4 +21,6 @@ __all__ = [
     "Reflector",
     "RemoteClusterSource",
     "RemoteLeaseStore",
+    "SharedInformer",
+    "pods_by_node_indexer",
 ]

@@ -370,42 +370,175 @@ class RemoteClusterSource:
 
     def __init__(self, endpoint: str):
         self.client = ApiClient(endpoint)
-        self._reflectors: List[Reflector] = []
+        # SHARED informers (one list/watch stream per resource, any number
+        # of consumers + named indexes — shared_informer.go:459); the
+        # scheduler registers as the first consumer, debuggers/metrics
+        # join via .informers without a second watch stream
+        self.informers: Dict[str, SharedInformer] = {
+            "nodes": SharedInformer(self.client, "nodes"),
+            "pods": SharedInformer(self.client, "pods"),
+        }
+
+    def pods_by_node(self, node_name: str):
+        """Assigned pods on one node via the shared informer's index —
+        registered lazily on first use so the hot watch path pays the
+        per-event index upkeep only when a consumer exists."""
+        inf = self.informers["pods"]
+        if "node" not in inf._indexers:
+            inf.add_indexer("node", pods_by_node_indexer)
+        return inf.by_index("node", node_name)
 
     def connect(self, scheduler) -> None:
         if getattr(scheduler, "event_broadcaster", None) is not None:
             # events currently stay process-local (an events API write
             # sink would slot in here)
             pass
-        self._reflectors = [
-            Reflector(
-                self.client,
-                "nodes",
-                scheduler.on_node_add,
-                scheduler.on_node_update,
-                scheduler.on_node_delete,
-            ),
-            Reflector(
-                self.client,
-                "pods",
-                scheduler.on_pod_add,
-                scheduler.on_pod_update,
-                scheduler.on_pod_delete,
-            ),
-        ]
+        self.informers["nodes"].add_handlers(
+            scheduler.on_node_add,
+            scheduler.on_node_update,
+            scheduler.on_node_delete,
+        )
+        self.informers["pods"].add_handlers(
+            scheduler.on_pod_add,
+            scheduler.on_pod_update,
+            scheduler.on_pod_delete,
+        )
         scheduler.binding_sink = self.client.bind
         scheduler.binding_sink_many = self.client.bind_many
         scheduler.pod_deleter = lambda pod: self.client.delete_pod(pod.uid)
         scheduler.status_patcher = self.client.patch_pod_status
 
     def start(self) -> "RemoteClusterSource":
-        for r in self._reflectors:
-            r.start()
+        for inf in self.informers.values():
+            inf.start()
         return self
 
     def wait_for_sync(self, timeout: float = 10.0) -> bool:
-        return all(r.synced.wait(timeout) for r in self._reflectors)
+        return all(
+            inf.synced.wait(timeout) for inf in self.informers.values()
+        )
 
     def stop(self) -> None:
-        for r in self._reflectors:
-            r.stop()
+        for inf in self.informers.values():
+            inf.stop()
+
+
+class SharedInformer:
+    """SharedIndexInformer's fan-out + indexer surface
+    (tools/cache/shared_informer.go:459): ONE Reflector list/watch stream
+    feeds any number of registered handler sets, and maintains named
+    INDEXES over the store (e.g. pods-by-node) that consumers query
+    instead of scanning — the reference narrows AssignedPodAdded requeue
+    work through exactly such indexers (backend/queue/
+    scheduling_queue.go:964-1135).
+
+    Handlers added AFTER start receive synthetic ADDs for the current
+    store contents (the informer's replay-on-join contract)."""
+
+    def __init__(self, client: ApiClient, resource: str):
+        self.resource = resource
+        self._handlers: List[tuple] = []  # (on_add, on_update, on_delete)
+        self._indexers: Dict[str, Callable] = {}
+        self._indexes: Dict[str, Dict[str, Dict[str, object]]] = {}
+        # _mu guards the index tables (by_index readers); _delivery_mu
+        # serializes {own-store update + index update + handler delivery}
+        # against join replays — the two-lock split keeps by_index safe to
+        # call from threads that hold locks the handlers also take
+        self._mu = threading.Lock()
+        self._delivery_mu = threading.RLock()
+        self._store: Dict[str, object] = {}  # delivery-consistent mirror
+        self._reflector = Reflector(
+            client,
+            resource,
+            self._on_add,
+            self._on_update,
+            self._on_delete,
+        )
+
+    # ----- indexers ---------------------------------------------------------
+
+    def add_indexer(self, name: str, key_fn: Callable) -> None:
+        """key_fn(obj) → index key or None (unindexed)."""
+        with self._delivery_mu:
+            snapshot = list(self._store.values())
+            with self._mu:
+                self._indexers[name] = key_fn
+                idx: Dict[str, Dict[str, object]] = {}
+                for obj in snapshot:
+                    k = key_fn(obj)
+                    if k is not None:
+                        idx.setdefault(k, {})[_key_of(obj)] = obj
+                self._indexes[name] = idx
+
+    def by_index(self, name: str, key: str) -> List[object]:
+        """Objects whose index key matches — O(bucket), not O(store)."""
+        with self._mu:
+            return list(self._indexes.get(name, {}).get(key, {}).values())
+
+    def _index_add(self, obj) -> None:
+        with self._mu:
+            for name, fn in self._indexers.items():
+                k = fn(obj)
+                if k is not None:
+                    self._indexes[name].setdefault(k, {})[_key_of(obj)] = obj
+
+    def _index_remove(self, obj) -> None:
+        with self._mu:
+            for name, fn in self._indexers.items():
+                k = fn(obj)
+                if k is not None:
+                    bucket = self._indexes[name].get(k)
+                    if bucket is not None:
+                        bucket.pop(_key_of(obj), None)
+                        if not bucket:
+                            del self._indexes[name][k]
+
+    # ----- fan-out ----------------------------------------------------------
+
+    def add_handlers(self, on_add, on_update, on_delete) -> None:
+        """Join the stream.  The replay happens under the DELIVERY lock
+        against the delivery-consistent store mirror, so a late joiner can
+        neither miss an object, see one twice, nor resurrect a concurrent
+        delete (the delta-queue sequencing client-go gets for free)."""
+        with self._delivery_mu:
+            for obj in self._store.values():
+                on_add(obj)
+            self._handlers.append((on_add, on_update, on_delete))
+
+    def _on_add(self, obj) -> None:
+        with self._delivery_mu:
+            self._store[_key_of(obj)] = obj
+            self._index_add(obj)
+            for add, _, _ in self._handlers:
+                add(obj)
+
+    def _on_update(self, old, new) -> None:
+        with self._delivery_mu:
+            self._store[_key_of(new)] = new
+            self._index_remove(old)
+            self._index_add(new)
+            for _, update, _ in self._handlers:
+                update(old, new)
+
+    def _on_delete(self, obj) -> None:
+        with self._delivery_mu:
+            self._store.pop(_key_of(obj), None)
+            self._index_remove(obj)
+            for _, _, delete in self._handlers:
+                delete(obj)
+
+    def start(self) -> "SharedInformer":
+        self._reflector.start()
+        return self
+
+    @property
+    def synced(self):
+        return self._reflector.synced
+
+    def stop(self) -> None:
+        self._reflector.stop()
+
+
+def pods_by_node_indexer(pod) -> Optional[str]:
+    """The pods-by-node index key (assigned pods only)."""
+    return pod.node_name or None

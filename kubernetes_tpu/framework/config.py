@@ -390,19 +390,9 @@ def _plugins_from(d: Optional[dict]) -> Plugins:
 
 def load_config(source) -> SchedulerConfiguration:
     """Load from a YAML string / path / dict."""
-    import os
+    from kubernetes_tpu.util.yamlsource import load_yaml_source
 
-    if isinstance(source, dict):
-        d = source
-    else:
-        import yaml
-
-        if isinstance(source, str) and os.path.exists(source):
-            with open(source) as f:
-                d = yaml.safe_load(f)
-        else:
-            d = yaml.safe_load(source)
-    d = d or {}
+    d = load_yaml_source(source)
     kind = d.get("kind", "KubeSchedulerConfiguration")
     if kind != "KubeSchedulerConfiguration":
         raise ValueError(f"unexpected kind {kind!r}")

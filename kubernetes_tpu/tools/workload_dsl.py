@@ -216,19 +216,9 @@ class WorkloadRunner:
 
 def run_workload(source, seed: int = 42) -> dict:
     """source: YAML path / YAML string / dict."""
-    import os
+    from kubernetes_tpu.util.yamlsource import load_yaml_source
 
-    if isinstance(source, dict):
-        spec = source
-    else:
-        import yaml
-
-        if isinstance(source, str) and os.path.exists(source):
-            with open(source) as f:
-                spec = yaml.safe_load(f)
-        else:
-            spec = yaml.safe_load(source)
-    return WorkloadRunner(spec, seed=seed).run()
+    return WorkloadRunner(load_yaml_source(source), seed=seed).run()
 
 
 def main(argv=None) -> int:

@@ -1,0 +1,23 @@
+"""Shared YAML/dict source loading for config-shaped inputs."""
+
+from __future__ import annotations
+
+import os
+
+
+def load_yaml_source(source) -> dict:
+    """Accepts a dict (returned as-is), a filesystem path, or a YAML
+    string; returns the parsed mapping ({} for empty)."""
+    if isinstance(source, dict):
+        return source
+    import yaml
+
+    if isinstance(source, str):
+        try:
+            is_path = os.path.exists(source)
+        except (ValueError, OSError):  # e.g. NUL bytes in a YAML string
+            is_path = False
+        if is_path:
+            with open(source) as f:
+                return yaml.safe_load(f) or {}
+    return yaml.safe_load(source) or {}
