@@ -378,17 +378,26 @@ class RemoteClusterSource:
             "nodes": SharedInformer(self.client, "nodes"),
             "pods": SharedInformer(self.client, "pods"),
         }
+        # registered EAGERLY: lazy registration would take the delivery
+        # lock on first query, inverting lock order against a caller that
+        # holds a handler-side lock (deadlock); per-event upkeep is two
+        # dict ops
+        self.informers["pods"].add_indexer("node", pods_by_node_indexer)
+        self._connected = False
 
     def pods_by_node(self, node_name: str):
-        """Assigned pods on one node via the shared informer's index —
-        registered lazily on first use so the hot watch path pays the
-        per-event index upkeep only when a consumer exists."""
-        inf = self.informers["pods"]
-        if "node" not in inf._indexers:
-            inf.add_indexer("node", pods_by_node_indexer)
-        return inf.by_index("node", node_name)
+        """Assigned pods on one node via the shared informer's index
+        (index reads take only the index lock — safe from any thread)."""
+        return self.informers["pods"].by_index("node", node_name)
 
     def connect(self, scheduler) -> None:
+        if self._connected:
+            raise RuntimeError(
+                "RemoteClusterSource.connect called twice — handler sets "
+                "accumulate on the shared informers; build a fresh source "
+                "per scheduler instead"
+            )
+        self._connected = True
         if getattr(scheduler, "event_broadcaster", None) is not None:
             # events currently stay process-local (an events API write
             # sink would slot in here)

@@ -61,6 +61,7 @@ def test_latest_recorded_bench_clears_floors():
     # Floors added AFTER a bench round was recorded only apply to later
     # rounds; config3/4 floors reflect the round-4 kernels, so only check
     # keys present in the recorded results AND not newer than them.
+    since = floors_doc.get("floors_since", {})
     failures = [
         f"{key}: {results[key]:.1f} < floor {floor}"
         for key, floor in floors.items()
@@ -80,8 +81,20 @@ def test_latest_recorded_bench_clears_floors():
     # names the regressed config keys); only those keys are excused — any
     # other floor failure in the same round still fails, and the gate fully
     # re-arms for every round after it.
+    # floors introduced in a later round than the recorded bench don't
+    # apply to it (floors_since maps key -> first enforced round)
+    failures = [
+        f for f in failures if since.get(f.split(":")[0], 0) <= n
+    ]
     acked = floors_doc.get("acknowledged_regressions", {}).get(str(n))
     if acked:
         excused = set(acked["keys"])
         failures = [f for f in failures if f.split(":")[0] not in excused]
     assert not failures, "bench regression below floors: " + "; ".join(failures)
+    # decision-parity gate: a recorded bench that ran the parity checks
+    # must show ZERO diffs — wrong decisions are a regression no matter
+    # how fast they were made
+    if "parity_total_diffs" in results:
+        assert results["parity_total_diffs"] == 0, (
+            f"parity diffs in recorded bench: {results['parity_total_diffs']}"
+        )
