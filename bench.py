@@ -36,6 +36,15 @@ def _mk_sched():
     sched = Scheduler()
     bindings = {}
     sched.binding_sink = lambda pod, node: bindings.__setitem__(pod.uid, node)
+
+    # bulk sink (the API tier's /bindings shape): a whole bind chunk rides
+    # one call, so the worker tail is one lock + one dict sweep
+    def sink_many(pairs):
+        for pod, node in pairs:
+            bindings[pod.uid] = node
+        return [None] * len(pairs)
+
+    sched.binding_sink_many = sink_many
     return sched, bindings
 
 
@@ -74,6 +83,9 @@ def _run_workload(nodes, pods, warm=None):
     _drain(sched)
     for p in pods[warm:]:
         sched.on_pod_add(p)
+    # phase watermark: callers diff against this to attribute the TIMED
+    # drain (the config0_phases breakdown) without warm-up noise
+    sched._phases_mark = sched.phases.snapshot()
     ok, dt = _drain(sched)
     return ok, max(dt, 1e-9), sched
 
@@ -278,6 +290,13 @@ def bench_density_churn(n_nodes=5000, n_pods=10000, waves=10):
     sched = Scheduler()
     bound = {}
     sched.binding_sink = lambda pod, node: bound.__setitem__(pod.uid, (pod, node))
+
+    def sink_many(pairs):
+        for pod, node in pairs:
+            bound[pod.uid] = (pod, node)
+        return [None] * len(pairs)
+
+    sched.binding_sink_many = sink_many
     sched.mirror.e_cap_hint = n_pods + sched.config.batch_size + 128
     nodes = _basic_nodes(n_nodes)
     for n in nodes:
@@ -455,6 +474,18 @@ def main():
     n_pods = int(os.environ.get("BENCH_PODS", "10000"))
     full = os.environ.get("BENCH_FULL", "1") != "0"
 
+    # --profile-dir=DIR (or BENCH_PROFILE_DIR): every Scheduler the bench
+    # builds wraps its drains in jax.profiler.trace, one xplane artifact
+    # per drain — the device-dispatch analogue of scheduler_perf's
+    # -cpuprofile (VERDICT "Next round" #8 / SURVEY §5).
+    prof_dir = os.environ.get("BENCH_PROFILE_DIR")
+    for a in sys.argv[1:]:
+        if a.startswith("--profile-dir="):
+            prof_dir = a.split("=", 1)[1]
+    if prof_dir:
+        os.makedirs(prof_dir, exist_ok=True)
+        os.environ["KTPU_PROFILE_DIR"] = prof_dir
+
     ok1, dt1, s1 = bench_basic(n_nodes, n_pods)
     v1 = ok1 / dt1
     print(
@@ -503,10 +534,23 @@ def main():
         ok0, dt0, s0 = bench_north_star(n0_nodes, n0_pods)
         configs["config0_100k_10k_pods_per_s"] = round(ok0 / dt0, 1)
         configs["config0_100k_10k_drain_s"] = round(dt0, 2)
+        # per-phase attribution of the timed drain (queue_pop/pack/h2d/
+        # device/d2h/commit/bind) — the bottleneck as a fact, not a guess.
+        # bind sums WORKER time and so can exceed the wall clock.
+        from kubernetes_tpu.metrics import PhaseAccumulator
+
+        phases = PhaseAccumulator.diff(
+            s0.phases.snapshot(), getattr(s0, "_phases_mark", {})
+        )
+        configs["config0_phases"] = {
+            k: round(v, 3) for k, v in sorted(phases.items())
+        }
         print(
             f"# config0 north-star: {ok0} pods / {n0_nodes} nodes drained in "
             f"{dt0:.2f}s (target <1s; fast={s0.metrics['fast_batches']} "
-            f"scan={s0.metrics['scan_batches']})",
+            f"scan={s0.metrics['scan_batches']}; phases="
+            + ",".join(f"{k}={v:.2f}" for k, v in sorted(phases.items()))
+            + ")",
             file=sys.stderr,
         )
         km = run_scale_sim(n_nodes=5000, n_pods=5000, churn_waves=4)

@@ -354,7 +354,15 @@ class Pod:
         return out
 
     def pvc_names(self) -> List[str]:
-        return [v.pvc_name for v in self.volumes if v.pvc_name]
+        """Memoized (read-only, like compute_requests): the volume-plugin
+        relevance probes ask this once per host filter per pod on the
+        batch-extension hot path."""
+        cached = self.__dict__.get("_pvc_memo")
+        if cached is None:
+            cached = self.__dict__["_pvc_memo"] = [
+                v.pvc_name for v in self.volumes if v.pvc_name
+            ]
+        return cached
 
     @property
     def key(self) -> str:

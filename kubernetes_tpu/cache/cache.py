@@ -165,6 +165,48 @@ class Cache:
         self.pod_states[pod.uid] = _PodState(assumed)
         self.assumed.add(pod.uid)
 
+    def assume_pods_bulk(self, pairs) -> List[object]:
+        """assume_pod for one dispatch's worth of placements in one pass.
+
+        Same protocol and invariants as the per-pod assume, minus the
+        per-pod overhead: callers guarantee the pods are signature-gated
+        (no (anti-)affinity terms, no host ports — the fast path's
+        eligibility), so the feature-flag probes collapse, and the
+        generation bump aggregates to one per TOUCHED NODE instead of one
+        per pod (the mirror repacks per node row, so per-pod bumps carry
+        no extra information).  Returns a list aligned with ``pairs``:
+        the assumed pod copy, or an error STRING for pods that violated
+        the protocol (already assumed/added) — those are not assumed,
+        exactly like the per-pod path's CacheError."""
+        out: List[object] = []
+        pod_states = self.pod_states
+        nodes = self.nodes
+        assumed_set = self.assumed
+        touched: Dict[str, CachedNode] = {}
+        n_ok = 0
+        for pod, node_name in pairs:
+            if pod.uid in pod_states:
+                out.append(f"pod {pod.key} already assumed/added")
+                continue
+            assumed = object.__new__(type(pod))
+            assumed.__dict__.update(pod.__dict__)
+            assumed.node_name = node_name
+            cn = nodes.get(node_name)
+            if cn is None:
+                cn = nodes[node_name] = CachedNode(node=None)
+            cn.requested.add(assumed.compute_requests())
+            cn.non_zero_requested.add(assumed.non_zero_requests())
+            cn.pods[pod.uid] = assumed
+            touched[node_name] = cn
+            pod_states[pod.uid] = _PodState(assumed)
+            assumed_set.add(pod.uid)
+            out.append(assumed)
+            n_ok += 1
+        self.pod_version += n_ok
+        for cn in touched.values():
+            cn.generation = next_generation()
+        return out
+
     def finish_binding(self, pod: Pod, now: Optional[float] = None) -> None:
         ps = self.pod_states.get(pod.uid)
         if ps is None or pod.uid not in self.assumed:

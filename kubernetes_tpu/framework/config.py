@@ -164,11 +164,6 @@ class SchedulerConfiguration:
     #     keeps first-max-in-node-order.
     reference_sampling_compat: bool = False
     tie_break_seed: Optional[int] = None
-    # Wave-commit mode for the gang scan ("off" | "on").  Off by default:
-    # benchmarked slower than the classic scan at every wave length on one
-    # v5e chip (see Scheduler._build_wave_slots); the kernel remains for
-    # experimentation and is bit-parity-tested against the classic scan.
-    wave_commit: str = "off"
     # component-base/featuregate tier (pkg/features/kube_features.go) —
     # only the scheduler-relevant gates exist
     feature_gates: Dict[str, bool] = field(
@@ -191,8 +186,6 @@ class SchedulerConfiguration:
             raise ValueError("podMaxBackoffSeconds < podInitialBackoffSeconds")
         if not 0 <= self.percentage_of_nodes_to_score <= 100:
             raise ValueError("percentageOfNodesToScore must be in [0, 100]")
-        if self.wave_commit not in ("off", "on"):
-            raise ValueError('waveCommit must be "off" or "on"')
         if self.batch_size <= 0:
             raise ValueError("batchSize must be positive")
         for p in self.profiles:
@@ -448,10 +441,6 @@ def load_config(source) -> SchedulerConfiguration:
         batch_size=d.get("batchSize", 512),
         fast_batch_max=d.get("fastBatchMax", 4096),
         fast_device_min=d.get("fastDeviceMin", 1024),
-        # YAML 1.1 parses bare on/off as booleans — accept both spellings
-        wave_commit={True: "on", False: "off"}.get(
-            d.get("waveCommit", "off"), d.get("waveCommit", "off")
-        ),
         reference_sampling_compat=d.get("referenceSamplingCompat", False),
         tie_break_seed=d.get("tieBreakSeed"),
     )
@@ -505,7 +494,6 @@ def dump_config(cfg: SchedulerConfiguration) -> dict:
         "batchSize": cfg.batch_size,
         "fastBatchMax": cfg.fast_batch_max,
         "fastDeviceMin": cfg.fast_device_min,
-        "waveCommit": cfg.wave_commit,
         "referenceSamplingCompat": cfg.reference_sampling_compat,
         "tieBreakSeed": cfg.tie_break_seed,
         "featureGates": dict(cfg.feature_gates),

@@ -42,6 +42,9 @@ class DynamicResources(
     EnqueueExtensions,
 ):
     name = "DynamicResources"
+    # for claim-less/PVC-less (fast-gated) pods pre_filter is a spec-only
+    # Skip — safe for per-signature grouping
+    pre_filter_spec_pure = True
     _STATE_KEY = "DynamicResources"
 
     def maybe_relevant(self, pod: Pod) -> bool:

@@ -169,6 +169,8 @@ class NodeAffinity(
 ):
     name = "NodeAffinity"
     kernel = "NodeAffinity"
+    # spec-only pre_filter: safe for per-signature grouping on the fast path
+    pre_filter_spec_pure = True
 
     def pre_filter(self, state, pod) -> Status:
         aff = pod.affinity

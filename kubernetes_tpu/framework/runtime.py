@@ -364,6 +364,37 @@ class Framework:
                 )
         return Status.success()
 
+    def pre_filter_spec_pure(self) -> bool:
+        """True when every enabled PreFilter plugin's verdict for a
+        signature-gated (fast-path) pod is a pure function of the pod SPEC:
+        either the plugin never overrode the base no-op ``pre_filter``, or
+        it declares ``pre_filter_spec_pure = True`` (every in-tree override
+        does — for PVC-less/claim-less/term-less pods they all reduce to a
+        spec-only Skip).  Lets the fast path run PreFilter once per
+        signature instead of once per pod; custom plugins that keep mutable
+        cross-pod state (quota counters) simply don't declare the flag and
+        keep the per-pod walk."""
+        cached = self.__dict__.get("_pf_pure")
+        if cached is None:
+            cached = self.__dict__["_pf_pure"] = all(
+                type(p).pre_filter is PreFilterPlugin.pre_filter
+                or getattr(p, "pre_filter_spec_pure", False)
+                for p in self._by_point.get("preFilter", [])
+                if isinstance(p, PreFilterPlugin)
+            )
+        return cached
+
+    def has_post_bind(self) -> bool:
+        """True when any PostBind plugin is enabled — the bulk binding
+        tail skips the per-pod walk entirely otherwise."""
+        cached = self.__dict__.get("_has_post_bind")
+        if cached is None:
+            cached = self.__dict__["_has_post_bind"] = any(
+                isinstance(p, PostBindPlugin)
+                for p in self._by_point.get("postBind", [])
+            )
+        return cached
+
     def reserve_permit_covered_by_host_filters(self) -> bool:
         """True when every Reserve/Permit plugin is also a host Filter
         plugin (the volumebinding/DRA shape).  For a batch the fast gate

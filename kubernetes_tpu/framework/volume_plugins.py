@@ -55,6 +55,9 @@ class VolumeZone(PreFilterPlugin, FilterPlugin, EnqueueExtensions):
     """PV topology labels vs node topology labels."""
 
     name = "VolumeZone"
+    # for claim-less/PVC-less (fast-gated) pods pre_filter is a spec-only
+    # Skip — safe for per-signature grouping
+    pre_filter_spec_pure = True
     _STATE_KEY = "VolumeZone"
 
     def maybe_relevant(self, pod: Pod) -> bool:
@@ -136,6 +139,9 @@ class VolumeRestrictions(PreFilterPlugin, FilterPlugin, EnqueueExtensions):
     """Single-attach disk conflicts + ReadWriteOncePod exclusivity."""
 
     name = "VolumeRestrictions"
+    # for claim-less/PVC-less (fast-gated) pods pre_filter is a spec-only
+    # Skip — safe for per-signature grouping
+    pre_filter_spec_pure = True
     _STATE_KEY = "VolumeRestrictions"
 
     def maybe_relevant(self, pod: Pod) -> bool:
@@ -223,6 +229,9 @@ class NodeVolumeLimits(PreFilterPlugin, FilterPlugin, EnqueueExtensions):
     the node's CSINode advertises one under the migrated driver name."""
 
     name = "NodeVolumeLimits"
+    # for claim-less/PVC-less (fast-gated) pods pre_filter is a spec-only
+    # Skip — safe for per-signature grouping
+    pre_filter_spec_pure = True
 
     def maybe_relevant(self, pod: Pod) -> bool:
         return bool(pod.pvc_names()) or any(

@@ -303,6 +303,9 @@ class VolumeBinding(
     """volume_binding.go — the plugin shim over VolumeBinder."""
 
     name = "VolumeBinding"
+    # for claim-less/PVC-less (fast-gated) pods pre_filter is a spec-only
+    # Skip — safe for per-signature grouping
+    pre_filter_spec_pure = True
 
     _STATE_KEY = "VolumeBinding"
 

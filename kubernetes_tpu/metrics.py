@@ -235,6 +235,85 @@ class MetricAsyncRecorder:
 
 
 # ---------------------------------------------------------------------------
+# per-phase attribution (the scheduler_perf collector's per-op breakdown:
+# test/integration/scheduler_perf reports steady-state throughput WITH the
+# time attributed to each phase of the hot loop, so a regression names its
+# phase instead of hiding in a total)
+# ---------------------------------------------------------------------------
+
+# The canonical hot-loop phases of one batched scheduling cycle.  Async
+# dispatch makes two of them subtle: ``device`` is the host-side submit of
+# the jitted kernel (the XLA work itself overlaps later host phases), and
+# ``d2h`` is the time the harvest BLOCKS waiting for results — i.e. the
+# device+copy latency that host work failed to hide.  ``bind`` accumulates
+# worker-thread time, so it can exceed the drain's wall clock.
+PHASES = (
+    "queue_pop",  # activeQ pop + batch-extension predicate
+    "pack",  # signature keys, PreFilter/PreScore, row packing, mirror sync
+    "h2d",  # host→device uploads (committer state, ids, stacked sigs)
+    "device",  # jitted dispatch submit (async: XLA overlaps host work)
+    "d2h",  # blocked time fetching results the async copy hadn't landed
+    "commit",  # assume/reserve/permit walk + committer replay
+    "bind",  # binding-cycle worker time (sink + post-bind bookkeeping)
+)
+
+
+class PhaseAccumulator:
+    """Cumulative per-phase wall seconds + per-observation histogram feed.
+
+    ``add`` is called from the scheduling loop AND binding workers, so it
+    takes a lock; the frequency is per batch / per bind chunk (not per
+    pod), which keeps the overhead unmeasurable next to the phases
+    themselves.  ``snapshot`` returns a plain dict — bench.py diffs two
+    snapshots around the timed drain to report ``config0_phases``.
+    """
+
+    def __init__(self, hist: Optional[Histogram] = None):
+        self._mu = threading.Lock()
+        self._totals: Dict[str, float] = {}
+        self.hist = hist
+
+    def add(self, phase: str, dt: float) -> None:
+        with self._mu:
+            self._totals[phase] = self._totals.get(phase, 0.0) + dt
+            if self.hist is not None:
+                self.hist.observe(dt, phase=phase)
+
+    def timer(self, phase: str):
+        """Context manager: accumulate the block's wall time."""
+        return _PhaseTimer(self, phase)
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._mu:
+            return dict(self._totals)
+
+    @staticmethod
+    def diff(after: Dict[str, float], before: Dict[str, float]) -> Dict[str, float]:
+        out = {}
+        for k, v in after.items():
+            d = v - before.get(k, 0.0)
+            if d > 0.0:
+                out[k] = d
+        return out
+
+
+class _PhaseTimer:
+    __slots__ = ("acc", "phase", "_t0")
+
+    def __init__(self, acc: PhaseAccumulator, phase: str):
+        self.acc = acc
+        self.phase = phase
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.acc.add(self.phase, time.perf_counter() - self._t0)
+        return False
+
+
+# ---------------------------------------------------------------------------
 # the scheduler's series (metrics.go:86-260)
 # ---------------------------------------------------------------------------
 
@@ -398,6 +477,14 @@ class SchedulerMetrics:
                 "scheduler_tpu_snapshot_pack_duration_seconds",
                 "Host time packing the incremental snapshot mirror.",
                 (),
+            )
+        )
+        self.phase_duration = r.register(
+            Histogram(
+                "scheduler_tpu_phase_duration_seconds",
+                "Per-batch hot-loop time by phase "
+                "(queue_pop/pack/h2d/device/d2h/commit/bind).",
+                ("phase",),
             )
         )
         self.recorder = MetricAsyncRecorder()

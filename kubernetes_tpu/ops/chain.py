@@ -108,7 +108,6 @@ def chain_dispatch(
     d_cap: int = 8,
     append_terms: bool = True,
     fit_strategy: tuple = gang.DEFAULT_FIT_STRATEGY,
-    wave_slots=None,
 ):
     """One fused dispatch: gang schedule the batch, then append its
     committed pods into the (donated) cluster at the given cursors.
@@ -145,7 +144,6 @@ def chain_dispatch(
         nom_req=nom_req,
         d_cap=d_cap,
         fit_strategy=fit_strategy,
-        wave_slots=wave_slots,
     )
     P = db.valid.shape[0]
     committed = (chosen >= 0) & db.valid

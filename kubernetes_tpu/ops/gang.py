@@ -529,7 +529,6 @@ def gang_schedule(
     sample_start=None,
     tie_key=None,
     attempt_base=None,
-    wave_slots=None,
 ):
     """Scan the batch in order; each pod sees all prior in-batch placements.
 
@@ -554,22 +553,6 @@ def gang_schedule(
     (RunFilterPluginsWithNominatedPods, runtime/framework.go:973: nominated
     pods with priority >= the evaluated pod count as present).
 
-    wave_slots (optional i32 [W, S], -1 pads) activates WAVE COMMIT
-    (SURVEY §7 "intra-batch conflicts"): each row lists consecutive batch
-    pods whose spread/inter-pod/port constraint domains provably cannot
-    interact (the host builder's conservative spec check).  The heavy
-    state-dependent tensors (the [C,N,J]/[AT,N,J] contractions against
-    already-placed peers) are then refreshed ONCE per wave — vectorized
-    over the wave's pods via vmap of the same `heavy_parts` the per-pod
-    scan uses — while the cheap state-dependent pieces (resource fit,
-    scores, normalization over the live feasible set, argmax, commit)
-    still run strictly in batch order in an inner scan.  Decisions are
-    sequential-identical by construction: within a wave the frozen
-    tensors equal what a per-pod recompute would produce (no peer in the
-    wave can change them), and everything that CAN change mid-wave is
-    recomputed per pod.  Requires sample_k/tie_key None and no port
-    conflicts inside a wave (the builder guarantees it).
-
     Returns (chosen [P] i32 node index or -1, n_feasible [P] i32).
     """
     P, N = g.static_mask.shape
@@ -578,9 +561,6 @@ def gang_schedule(
     C = g.sp_dv.shape[1]
     AT = g.ip_dv.shape[1]
     Kd2 = g.ip_key_cols.shape[0]
-    if wave_slots is not None and (sample_k is not None or tie_key is not None):
-        raise ValueError("wave mode is incompatible with sampling/tie-break")
-
     # Nominated-pod node charge matrix, built once outside the scan: per-step
     # work is a tiny [G]·[G,N] contraction instead of a segment scatter.
     if nom_node is not None:
@@ -613,8 +593,7 @@ def gang_schedule(
     def heavy_parts(p, assigned_valid, eqJ):
         """State-dependent tensors whose value cannot change while no
         INTERACTING peer commits: spread/inter-pod masks, count rows, and
-        port conflicts.  The per-pod scan calls this every step; the wave
-        path calls it once per wave (vmapped over the wave's pods)."""
+        port conflicts.  The per-pod scan calls this every step."""
         av = assigned_valid[None, :]
         m_portb = true_n
         if g.port_b.shape[1]:
@@ -656,7 +635,7 @@ def gang_schedule(
                 ~g.sp_dom_pres[p] | (skew <= db.tsc_max_skew[p][:, None])
             )
             m_spread = jnp.all(~g.sp_hard[p][:, None] | c_ok, axis=0)
-            # score-side counts (wave-frozen too): _spread_cnt
+            # score-side counts: _spread_cnt
             dyn_host = jnp.einsum("cj,jn->cn", bm.astype(I32), eqJ_i)
             cg_at = (
                 jnp.einsum(
@@ -917,12 +896,12 @@ def gang_schedule(
 
         # InterPodAffinity: static symmetric + incoming preferred (with batch
         # contributions) + symmetric from batch-assigned pods' terms —
-        # wave-frozen in hv (see heavy_parts).
+        # carried in hv (see heavy_parts).
         ip_raw = hv["ip_raw"]
 
-        # PodTopologySpread score: the count rows are wave-frozen; the
-        # log-weight normalization depends on the LIVE feasible set, so it
-        # runs here per pod.
+        # PodTopologySpread score: the count rows come from heavy_parts;
+        # the log-weight normalization depends on the LIVE feasible set,
+        # so it runs here per pod.
         if C:
             sp_raw, sp_valid = _spread_raw(
                 dc, db, g, p, feas, hv["sp_cnt"], d_cap
@@ -985,7 +964,7 @@ def gang_schedule(
             nonzero=state["nonzero"]
             + onehot_n[:, None].astype(I32) * db.nonzero_req[p][None, :],
             num_pods=state["num_pods"] + onehot_n.astype(I32),
-            # inactive (wave-pad) slots must not clobber row p's assignment
+            # inactive (pad) slots must not clobber row p's assignment
             assigned=state["assigned"]
             .at[p]
             .set(jnp.where(active, choice, state["assigned"][p])),
@@ -1002,52 +981,9 @@ def gang_schedule(
             ).astype(I32)
         return new_state, (choice, n_feas, reason_counts)
 
-    if wave_slots is None:
-        state, (chosen, n_feas, reason_counts) = jax.lax.scan(
-            step, init, jnp.arange(P, dtype=I32)
-        )
-    else:
-        W, S = wave_slots.shape
-
-        def wave_step(state, slots):
-            # one heavy refresh for the whole wave, vectorized over slots
-            assigned_valid, eqJ = peer_view(state["assigned"])
-            pc = jnp.clip(slots, 0, P - 1)
-            hv_w = jax.vmap(
-                lambda p: heavy_parts(p, assigned_valid, eqJ)
-            )(pc)
-
-            def slot_step(st, s):
-                p = pc[s]
-                hv = jax.tree_util.tree_map(lambda a: a[s], hv_w)
-                active = (slots[s] >= 0) & db.valid[p]
-                return cheap_body(st, p, hv, active)
-
-            st, outs = jax.lax.scan(
-                slot_step, state, jnp.arange(S, dtype=I32)
-            )
-            return st, outs
-
-        state, (ch_w, nf_w, rc_w) = jax.lax.scan(wave_step, init, wave_slots)
-        # scatter [W, S] slot outputs back to batch order; pads → dump row
-        flat = wave_slots.reshape(-1)
-        idx = jnp.where(flat >= 0, flat, P)
-        chosen = (
-            jnp.full((P + 1,), ABSENT, I32)
-            .at[idx]
-            .set(ch_w.reshape(-1).astype(I32))[:P]
-        )
-        n_feas = (
-            jnp.zeros((P + 1,), I32)
-            .at[idx]
-            .set(nf_w.reshape(-1).astype(I32))[:P]
-        )
-        n_diag = rc_w.shape[-1]
-        reason_counts = (
-            jnp.zeros((P + 1, n_diag), I32)
-            .at[idx]
-            .set(rc_w.reshape(-1, n_diag).astype(I32))[:P]
-        )
+    state, (chosen, n_feas, reason_counts) = jax.lax.scan(
+        step, init, jnp.arange(P, dtype=I32)
+    )
     # Final node tallies let the caller chain batches without a host round
     # trip: feed them back as the next DeviceCluster's requested/nonzero/
     # num_pods (the across-batch analogue of the assume cache).
@@ -1102,7 +1038,6 @@ def gang_run(
     sample_start=None,
     tie_key=None,
     attempt_base=None,
-    wave_slots=None,
 ):
     """Fused precompute + scan: ONE device dispatch per batch."""
     g = precompute(
@@ -1138,7 +1073,6 @@ def gang_run(
         sample_start=sample_start,
         tie_key=tie_key,
         attempt_base=attempt_base,
-        wave_slots=wave_slots,
     )
 
 

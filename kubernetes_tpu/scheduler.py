@@ -51,6 +51,10 @@ logger = logging.getLogger(__name__)
 
 _MISSING = object()  # dict-miss sentinel (cached signature keys can be None)
 
+# One immutable success Status shared by bulk commits: success statuses are
+# never mutated anywhere (failure paths REPLACE outcome.status wholesale).
+STATUS_SUCCESS = Status.success()
+
 
 @dataclass
 class _BindTask:
@@ -67,6 +71,19 @@ class _BindTask:
 
     def lean_eligible(self) -> bool:
         return self.lean and not self.waited and self.binder_override is None
+
+
+@dataclass
+class _BulkBindTask:
+    """A contiguous run of LEAN fast-path binding cycles: one worker
+    submit, one sink write (bulk when the API tier installed one), one
+    lock acquisition for the whole post-bind bookkeeping tail.  Built only
+    by _commit_fast_bulk, whose gate proved every per-pod extension-point
+    walk a no-op for these pods."""
+
+    fwk: object
+    state: object
+    items: list  # [(qp, node_name, outcome)]
 
 
 @dataclass
@@ -212,6 +229,7 @@ class Scheduler:
         clock=time.monotonic,
         extenders=None,
         event_broadcaster=None,
+        profile_dir: Optional[str] = None,
     ):
         self.config = configuration or cfg.SchedulerConfiguration()
         self.config.validate()
@@ -249,6 +267,7 @@ class Scheduler:
         self._bind_pool: Optional[ThreadPoolExecutor] = None
         self._inflight_binds: List = []
         self._bind_buffer: List = []
+        self._bulk_bind_buffer: List = []  # _BulkBindTask runs (fast path)
         # chained-dispatch state (see _try_dispatch_chained)
         self._chain = None
 
@@ -349,9 +368,20 @@ class Scheduler:
             clock=clock,
             key_fn=key_fn,
         )
-        from kubernetes_tpu.metrics import SchedulerMetrics
+        from kubernetes_tpu.metrics import PhaseAccumulator, SchedulerMetrics
 
         self.prom = SchedulerMetrics()
+        # Per-phase hot-loop attribution (queue_pop/pack/h2d/device/d2h/
+        # commit/bind) — the scheduler_perf-style breakdown bench.py emits
+        # as config0_phases.  Feeds the phase_duration histogram too.
+        self.phases = PhaseAccumulator(hist=self.prom.phase_duration)
+        # jax.profiler trace hook (SURVEY §5; the --profiling/pprof analog,
+        # apis/config/types.go:60): when set, schedule_pending wraps each
+        # drain in jax.profiler.trace(profile_dir).
+        import os as _os
+
+        self.profile_dir = profile_dir or _os.environ.get("KTPU_PROFILE_DIR")
+        self._profiling = False  # reentrancy guard (nested drains)
         self.queue.incoming_counter = self.prom.queue_incoming_pods
         self._dirty_pending = False
         self._oracle_cache: Optional[OracleState] = None
@@ -654,7 +684,26 @@ class Scheduler:
     # ----- the scheduling loop ---------------------------------------------
 
     def schedule_pending(self, max_batches: Optional[int] = None) -> List[ScheduleOutcome]:
-        """Drain the active queue in gang batches; returns all outcomes."""
+        """Drain the active queue in gang batches; returns all outcomes.
+
+        With ``profile_dir`` set (ctor arg or KTPU_PROFILE_DIR), the whole
+        drain runs under ``jax.profiler.trace`` — one xplane artifact per
+        drain, the device-dispatch answer to scheduler_perf's -cpuprofile.
+        """
+        if self.profile_dir and not self._profiling:
+            import jax.profiler as _jprof
+
+            self._profiling = True
+            try:
+                with _jprof.trace(self.profile_dir):
+                    return self._schedule_pending_impl(max_batches)
+            finally:
+                self._profiling = False
+        return self._schedule_pending_impl(max_batches)
+
+    def _schedule_pending_impl(
+        self, max_batches: Optional[int] = None
+    ) -> List[ScheduleOutcome]:
         outcomes: List[ScheduleOutcome] = []
         batches = 0
         # Pre-size the placed-pod tensor axes for the whole drain: every
@@ -681,8 +730,10 @@ class Scheduler:
                     outcomes.extend(self._finish_chained(rec))
 
         while True:
+            t_pop = time.perf_counter()
             with self._mu:
                 batch = self.queue.pop_batch(self.config.batch_size)
+            self.phases.add("queue_pop", time.perf_counter() - t_pop)
             if not batch:
                 break
             # Segregate by profile (schedule_one.go:376-382): each group
@@ -712,9 +763,13 @@ class Scheduler:
                     # play a commit can realistically fail (and forget), so
                     # harvest eagerly — one batch in flight — to keep the
                     # optimism window close to the reference's (a forget is
-                    # visible to the very next scheduling cycle).
+                    # visible to the very next scheduling cycle).  When every
+                    # Reserve/Permit plugin is also a host Filter the gate
+                    # already proved irrelevant (the default volumebinding/
+                    # DRA shape), their walks are no-ops for these batches —
+                    # keep the full two-deep double buffer.
                     pending.append(rec)
-                    flush(1 if fwk.has_reserve_or_permit() else 2)
+                    flush(1 if self._rp_can_fail(fwk) else 2)
                     continue
                 if rec == "handled":
                     continue
@@ -738,7 +793,7 @@ class Scheduler:
                     )
                 if isinstance(frec, dict):
                     pending.append(frec)
-                    flush(1 if fwk.has_reserve_or_permit() else 2)
+                    flush(1 if self._rp_can_fail(fwk) else 2)
                     continue
                 if frec == "handled":
                     continue
@@ -766,6 +821,17 @@ class Scheduler:
         # exactly like the reference's retry flow.
         self.wait_for_bindings()
         return outcomes
+
+    def _rp_can_fail(self, fwk) -> bool:
+        """True when a Reserve/Permit plugin could actually reject a
+        pipelined batch's pod — the case that caps the pipeline at one
+        batch in flight.  Plugins covered by the host-filter gate are
+        no-ops for gated batches (reserve_permit_covered_by_host_filters),
+        so the default registry double-buffers at full depth."""
+        return (
+            fwk.has_reserve_or_permit()
+            and not fwk.reserve_permit_covered_by_host_filters()
+        )
 
     def _record_batch_metrics(self, profile, group, outs, dt: float) -> None:
         """Attempt counters + latency histograms (metrics.go:86-147).  The
@@ -973,6 +1039,7 @@ class Scheduler:
             self.prom.recorder.observe(
                 self.prom.snapshot_pack_duration, time.perf_counter() - t_pack
             )
+            self.phases.add("pack", time.perf_counter() - t_pack)
             trace.step("Snapshot mirror updated")
 
             self._p_cap_max = max(self._p_cap_max, bucket_cap(len(pods), 1))
@@ -992,6 +1059,7 @@ class Scheduler:
                 time.perf_counter() - t_sync,
                 phase="device_sync",
             )
+            self.phases.add("h2d", time.perf_counter() - t_sync)
             v_cap = bucket_cap(len(vocab.label_vals))
             hostname_key = self._hostname_dev(vocab)
             tables = self._gang_tables(pb, vocab)
@@ -1038,14 +1106,6 @@ class Scheduler:
             if sample_k is not None
             else None
         )
-        wave_slots = None
-        if sample_k is None and tie_key is None:
-            ws = self._build_wave_slots(pods)
-            if ws is not None:
-                wave_slots = jnp.asarray(ws)
-                self.metrics["wave_batches"] = (
-                    self.metrics.get("wave_batches", 0) + 1
-                )
         t_gang = time.perf_counter()
         chosen, n_feas, reason_counts, tallies = gang.gang_run(
             dc,
@@ -1068,10 +1128,12 @@ class Scheduler:
             sample_start=sample_start,
             tie_key=tie_key,
             attempt_base=attempt_base,
-            wave_slots=wave_slots,
             **tables,
         )
+        t_d2h = time.perf_counter()
+        self.phases.add("device", t_d2h - t_gang)
         both = jax.device_get(jnp.stack([chosen, n_feas]))
+        self.phases.add("d2h", time.perf_counter() - t_d2h)
         chosen, n_feas = both[0], both[1]
         if sample_k is not None:
             self._next_start_node_index = int(
@@ -1119,6 +1181,7 @@ class Scheduler:
         """The per-pod result walk shared by the direct and chained paths:
         failures → diagnosis + PostFilter, successes → _commit (which hands
         binding to the async workers)."""
+        t_commit = time.perf_counter()
         node_names = self.mirror.nodes.names
         n_nodes = len(self.cache.real_nodes())
         counts = None  # fetched lazily — only failures read it
@@ -1162,6 +1225,7 @@ class Scheduler:
             node_name = node_names[idx]
             outcome = self._commit(fwk, state, qp, node_name, int(n_feas[i]))
             outcomes.append(outcome)
+        self.phases.add("commit", time.perf_counter() - t_commit)
 
     # ----- the chained (pipelined) dispatch path ---------------------------
     #
@@ -1230,7 +1294,24 @@ class Scheduler:
         """mirror.update + key-width guard: one forced full repack when the
         label-key bucket grew past the packed node-tensor width.  The single
         definition shared by the scan path, the fast-path sync, and the
-        chained-dispatch prep."""
+        chained-dispatch prep.  When a live fast committer proves every
+        pending usage delta is its own (same lineage epoch, nothing
+        unharvested), its state flushes into the mirror in one vectorized
+        pass first, so update()'s per-dirty-node walk sees clean rows."""
+        holder = getattr(self, "_fastdev", None)
+        if (
+            holder is not None
+            and not holder["dev_inflight"]
+            and getattr(self, "_fc_key", None) is not None
+            and self._fc_key[:3]
+            == (
+                self._external_mutations,
+                getattr(self, "_nonfast_commits", 0),
+                self.mirror._full_packs,
+            )
+            and self.mirror.nodes is holder["nt"]
+        ):
+            self.mirror.apply_fast_usage(holder["fc"], self.cache)
         self.mirror.update(self.cache, self.namespace_labels)
         if bucket_cap(len(self.mirror.vocab.label_keys)) > self.mirror.nodes.k_cap:
             self.mirror._force_full = True
@@ -1263,7 +1344,7 @@ class Scheduler:
             if n_t > 64:
                 # probe checks would cost more than the scan saves
                 return False
-            from kubernetes_tpu.waves import _pod_probes
+            from kubernetes_tpu.fastpath import _pod_probes
 
             key = self.cache.term_version
             cached = getattr(self, "_term_probe_cache", None)
@@ -1320,19 +1401,22 @@ class Scheduler:
         params = (n_lanes, len(vocab.resources))
         lanes_box: list = [None]
 
+        # the default registry leaves every gate list empty — guard each
+        # any() so the hot steady-state predicate is just the signature
+        # memo lookup (pop_batch_while runs this once per extended pod)
         def elig(qp) -> bool:
             p = qp.pod
             if p.scheduler_name != group_name or p.nominated_node_name:
                 return False
             if max_nom is not None and p.priority <= max_nom:
                 return False
-            if any(pl.maybe_relevant(p) for pl in hf):
+            if hf and any(pl.maybe_relevant(p) for pl in hf):
                 return False
-            if any(e.is_interested(p) for e in extenders):
+            if extenders and any(e.is_interested(p) for e in extenders):
                 return False
-            if any(pl.score_relevant(p) for pl in ns_plugins):
+            if ns_plugins and any(pl.score_relevant(p) for pl in ns_plugins):
                 return False
-            if any(pl.score_relevant(p) for pl in host_scores):
+            if host_scores and any(pl.score_relevant(p) for pl in host_scores):
                 return False
             if probes:
                 gk = (p.namespace, tuple(sorted(p.labels.items())))
@@ -1342,7 +1426,11 @@ class Scheduler:
                     group_hit[gk] = hit
                 if hit:
                     return False
-            k = self._pod_sig_key(p, params, lanes_box)
+            memo = p.__dict__.get("_sigkey_memo")
+            if memo is not None and memo[0] == params:
+                k = memo[1]
+            else:
+                k = self._pod_sig_key(p, params, lanes_box)
             if k is None:
                 return False
             if known_rows is not None:
@@ -1368,57 +1456,6 @@ class Scheduler:
             self.prom.recorder.observe(
                 self.prom.snapshot_pack_duration, time.perf_counter() - t0
             )
-
-    def _build_wave_slots(self, pods):
-        """np [W, S] wave matrix for the gang scan's wave-commit mode, or
-        None when wave commit should not engage.  See kubernetes_tpu.waves.
-
-        Wave commit is OFF unless ``config.wave_commit == "on"``: measured
-        on one v5e chip it LOSES to the classic per-pod scan at every wave
-        length tried — 50-pod waves (anti-affinity, 50 groups) ran 968 vs
-        2263 pods/s and even 512-pod whole-batch waves (1000 groups) ran
-        107 vs 2346 pods/s — because the vmapped per-wave heavy refresh
-        does the same total contraction work as the serial scan but with
-        [S, N]-sized intermediates, and its data-dependent (W, S) shapes
-        recompile mid-drain (~28 s each).  The kernel stays available (and
-        bit-parity-tested, tests/test_waves.py) as the substrate for true
-        multi-pod-per-step commit experiments."""
-        if getattr(self.config, "wave_commit", "off") != "on":
-            return None
-        if len(pods) < 16:
-            return None
-        import numpy as np
-
-        from kubernetes_tpu.waves import WaveBuilder
-        builder = getattr(self, "_wave_builder", None)
-        if builder is None:
-            builder = self._wave_builder = WaveBuilder()
-        runs = builder.build(pods)
-        if len(runs) * 4 > len(pods):
-            return None
-        # Sticky (W, S): every distinct wave-matrix shape is a fresh XLA
-        # compile of the whole pipeline (~25s) — partial final batches and
-        # drifting run lengths must reuse the steady-state shape.  Extra
-        # all-pad rows/slots are masked inner iterations, far cheaper than
-        # a recompile.
-        S = bucket_cap(max(1, -(-len(pods) // len(runs))), 4)
-        S = self._wave_S = max(getattr(self, "_wave_S", 4), S)
-        rows = []
-        for r in runs:
-            for i in range(0, len(r), S):
-                rows.append(r[i : i + S])
-        W = bucket_cap(len(rows), 1)
-        W = self._wave_W = max(getattr(self, "_wave_W", 1), W)
-        # Joint cap: independently-sticky W and S can multiply (one batch
-        # of short runs pins W high, a later long-run batch pins S high);
-        # a W·S area far above the batch would make every wave dispatch
-        # scan mostly pad slots — fall back to the classic scan instead.
-        if W * S > 4 * bucket_cap(len(pods), 1):
-            return None
-        slots = np.full((W, S), -1, np.int32)
-        for w, row in enumerate(rows):
-            slots[w, : len(row)] = row
-        return slots
 
     def _pod_sig_key(self, pod, params, lanes_box):
         """signature_key for one pod, memoized twice over: ON the pod object
@@ -1461,11 +1498,18 @@ class Scheduler:
         params = (n_lanes, len(vocab.resources))
         lanes_box: list = [None]
         keys = []
+        append = keys.append
         for qp in batch:
-            k = self._pod_sig_key(qp.pod, params, lanes_box)
+            # inline the per-pod memo hit (the steady-state case: every pod
+            # was keyed once by the extension predicate already)
+            memo = qp.pod.__dict__.get("_sigkey_memo")
+            if memo is not None and memo[0] == params:
+                k = memo[1]
+            else:
+                k = self._pod_sig_key(qp.pod, params, lanes_box)
             if k is None:
                 return None
-            keys.append(k)
+            append(k)
         return keys
 
     def _try_dispatch_chained(self, fwk, batch, outcomes, can_restart: bool):
@@ -1620,13 +1664,6 @@ class Scheduler:
                 fwk.score_weights.get(n, 0) for n in gang.WEIGHT_ORDER
             )
             fit_strategy = fwk.fit_strategy()
-            wave_slots = None
-            ws = self._build_wave_slots(pods)
-            if ws is not None:
-                wave_slots = jnp.asarray(ws)
-                self.metrics["wave_batches"] = (
-                    self.metrics.get("wave_batches", 0) + 1
-                )
             t0 = time.perf_counter()
             dc2, results, reasons = chain_ops.chain_dispatch(
                 ch["dc"],
@@ -1646,7 +1683,6 @@ class Scheduler:
                 nom_req=nom_req,
                 append_terms=append_terms,
                 fit_strategy=fit_strategy,
-                wave_slots=wave_slots,
                 **tables,
             )
             self._chain = {
@@ -1678,7 +1714,9 @@ class Scheduler:
         """Harvest one pipelined batch: fetch its results and walk the
         commits (the host half that overlapped later dispatches)."""
         outcomes: List[ScheduleOutcome] = []
+        t_d2h = time.perf_counter()
         both = jax.device_get(rec["results"])
+        self.phases.add("d2h", time.perf_counter() - t_d2h)
         self.prom.recorder.observe(
             self.prom.gang_dispatch_duration,
             time.perf_counter() - rec["t0"],
@@ -1948,7 +1986,11 @@ class Scheduler:
                 # device-batch replays changed scores under the lazy heaps
                 holder["fc"].invalidate_heaps()
                 holder["heaps_dirty"] = False
+            # the host greedy IS the selection step here — attribute it to
+            # the device phase it replaces
+            t_dev = time.perf_counter()
             choices = holder["fc"].run(pod_sigs)
+            self.phases.add("device", time.perf_counter() - t_dev)
             holder["dev"] = None  # device copy (if any) is now stale
             self.metrics["fast_batches"] += 1
             return {
@@ -1972,6 +2014,7 @@ class Scheduler:
         # signature ids with the node-usage state resident in HBM
         # (ops/fastpath.sig_scan) — one dispatch per batch, no [P, N]
         # tensors, bit-identical to the host FastCommitter
+        t_h2d = time.perf_counter()
         if holder["stack"] is None:
             holder["stack"] = self._stack_signatures(holder)
         st = holder["stack"]
@@ -2009,6 +2052,8 @@ class Scheduler:
                     jnp.asarray(np.asarray(fc.num_pods, np.int32)),
                 )
             used, nz0, nz1, num_pods = holder["dev"]
+            t_dev = time.perf_counter()
+            self.phases.add("h2d", t_dev - t_h2d)
             choices_dev, holder["dev"] = ops_fp.sig_scan(
                 jnp.asarray(ids),
                 st["req"],
@@ -2032,6 +2077,7 @@ class Scheduler:
             # latency-hiding discipline as the chained gang pipeline)
             choices_dev.copy_to_host_async()
             holder["dev_inflight"] += 1
+            self.phases.add("device", time.perf_counter() - t_dev)
         except Exception:
             # the donated state buffers may be gone — drop the holder so the
             # next fast batch rebuilds from the mirror, and let the caller
@@ -2079,7 +2125,9 @@ class Scheduler:
         outcomes: List[ScheduleOutcome] = []
         choices = rec["choices_host"]
         if choices is None:
+            t_d2h = time.perf_counter()
             choices = jax.device_get(rec["choices_dev"])[: len(batch)].tolist()
+            self.phases.add("d2h", time.perf_counter() - t_d2h)
             holder["dev_inflight"] -= 1
             # advance the host committer to the post-batch state by
             # replaying the kernel's commits (pure host arithmetic — the
@@ -2132,30 +2180,43 @@ class Scheduler:
             and not fwk.reserve_permit_covered_by_host_filters()
         )
         lean = fwk.lean_bind_ok()
+        # the bulk pass needs neither reserve/permit walks nor per-pod bind
+        # plugin dispatch — exactly the lean fast-batch conditions
+        bulk_ok = lean and not has_rp
         keys = rec["keys"]
         n = len(batch)
         self.metrics["schedule_attempts"] += n
+        t_commit = time.perf_counter()
         i = 0
         while i < n:
             if choices[i] >= 0:
                 # commit the whole contiguous run of scheduled pods under
                 # ONE lock acquisition (in order — runs preserve the
                 # sequential-equivalent commit sequence)
-                with self._mu:
-                    while i < n and choices[i] >= 0:
-                        outcomes.append(
-                            self._commit_under_lock(
-                                fwk,
-                                state,
-                                batch[i],
-                                node_names[choices[i]],
-                                -1,
-                                None,
-                                has_rp,
-                                lean,
+                j = i
+                while j < n and choices[j] >= 0:
+                    j += 1
+                if bulk_ok:
+                    self._commit_fast_bulk(
+                        fwk, state, batch, choices, i, j, node_names,
+                        outcomes, pod_sigs,
+                    )
+                else:
+                    with self._mu:
+                        for k_ in range(i, j):
+                            outcomes.append(
+                                self._commit_under_lock(
+                                    fwk,
+                                    state,
+                                    batch[k_],
+                                    node_names[choices[k_]],
+                                    -1,
+                                    None,
+                                    has_rp,
+                                    lean,
+                                )
                             )
-                        )
-                        i += 1
+                i = j
                 continue
             qp, sig, k = batch[i], pod_sigs[i], keys[i]
             i += 1
@@ -2172,6 +2233,7 @@ class Scheduler:
                     fwk, state, qp, status, 0, diag, set(diag)
                 )
             )
+        self.phases.add("commit", time.perf_counter() - t_commit)
         if rec["record_metrics"]:
             self._record_batch_metrics(
                 fwk.profile_name,
@@ -2250,6 +2312,7 @@ class Scheduler:
             ):
                 return None
 
+        t_pack = time.perf_counter()
         with self._mu:
             vocab = self.mirror.vocab
             for qp in batch:
@@ -2265,6 +2328,7 @@ class Scheduler:
         # while the seed group is the only thing popped — extension pods
         # would be lost to the direct-path fallback otherwise.
         rows = self._fast_sig_rows(fwk, batch, keys, enabled, weights)
+        self.phases.add("pack", time.perf_counter() - t_pack)
         if rows is None:
             return None
 
@@ -2279,8 +2343,10 @@ class Scheduler:
             elig = self._fast_pod_predicate(
                 fwk, batch[0].pod.scheduler_name, known_rows=rows
             )
+            t_pop = time.perf_counter()
             with self._mu:
                 extra = self.queue.pop_batch_while(ext, elig)
+            self.phases.add("queue_pop", time.perf_counter() - t_pop)
             if extra:
                 with self._mu:
                     for qp in extra:
@@ -2292,13 +2358,14 @@ class Scheduler:
 
         state = CycleState()
         pods_all = [qp.pod for qp in batch]
+        t_pack = time.perf_counter()
         # ---- point of commitment: PreFilter mutates outcomes/queue state,
         # so every bail-out above happened first (the direct path must not
         # replay it, and extension pods are already part of this batch);
         # after this, the rare dispatch failure error-requeues the batch
         with self._mu:
             fwk.run_pre_score(state, pods_all, self.mirror.nodes.names)
-            pf_failures = fwk.run_pre_filter(state, pods_all)
+            pf_failures = self._run_pre_filter_fast(fwk, state, batch, keys)
             if pf_failures:
                 live = []
                 for qp in batch:
@@ -2312,8 +2379,10 @@ class Scheduler:
                     )
                 batch = live
                 if not batch:
+                    self.phases.add("pack", time.perf_counter() - t_pack)
                     return "handled"
                 keys = self._batch_signature_keys(batch)
+        self.phases.add("pack", time.perf_counter() - t_pack)
         # fast commits happen outside the chain's device state — drop it
         # (it restarts from the repacked mirror once the pipeline settles)
         self._chain = None
@@ -2331,6 +2400,50 @@ class Scheduler:
         rec["record_metrics"] = True
         return rec
 
+
+    def _run_pre_filter_fast(self, fwk, state, batch, keys):
+        """RunPreFilterPlugins for a signature-gated batch, ONE walk per
+        distinct signature instead of per pod.
+
+        Pods of one signature share the spec fields every in-tree
+        PreFilter reads (pre_filter_spec_pure), and the cluster state a
+        fast lineage runs against is frozen between external mutations /
+        non-fast commits — both are part of the memo key, so a cached
+        verdict can never outlive the state it judged.  Signatures whose
+        representative FAILED re-run the real per-pod walk (per-pod Status
+        objects + CycleState writes feed the PostFilter/preemption path);
+        the hot case — every signature passes — costs one dict hit per pod.
+        Falls back to the reference-shaped per-pod walk whenever any
+        enabled PreFilter plugin doesn't declare spec purity."""
+        if not fwk.pre_filter_spec_pure():
+            return fwk.run_pre_filter(state, [qp.pod for qp in batch])
+        mkey = (
+            self._external_mutations,
+            getattr(self, "_nonfast_commits", 0),
+            self.mirror._full_packs,
+            fwk.profile_name,
+        )
+        memo = getattr(self, "_pf_memo", None)
+        if memo is None or memo[0] != mkey:
+            memo = self._pf_memo = (mkey, {})
+        verdicts = memo[1]
+        failures: Dict[str, Status] = {}
+        for k, qp in zip(keys, batch):
+            hit = verdicts.get(k, _MISSING)
+            if hit is _MISSING:
+                s = fwk.run_pre_filter(state, [qp.pod]).get(qp.pod.uid)
+                verdicts[k] = s
+                if s is not None:
+                    failures[qp.pod.uid] = s
+            elif hit is not None:
+                # known-failing signature: real walk for THIS pod so its
+                # Status and per-uid state are its own
+                s = fwk.run_pre_filter(state, [qp.pod]).get(qp.pod.uid)
+                if s is not None:
+                    failures[qp.pod.uid] = s
+                else:
+                    verdicts[k] = None  # plugin state moved — trust the rerun
+        return failures
 
     def _stack_signatures(self, holder):
         """[S_cap, ...] stacked per-signature tensors for sig_scan; S_cap is
@@ -3189,6 +3302,75 @@ class Scheduler:
             self._bind_buffer.append(task)
         return outcome
 
+    def _commit_fast_bulk(
+        self, fwk, state, batch, choices, i, j, node_names, outcomes, pod_sigs
+    ) -> None:
+        """Commit batch[i:j] — a contiguous run of fast-scheduled, lean
+        pods — as ONE vectorized pass: bulk assume into the cache (per-node
+        aggregated accounting), shared success Status, and a single bulk
+        binding task instead of per-pod _BindTasks.  Decisions are
+        untouched (they were made by the kernel/committer); this collapses
+        the per-pod Python of the commit tail, which the config0 phase
+        breakdown showed dominating the drain.  Falls back per pod
+        (_commit_under_lock) whenever reserve/permit could act or a
+        non-default binder is configured — see _finish_fast's bulk_ok."""
+        run = batch[i:j]
+        names = [node_names[choices[k]] for k in range(i, j)]
+        # Seed the per-pod request memos from a per-SIGNATURE representative
+        # before the cache accounting reads them: pods of one signature have
+        # identical requests by construction, and the memoized Resources are
+        # read-only by contract, so sharing the representative's objects
+        # replaces two Resource builds per pod with two dict writes.
+        req_by_sig: Dict[int, tuple] = {}
+        for k in range(i, j):
+            pod = batch[k].pod
+            d = pod.__dict__
+            if "_nzreq_memo" in d:
+                continue
+            sid = id(pod_sigs[k])
+            rep = req_by_sig.get(sid)
+            if rep is None:
+                rep = req_by_sig[sid] = (
+                    pod.compute_requests(),
+                    pod.non_zero_requests(),
+                )
+            else:
+                d["_req_memo"], d["_nzreq_memo"] = rep
+        # one Status shared by the whole run: success statuses are treated
+        # as immutable everywhere (failure paths REPLACE outcome.status)
+        success = STATUS_SUCCESS
+        items = []
+        with self._mu:
+            results = self.cache.assume_pods_bulk(
+                list(zip((qp.pod for qp in run), names))
+            )
+            view_live = self._oracle_cache is not None
+            for qp, nn, res in zip(run, names, results):
+                if isinstance(res, str):
+                    # protocol violation (double assume — the multi-
+                    # scheduler race): fail the pod AND rebuild the fast
+                    # lineage, whose committer already charged this
+                    # placement the cache just rejected
+                    self._external_mutations += 1
+                    s = Status.error(f"assume failed: {res}")
+                    self._handle_failure(qp, s)
+                    outcomes.append(ScheduleOutcome(qp.pod, None, s, -1))
+                    continue
+                if view_live:
+                    self._view_pod_added(res)
+                outcome = ScheduleOutcome(
+                    qp.pod,
+                    nn,
+                    success,
+                    -1,
+                    pod_attempts=qp.attempts,
+                    first_enqueue_time=qp.timestamp,
+                )
+                outcomes.append(outcome)
+                items.append((qp, nn, outcome))
+        if items:
+            self._bulk_bind_buffer.append(_BulkBindTask(fwk, state, items))
+
     def _ensure_bind_pool(self) -> None:
         if self._bind_pool is None:
             self._bind_pool = ThreadPoolExecutor(
@@ -3201,7 +3383,32 @@ class Scheduler:
         bindings still overlap the NEXT batch's device dispatch.  The chunk
         shrinks when the buffer is small relative to the worker pool so a
         single (possibly extended) batch still spreads its binds across all
-        workers — one future per ~64 pods is only the ceiling."""
+        workers — one future per ~64 pods is only the ceiling.  Bulk tasks
+        (fast-path runs) split into per-worker slices the same way, but
+        keep their one-sink-write/one-lock-tail discipline per slice."""
+        bulk = self._bulk_bind_buffer
+        if bulk:
+            self._bulk_bind_buffer = []
+            self._ensure_bind_pool()
+            workers = max(self.config.parallelism, 1)
+            sink_many = self.binding_sink_many is not None
+            for t in bulk:
+                n = len(t.items)
+                if sink_many:
+                    # one bulk write + one lock tail per slice: big slices,
+                    # or worker threads just fight the GIL with the
+                    # scheduling loop over a few dict ops each
+                    per = max(1024, -(-n // workers))
+                else:
+                    # per-pod sink calls may block on I/O (the reference's
+                    # binding goroutine shape): small slices spread them
+                    # across the pool so latencies overlap
+                    per = min(64, max(1, -(-n // workers)))
+                for lo in range(0, n, per):
+                    part = _BulkBindTask(t.fwk, t.state, t.items[lo : lo + per])
+                    self._inflight_binds.append(
+                        self._bind_pool.submit(self._binding_bulk, part)
+                    )
         buf = self._bind_buffer
         if not buf:
             return
@@ -3214,6 +3421,83 @@ class Scheduler:
                 self._bind_pool.submit(self._binding_chunk, part)
             )
 
+    def _binding_bulk(self, t: "_BulkBindTask") -> None:
+        """One worker's slice of a bulk fast-path binding run.
+
+        The per-pod walk collapses by construction: the fast gate proved
+        PreBind irrelevant and DefaultBinder is the only Bind plugin
+        (lean), and no Reserve/Permit plugin can act — so the cycle is
+        exactly one sink write per pod (or ONE bulk write for the slice
+        when the API tier installed binding_sink_many) plus the post-bind
+        bookkeeping, settled under a single lock acquisition.  Failures
+        unwind per pod through the standard _bind_fail path."""
+        from kubernetes_tpu import events as ev
+
+        t0 = time.perf_counter()
+        fwk, state, items = t.fwk, t.state, t.items
+        ok_items = []
+        sink_many = self.binding_sink_many
+        if sink_many is not None and len(items) > 1:
+            try:
+                errs = sink_many([(qp.pod, nn) for qp, nn, _ in items])
+            except Exception as e:  # noqa: BLE001 — whole-slice failure
+                errs = [str(e)] * len(items)
+            if not isinstance(errs, (list, tuple)) or len(errs) != len(items):
+                # a misaligned result list would silently drop pods from
+                # the zip below, leaking them as assumed-forever — treat
+                # it as a whole-slice failure instead
+                errs = ["bulk binding sink returned misaligned results"] * len(
+                    items
+                )
+            for (qp, nn, outcome), err in zip(items, errs):
+                if err is None:
+                    ok_items.append((qp, nn, outcome))
+                else:
+                    self._bind_fail(fwk, state, qp, nn, outcome, Status.error(err))
+        else:
+            sink = self.binding_sink
+            for qp, nn, outcome in items:
+                try:
+                    sink(qp.pod, nn)
+                except Exception as e:  # noqa: BLE001 — surfaced as Status
+                    self._bind_fail(
+                        fwk, state, qp, nn, outcome,
+                        Status.error(f"binding cycle panicked: {e}"),
+                    )
+                    continue
+                ok_items.append((qp, nn, outcome))
+        if ok_items:
+            with self._mu:
+                queue_done = self.queue.done
+                finish = self.cache.finish_binding
+                nom = self.nominator if len(self.nominator) else None
+                for qp, _, _ in ok_items:
+                    pod = qp.pod
+                    queue_done(pod.uid)
+                    finish(pod)
+                    if nom is not None:
+                        nom.delete(pod)
+                self.metrics["scheduled"] += len(ok_items)
+            if fwk.has_post_bind():
+                for qp, nn, _ in ok_items:
+                    fwk.run_post_bind(state, qp.pod, nn)
+            rec = self.recorders.get(ok_items[0][0].pod.scheduler_name)
+            if rec is not None and not isinstance(rec, ev.NullRecorder):
+                for qp, nn, _ in ok_items:
+                    pod = qp.pod
+                    rec.eventf(
+                        ev.ObjectRef.for_pod(pod),
+                        ev.TYPE_NORMAL,
+                        "Scheduled",
+                        "Binding",
+                        f"Successfully assigned {pod.key} to {nn}",
+                    )
+        dt = time.perf_counter() - t0
+        if items:
+            # amortized binding latency: the slice shares one wall clock
+            self.prom.binding_duration.observe_n(dt / len(items), len(items))
+        self.phases.add("bind", dt)
+
     def _binding_chunk(self, part: List["_BindTask"]) -> None:
         """One worker's buffered binding cycles.  Lean cycles (fast batches
         with the default binder only) run their sink calls first and then
@@ -3223,6 +3507,7 @@ class Scheduler:
         changing what any concurrent reader can observe mid-chunk."""
         from kubernetes_tpu import events as ev
 
+        t_bind = time.perf_counter()
         lean_ok = []
         lean_tasks = [t for t in part if t.lean_eligible()]
         sink_many = getattr(self, "binding_sink_many", None)
@@ -3234,6 +3519,14 @@ class Scheduler:
                 errs = sink_many([(t.qp.pod, t.node_name) for t in lean_tasks])
             except Exception as e:  # noqa: BLE001 — whole-batch failure
                 errs = [str(e)] * len(lean_tasks)
+            if not isinstance(errs, (list, tuple)) or len(errs) != len(
+                lean_tasks
+            ):
+                # misaligned results would drop tasks from the zip —
+                # whole-batch failure keeps every pod accounted for
+                errs = ["bulk binding sink returned misaligned results"] * len(
+                    lean_tasks
+                )
             for t, err in zip(lean_tasks, errs):
                 if err is None:
                     lean_ok.append(t)
@@ -3260,6 +3553,7 @@ class Scheduler:
             else:
                 self._binding_cycle(t)
         if not lean_ok:
+            self.phases.add("bind", time.perf_counter() - t_bind)
             return
         with self._mu:
             for t in lean_ok:
@@ -3272,7 +3566,7 @@ class Scheduler:
             pod = t.qp.pod
             t.fwk.run_post_bind(t.state, pod, t.node_name)
             rec = self.recorders.get(pod.scheduler_name)
-            if rec is not None:
+            if rec is not None and not isinstance(rec, ev.NullRecorder):
                 rec.eventf(
                     ev.ObjectRef.for_pod(pod),
                     ev.TYPE_NORMAL,
@@ -3280,6 +3574,7 @@ class Scheduler:
                     "Binding",
                     f"Successfully assigned {pod.key} to {t.node_name}",
                 )
+        self.phases.add("bind", time.perf_counter() - t_bind)
 
     def _bind_fail(self, fwk, state, qp, node_name, outcome, s) -> None:
         """Bind-failure unwind: Unreserve + ForgetPod + requeue under the

@@ -42,7 +42,12 @@ def accumulate_node_usage(
     vocab: Vocab,
 ) -> None:
     """Fold placed pods into per-node requested/non-zero/pod-count/port
-    accounting (NodeInfo.AddPodInfo, framework/types.go:829)."""
+    accounting (NodeInfo.AddPodInfo, framework/types.go:829).
+
+    Batched: per-pod request rows are built once per DISTINCT memoized
+    request object (pods stamped from one template share it — the 100k-pod
+    full-pack shape) and folded into the per-node accumulators with one
+    np.add.at sweep per tensor instead of a numpy row-add per pod."""
     lanes = ResourceLanes(vocab)
     R = nt.allocatable.shape[1]
     nt.requested[:] = 0
@@ -50,18 +55,33 @@ def accumulate_node_usage(
     nt.num_pods[:] = 0
 
     port_rows: Dict[int, list] = {}
+    idxs: list = []
+    rows: list = []
+    nz_rows: list = []
+    row_cache: Dict[int, tuple] = {}
+    name_to_idx = nt.name_to_idx
     for pod in placed_pods:
-        i = nt.name_to_idx.get(pod.node_name)
+        i = name_to_idx.get(pod.node_name)
         if i is None:
             continue
         req = pod.compute_requests()
-        nt.requested[i] += lanes.request_row(req, R)
-        nz = req.non_zero_defaulted()
-        nt.nonzero_req[i, 0] += nz.milli_cpu
-        nt.nonzero_req[i, 1] += -(-nz.memory // MEM_UNIT)
-        nt.num_pods[i] += 1
+        ent = row_cache.get(id(req))
+        if ent is None:
+            nz = req.non_zero_defaulted()
+            ent = row_cache[id(req)] = (
+                lanes.request_row(req, R),
+                (nz.milli_cpu, -(-nz.memory // MEM_UNIT)),
+            )
+        idxs.append(i)
+        rows.append(ent[0])
+        nz_rows.append(ent[1])
         for p in pod.host_ports():
             port_rows.setdefault(i, []).append(encode_port(vocab, p))
+    if idxs:
+        ii = np.asarray(idxs, np.intp)
+        np.add.at(nt.requested, ii, np.stack(rows))
+        np.add.at(nt.nonzero_req, ii, np.asarray(nz_rows, nt.nonzero_req.dtype))
+        np.add.at(nt.num_pods, ii, 1)
 
     U = bucket_cap(max((len(r) for r in port_rows.values()), default=1), 1)
     N = nt.n_cap

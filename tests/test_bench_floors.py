@@ -45,10 +45,14 @@ def _bench_configs(bench):
 
 
 def test_floors_file_is_wellformed():
-    floors = _load(os.path.join(ROOT, "BENCH_FLOORS.json"))["floors"]
+    doc = _load(os.path.join(ROOT, "BENCH_FLOORS.json"))
+    floors = doc["floors"]
     assert floors, "no floors recorded"
     for k, v in floors.items():
         assert v > 0, f"floor {k} must be positive"
+    for k, v in doc.get("ceilings", {}).items():
+        assert v > 0, f"ceiling {k} must be positive"
+        assert k not in floors, f"{k} cannot be both floor and ceiling"
 
 
 def test_latest_recorded_bench_clears_floors():
@@ -66,6 +70,15 @@ def test_latest_recorded_bench_clears_floors():
         f"{key}: {results[key]:.1f} < floor {floor}"
         for key, floor in floors.items()
         if key in results and results[key] < floor
+    ]
+    # Ceilings: lower-is-better wall-clock budgets (the config0 north-star
+    # drain).  Same since-round gating as floors, via ceilings_since.
+    ceilings = floors_doc.get("ceilings", {})
+    ceilings_since = floors_doc.get("ceilings_since", {})
+    ceiling_failures = [
+        f"{key}: {results[key]:.2f} > ceiling {cap}"
+        for key, cap in ceilings.items()
+        if key in results and results[key] > cap
     ]
     # Round 3's recorded results predate these floors (the floors were
     # introduced because round 3 regressed); enforcement begins with the
@@ -85,6 +98,9 @@ def test_latest_recorded_bench_clears_floors():
     # apply to it (floors_since maps key -> first enforced round)
     failures = [
         f for f in failures if since.get(f.split(":")[0], 0) <= n
+    ]
+    failures += [
+        f for f in ceiling_failures if ceilings_since.get(f.split(":")[0], 0) <= n
     ]
     acked = floors_doc.get("acknowledged_regressions", {}).get(str(n))
     if acked:

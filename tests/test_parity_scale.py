@@ -5,7 +5,7 @@ Strategy (runtime-stratified so the suite stays runnable):
   * CROSS-BATCH-SIZE agreement, 12 seeds at 500 nodes / 1000 pods plus
     one 2000-node / 3000-pod case: sequential equivalence means drains at
     batch 256 and 32 must produce IDENTICAL bindings — this exercises the
-    fast path, gang scan, wave mode, and chain pipeline against each
+    fast path, gang scan, and chain pipeline against each
     other at real scale (their per-batch state hand-offs differ, so
     machinery bugs diverge);
   * SERIAL-ANCHORED parity, 4 seeds at 300 nodes / 400 pods: the scalar
