@@ -1,0 +1,117 @@
+"""Invariant analyzers for the TPU scheduler (``python -m kubernetes_tpu.analysis``).
+
+Three AST checkers guard the contracts PR 1's concurrency layering relies
+on (the race-detector/vet role the reference scheduler gets from the Go
+toolchain):
+
+  * ``lock-discipline`` — registered lock-guarded fields are only mutated
+    under their lock or in callers-verified ``*_under_lock`` methods;
+  * ``plugin-purity`` — ``pre_filter_spec_pure`` plugins keep their spec
+    path free of state reads/writes;
+  * ``jit-boundary`` — nothing reachable from the jitted pipelines in
+    ``ops/`` host-syncs or branches on tracers.
+
+Plus a runtime sanitizer (``KTPU_SANITIZE=1``, see ``sanitizer.py``).
+Suppressions: ``# ktpu: allow(<rule>) — <reason>`` (reason mandatory).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence
+
+from kubernetes_tpu.analysis.core import (
+    Finding,
+    SourceModule,
+    collect_bare_suppressions,
+    render_json,
+    render_text,
+)
+from kubernetes_tpu.analysis.jit import JitChecker
+from kubernetes_tpu.analysis.locks import LockChecker
+from kubernetes_tpu.analysis.purity import PurityChecker
+
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# the shipped tree's checker targets
+LOCK_MODULES = (
+    "scheduler.py",
+    os.path.join("cache", "cache.py"),
+    os.path.join("cache", "mirror.py"),
+    os.path.join("queue", "scheduling_queue.py"),
+)
+PURITY_MODULES = (
+    os.path.join("framework", "plugins.py"),
+    os.path.join("framework", "volume_plugins.py"),
+    os.path.join("framework", "volumebinding.py"),
+    os.path.join("framework", "dynamicresources.py"),
+)
+JIT_MODULES = (
+    os.path.join("ops", "chain.py"),
+    os.path.join("ops", "common.py"),
+    os.path.join("ops", "fastpath.py"),
+    os.path.join("ops", "filters.py"),
+    os.path.join("ops", "gang.py"),
+    os.path.join("ops", "pipeline.py"),
+    os.path.join("ops", "preemption.py"),
+    os.path.join("ops", "scores.py"),
+    os.path.join("ops", "wire.py"),
+)
+
+
+def default_targets() -> Dict[str, List[str]]:
+    return {
+        "locks": [os.path.join(_PKG_ROOT, p) for p in LOCK_MODULES],
+        "purity": [os.path.join(_PKG_ROOT, p) for p in PURITY_MODULES],
+        "jit": [os.path.join(_PKG_ROOT, p) for p in JIT_MODULES],
+    }
+
+
+def run_analysis(
+    targets: Optional[Dict[str, Sequence[str]]] = None,
+) -> List[Finding]:
+    """Run every checker over its target file set; returns ALL findings
+    (post-suppression), sorted by path/line.  ``targets`` maps checker key
+    ('locks'/'purity'/'jit') → file paths; defaults to the shipped tree.
+    """
+    t = dict(default_targets())
+    if targets is not None:
+        t.update({k: list(v) for k, v in targets.items()})
+
+    loaded: Dict[str, SourceModule] = {}
+
+    def load(paths: Sequence[str]) -> List[SourceModule]:
+        out = []
+        for p in paths:
+            key = os.path.abspath(p)
+            if key not in loaded:
+                loaded[key] = SourceModule.load(p)
+            out.append(loaded[key])
+        return out
+
+    findings: List[Finding] = []
+
+    lc = LockChecker()
+    lc.run(load(t.get("locks", ())))
+    findings.extend(lc.findings)
+
+    pc = PurityChecker()
+    pc.run(load(t.get("purity", ())))
+    findings.extend(pc.findings)
+
+    jc = JitChecker()
+    jc.run(load(t.get("jit", ())))
+    findings.extend(jc.findings)
+
+    findings.extend(collect_bare_suppressions(loaded.values()))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+__all__ = [
+    "Finding",
+    "run_analysis",
+    "default_targets",
+    "render_text",
+    "render_json",
+]

@@ -1,0 +1,68 @@
+"""CLI: ``python -m kubernetes_tpu.analysis [--json] [--rule R] [paths…]``.
+
+Exit status: 0 when clean, 1 when any finding survives suppression
+filtering (CI gates on this), 2 on usage/internal errors.
+
+With no paths, the shipped tree is analyzed (each checker over its
+registered modules).  Explicit paths are handed to ALL checkers — the
+fixture-driven mode the tier-1 test uses (a fixture file declares its own
+``_KTPU_GUARDED`` / ``pre_filter_spec_pure`` / ``jax.jit`` markers, so
+only the relevant checker fires on it).
+
+``--json`` prints a machine-readable report (findings + per-rule counts)
+for the bench tooling instead of the line-per-finding text form.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List
+
+from kubernetes_tpu.analysis import (
+    render_json,
+    render_text,
+    run_analysis,
+)
+from kubernetes_tpu.analysis.core import ALL_RULES
+
+
+def main(argv: List[str] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m kubernetes_tpu.analysis",
+        description="Static invariant analysis (lock-discipline, "
+        "plugin-purity, jit-boundary).",
+    )
+    ap.add_argument("paths", nargs="*", help="files to analyze (default: shipped tree)")
+    ap.add_argument("--json", action="store_true", help="JSON report on stdout")
+    ap.add_argument(
+        "--rule",
+        action="append",
+        choices=sorted(ALL_RULES),
+        help="restrict output to RULE (repeatable)",
+    )
+    args = ap.parse_args(argv)
+
+    try:
+        if args.paths:
+            targets = {
+                "locks": args.paths,
+                "purity": args.paths,
+                "jit": args.paths,
+            }
+            findings = run_analysis(targets)
+        else:
+            findings = run_analysis()
+    except (OSError, SyntaxError) as e:
+        print(f"kubernetes_tpu.analysis: error: {e}", file=sys.stderr)
+        return 2
+
+    if args.rule:
+        findings = [f for f in findings if f.rule in set(args.rule)]
+
+    print(render_json(findings) if args.json else render_text(findings))
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

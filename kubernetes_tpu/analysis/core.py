@@ -1,0 +1,193 @@
+"""Shared machinery for the invariant analyzers.
+
+The suite is AST-only: no analyzed module is ever imported, so fixture
+files with deliberately broken concurrency and framework modules with
+heavyweight imports analyze identically.  Each checker consumes
+``SourceModule`` objects and emits ``Finding``s; suppression comments
+(``# ktpu: allow(<rule>) — <reason>``) are resolved here, uniformly, so
+a checker never needs to know it was silenced.
+
+A suppression without a reason is itself a finding (``bare-suppression``)
+— the suppression syntax exists to FORCE the justification into the
+diff, not to provide an escape hatch from it.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import re
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Sequence
+
+RULE_LOCK = "lock-discipline"
+RULE_PURITY = "plugin-purity"
+RULE_JIT = "jit-boundary"
+RULE_BARE_SUPPRESSION = "bare-suppression"
+
+ALL_RULES = (RULE_LOCK, RULE_PURITY, RULE_JIT, RULE_BARE_SUPPRESSION)
+
+# `# ktpu: allow(rule[, rule...]) — reason`  (em/en/double/single dash or
+# colon all accepted as the reason separator; the reason is mandatory)
+_SUPPRESS_RE = re.compile(
+    r"#\s*ktpu:\s*allow\(\s*([a-zA-Z0-9_,\- ]+?)\s*\)\s*(?:(?:—|–|--|-|:)\s*(\S.*))?$"
+)
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str
+    line: int
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    def as_dict(self) -> Dict[str, object]:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "message": self.message,
+        }
+
+
+@dataclass
+class Suppression:
+    rules: List[str]
+    line: int
+    reason: str  # "" when bare
+    used: bool = False
+
+
+class SourceModule:
+    """One parsed file: source lines, AST, and its suppression table."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        # line → suppressions; comments alone on their lines (STACKABLE —
+        # one per rule with its own reason) cover the next non-comment
+        # line, a trailing comment covers its own line.
+        self.suppressions: Dict[int, List[Suppression]] = {}
+        self.bare_suppressions: List[int] = []
+        self._scan_suppressions()
+
+    @classmethod
+    def load(cls, path: str) -> "SourceModule":
+        with open(path, "r", encoding="utf-8") as f:
+            return cls(path, f.read())
+
+    def _scan_suppressions(self) -> None:
+        pending: List[Suppression] = []
+        for i, raw in enumerate(self.lines, start=1):
+            stripped = raw.strip()
+            m = _SUPPRESS_RE.search(raw)
+            if m:
+                rules = [r.strip() for r in m.group(1).split(",") if r.strip()]
+                reason = (m.group(2) or "").strip()
+                sup = Suppression(rules=rules, line=i, reason=reason)
+                if not reason:
+                    self.bare_suppressions.append(i)
+                if stripped.startswith("#"):
+                    pending.append(sup)  # standalone → covers next code line
+                else:
+                    self.suppressions.setdefault(i, []).append(sup)
+                continue
+            if pending and stripped and not stripped.startswith("#"):
+                self.suppressions.setdefault(i, []).extend(pending)
+                pending = []
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        for sup in self.suppressions.get(line, ()):
+            if rule in sup.rules and sup.reason:
+                sup.used = True
+                return True
+        return False
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """Flatten Name/Attribute chains to 'a.b.c' (None for anything else)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(node: ast.Call) -> Optional[str]:
+    return dotted_name(node.func)
+
+
+def module_literal(tree: ast.Module, name: str):
+    """Evaluate a module-level literal assignment (the annotation registry
+    pattern: ``_KTPU_GUARDED = {...}``) without importing the module."""
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == name:
+                    try:
+                        return ast.literal_eval(node.value)
+                    except (ValueError, SyntaxError):
+                        return None
+    return None
+
+
+class Checker:
+    """Base: run() yields raw findings; filter_findings applies suppressions
+    from the owning module."""
+
+    rule: str = ""
+
+    def __init__(self) -> None:
+        self.findings: List[Finding] = []
+
+    def emit(self, mod: SourceModule, line: int, message: str, rule: Optional[str] = None) -> None:
+        r = rule or self.rule
+        if not mod.suppressed(r, line):
+            self.findings.append(Finding(r, mod.path, line, message))
+
+
+def collect_bare_suppressions(mods: Iterable[SourceModule]) -> List[Finding]:
+    out = []
+    for mod in mods:
+        for line in mod.bare_suppressions:
+            out.append(
+                Finding(
+                    RULE_BARE_SUPPRESSION,
+                    mod.path,
+                    line,
+                    "suppression without a justification — write "
+                    "`# ktpu: allow(<rule>) — <reason>`",
+                )
+            )
+    return out
+
+
+def render_text(findings: Sequence[Finding]) -> str:
+    if not findings:
+        return "kubernetes_tpu.analysis: no findings"
+    lines = [f.format() for f in findings]
+    lines.append(f"kubernetes_tpu.analysis: {len(findings)} finding(s)")
+    return "\n".join(lines)
+
+
+def render_json(findings: Sequence[Finding]) -> str:
+    by_rule: Dict[str, int] = {}
+    for f in findings:
+        by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+    return json.dumps(
+        {
+            "findings": [f.as_dict() for f in findings],
+            "count": len(findings),
+            "by_rule": by_rule,
+        },
+        indent=2,
+        sort_keys=True,
+    )

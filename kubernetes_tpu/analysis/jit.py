@@ -1,0 +1,568 @@
+"""Jit-boundary hygiene checker (rule: ``jit-boundary``).
+
+Everything reachable from a ``jax.jit``-decorated function in ``ops/``
+executes under tracing: an ``.item()``, a ``float()/int()/bool()`` on an
+array, an ``np.*`` call on a device value, or a Python branch on a tracer
+either crashes at trace time in a rarely-exercised shape configuration or
+— worse — silently forces a host sync that erases the drain overlap wins.
+
+The checker is a small abstract interpretation over STATICNESS:
+
+  * module-level globals are trace-time constants → static;
+  * a jitted root's parameters are traced except its ``static_argnames``;
+  * a helper's parameter is static when annotated ``int/bool/str/float``
+    or when every intra-package call site passes a static argument
+    (computed to fixpoint over the call graph, reachable-from-roots only);
+  * ``.shape``/``.ndim``/``.dtype``/``.size`` and ``len()`` NEUTRALIZE:
+    they are static even on traced arrays (shapes are compile-time under
+    jit) — this is what lets genuinely shape-driven host Python inside
+    kernels pass without suppressions.
+
+Violations (all reported under the one ``jit-boundary`` rule):
+
+  * ``.item()`` / ``.tolist()`` / ``.block_until_ready()`` on a traced
+    value, and ``jax.device_get(...)`` of one;
+  * ``np.<fn>(traced)`` — numpy coerces through the host;
+  * ``int()/float()/bool()`` of a traced value;
+  * ``if``/``while``/``assert`` conditions, and ``for``/comprehension
+    iterables, that are traced.
+
+Host-side wrappers in ``ops/`` (``from_host`` packers, dispatch glue) are
+exempt by construction: they are not reachable from any jitted root.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from kubernetes_tpu.analysis.core import (
+    RULE_JIT,
+    Checker,
+    SourceModule,
+    dotted_name,
+)
+
+NEUTRAL_ATTRS = {"shape", "ndim", "dtype", "size", "nbytes", "itemsize"}
+SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+STATIC_ANNOTATIONS = {"int", "bool", "str", "float"}
+CAST_BUILTINS = {"int", "float", "bool"}
+# builtins whose result is static whenever their arguments are
+LEN_LIKE = {"len"}
+MAX_FIXPOINT_ROUNDS = 12
+
+
+def _jit_decoration(fn: ast.FunctionDef) -> Optional[Tuple[bool, Set[str]]]:
+    """(is_jitted, static_argnames) when a decorator is jax.jit or a
+    partial over it."""
+    for dec in fn.decorator_list:
+        dn = dotted_name(dec)
+        if dn is not None and dn.split(".")[-1] == "jit":
+            return True, set()
+        if isinstance(dec, ast.Call):
+            dnc = dotted_name(dec.func)
+            if dnc is not None and dnc.split(".")[-1] == "jit":
+                return True, _static_argnames(dec)
+            if dnc is not None and dnc.split(".")[-1] == "partial" and dec.args:
+                first = dotted_name(dec.args[0])
+                if first is not None and first.split(".")[-1] == "jit":
+                    return True, _static_argnames(dec)
+    return None
+
+
+def _static_argnames(call: ast.Call) -> Set[str]:
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            try:
+                v = ast.literal_eval(kw.value)
+            except (ValueError, SyntaxError):
+                return set()
+            if isinstance(v, str):
+                return {v}
+            return set(v)
+    return set()
+
+
+class _FuncInfo:
+    def __init__(self, key: str, mod: SourceModule, node: ast.FunctionDef,
+                 enclosing: Optional["_FuncInfo"] = None):
+        self.key = key  # "module_basename:qualname"
+        self.mod = mod
+        self.node = node
+        self.enclosing = enclosing
+        self.is_root = False
+        self.static_argnames: Set[str] = set()
+        self.params = [a.arg for a in node.args.args + node.args.kwonlyargs]
+        self.annotated_static = {
+            a.arg
+            for a in node.args.args + node.args.kwonlyargs
+            if a.annotation is not None
+            and isinstance(a.annotation, ast.Name)
+            and a.annotation.id in STATIC_ANNOTATIONS
+        }
+        # param → static?  (fixpoint state; optimistic start)
+        self.param_static: Dict[str, bool] = {}
+
+
+class JitChecker(Checker):
+    rule = RULE_JIT
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.funcs: Dict[str, _FuncInfo] = {}
+        self.by_module: Dict[str, Dict[str, str]] = {}  # mod base → name → key
+        self.aliases: Dict[str, Dict[str, str]] = {}  # mod base → alias → module base
+        self.np_roots: Dict[str, Set[str]] = {}  # mod base → names bound to numpy
+        self.jax_roots: Dict[str, Set[str]] = {}
+        self.reachable: Set[str] = set()
+        # callee key → param → all-static-so-far
+        self._callsite_static: Dict[str, Dict[str, bool]] = {}
+        self._emit_mode = False
+
+    # ----- entry point ------------------------------------------------------
+
+    def run(self, mods: List[SourceModule]) -> None:
+        for mod in mods:
+            self._index_module(mod)
+
+        roots = [f for f in self.funcs.values() if f.is_root]
+        for f in self.funcs.values():
+            init = {}
+            for p in f.params:
+                if f.is_root:
+                    init[p] = p in f.static_argnames or p in f.annotated_static
+                else:
+                    init[p] = True  # optimistic; downgraded by call sites
+            f.param_static = init
+
+        self.reachable = {f.key for f in roots}
+        for _ in range(MAX_FIXPOINT_ROUNDS):
+            changed = False
+            self._callsite_static = {}
+            frontier = list(self.reachable)
+            for key in frontier:
+                self._analyze(self.funcs[key])
+            # grow reachability
+            for key in list(self._callsite_static):
+                if key not in self.reachable:
+                    self.reachable.add(key)
+                    changed = True
+            # downgrade params from observed call sites
+            for key, per_param in self._callsite_static.items():
+                f = self.funcs.get(key)
+                if f is None or f.is_root:
+                    continue
+                for p, is_static in per_param.items():
+                    forced = p in f.annotated_static
+                    new = forced or is_static
+                    if f.param_static.get(p, True) != new:
+                        f.param_static[p] = new
+                        changed = True
+            if not changed:
+                break
+
+        # final pass with emission on
+        self._emit_mode = True
+        for key in sorted(self.reachable):
+            self._analyze(self.funcs[key])
+
+    # ----- indexing ---------------------------------------------------------
+
+    def _index_module(self, mod: SourceModule) -> None:
+        base = mod.path.rsplit("/", 1)[-1].rsplit(".", 1)[0]
+        self.by_module[base] = {}
+        self.aliases[base] = {}
+        self.np_roots[base] = set()
+        self.jax_roots[base] = set()
+
+        for node in mod.tree.body:
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    name = a.asname or a.name.split(".")[0]
+                    if a.name == "numpy":
+                        self.np_roots[base].add(name)
+                    elif a.name == "jax.numpy":
+                        pass  # jnp stays device-side
+                    elif a.name == "jax":
+                        self.jax_roots[base].add(name)
+            elif isinstance(node, ast.ImportFrom):
+                m = node.module or ""
+                if m == "numpy":
+                    for a in node.names:
+                        self.np_roots[base].add(a.asname or a.name)
+                    continue
+                tail = m.rsplit(".", 1)[-1] if m else ""
+                for a in node.names:
+                    local = a.asname or a.name
+                    if a.name == "numpy":
+                        self.np_roots[base].add(local)
+                    elif m.endswith("ops") or ".ops." in m + ".":
+                        # from kubernetes_tpu.ops import filters as F /
+                        # from kubernetes_tpu.ops.common import eval_table
+                        if m.endswith(".ops") or m == "ops":
+                            self.aliases[base][local] = a.name
+                        else:
+                            self.aliases[base][local] = f"{tail}.{a.name}"
+
+        def index_fn(fn: ast.FunctionDef, qual: str, enclosing: Optional[_FuncInfo]):
+            key = f"{base}:{qual}"
+            info = _FuncInfo(key, mod, fn, enclosing)
+            jd = _jit_decoration(fn)
+            if jd is not None:
+                info.is_root = True
+                info.static_argnames = jd[1]
+            self.funcs[key] = info
+            self.by_module[base][qual] = key
+            if "." not in qual:
+                self.by_module[base].setdefault(fn.name, key)
+            for sub in fn.body:
+                if isinstance(sub, ast.FunctionDef):
+                    index_fn(sub, f"{qual}.{sub.name}", info)
+
+        for node in mod.tree.body:
+            if isinstance(node, ast.FunctionDef):
+                index_fn(node, node.name, None)
+            elif isinstance(node, ast.ClassDef):
+                for item in node.body:
+                    if isinstance(item, ast.FunctionDef):
+                        index_fn(item, f"{node.name}.{item.name}", None)
+
+    def _resolve_call(self, base_mod: str, func: ast.expr) -> Optional[str]:
+        """Resolve a call expression to an indexed function key."""
+        dn = dotted_name(func)
+        if dn is None:
+            return None
+        parts = dn.split(".")
+        local = self.by_module.get(base_mod, {})
+        if len(parts) == 1:
+            key = local.get(parts[0])
+            if key is not None:
+                return key
+            target = self.aliases.get(base_mod, {}).get(parts[0])
+            if target and "." in target:
+                m, fn = target.split(".", 1)
+                return self.by_module.get(m, {}).get(fn)
+            return None
+        # F.all_masks → alias F = module 'filters'
+        target = self.aliases.get(base_mod, {}).get(parts[0])
+        if target and "." not in target and len(parts) == 2:
+            return self.by_module.get(target, {}).get(parts[1])
+        return None
+
+    # ----- per-function analysis --------------------------------------------
+
+    def _analyze(self, f: _FuncInfo) -> None:
+        base = f.key.split(":", 1)[0]
+        env: Dict[str, bool] = dict(f.param_static)
+        # defaults evaluated at module scope → params missing a call-site
+        # record keep their optimistic/static value
+        self._exec_block(f, base, f.node.body, env)
+
+    def _exec_block(
+        self, f: _FuncInfo, base: str, stmts: List[ast.stmt], env: Dict[str, bool]
+    ) -> None:
+        for st in stmts:
+            if isinstance(st, ast.FunctionDef):
+                # nested defs (vmap bodies etc.) analyzed via closure env:
+                # params traced unless annotated, closures resolve to env
+                key = f"{f.key.split(':', 1)[1]}.{st.name}"
+                info = self.funcs.get(f"{base}:{key}")
+                if info is not None and f.key in self.reachable:
+                    self.reachable.add(info.key)
+                    nested_env = {
+                        p: (p in info.annotated_static) for p in info.params
+                    }
+                    closure_env = dict(env)
+                    closure_env.update(nested_env)
+                    self._exec_block(info, base, info.node.body, closure_env)
+                env[st.name] = True
+                continue
+            if isinstance(st, ast.Assign):
+                s = self._static(f, base, st.value, env)
+                self._scan_expr(f, base, st.value, env)
+                for t in st.targets:
+                    self._bind_target(t, s, env)
+                continue
+            if isinstance(st, ast.AnnAssign):
+                if st.value is not None:
+                    s = self._static(f, base, st.value, env)
+                    self._scan_expr(f, base, st.value, env)
+                    self._bind_target(st.target, s, env)
+                continue
+            if isinstance(st, ast.AugAssign):
+                s = self._static(f, base, st.value, env)
+                self._scan_expr(f, base, st.value, env)
+                if isinstance(st.target, ast.Name):
+                    env[st.target.id] = env.get(st.target.id, True) and s
+                continue
+            if isinstance(st, (ast.If, ast.While)):
+                if not self._static(f, base, st.test, env):
+                    self._violation(
+                        f,
+                        st.test.lineno,
+                        f"branch on a traced value ({ast.unparse(st.test)[:60]})",
+                    )
+                self._scan_expr(f, base, st.test, env)
+                self._exec_block(f, base, st.body, env)
+                self._exec_block(f, base, st.orelse, env)
+                continue
+            if isinstance(st, ast.For):
+                if not self._static_iterable(f, base, st.iter, env):
+                    self._violation(
+                        f,
+                        st.iter.lineno,
+                        f"iteration over a traced value "
+                        f"({ast.unparse(st.iter)[:60]})",
+                    )
+                self._scan_expr(f, base, st.iter, env)
+                self._bind_target(st.target, self._static(f, base, st.iter, env), env)
+                self._exec_block(f, base, st.body, env)
+                self._exec_block(f, base, st.orelse, env)
+                continue
+            if isinstance(st, ast.Assert):
+                if not self._static(f, base, st.test, env):
+                    self._violation(
+                        f, st.test.lineno, "assert on a traced value"
+                    )
+                self._scan_expr(f, base, st.test, env)
+                continue
+            if isinstance(st, ast.Return):
+                if st.value is not None:
+                    self._scan_expr(f, base, st.value, env)
+                continue
+            if isinstance(st, ast.Expr):
+                self._scan_expr(f, base, st.value, env)
+                continue
+            # generic recursion (With/Try/…)
+            for attr in ("body", "orelse", "finalbody"):
+                sub = getattr(st, attr, None)
+                if sub:
+                    self._exec_block(f, base, sub, env)
+            for handler in getattr(st, "handlers", ()) or ():
+                self._exec_block(f, base, handler.body, env)
+
+    def _bind_target(self, target: ast.expr, static: bool, env: Dict[str, bool]) -> None:
+        if isinstance(target, ast.Name):
+            env[target.id] = static
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                self._bind_target(el, static, env)
+        # attribute/subscript writes don't rebind names
+
+    # ----- violation scanning ----------------------------------------------
+
+    def _scan_expr(
+        self, f: _FuncInfo, base: str, expr: ast.expr, env: Dict[str, bool]
+    ) -> None:
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                self._check_call(f, base, node, env)
+                # record call-site staticness for indexed callees
+                key = self._resolve_call(base, node.func)
+                if key is not None:
+                    self._record_callsite(f, base, key, node, env)
+            elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)):
+                for gen in node.generators:
+                    if not self._static_iterable(f, base, gen.iter, env):
+                        self._violation(
+                            f,
+                            gen.iter.lineno,
+                            "comprehension over a traced value",
+                        )
+
+    def _check_call(
+        self, f: _FuncInfo, base: str, node: ast.Call, env: Dict[str, bool]
+    ) -> None:
+        func = node.func
+        args_traced = any(
+            not self._static(f, base, a, env)
+            for a in list(node.args) + [kw.value for kw in node.keywords]
+        )
+        if isinstance(func, ast.Attribute):
+            if func.attr in SYNC_METHODS and not self._static(
+                f, base, func.value, env
+            ):
+                self._violation(
+                    f,
+                    node.lineno,
+                    f".{func.attr}() forces a host sync on a traced value",
+                )
+                return
+            dn = dotted_name(func)
+            if dn is not None:
+                root = dn.split(".")[0]
+                if root in self.np_roots.get(base, ()) and args_traced:
+                    self._violation(
+                        f,
+                        node.lineno,
+                        f"{dn}(...) coerces a traced value through host numpy",
+                    )
+                    return
+                if (
+                    root in self.jax_roots.get(base, ())
+                    and dn.split(".")[-1] == "device_get"
+                ):
+                    self._violation(
+                        f, node.lineno, "jax.device_get inside a jitted pipeline"
+                    )
+                    return
+        elif isinstance(func, ast.Name):
+            if (
+                func.id in CAST_BUILTINS
+                and func.id not in env  # not shadowed by a local
+                and node.args
+                and not self._static(f, base, node.args[0], env)
+            ):
+                self._violation(
+                    f,
+                    node.lineno,
+                    f"{func.id}() on a traced value forces a host sync",
+                )
+
+    def _record_callsite(
+        self, f: _FuncInfo, base: str, callee_key: str, node: ast.Call, env: Dict[str, bool]
+    ) -> None:
+        callee = self.funcs.get(callee_key)
+        if callee is None:
+            return
+        rec = self._callsite_static.setdefault(callee_key, {})
+        params = callee.params
+        has_self = params and params[0] == "self"
+        offset = 1 if has_self else 0
+        for i, a in enumerate(node.args):
+            if i + offset < len(params):
+                p = params[i + offset]
+                s = self._static(f, base, a, env)
+                rec[p] = rec.get(p, True) and s
+        for kw in node.keywords:
+            if kw.arg is not None and kw.arg in params:
+                s = self._static(f, base, kw.value, env)
+                rec[kw.arg] = rec.get(kw.arg, True) and s
+
+    def _violation(self, f: _FuncInfo, line: int, message: str) -> None:
+        if self._emit_mode:
+            fn_name = f.key.split(":", 1)[1]
+            self.emit(f.mod, line, f"{fn_name}: {message}")
+
+    # ----- staticness -------------------------------------------------------
+
+    def _static_iterable(
+        self, f: _FuncInfo, base: str, node: ast.expr, env: Dict[str, bool]
+    ) -> bool:
+        """Can Python iterate this without consuming a tracer?  A tuple/
+        list DISPLAY has static structure even with traced elements
+        (``for a, b in ((x, y), (z, w))``); zip/enumerate inherit from
+        their operands' structure."""
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return True
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+            if node.func.id in ("zip", "enumerate") and node.func.id not in env:
+                return all(
+                    self._static_iterable(f, base, a, env) for a in node.args
+                )
+        return self._static(f, base, node, env)
+
+    def _static(
+        self, f: _FuncInfo, base: str, node: ast.expr, env: Dict[str, bool]
+    ) -> bool:
+        if isinstance(node, ast.Constant):
+            return True
+        if isinstance(node, ast.Name):
+            return env.get(node.id, True)  # unknown → module global → static
+        if isinstance(node, ast.Attribute):
+            if node.attr in NEUTRAL_ATTRS:
+                return True
+            return self._static(f, base, node.value, env)
+        if isinstance(node, ast.Subscript):
+            return self._static(f, base, node.value, env) and self._static(
+                f, base, node.slice, env
+            )
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return all(self._static(f, base, el, env) for el in node.elts)
+        if isinstance(node, ast.Dict):
+            return all(
+                self._static(f, base, v, env)
+                for v in list(node.keys) + list(node.values)
+                if v is not None
+            )
+        if isinstance(node, ast.BoolOp):
+            return all(self._static(f, base, v, env) for v in node.values)
+        if isinstance(node, ast.BinOp):
+            return self._static(f, base, node.left, env) and self._static(
+                f, base, node.right, env
+            )
+        if isinstance(node, ast.UnaryOp):
+            return self._static(f, base, node.operand, env)
+        if isinstance(node, ast.Compare):
+            # `x is None` / `x is not None` on a traced OBJECT is a Python
+            # identity check, not a tracer branch — the optional-array
+            # idiom (kernels take `nom_node=None` to drop whole phases)
+            if (
+                len(node.ops) == 1
+                and isinstance(node.ops[0], (ast.Is, ast.IsNot))
+                and any(
+                    isinstance(side, ast.Constant) and side.value is None
+                    for side in (node.left, node.comparators[0])
+                )
+            ):
+                return True
+            return self._static(f, base, node.left, env) and all(
+                self._static(f, base, c, env) for c in node.comparators
+            )
+        if isinstance(node, ast.IfExp):
+            return (
+                self._static(f, base, node.test, env)
+                and self._static(f, base, node.body, env)
+                and self._static(f, base, node.orelse, env)
+            )
+        if isinstance(node, ast.Slice):
+            return all(
+                self._static(f, base, p, env)
+                for p in (node.lower, node.upper, node.step)
+                if p is not None
+            )
+        if isinstance(node, ast.Starred):
+            return self._static(f, base, node.value, env)
+        if isinstance(node, ast.Call):
+            return self._static_call(f, base, node, env)
+        if isinstance(node, ast.JoinedStr):
+            return True
+        # conservative fallback: traced if any referenced name is traced
+        return not any(
+            isinstance(n, ast.Name) and not env.get(n.id, True)
+            for n in ast.walk(node)
+        )
+
+    def _static_call(
+        self, f: _FuncInfo, base: str, node: ast.Call, env: Dict[str, bool]
+    ) -> bool:
+        func = node.func
+        args = list(node.args) + [kw.value for kw in node.keywords]
+        args_static = all(self._static(f, base, a, env) for a in args)
+        if isinstance(func, ast.Name):
+            if func.id in LEN_LIKE and func.id not in env:
+                return True  # len() of a tracer is its static leading dim
+            if func.id in ("range", "enumerate", "zip", "min", "max", "abs",
+                           "sum", "sorted", "reversed", "tuple", "list",
+                           "set", "dict", "repr", "str") and func.id not in env:
+                return args_static
+            if func.id in CAST_BUILTINS and func.id not in env:
+                return args_static
+        key = self._resolve_call(base, func)
+        if key is not None:
+            # intra-package helper: static result iff static inputs
+            return args_static
+        if isinstance(func, ast.Attribute):
+            dn = dotted_name(func)
+            if dn is not None:
+                root = dn.split(".")[0]
+                if root in self.np_roots.get(base, ()):
+                    return args_static  # np on static data stays host/static
+                if root in env and not env[root]:
+                    return False  # method on a traced object
+                if root in env and env[root]:
+                    return args_static
+                # module global (jnp/jax/…): traced iff any traced arg
+                return args_static
+            return args_static and self._static(f, base, func, env)
+        return args_static

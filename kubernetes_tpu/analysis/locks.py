@@ -1,0 +1,350 @@
+"""Lock-discipline checker (rule: ``lock-discipline``).
+
+The concurrency-bearing modules declare a ``_KTPU_GUARDED`` literal that
+registers which fields are guarded by which lock:
+
+    _KTPU_GUARDED = {
+        "Scheduler": {
+            "lock": "_mu",
+            "guards": {"cache": "Cache", "queue": "SchedulingQueue", ...},
+            "requires_lock": ["_view_pod_added", ...],
+        },
+        "Cache": {
+            "external_lock": "Scheduler._mu",
+            "readonly": ["is_assumed", "real_nodes", ...],
+        },
+    }
+
+Enforced invariants:
+
+  * a MUTATION routed through a guarded field (attribute/subscript
+    assignment, augmented assignment, delete, or a call to any method not
+    registered read-only) must happen inside a ``with <lock>`` block, or
+    inside a method whose callers are verified to hold the lock — a
+    ``*_under_lock``/``*_locked`` method or one listed in
+    ``requires_lock``;
+  * every intra-package call site of such a lock-expecting method must
+    itself be in a lock-held context (the call-graph walk — transitively,
+    since lock-expecting callers are only accepted when all THEIR callers
+    verify);
+  * methods of a class registered with ``external_lock`` are contractually
+    entered with that lock held (their bodies are exempt); calls INTO them
+    from other code follow the mutating-vs-readonly rules above.
+
+Simple aliases are tracked per function: ``done = self.queue.done`` makes
+a later ``done(uid)`` a guarded call, and ``cn = self.cache.nodes.get(x)``
+taints ``cn`` so ``cn.node = ...`` needs the lock.
+
+Reads are deliberately NOT flagged: the codebase's snapshot/epoch
+machinery does racy reads by design (generation watermarks); it is the
+writes that corrupt.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from kubernetes_tpu.analysis.core import (
+    RULE_LOCK,
+    Checker,
+    SourceModule,
+    dotted_name,
+    module_literal,
+)
+
+REGISTRY_NAME = "_KTPU_GUARDED"
+
+# method names safe on ANY guarded object without the lock (builtin
+# container accessors and pure introspection)
+GENERIC_READONLY = {
+    "get",
+    "keys",
+    "values",
+    "items",
+    "copy",
+    "index",
+    "count",
+    "stats",
+}
+
+LOCK_SUFFIXES = ("_under_lock", "_locked")
+
+
+def _is_lock_expecting(name: str, requires: Set[str]) -> bool:
+    return name.endswith(LOCK_SUFFIXES) or name in requires
+
+
+class _ClassSpec:
+    def __init__(self, name: str, spec: dict):
+        self.name = name
+        self.lock: Optional[str] = spec.get("lock")
+        self.external_lock: Optional[str] = spec.get("external_lock")
+        self.guards: Dict[str, Optional[str]] = dict(spec.get("guards", {}))
+        self.requires_lock: Set[str] = set(spec.get("requires_lock", ()))
+        self.readonly: Set[str] = set(spec.get("readonly", ()))
+
+
+class LockChecker(Checker):
+    rule = RULE_LOCK
+
+    def __init__(self) -> None:
+        super().__init__()
+        # externally-guarded class name → readonly method set
+        self._ext_readonly: Dict[str, Set[str]] = {}
+        # guarded field name → guarded class name (or None for plain)
+        self._field_class: Dict[str, Optional[str]] = {}
+        self._requires: Set[str] = set()
+        # (mod, funcname-qual, line) of unverified lock-expecting callsites
+        self._lock_names: Set[str] = set()
+
+    # ----- entry point ------------------------------------------------------
+
+    def run(self, mods: List[SourceModule]) -> None:
+        specs: List[Tuple[SourceModule, _ClassSpec]] = []
+        for mod in mods:
+            reg = module_literal(mod.tree, REGISTRY_NAME)
+            if not isinstance(reg, dict):
+                continue
+            for cls_name, spec in reg.items():
+                if isinstance(spec, dict):
+                    specs.append((mod, _ClassSpec(cls_name, spec)))
+        for _, spec in specs:
+            if spec.external_lock is not None:
+                self._ext_readonly[spec.name] = spec.readonly
+            for f, cls in spec.guards.items():
+                self._field_class[f] = cls
+            self._requires |= spec.requires_lock
+            if spec.lock:
+                self._lock_names.add(spec.lock)
+        if not self._lock_names:
+            self._lock_names = {"_mu"}
+
+        # map guarded class name → its registered readonly set (guards may
+        # point at externally-guarded classes declared in ANOTHER module)
+        for mod in mods:
+            self._check_module(mod)
+
+    # ----- per-module walk --------------------------------------------------
+
+    def _check_module(self, mod: SourceModule) -> None:
+        ext_classes = set(self._ext_readonly)
+        for node in mod.tree.body:
+            if isinstance(node, ast.ClassDef):
+                exempt = node.name in ext_classes
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        # __init__ runs before the object is published to
+                        # any other thread — the standard ctor exemption
+                        self._check_function(
+                            mod,
+                            item,
+                            exempt_body=exempt or item.name == "__init__",
+                        )
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._check_function(mod, node, exempt_body=False)
+
+    def _check_function(
+        self, mod: SourceModule, fn: ast.FunctionDef, exempt_body: bool
+    ) -> None:
+        held = exempt_body or _is_lock_expecting(fn.name, self._requires)
+        aliases: Dict[str, str] = {}  # local name → guarded field it taints
+        self._walk(mod, list(fn.body), held, aliases, exempt_body)
+
+    def _walk(
+        self,
+        mod: SourceModule,
+        stmts: List[ast.stmt],
+        held: bool,
+        aliases: Dict[str, str],
+        exempt: bool,
+    ) -> None:
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # a nested closure runs later, on another thread's schedule:
+                # the enclosing lock scope does NOT carry over
+                self._check_function(mod, st, exempt_body=exempt)
+                continue
+            if isinstance(st, ast.With):
+                if any(self._is_lock_acquire(item.context_expr) for item in st.items):
+                    self._walk(mod, list(st.body), True, aliases, exempt)
+                    continue
+                self._check_stmt_exprs(mod, st, held, aliases, exempt)
+                self._walk(mod, list(st.body), held, aliases, exempt)
+                continue
+            self._check_stmt_exprs(mod, st, held, aliases, exempt)
+            for attr in ("body", "orelse", "finalbody"):
+                sub = getattr(st, attr, None)
+                if sub:
+                    self._walk(mod, list(sub), held, aliases, exempt)
+            for handler in getattr(st, "handlers", ()) or ():
+                self._walk(mod, list(handler.body), held, aliases, exempt)
+
+    # ----- statement / expression checks ------------------------------------
+
+    def _check_stmt_exprs(
+        self,
+        mod: SourceModule,
+        st: ast.stmt,
+        held: bool,
+        aliases: Dict[str, str],
+        exempt: bool,
+    ) -> None:
+        # assignment targets
+        if isinstance(st, ast.Assign):
+            for t in st.targets:
+                self._check_target(mod, t, held, aliases, exempt)
+            self._track_alias(st, aliases)
+            self._check_expr_calls(mod, st.value, held, aliases, exempt)
+            return
+        if isinstance(st, ast.AugAssign):
+            self._check_target(mod, st.target, held, aliases, exempt)
+            self._check_expr_calls(mod, st.value, held, aliases, exempt)
+            return
+        if isinstance(st, ast.Delete):
+            for t in st.targets:
+                self._check_target(mod, t, held, aliases, exempt)
+            return
+        # everything else: scan only the statement's own expressions, not
+        # nested statement bodies (handled by _walk)
+        for child in ast.iter_child_nodes(st):
+            if isinstance(child, (ast.stmt, ast.excepthandler)):
+                continue
+            self._check_expr_calls(mod, child, held, aliases, exempt)
+
+    def _check_target(
+        self,
+        mod: SourceModule,
+        target: ast.expr,
+        held: bool,
+        aliases: Dict[str, str],
+        exempt: bool,
+    ) -> None:
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                self._check_target(mod, el, held, aliases, exempt)
+            return
+        if isinstance(target, ast.Name):
+            return  # plain local rebind is never a guarded mutation
+        base = target
+        while isinstance(base, ast.Subscript):
+            base = base.value
+        field = self._guarded_field_of(base, aliases)
+        if field is not None and not held and not exempt:
+            self.emit(
+                mod,
+                target.lineno,
+                f"mutation of lock-guarded state through {field!r} outside "
+                f"the guarding lock",
+            )
+
+    def _check_expr_calls(
+        self,
+        mod: SourceModule,
+        expr: ast.expr,
+        held: bool,
+        aliases: Dict[str, str],
+        exempt: bool,
+    ) -> None:
+        for node in ast.walk(expr):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            method: Optional[str] = None
+            field: Optional[str] = None
+            if isinstance(func, ast.Attribute):
+                method = func.attr
+                field = self._guarded_field_of(func.value, aliases)
+            elif isinstance(func, ast.Name):
+                method = func.id
+                if func.id in aliases:
+                    # alias of a bound method of a guarded object
+                    field = aliases[func.id]
+            if method is None:
+                continue
+            # (a) mutating call on guarded state
+            if field is not None and not held and not exempt:
+                if not self._is_readonly(field, method):
+                    self.emit(
+                        mod,
+                        node.lineno,
+                        f"call to mutating method {method!r} on lock-guarded "
+                        f"{field!r} outside the guarding lock",
+                    )
+            # (b) call-graph verification of lock-expecting functions
+            if (
+                _is_lock_expecting(method, self._requires)
+                and not held
+                and not exempt
+            ):
+                self.emit(
+                    mod,
+                    node.lineno,
+                    f"call to {method!r} (contract: lock already held) from "
+                    f"a context not verified to hold the lock",
+                )
+
+    # ----- helpers ----------------------------------------------------------
+
+    def _track_alias(self, st: ast.Assign, aliases: Dict[str, str]) -> None:
+        if len(st.targets) != 1 or not isinstance(st.targets[0], ast.Name):
+            return
+        name = st.targets[0].id
+        value = st.value
+        # method/object alias: local = <chain through a guarded field>
+        src = value
+        if isinstance(src, ast.Call):
+            src = src.func
+            # a call RESULT taints only when routed through a guarded field
+            # via a readonly accessor (e.g. nodes.get) — anything else
+            # returns fresh data
+        field = self._guarded_field_of(src, aliases)
+        if field is not None:
+            aliases[name] = field
+        elif name in aliases:
+            del aliases[name]  # rebound to something unguarded
+
+    def _guarded_field_of(
+        self, node: ast.expr, aliases: Dict[str, str]
+    ) -> Optional[str]:
+        """The guarded field a Name/Attribute chain routes through, if any.
+
+        ``self.cache.nodes`` → 'cache'; ``self._s.queue`` → 'queue'; a Name
+        that aliases guarded state resolves through the alias table.
+        """
+        dn = dotted_name(node)
+        if dn is None:
+            # chains through subscripts/calls: peel and retry on the value
+            while isinstance(node, (ast.Subscript, ast.Call)):
+                node = node.value if isinstance(node, ast.Subscript) else node.func
+            dn = dotted_name(node)
+            if dn is None:
+                return None
+        parts = dn.split(".")
+        root = parts[0]
+        if root in aliases:
+            return aliases[root]
+        # the ROOT name only matches through the alias table — a bare local
+        # that happens to be called `cache` (memo dicts, loop locals) is not
+        # the scheduler's cache; guarded fields are reached as ATTRIBUTES
+        # (self.cache…, self._s.queue…)
+        for comp in parts[1:]:
+            if comp in self._field_class:
+                return comp
+        return None
+
+    def _is_readonly(self, field: str, method: str) -> bool:
+        if method in GENERIC_READONLY:
+            return True
+        cls = self._field_class.get(field)
+        if cls is not None and method in self._ext_readonly.get(cls, ()):
+            return True
+        return False
+
+    def _is_lock_acquire(self, expr: ast.expr) -> bool:
+        dn = dotted_name(expr)
+        if dn is None:
+            return False
+        return dn.split(".")[-1] in self._lock_names

@@ -1,0 +1,344 @@
+"""Plugin-purity checker (rule: ``plugin-purity``).
+
+A plugin declaring ``pre_filter_spec_pure = True`` promises the fast path
+that, for a signature-gated pod, its ``pre_filter`` verdict is a pure
+function of the pod SPEC — the per-signature PreFilter grouping replays
+one representative's verdict for every pod of the signature, so anything
+the spec path reads beyond the pod (handle caches, CycleState, plugin
+fields that mutate) or writes anywhere silently diverges per pod.
+
+The SPEC PATH is the statement prefix a gated pod executes: top-level
+statements up to and including the first *gate* — an ``if`` whose
+condition is spec-derived and whose body unconditionally returns a
+Status. Code after the gate only runs for non-gated pods (the plugin is
+relevant; the per-pod walk applies) and is exempt.  A ``pre_filter``
+with no gate is entirely spec path.
+
+Checked on the spec path:
+
+  * conditions and assigned expressions must be SPEC-DERIVED: built only
+    from ``pod`` (attribute reads and method calls on it are assumed
+    pure), locals already proven spec-derived, constants, and a small
+    pure-builtin allowlist — reading ``state``, ``self.handle``, any
+    global lister, clocks or RNGs is a finding;
+  * no writes: assignments/deletes targeting attributes or subscripts of
+    anything non-local (``state``, ``self``, handle caches) are findings,
+    as are calls to known-mutating APIs (``state.write``, ``.pop``,
+    ``.setdefault`` …) on non-spec objects.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from kubernetes_tpu.analysis.core import (
+    RULE_PURITY,
+    Checker,
+    SourceModule,
+    dotted_name,
+)
+
+PURITY_FLAG = "pre_filter_spec_pure"
+
+# names a spec-path expression may reference besides `pod` and locals
+PURE_GLOBALS = {
+    "Status",
+    "len",
+    "bool",
+    "int",
+    "float",
+    "str",
+    "set",
+    "frozenset",
+    "tuple",
+    "list",
+    "dict",
+    "any",
+    "all",
+    "isinstance",
+    "getattr",
+    "min",
+    "max",
+    "sorted",
+    "None",
+    "True",
+    "False",
+}
+
+# reads of self.<attr> are allowed (class constants like `name`), but
+# CALLS routed through these roots are impure on the spec path
+IMPURE_ROOTS = {"state", "self", "handle"}
+
+
+def _flag_declared_true(cls: ast.ClassDef) -> bool:
+    for st in cls.body:
+        if isinstance(st, ast.Assign):
+            for t in st.targets:
+                if isinstance(t, ast.Name) and t.id == PURITY_FLAG:
+                    return isinstance(st.value, ast.Constant) and st.value.value is True
+    return False
+
+
+class PurityChecker(Checker):
+    rule = RULE_PURITY
+
+    def run(self, mods: List[SourceModule]) -> None:
+        for mod in mods:
+            for node in mod.tree.body:
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                if not _flag_declared_true(node):
+                    continue
+                pf = next(
+                    (
+                        st
+                        for st in node.body
+                        if isinstance(st, ast.FunctionDef)
+                        and st.name == "pre_filter"
+                    ),
+                    None,
+                )
+                if pf is None:
+                    continue  # inherits the base no-op — nothing to check
+                self._check_pre_filter(mod, node.name, pf)
+
+    # ----- spec-path walk ---------------------------------------------------
+
+    def _check_pre_filter(
+        self, mod: SourceModule, cls_name: str, fn: ast.FunctionDef
+    ) -> None:
+        args = [a.arg for a in fn.args.args]
+        pod_name = args[2] if len(args) >= 3 else "pod"
+        spec_locals: Set[str] = {pod_name}
+
+        for st in fn.body:
+            if self._is_gate(st, spec_locals):
+                # the gate's own condition and returned Status must be pure
+                self._check_expr(mod, cls_name, st.test, spec_locals)
+                for sub in st.body:
+                    if isinstance(sub, ast.Return) and sub.value is not None:
+                        self._check_return_value(mod, cls_name, sub.value, spec_locals)
+                return  # statements past the gate are off the spec path
+            self._check_stmt(mod, cls_name, st, spec_locals)
+
+    def _is_gate(self, st: ast.stmt, spec_locals: Set[str]) -> bool:
+        """A spec-derived ``if`` whose body unconditionally returns."""
+        if not isinstance(st, ast.If) or st.orelse:
+            return False
+        if not st.body or not isinstance(st.body[-1], ast.Return):
+            return False
+        if not all(isinstance(s, (ast.Return, ast.Expr)) for s in st.body):
+            return False
+        return self._is_spec_expr(st.test, spec_locals)
+
+    def _check_stmt(
+        self,
+        mod: SourceModule,
+        cls_name: str,
+        st: ast.stmt,
+        spec_locals: Set[str],
+    ) -> None:
+        if isinstance(st, ast.Assign):
+            for t in st.targets:
+                self._check_write_target(mod, cls_name, t)
+            self._check_expr(mod, cls_name, st.value, spec_locals)
+            # a local assigned a spec-derived expression joins the set
+            if (
+                len(st.targets) == 1
+                and isinstance(st.targets[0], ast.Name)
+                and self._is_spec_expr(st.value, spec_locals)
+            ):
+                spec_locals.add(st.targets[0].id)
+            return
+        if isinstance(st, ast.AugAssign):
+            self._check_write_target(mod, cls_name, st.target)
+            self._check_expr(mod, cls_name, st.value, spec_locals)
+            return
+        if isinstance(st, ast.Return):
+            if st.value is not None:
+                self._check_return_value(mod, cls_name, st.value, spec_locals)
+            return
+        if isinstance(st, ast.If):
+            # non-gate conditional: both arms stay on the spec path
+            self._check_expr(mod, cls_name, st.test, spec_locals)
+            for sub in st.body + st.orelse:
+                self._check_stmt(mod, cls_name, sub, spec_locals)
+            return
+        if isinstance(st, (ast.For, ast.While)):
+            if isinstance(st, ast.For):
+                self._check_expr(mod, cls_name, st.iter, spec_locals)
+                if isinstance(st.target, ast.Name):
+                    spec_locals.add(st.target.id)
+            else:
+                self._check_expr(mod, cls_name, st.test, spec_locals)
+            for sub in st.body + st.orelse:
+                self._check_stmt(mod, cls_name, sub, spec_locals)
+            return
+        if isinstance(st, ast.Expr):
+            self._check_expr(mod, cls_name, st.value, spec_locals)
+            return
+        if isinstance(st, (ast.Pass, ast.Import, ast.ImportFrom)):
+            return
+        # anything structurally unusual on the spec path (try/with/del/
+        # global …) is outside the purity contract's shape
+        self.emit(
+            mod,
+            st.lineno,
+            f"{cls_name}.pre_filter: {type(st).__name__} statement on the "
+            f"spec path of a pre_filter_spec_pure plugin",
+        )
+
+    # ----- expression checks ------------------------------------------------
+
+    def _check_write_target(
+        self, mod: SourceModule, cls_name: str, target: ast.expr
+    ) -> None:
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                self._check_write_target(mod, cls_name, el)
+            return
+        if isinstance(target, ast.Name):
+            return  # plain local
+        self.emit(
+            mod,
+            target.lineno,
+            f"{cls_name}.pre_filter: write to non-local state "
+            f"({ast.unparse(target)}) on the spec path",
+        )
+
+    def _check_return_value(
+        self, mod: SourceModule, cls_name: str, value: ast.expr, spec_locals: Set[str]
+    ) -> None:
+        # Status.<ctor>(...) with spec-derived args, a bare constant, or a
+        # spec-derived expression
+        if isinstance(value, ast.Call):
+            dn = dotted_name(value.func)
+            if dn is not None and dn.split(".")[0] == "Status":
+                for a in value.args:
+                    self._check_expr(mod, cls_name, a, spec_locals)
+                for kw in value.keywords:
+                    self._check_expr(mod, cls_name, kw.value, spec_locals)
+                return
+        self._check_expr(mod, cls_name, value, spec_locals)
+
+    @staticmethod
+    def _comp_targets(expr: ast.expr) -> Set[str]:
+        """Comprehension-bound names inside the expression — scoped to it,
+        and spec-derived whenever their iterables pass the checks."""
+        out: Set[str] = set()
+        for node in ast.walk(expr):
+            if isinstance(
+                node, (ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)
+            ):
+                for gen in node.generators:
+                    for t in ast.walk(gen.target):
+                        if isinstance(t, ast.Name):
+                            out.add(t.id)
+        return out
+
+    @staticmethod
+    def _is_constant_name(name: str) -> bool:
+        """Module-level constants by convention (ALL_CAPS) are immutable
+        trace-through reads, not hidden state."""
+        return name.isupper() or (
+            name.startswith("_") and name[1:].isupper() and len(name) > 1
+        )
+
+    def _check_expr(
+        self, mod: SourceModule, cls_name: str, expr: ast.expr, spec_locals: Set[str]
+    ) -> None:
+        spec_locals = spec_locals | self._comp_targets(expr)
+        reported: Set[int] = set()  # Attribute ids already covered by a call
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                dn = dotted_name(node.func)
+                if dn is not None:
+                    root = dn.split(".")[0]
+                    if root in IMPURE_ROOTS or (
+                        len(dn.split(".")) > 1
+                        and root not in spec_locals
+                        and root not in PURE_GLOBALS
+                        and root != "Status"
+                    ):
+                        self.emit(
+                            mod,
+                            node.lineno,
+                            f"{cls_name}.pre_filter: impure call "
+                            f"{dn}(...) on the spec path",
+                        )
+                        sub = node.func
+                        while isinstance(sub, ast.Attribute):
+                            reported.add(id(sub))
+                            sub = sub.value
+            elif isinstance(node, ast.Attribute) and isinstance(node.ctx, ast.Load):
+                # plain reads through self/state/handle are hidden state
+                # too: `if self.disabled: …` diverges per pod exactly like
+                # a call would.  Class constants (self.name, self._STATE_
+                # KEY-style ALL_CAPS) are the allowed exceptions.
+                if id(node) in reported:
+                    continue
+                dn = dotted_name(node)
+                if dn is None:
+                    continue
+                parts = dn.split(".")
+                if parts[0] not in IMPURE_ROOTS:
+                    continue
+                if (
+                    parts[0] == "self"
+                    and len(parts) == 2
+                    and (parts[1] == "name" or self._is_constant_name(parts[1]))
+                ):
+                    continue
+                self.emit(
+                    mod,
+                    node.lineno,
+                    f"{cls_name}.pre_filter: read of mutable state "
+                    f"{dn} on the spec path",
+                )
+                sub = node.value
+                while isinstance(sub, ast.Attribute):
+                    reported.add(id(sub))
+                    sub = sub.value
+            elif isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                if (
+                    node.id not in spec_locals
+                    and node.id not in PURE_GLOBALS
+                    and node.id not in IMPURE_ROOTS  # reported at the call
+                    and not self._is_constant_name(node.id)
+                ):
+                    # a bare read of `self`/`state` attribute is allowed only
+                    # through Attribute nodes; bare foreign names are reads
+                    # of globals/closures — not spec-derived
+                    self.emit(
+                        mod,
+                        node.lineno,
+                        f"{cls_name}.pre_filter: read of non-spec name "
+                        f"{node.id!r} on the spec path",
+                    )
+
+    # ----- spec-derived test ------------------------------------------------
+
+    def _is_spec_expr(self, expr: ast.expr, spec_locals: Set[str]) -> bool:
+        """True when every leaf name is `pod`/spec-derived/pure-builtin and
+        no call routes through an impure root."""
+        spec_locals = spec_locals | self._comp_targets(expr)
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Name):
+                if (
+                    node.id in spec_locals
+                    or node.id in PURE_GLOBALS
+                    or self._is_constant_name(node.id)
+                ):
+                    continue
+                return False
+            if isinstance(node, ast.Call):
+                dn = dotted_name(node.func)
+                if dn is None:
+                    return False
+                root = dn.split(".")[0]
+                if root in IMPURE_ROOTS:
+                    return False
+            if isinstance(node, (ast.Await, ast.Yield, ast.YieldFrom, ast.NamedExpr)):
+                return False
+        return True

@@ -1,0 +1,141 @@
+"""Runtime sanitizer mode (``KTPU_SANITIZE=1``).
+
+The static checkers prove lock discipline for the code as written; the
+sanitizer catches what statics can't — a caller reached through a path
+the call-graph walk under-approximated, or cache↔mirror drift from a
+delta-protocol bug.  It is a debug mode: every probe is a no-op unless
+``KTPU_SANITIZE`` is set to a non-empty, non-"0" value, so production
+drains pay one cached env lookup per process, not per call.
+
+Violations raise ``AssertionError`` at the corrupting site AND bump both
+the module counter (``violation_count()``, monotonic per process) and the
+``scheduler_tpu_sanitizer_violations_total`` Prometheus counter of every
+registered ``SchedulerMetrics`` — the raise can be swallowed by broad
+``except`` layers above, the counter cannot.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import weakref
+from typing import Optional
+
+_enabled_memo: Optional[bool] = None
+_violations = 0
+_violation_lock = threading.Lock()
+# registered metrics Counters — weakly held, so a dead Scheduler's metrics
+# registry is collectable even in long sanitize-mode processes (bench runs
+# construct one Scheduler per config)
+_counters: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def enabled() -> bool:
+    global _enabled_memo
+    if _enabled_memo is None:
+        _enabled_memo = os.environ.get("KTPU_SANITIZE", "") not in ("", "0")
+    return _enabled_memo
+
+
+def reset_enabled_memo() -> None:
+    """Re-read KTPU_SANITIZE (tests toggle it per-case)."""
+    global _enabled_memo
+    _enabled_memo = None
+
+
+def register_counter(counter) -> None:
+    """Wire a metrics Counter (scheduler_tpu_sanitizer_violations_total);
+    idempotent per counter instance, weakly held."""
+    if counter is not None:
+        _counters.add(counter)
+
+
+def violation_count() -> int:
+    return _violations
+
+
+def _record(kind: str) -> None:
+    global _violations
+    with _violation_lock:
+        _violations += 1
+    for c in list(_counters):
+        try:
+            c.inc(kind=kind)
+        except Exception:  # noqa: BLE001 — accounting must never mask the raise
+            pass
+
+
+def violation(kind: str, message: str) -> None:
+    _record(kind)
+    raise AssertionError(f"ktpu-sanitize[{kind}]: {message}")
+
+
+def assert_owned(lock, what: str = "guarded state") -> None:
+    """Assert the calling thread owns ``lock`` (RLock ownership probe).
+
+    ``lock`` may be None (e.g. a Cache used standalone in unit tests with
+    no scheduler attached) — then there is no discipline to enforce.
+    """
+    if lock is None or not enabled():
+        return
+    is_owned = getattr(lock, "_is_owned", None)
+    if is_owned is None:
+        return  # non-RLock stand-in (tests may inject mocks)
+    if not is_owned():
+        violation(
+            "lock",
+            f"{what} mutated without holding the guarding lock "
+            f"(thread {threading.current_thread().name})",
+        )
+
+
+def check_mirror_consistency(cache, mirror) -> None:
+    """Snapshot↔mirror drift probe, run after each drain.
+
+    Verifies the per-node usage rows the device kernels read (requested /
+    nonzero_req / num_pods) against a fresh recomputation from the
+    authoritative cache.  Only meaningful when the mirror has packed at
+    least once and its watermark covers the cache (callers run it right
+    after a drain's final repack); nodes added after the last pack are
+    skipped rather than misreported.
+    """
+    if not enabled():
+        return
+    nt = mirror.nodes
+    if nt is None:
+        return
+    import numpy as np
+
+    from kubernetes_tpu.snapshot.schema import MEM_UNIT, ResourceLanes
+
+    lanes = ResourceLanes(mirror.vocab)
+    R = nt.allocatable.shape[1]
+    for cn in cache.real_nodes():
+        idx = nt.name_to_idx.get(cn.node.name)
+        if idx is None or cn.generation > mirror.generation:
+            continue  # not packed yet / legitimately newer than the mirror
+        want_req = np.asarray(lanes.request_row(cn.requested, R))
+        got_req = np.asarray(nt.requested[idx])
+        if not np.array_equal(want_req, got_req):
+            violation(
+                "mirror",
+                f"node {cn.node.name!r} requested row drifted: "
+                f"cache={want_req.tolist()} mirror={got_req.tolist()}",
+            )
+        want_nz = (
+            cn.non_zero_requested.milli_cpu,
+            -(-cn.non_zero_requested.memory // MEM_UNIT),
+        )
+        got_nz = (int(nt.nonzero_req[idx, 0]), int(nt.nonzero_req[idx, 1]))
+        if want_nz != got_nz:
+            violation(
+                "mirror",
+                f"node {cn.node.name!r} nonzero_req drifted: "
+                f"cache={want_nz} mirror={got_nz}",
+            )
+        if int(nt.num_pods[idx]) != len(cn.pods):
+            violation(
+                "mirror",
+                f"node {cn.node.name!r} num_pods drifted: "
+                f"cache={len(cn.pods)} mirror={int(nt.num_pods[idx])}",
+            )

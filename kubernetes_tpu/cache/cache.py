@@ -24,8 +24,22 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
+from kubernetes_tpu.analysis import sanitizer
 from kubernetes_tpu.api.resource import Resource
 from kubernetes_tpu.api.types import Node, Pod
+
+# Lock-discipline registry (kubernetes_tpu.analysis): Cache has no lock of
+# its own — every mutating method is contractually entered with the owning
+# Scheduler's _mu held (cache.mu in the reference lives inside the cache;
+# here the scheduler's one lock covers cache+queue+mirror so commit tails
+# settle under a single acquisition).  Methods listed read-only are safe to
+# call without the lock.
+_KTPU_GUARDED = {
+    "Cache": {
+        "external_lock": "Scheduler._mu",
+        "readonly": ["is_assumed", "real_nodes", "placed_pods", "stats", "_pod_flags"],
+    },
+}
 
 _generation = itertools.count(1)
 
@@ -178,6 +192,13 @@ class Cache:
         the assumed pod copy, or an error STRING for pods that violated
         the protocol (already assumed/added) — those are not assumed,
         exactly like the per-pod path's CacheError."""
+        # KTPU_SANITIZE probe: memoized enabled() check + getattr, once per
+        # bulk dispatch (not per pod).  The owning scheduler stamps
+        # _ktpu_lock at construction when the sanitizer is on; a standalone
+        # Cache has no discipline to enforce.
+        sanitizer.assert_owned(
+            getattr(self, "_ktpu_lock", None), "cache.assume_pods_bulk"
+        )
         out: List[object] = []
         pod_states = self.pod_states
         nodes = self.nodes

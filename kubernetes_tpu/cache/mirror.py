@@ -38,6 +38,17 @@ from kubernetes_tpu.snapshot.schema import (
 )
 
 
+# Lock-discipline registry (kubernetes_tpu.analysis): the mirror is
+# externally guarded by the owning Scheduler's _mu — update()/apply_fast_
+# usage() and even the lazy `existing` property REBUILD tensors in place.
+_KTPU_GUARDED = {
+    "SnapshotMirror": {
+        "external_lock": "Scheduler._mu",
+        "readonly": ["stats"],
+    },
+}
+
+
 class SnapshotMirror:
     def __init__(self, vocab: Optional[Vocab] = None):
         self.vocab = vocab or Vocab()

@@ -43,7 +43,8 @@ class DynamicResources(
 ):
     name = "DynamicResources"
     # for claim-less/PVC-less (fast-gated) pods pre_filter is a spec-only
-    # Skip — safe for per-signature grouping
+    # Skip — safe for per-signature grouping (enforced: kubernetes_tpu.
+    # analysis plugin-purity checks the spec path stays handle/state-free)
     pre_filter_spec_pure = True
     _STATE_KEY = "DynamicResources"
 

@@ -170,6 +170,7 @@ class NodeAffinity(
     name = "NodeAffinity"
     kernel = "NodeAffinity"
     # spec-only pre_filter: safe for per-signature grouping on the fast path
+    # (enforced: kubernetes_tpu.analysis plugin-purity checks the spec path)
     pre_filter_spec_pure = True
 
     def pre_filter(self, state, pod) -> Status:

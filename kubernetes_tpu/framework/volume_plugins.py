@@ -56,7 +56,8 @@ class VolumeZone(PreFilterPlugin, FilterPlugin, EnqueueExtensions):
 
     name = "VolumeZone"
     # for claim-less/PVC-less (fast-gated) pods pre_filter is a spec-only
-    # Skip — safe for per-signature grouping
+    # Skip — safe for per-signature grouping (enforced: kubernetes_tpu.
+    # analysis plugin-purity checks the spec path stays handle/state-free)
     pre_filter_spec_pure = True
     _STATE_KEY = "VolumeZone"
 
@@ -140,7 +141,8 @@ class VolumeRestrictions(PreFilterPlugin, FilterPlugin, EnqueueExtensions):
 
     name = "VolumeRestrictions"
     # for claim-less/PVC-less (fast-gated) pods pre_filter is a spec-only
-    # Skip — safe for per-signature grouping
+    # Skip — safe for per-signature grouping (enforced: kubernetes_tpu.
+    # analysis plugin-purity checks the spec path stays handle/state-free)
     pre_filter_spec_pure = True
     _STATE_KEY = "VolumeRestrictions"
 
@@ -153,6 +155,12 @@ class VolumeRestrictions(PreFilterPlugin, FilterPlugin, EnqueueExtensions):
         needs_check = any(
             v.source_kind in _SINGLE_ATTACH_KINDS for v in pod.volumes
         )
+        if not needs_check and not pod.pvc_names():
+            # spec-only gate FIRST: a fast-gated (PVC-less, no single-attach
+            # volume) pod must Skip without touching the pvc_cache — the
+            # per-signature PreFilter grouping replays this verdict for
+            # every pod of the signature (pre_filter_spec_pure contract)
+            return Status.skip()
         rwop: Set[str] = set()
         for name in pod.pvc_names():
             pvc = self.handle.pvc_cache.get(f"{pod.namespace}/{name}")
@@ -230,7 +238,8 @@ class NodeVolumeLimits(PreFilterPlugin, FilterPlugin, EnqueueExtensions):
 
     name = "NodeVolumeLimits"
     # for claim-less/PVC-less (fast-gated) pods pre_filter is a spec-only
-    # Skip — safe for per-signature grouping
+    # Skip — safe for per-signature grouping (enforced: kubernetes_tpu.
+    # analysis plugin-purity checks the spec path stays handle/state-free)
     pre_filter_spec_pure = True
 
     def maybe_relevant(self, pod: Pod) -> bool:

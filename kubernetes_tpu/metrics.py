@@ -38,6 +38,11 @@ class Metric:
         self.name = name
         self.help = help_
         self.label_names = tuple(label_names)
+        # binding workers record series concurrently with the scheduling
+        # loop; the read-modify-write below (dict get + add) loses updates
+        # without it.  Frequency is per batch/slice, not per pod, so the
+        # uncontended acquire is noise next to the observed phases.
+        self._mu = threading.Lock()
 
     def expose(self) -> List[str]:
         raise NotImplementedError
@@ -55,7 +60,8 @@ class Counter(Metric):
 
     def inc(self, amount: float = 1.0, **labels) -> None:
         k = self._key(labels)
-        self._values[k] = self._values.get(k, 0.0) + amount
+        with self._mu:
+            self._values[k] = self._values.get(k, 0.0) + amount
 
     def value(self, **labels) -> float:
         return self._values.get(self._key(labels), 0.0)
@@ -79,7 +85,8 @@ class Gauge(Metric):
 
     def inc(self, amount: float = 1.0, **labels) -> None:
         k = self._key(labels)
-        self._values[k] = self._values.get(k, 0.0) + amount
+        with self._mu:
+            self._values[k] = self._values.get(k, 0.0) + amount
 
     def value(self, **labels) -> float:
         return self._values.get(self._key(labels), 0.0)
@@ -116,14 +123,15 @@ class Histogram(Metric):
         if n <= 0:
             return
         k = self._key(labels)
-        counts = self._counts.get(k)
-        if counts is None:
-            counts = self._counts[k] = [0] * (len(self.buckets) + 1)
-            self._sum[k] = 0.0
-            self._n[k] = 0
-        counts[bisect.bisect_left(self.buckets, value)] += n
-        self._sum[k] += value * n
-        self._n[k] += n
+        with self._mu:
+            counts = self._counts.get(k)
+            if counts is None:
+                counts = self._counts[k] = [0] * (len(self.buckets) + 1)
+                self._sum[k] = 0.0
+                self._n[k] = 0
+            counts[bisect.bisect_left(self.buckets, value)] += n
+            self._sum[k] += value * n
+            self._n[k] += n
 
     def count(self, **labels) -> int:
         return self._n.get(self._key(labels), 0)
@@ -485,6 +493,14 @@ class SchedulerMetrics:
                 "Per-batch hot-loop time by phase "
                 "(queue_pop/pack/h2d/device/d2h/commit/bind).",
                 ("phase",),
+            )
+        )
+        self.sanitizer_violations = r.register(
+            Counter(
+                "scheduler_tpu_sanitizer_violations_total",
+                "Invariant violations detected by the KTPU_SANITIZE runtime "
+                "mode (kind: lock / mirror).",
+                ("kind",),
             )
         )
         self.recorder = MetricAsyncRecorder()

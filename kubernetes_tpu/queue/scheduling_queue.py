@@ -41,6 +41,27 @@ DEFAULT_POD_MAX_BACKOFF = 10.0
 DEFAULT_UNSCHEDULABLE_TIMEOUT = 5 * 60.0
 DEFAULT_UNSCHEDULABLE_FLUSH_INTERVAL = 30.0  # scheduling_queue.go:356
 
+# Lock-discipline registry (kubernetes_tpu.analysis): like the cache, the
+# queue trusts its caller's lock — the reference queue carries its own
+# mutex (scheduling_queue.go:146); here the Scheduler's _mu spans queue,
+# cache and mirror so a commit's queue.done + cache.finish_binding settle
+# atomically with respect to informer handlers.
+_KTPU_GUARDED = {
+    "SchedulingQueue": {
+        "external_lock": "Scheduler._mu",
+        "readonly": [
+            "pending_pods",
+            "stats",
+            "_find",
+            "_entry_live",
+            "_is_worth_requeuing",
+            "_backoff_expiry",
+            "_active_key",
+            "_default_less",
+        ],
+    },
+}
+
 _seq = itertools.count()
 
 

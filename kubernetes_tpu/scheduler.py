@@ -25,6 +25,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
+from kubernetes_tpu.analysis import sanitizer
 from kubernetes_tpu.api.types import Node, Pod
 from kubernetes_tpu.cache import Cache, SnapshotMirror
 from kubernetes_tpu.framework import config as cfg
@@ -48,6 +49,39 @@ from kubernetes_tpu.snapshot.interner import PAD
 from kubernetes_tpu.snapshot.schema import bucket_cap, pack_pod_batch
 
 logger = logging.getLogger(__name__)
+
+# Lock-discipline registry read by kubernetes_tpu.analysis (AST-only — the
+# analyzer literal-evals this without importing the module).  Fields listed
+# under "guards" may only be mutated while holding Scheduler._mu; methods in
+# "requires_lock" are entered with the lock already held (the analyzer
+# verifies every caller), same contract as the *_under_lock name suffix.
+_KTPU_GUARDED = {
+    "Scheduler": {
+        "lock": "_mu",
+        "guards": {
+            "cache": "Cache",
+            "queue": "SchedulingQueue",
+            "mirror": "SnapshotMirror",
+            "nominator": "Nominator",
+            "_external_mutations": None,
+            "_oracle_cache": None,
+            "_nonfast_commits": None,
+            "metrics": None,
+        },
+        "requires_lock": [
+            "_view_pod_added",
+            "_view_pod_removed",
+            "_invalidate_view",
+            "_is_confirmation",
+            "_repack_mirror",
+            "_sync_mirror_external",
+        ],
+    },
+    "Nominator": {
+        "external_lock": "Scheduler._mu",
+        "readonly": ["entries", "pods_for_node", "nominated_node"],
+    },
+}
 
 _MISSING = object()  # dict-miss sentinel (cached signature keys can be None)
 
@@ -264,6 +298,15 @@ class Scheduler:
         # every cache/queue mutation (informer handlers, commits, unwinds)
         # holds it; the device dispatch and bind RTTs run outside it.
         self._mu = threading.RLock()
+        # KTPU_SANITIZE=1: lock-ownership probes at the annotated mutation
+        # sites + the post-drain mirror-consistency check.  Captured once
+        # per scheduler so the per-POD commit probe is a plain attribute
+        # branch, not a function call, when the mode is off.
+        self._sanitize = sanitizer.enabled()
+        if self._sanitize:
+            # the cache carries a backref to the guarding lock so its own
+            # assert_owned works without knowing about the scheduler
+            self.cache._ktpu_lock = self._mu
         self._bind_pool: Optional[ThreadPoolExecutor] = None
         self._inflight_binds: List = []
         self._bind_buffer: List = []
@@ -371,6 +414,8 @@ class Scheduler:
         from kubernetes_tpu.metrics import PhaseAccumulator, SchedulerMetrics
 
         self.prom = SchedulerMetrics()
+        if self._sanitize:
+            sanitizer.register_counter(self.prom.sanitizer_violations)
         # Per-phase hot-loop attribution (queue_pop/pack/h2d/device/d2h/
         # commit/bind) — the scheduler_perf-style breakdown bench.py emits
         # as config0_phases.  Feeds the phase_duration histogram too.
@@ -820,6 +865,11 @@ class Scheduler:
         # requeued with backoff by now — they surface on a later drain,
         # exactly like the reference's retry flow.
         self.wait_for_bindings()
+        if self._sanitize:
+            # KTPU_SANITIZE drift probe: every usage row the mirror claims
+            # current must match a fresh recomputation from the cache
+            with self._mu:
+                sanitizer.check_mirror_consistency(self.cache, self.mirror)
         return outcomes
 
     def _rp_can_fail(self, fwk) -> bool:
@@ -1191,8 +1241,14 @@ class Scheduler:
             ]
             if failed:
                 self._batched_preemption_narrow(fwk, state, failed)
+        # one locked bump for the whole batch: `metrics` is a registered
+        # lock-guarded field (binding workers write other keys of it under
+        # _mu); uniform write discipline costs one acquisition per batch
+        # and stays correct if the interpreter ever drops the GIL's
+        # per-op dict atomicity
+        with self._mu:
+            self.metrics["schedule_attempts"] += len(batch)
         for i, qp in enumerate(batch):
-            self.metrics["schedule_attempts"] += 1
             idx = int(chosen[i])
             if idx < 0:
                 if counts is None:
@@ -1992,7 +2048,8 @@ class Scheduler:
             choices = holder["fc"].run(pod_sigs)
             self.phases.add("device", time.perf_counter() - t_dev)
             holder["dev"] = None  # device copy (if any) is now stale
-            self.metrics["fast_batches"] += 1
+            with self._mu:  # metrics is a registered lock-guarded field
+                self.metrics["fast_batches"] += 1
             return {
                 "kind": "fast",
                 "fwk": fwk,
@@ -2087,10 +2144,15 @@ class Scheduler:
             # the dropped lineage's commits live only in the CACHE; force
             # the next _sync_mirror_external to repack from it, or the
             # rebuilt committer would start from the drain-start mirror
-            # and double-book every node's capacity
-            self._external_mutations += 1
+            # and double-book every node's capacity.  Locked: an unlocked
+            # `+=` racing an informer handler's bump can LOSE one of the
+            # two — an epoch that silently never advances is exactly the
+            # stale-lineage reuse this counter exists to prevent.
+            with self._mu:
+                self._external_mutations += 1
             return None
-        self.metrics["fast_batches"] += 1
+        with self._mu:  # metrics is a registered lock-guarded field
+            self.metrics["fast_batches"] += 1
         return {
             "kind": "fast",
             "fwk": fwk,
@@ -2185,7 +2247,8 @@ class Scheduler:
         bulk_ok = lean and not has_rp
         keys = rec["keys"]
         n = len(batch)
-        self.metrics["schedule_attempts"] += n
+        with self._mu:  # metrics is a registered lock-guarded field
+            self.metrics["schedule_attempts"] += n
         t_commit = time.perf_counter()
         i = 0
         while i < n:
@@ -2198,8 +2261,7 @@ class Scheduler:
                     j += 1
                 if bulk_ok:
                     self._commit_fast_bulk(
-                        fwk, state, batch, choices, i, j, node_names,
-                        outcomes, pod_sigs,
+                        fwk, state, batch, choices, i, j, node_names, outcomes
                     )
                 else:
                     with self._mu:
@@ -2392,10 +2454,11 @@ class Scheduler:
             # PreFilter ran: error-requeue the whole batch with backoff —
             # the retry drains through whatever path is healthy then
             s = Status.error("fast-path device dispatch failed; requeued")
-            for qp in batch:
-                self.metrics["schedule_attempts"] += 1
-                self._handle_failure(qp, s)
-                outcomes.append(ScheduleOutcome(qp.pod, None, s, 0))
+            with self._mu:  # one acquisition for the whole error-requeue
+                self.metrics["schedule_attempts"] += len(batch)
+                for qp in batch:
+                    self._handle_failure(qp, s)
+                    outcomes.append(ScheduleOutcome(qp.pod, None, s, 0))
             return "handled"
         rec["record_metrics"] = True
         return rec
@@ -2577,7 +2640,8 @@ class Scheduler:
                 return [self._commit(fwk, state, qp, nom, 1)]
         # Nominated node no longer fits — full evaluation (the attempt
         # counter for the fallback cycle is bumped there, so compensate).
-        self.metrics["schedule_attempts"] -= 1
+        with self._mu:  # metrics is a registered lock-guarded field
+            self.metrics["schedule_attempts"] -= 1
         return self._schedule_one_extender(fwk, qp)
 
     def _schedule_one_extender(self, fwk, qp) -> List[ScheduleOutcome]:
@@ -3242,6 +3306,8 @@ class Scheduler:
         harvest commit a whole run of pods under ONE lock acquisition."""
         from kubernetes_tpu.cache.cache import CacheError
 
+        if self._sanitize:
+            sanitizer.assert_owned(self._mu, "_commit_under_lock")
         pod = qp.pod
         try:
             self.cache.assume_pod(pod, node_name)
@@ -3303,7 +3369,7 @@ class Scheduler:
         return outcome
 
     def _commit_fast_bulk(
-        self, fwk, state, batch, choices, i, j, node_names, outcomes, pod_sigs
+        self, fwk, state, batch, choices, i, j, node_names, outcomes
     ) -> None:
         """Commit batch[i:j] — a contiguous run of fast-scheduled, lean
         pods — as ONE vectorized pass: bulk assume into the cache (per-node
@@ -3316,24 +3382,29 @@ class Scheduler:
         non-default binder is configured — see _finish_fast's bulk_ok."""
         run = batch[i:j]
         names = [node_names[choices[k]] for k in range(i, j)]
-        # Seed the per-pod request memos from a per-SIGNATURE representative
-        # before the cache accounting reads them: pods of one signature have
-        # identical requests by construction, and the memoized Resources are
-        # read-only by contract, so sharing the representative's objects
-        # replaces two Resource builds per pod with two dict writes.
-        req_by_sig: Dict[int, tuple] = {}
+        # Seed the per-pod request memos from a representative keyed by RAW
+        # spec identity (fastpath.spec_key — the exact request strings)
+        # before the cache accounting reads them: template-stamped pods
+        # share one quantity parse, and the memoized Resources are
+        # read-only by contract.  Keying by Signature would be wrong here:
+        # signature rows QUANTIZE (ceil-MiB memory lanes), so byte-
+        # different pods can share a Signature, and stamping them with the
+        # representative's Resources would charge the cache the wrong
+        # values for the placement's whole lifetime.
+        from kubernetes_tpu import fastpath as fp
+
+        req_by_spec: Dict[object, tuple] = {}
         for k in range(i, j):
             pod = batch[k].pod
             d = pod.__dict__
             if "_nzreq_memo" in d:
                 continue
-            sid = id(pod_sigs[k])
-            rep = req_by_sig.get(sid)
+            sk = fp.spec_key(pod)
+            rep = req_by_spec.get(sk) if sk is not None else None
             if rep is None:
-                rep = req_by_sig[sid] = (
-                    pod.compute_requests(),
-                    pod.non_zero_requests(),
-                )
+                rep = (pod.compute_requests(), pod.non_zero_requests())
+                if sk is not None:
+                    req_by_spec[sk] = rep
             else:
                 d["_req_memo"], d["_nzreq_memo"] = rep
         # one Status shared by the whole run: success statuses are treated
@@ -3341,6 +3412,8 @@ class Scheduler:
         success = STATUS_SUCCESS
         items = []
         with self._mu:
+            if self._sanitize:
+                sanitizer.assert_owned(self._mu, "_commit_fast_bulk")
             results = self.cache.assume_pods_bulk(
                 list(zip((qp.pod for qp in run), names))
             )

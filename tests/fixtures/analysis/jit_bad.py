@@ -1,0 +1,37 @@
+"""Seeded jit-boundary violations — every marked line MUST be found.
+
+Never imported: the analyzer parses it (tests/test_static_analysis.py).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _helper_syncs(x):
+    # reachable from the jitted root below → checked in jit context
+    return x.item()  # VIOLATION: host sync
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def kernel(values, mask, n: int):
+    total = jnp.sum(values)
+    if total > 0:  # VIOLATION: branch on a traced value
+        total = -total
+    host = np.asarray(values)  # VIOLATION: numpy coercion of a traced value
+    flag = bool(mask)  # VIOLATION: bool() on a traced value
+    peek = _helper_syncs(total)
+    out = jnp.zeros(n)
+    for v in values:  # VIOLATION: iteration over a traced value
+        out = out + v
+    return out, host, flag, peek
+
+
+@jax.jit
+def loops_on_tracer(xs):
+    acc = jnp.zeros_like(xs)
+    while xs.sum() > 0:  # VIOLATION: while on a traced condition
+        acc = acc + xs
+    return acc

@@ -1,0 +1,47 @@
+"""Jit-boundary negative fixture — the analyzer must stay silent.
+
+Shape-derived host Python, static_argnames, the optional-array
+`is None` idiom, and host-side wrappers (unreachable from any root)
+are all legal.  Never imported: the analyzer parses it.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _accumulate(table, label_vals):
+    # reachable helper: static shape-driven loops are fine under trace
+    R = table.shape[-1]
+    ok = None
+    for r in range(R):
+        col = table[..., r]
+        ok = col if ok is None else (ok & col)  # `is None` — not a branch
+    if ok is None:
+        ok = jnp.ones(label_vals.shape, bool)
+    return ok
+
+
+@functools.partial(jax.jit, static_argnames=("v_cap", "extra"))
+def kernel(dc, batch, v_cap: int, extra=None):
+    n = len(batch)  # len() of a tracer is its static leading dim
+    width = int(dc.shape[1])  # int() of a static shape value
+    masks = _accumulate(dc, batch)
+    if extra is not None:  # optional-operand idiom: identity, not a branch
+        masks = masks & extra
+    if v_cap > 0:  # static_argnames value: compile-time branch
+        masks = masks[:v_cap]
+    big = jnp.iinfo(jnp.int32).max
+    scores = jnp.where(masks, big, 0)
+    for a, b in ((scores, masks), (masks, scores)):  # tuple display: static
+        scores = jnp.where(b, scores, a)
+    return scores[:n], width
+
+
+def host_wrapper(host_rows):
+    # NOT reachable from a jitted root — host numpy/casts are fine here
+    arr = np.asarray(host_rows, np.int32)
+    total = int(arr.sum())
+    return jax.device_get(kernel(arr, arr, total))

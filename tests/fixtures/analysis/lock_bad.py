@@ -1,0 +1,67 @@
+"""Seeded lock-discipline violations — every marked line MUST be found.
+
+Never imported: the analyzer parses it (tests/test_static_analysis.py).
+"""
+
+import threading
+
+_KTPU_GUARDED = {
+    "Owner": {
+        "lock": "_mu",
+        "guards": {"cache": "Store", "_epoch": None},
+        "requires_lock": ["_patch_view"],
+    },
+    "Store": {
+        "external_lock": "Owner._mu",
+        "readonly": ["peek"],
+    },
+}
+
+
+class Store:
+    def __init__(self):
+        self.items = {}
+
+    def put(self, k, v):  # mutating — callers must hold Owner._mu
+        self.items[k] = v
+
+    def peek(self, k):
+        return self.items.get(k)
+
+
+class Owner:
+    def __init__(self):
+        self._mu = threading.RLock()
+        self.cache = Store()
+        self._epoch = 0
+
+    def ok_locked_mutation(self, k, v):
+        with self._mu:
+            self.cache.put(k, v)
+            self._epoch += 1
+
+    def bad_unlocked_call(self, k, v):
+        self.cache.put(k, v)  # VIOLATION: mutating call without the lock
+
+    def bad_unlocked_field(self):
+        self._epoch += 1  # VIOLATION: guarded field write without the lock
+
+    def bad_unlocked_alias(self, k):
+        entry = self.cache.items.get(k)
+        entry.value = 1  # VIOLATION: mutation through a cache-derived alias
+
+    def _commit_under_lock(self, k, v):
+        # exempt body: the name suffix promises callers hold the lock
+        self.cache.put(k, v)
+        self._epoch += 1
+
+    def _patch_view(self):
+        self._epoch += 1  # exempt: registered in requires_lock
+
+    def ok_verified_caller(self, k, v):
+        with self._mu:
+            self._commit_under_lock(k, v)
+            self._patch_view()
+
+    def bad_unverified_caller(self, k, v):
+        self._commit_under_lock(k, v)  # VIOLATION: contract needs the lock

@@ -1,0 +1,62 @@
+"""Lock-discipline negative fixture — the analyzer must stay silent.
+
+Never imported: the analyzer parses it (tests/test_static_analysis.py).
+"""
+
+import threading
+
+_KTPU_GUARDED = {
+    "Owner": {
+        "lock": "_mu",
+        "guards": {"cache": "Store", "_epoch": None},
+        "requires_lock": ["_patch_view"],
+    },
+    "Store": {
+        "external_lock": "Owner._mu",
+        "readonly": ["peek"],
+    },
+}
+
+
+class Store:
+    def __init__(self):
+        self.items = {}
+
+    def put(self, k, v):
+        self.items[k] = v
+
+    def peek(self, k):
+        return self.items.get(k)
+
+
+class Owner:
+    def __init__(self):
+        self._mu = threading.RLock()
+        self.cache = Store()
+        self._epoch = 0
+
+    def locked_mutation(self, k, v):
+        with self._mu:
+            self.cache.put(k, v)
+            self._epoch += 1
+
+    def unlocked_read(self, k):
+        return self.cache.peek(k)  # readonly method — no lock needed
+
+    def _commit_under_lock(self, k, v):
+        self.cache.put(k, v)
+        self._patch_view()
+
+    def _patch_view(self):
+        self._epoch += 1
+
+    def verified_caller(self, k, v):
+        with self._mu:
+            self._commit_under_lock(k, v)
+
+    def closure_takes_its_own_lock(self):
+        def handler(k, v):
+            with self._mu:
+                self.cache.put(k, v)
+
+        return handler

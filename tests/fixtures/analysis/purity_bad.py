@@ -1,0 +1,67 @@
+"""Seeded plugin-purity violations — every marked line MUST be found.
+
+Never imported: the analyzer parses it (tests/test_static_analysis.py).
+"""
+
+
+class Status:
+    @staticmethod
+    def skip():
+        return Status()
+
+    @staticmethod
+    def success():
+        return Status()
+
+
+class LeakyStateWrite:
+    """Writes CycleState before the gate — verdict diverges per pod."""
+
+    name = "LeakyStateWrite"
+    pre_filter_spec_pure = True
+
+    def pre_filter(self, state, pod):
+        state.write(("k", pod.uid), {})  # VIOLATION: impure call pre-gate
+        if not pod.pvc_names():
+            return Status.skip()
+        return Status.success()
+
+
+class HandleReadBeforeGate:
+    """Reads a handle cache on the spec path."""
+
+    name = "HandleReadBeforeGate"
+    pre_filter_spec_pure = True
+
+    def pre_filter(self, state, pod):
+        known = self.handle.pvc_cache.get(pod.namespace)  # VIOLATION
+        if known is None and not pod.pvc_names():
+            return Status.skip()
+        return Status.success()
+
+
+class GateOnInstanceState:
+    """Branches the verdict on mutable plugin state — no call involved."""
+
+    name = "GateOnInstanceState"
+    pre_filter_spec_pure = True
+
+    def pre_filter(self, state, pod):
+        if self.disabled:  # VIOLATION: read of mutable state pre-gate
+            return Status.skip()
+        if not pod.volumes:
+            return Status.skip()
+        return Status.success()
+
+
+class SelfMutation:
+    """Caches cross-pod state on the plugin instance."""
+
+    name = "SelfMutation"
+    pre_filter_spec_pure = True
+
+    def pre_filter(self, state, pod):
+        self.seen = pod.uid  # VIOLATION: write to non-local state
+        if not pod.volumes:
+            return Status.skip()
+        return Status.success()

@@ -1,0 +1,77 @@
+"""Plugin-purity negative fixture — the analyzer must stay silent.
+
+Never imported: the analyzer parses it (tests/test_static_analysis.py).
+"""
+
+_SPECIAL_KINDS = frozenset({"gce-pd", "iscsi"})
+
+
+class Status:
+    @staticmethod
+    def skip():
+        return Status()
+
+    @staticmethod
+    def success():
+        return Status()
+
+    @staticmethod
+    def unresolvable(*reasons, plugin=None):
+        return Status()
+
+
+class GateFirst:
+    """The in-tree shape: spec-only gate, then the impure tail."""
+
+    name = "GateFirst"
+    pre_filter_spec_pure = True
+
+    def pre_filter(self, state, pod):
+        if not pod.pvc_names():
+            return Status.skip()
+        # off the spec path: non-gated pods take the per-pod walk
+        claims = self.handle.pvc_cache.get(pod.namespace)
+        state.write(("k", pod.uid), claims)
+        return Status.success()
+
+
+class SpecDerivedLocals:
+    """Locals computed from the pod (and ALL_CAPS constants) stay pure."""
+
+    name = "SpecDerivedLocals"
+    pre_filter_spec_pure = True
+
+    def pre_filter(self, state, pod):
+        needs_check = any(
+            v.source_kind in _SPECIAL_KINDS for v in pod.volumes
+        )
+        names = pod.pvc_names()
+        if not needs_check and not names:
+            return Status.skip()
+        state.write(("k", pod.uid), set(names))
+        return Status.success()
+
+
+class FullySpecPure:
+    """No gate at all — the entire body is (pure) spec path."""
+
+    name = "FullySpecPure"
+    pre_filter_spec_pure = True
+
+    def pre_filter(self, state, pod):
+        aff = pod.affinity
+        required = aff.node_affinity if aff else None
+        if required is None and not pod.node_selector:
+            return Status.skip()
+        return Status.success()
+
+
+class UndeclaredStateful:
+    """No purity flag declared — outside the checker's scope entirely."""
+
+    name = "UndeclaredStateful"
+
+    def pre_filter(self, state, pod):
+        self.counter = getattr(self, "counter", 0) + 1
+        state.write(("quota", pod.namespace), self.counter)
+        return Status.success()

@@ -166,3 +166,31 @@ def test_mixed_drain_decisions_match_serial():
     batched = run(64, copy.deepcopy(pods))
     serial = run(1, copy.deepcopy(pods))
     assert batched == serial
+
+
+def test_bulk_commit_charges_exact_bytes_within_quantized_signature():
+    """Two pods whose memory requests differ in raw bytes but ceil to the
+    same MiB lane share a SIGNATURE, not a request: the bulk commit's memo
+    seeding must charge each pod's exact bytes to the cache (sharing the
+    representative's Resource objects across the quantization boundary
+    drifted the authoritative accounting for the placement's lifetime)."""
+    sched, bindings = _mk()
+    mem_a, mem_b = 268435455, 268000000  # both ceil to 256 MiB lanes
+    pods = [
+        Pod(
+            name="exact-a",
+            containers=[Container(name="c", requests={"cpu": "100m", "memory": mem_a})],
+        ),
+        Pod(
+            name="exact-b",
+            containers=[Container(name="c", requests={"cpu": "100m", "memory": mem_b})],
+        ),
+    ]
+    for p in pods:
+        sched.on_pod_add(p)
+    sched.schedule_pending()
+    assert len(bindings) == 2
+    got = sum(
+        cn.requested.memory for cn in sched.cache.nodes.values()
+    )
+    assert got == mem_a + mem_b, f"cache charged {got}, want {mem_a + mem_b}"
