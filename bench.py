@@ -564,6 +564,32 @@ def main():
             f"p99 attempt {km.p99_attempt_s * 1000:.2f} ms)",
             file=sys.stderr,
         )
+        # config7: chaos soak — throughput at a FIXED fault rate over the
+        # HTTP tier (watch cuts, forced 410s, transport errors, bind 409s)
+        # plus the fault→queue-drained recovery p99.  The invariant oracle
+        # must come back clean or the numbers are meaningless — soak
+        # problems zero the throughput so the floors gate catches it.
+        from kubernetes_tpu.chaos.runner import run_chaos_soak
+
+        cs = run_chaos_soak(
+            n_nodes=int(os.environ.get("BENCH_CHAOS_NODES", "24")),
+            n_pods=int(os.environ.get("BENCH_CHAOS_PODS", "600")),
+            fault_rate=float(os.environ.get("BENCH_CHAOS_RATE", "0.15")),
+        )
+        configs["config7_chaos_soak_pods_per_s"] = (
+            0.0 if cs["problems"] else round(cs["pods_per_s"], 1)
+        )
+        configs["config7_chaos_recovery_p99_ms"] = round(
+            cs["recovery_p99_s"] * 1000, 2
+        )
+        configs["config7_chaos_injected_total"] = cs["injected_total"]
+        print(
+            f"# config7 chaos soak: {cs['bound']} pods in {cs['wall_s']:.2f}s "
+            f"({cs['injected_total']} faults, recovery p99 "
+            f"{cs['recovery_p99_s'] * 1000:.1f} ms, "
+            f"{len(cs['problems'])} oracle problems)",
+            file=sys.stderr,
+        )
 
     if full and os.environ.get("BENCH_PARITY", "1") != "0":
         # north-star-scale decision-parity evidence (device fast pipeline

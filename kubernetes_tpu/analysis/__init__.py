@@ -39,6 +39,12 @@ LOCK_MODULES = (
     os.path.join("cache", "cache.py"),
     os.path.join("cache", "mirror.py"),
     os.path.join("queue", "scheduling_queue.py"),
+    # chaos subsystem: the injection log / one-shot ledger, per-seam
+    # ordinal counters, and journal entries are all appended from
+    # reflector threads and binding workers concurrently
+    os.path.join("chaos", "faults.py"),
+    os.path.join("chaos", "proxy.py"),
+    os.path.join("chaos", "journal.py"),
 )
 PURITY_MODULES = (
     os.path.join("framework", "plugins.py"),

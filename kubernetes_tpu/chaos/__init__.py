@@ -1,0 +1,56 @@
+"""Chaos & replay: deterministic fault injection + journal replay.
+
+The subsystem that drives the control plane's failure machinery instead of
+waiting for production to: seeded fault plans (``faults``), injection
+wrappers at the real transport seams (``proxy``), a logical-time journal
+with a bit-for-bit replayer (``journal``), and a scenario soak runner with
+a post-run invariant oracle (``runner``).  See CHAOS.md for the fault
+vocabulary and the record/replay workflow.
+
+    python -m kubernetes_tpu.chaos --scenario mixed-soak --journal /tmp/j.jsonl
+    python -m kubernetes_tpu.chaos --replay /tmp/j.jsonl
+"""
+
+from kubernetes_tpu.chaos.faults import ALL_KINDS, FaultPlan, Injection
+from kubernetes_tpu.chaos.journal import (
+    Journal,
+    JournalRecorder,
+    LogicalClock,
+    ReplayResult,
+    replay,
+)
+from kubernetes_tpu.chaos.proxy import (
+    ChaosClient,
+    ChaosLeaseStore,
+    chaos_binding_sink,
+    chaos_binding_sink_many,
+)
+from kubernetes_tpu.chaos.runner import (
+    SCENARIOS,
+    Scenario,
+    ScenarioResult,
+    check_invariants,
+    run_chaos_soak,
+    run_scenario,
+)
+
+__all__ = [
+    "ALL_KINDS",
+    "FaultPlan",
+    "Injection",
+    "Journal",
+    "JournalRecorder",
+    "LogicalClock",
+    "ReplayResult",
+    "replay",
+    "ChaosClient",
+    "ChaosLeaseStore",
+    "chaos_binding_sink",
+    "chaos_binding_sink_many",
+    "SCENARIOS",
+    "Scenario",
+    "ScenarioResult",
+    "check_invariants",
+    "run_chaos_soak",
+    "run_scenario",
+]

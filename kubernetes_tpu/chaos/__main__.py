@@ -1,0 +1,110 @@
+"""CLI: ``python -m kubernetes_tpu.chaos``.
+
+    --list                      show the scenario catalogue
+    --scenario NAME [--seed N]  run one seeded scenario (repeatable)
+    --all                       run every catalogued scenario
+    --journal PATH              record the run's journal to PATH
+    --replay PATH               replay a recorded journal; exit 1 on any
+                                placement mismatch
+    --soak [--pods N --nodes N --rate R --seed N]
+                                fixed-rate mixed-fault soak (the bench's
+                                config7 shape), JSON result on stdout
+
+Exit status: 0 when every oracle/replay check passes, 1 otherwise.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+from typing import List, Optional
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    from kubernetes_tpu.chaos import SCENARIOS, replay, run_chaos_soak, run_scenario
+
+    ap = argparse.ArgumentParser(prog="python -m kubernetes_tpu.chaos")
+    ap.add_argument("--list", action="store_true", help="list scenarios")
+    ap.add_argument("--scenario", action="append", help="scenario name (repeatable)")
+    ap.add_argument("--all", action="store_true", help="run every scenario")
+    ap.add_argument("--seed", type=int, help="override the scenario seed")
+    ap.add_argument("--journal", help="record the journal to this path")
+    ap.add_argument("--replay", help="replay a recorded journal")
+    ap.add_argument("--soak", action="store_true", help="fixed-rate mixed soak")
+    ap.add_argument("--pods", type=int, default=600)
+    ap.add_argument("--nodes", type=int, default=24)
+    ap.add_argument("--rate", type=float, default=0.15)
+    args = ap.parse_args(argv)
+
+    if args.list:
+        for name, scn in sorted(SCENARIOS.items()):
+            print(
+                f"{name:20s} seed={scn.seed} kind={scn.kind} mode={scn.mode} "
+                f"pods={scn.n_pods} rates={scn.rates}"
+            )
+        return 0
+
+    if args.replay:
+        res = replay(args.replay)
+        print(
+            f"replayed {res.drains} drains / {res.deliveries} deliveries: "
+            f"{len(res.placements)} placements, "
+            f"{len(res.mismatches)} mismatches"
+        )
+        for m in res.mismatches:
+            print(f"  MISMATCH {m}")
+        return 0 if res.ok else 1
+
+    if args.soak:
+        out = run_chaos_soak(
+            n_nodes=args.nodes,
+            n_pods=args.pods,
+            fault_rate=args.rate,
+            seed=args.seed if args.seed is not None else 2026,
+            progress=lambda m: print(f"# {m}", file=sys.stderr),
+        )
+        print(json.dumps(out, sort_keys=True))
+        return 0 if not out["problems"] else 1
+
+    names = list(SCENARIOS) if args.all else (args.scenario or [])
+    if not names:
+        ap.print_help()
+        return 2
+    rc = 0
+    for name in names:
+        scn = SCENARIOS[name]
+        if args.seed is not None:
+            scn = dataclasses.replace(scn, seed=args.seed)
+        journal_path = args.journal
+        if journal_path and len(names) > 1:
+            # one file per scenario — a shared path would silently keep
+            # only the last recording
+            root, ext = os.path.splitext(journal_path)
+            journal_path = f"{root}.{name}{ext or '.jsonl'}"
+        res = run_scenario(
+            scn,
+            journal_path=journal_path,
+            progress=lambda m: print(f"# {m}", file=sys.stderr),
+        )
+        status = "ok" if res.ok else "FAIL"
+        print(
+            f"{name}: {status} bound={len(res.placements)} "
+            f"faults={res.injected} wall={res.wall_s:.2f}s"
+            + (
+                f" failover_stall={res.failover_stall_s:.1f}s"
+                if res.failover_stall_s is not None
+                else ""
+            )
+        )
+        for p in res.problems:
+            print(f"  PROBLEM {p}")
+        if not res.ok:
+            rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,217 @@
+"""Seeded, fully deterministic fault plans for the chaos subsystem.
+
+A ``FaultPlan`` answers one question at every injection seam: *does this
+operation fail, and how?*  Decisions are pure functions of
+``(seed, kind, seam, key)`` via a keyed blake2b hash — NOT a shared PRNG
+stream — so two threads racing through the same seam draw the same
+verdict for the same key regardless of interleaving, and a replay run
+re-derives the exact fault sequence from the journal header's seed alone.
+
+Keys are chosen for stability under concurrency: bind faults key on the
+pod UID (worker threads race, UIDs don't), watch faults on the per-stream
+reconnect ordinal, request faults on a per-(method, path-family) counter.
+
+Semantics that keep chaotic runs convergent:
+
+  * bind faults are ONE-SHOT per pod — the retry after the unwind/requeue
+    succeeds, exactly like a real 409 whose conflicting writer went away;
+  * request/watch faults re-draw per attempt, so a seam with rate r heals
+    with probability (1 - r) on every retry;
+  * a scripted ``lease_blackout`` window suppresses one holder's lease
+    CAS between two logical times (the deterministic way to force a
+    leader failover mid-scenario).
+
+Every fault that actually fires is appended to ``injections`` (the
+journal/metrics feed) under the plan lock.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+# ----- fault vocabulary ------------------------------------------------------
+
+WATCH_CUT = "watch_cut"  # watch stream EOF mid-stream
+COMPACT = "compact"  # forced compaction: 410 Gone → relist
+API_ERROR = "api_error"  # transport error on a REST call
+API_TIMEOUT = "api_timeout"  # request timeout on a REST call
+BIND_CONFLICT = "bind_conflict"  # binding sink 409 conflict
+BIND_SLOW = "bind_slow"  # slow bind (sink stalls before writing)
+NODE_FLAP = "node_flap"  # heartbeat suppression → NotReady → evict
+LEASE_CONTENTION = "lease_contention"  # lease CAS loses → leader failover
+CLOCK_SKEW = "clock_skew"  # elector clock offset (failover scenarios)
+
+ALL_KINDS = (
+    WATCH_CUT,
+    COMPACT,
+    API_ERROR,
+    API_TIMEOUT,
+    BIND_CONFLICT,
+    BIND_SLOW,
+    NODE_FLAP,
+    LEASE_CONTENTION,
+    CLOCK_SKEW,
+)
+
+# Lock-discipline registry (kubernetes_tpu.analysis reads this literal):
+# the injection log and one-shot ledger are appended from binding workers,
+# reflector threads, and the scenario driver concurrently.
+_KTPU_GUARDED = {
+    "FaultPlan": {
+        "lock": "_mu",
+        "guards": {"injections": None, "_fired": None},
+    },
+}
+
+
+def _draw(seed: int, kind: str, seam: str, key) -> float:
+    """Deterministic uniform [0, 1) from (seed, kind, seam, key)."""
+    h = hashlib.blake2b(
+        f"{seed}|{kind}|{seam}|{key}".encode(), digest_size=8
+    ).digest()
+    return int.from_bytes(h, "big") / 2.0**64
+
+
+@dataclass
+class Injection:
+    """One fault that actually fired (the journal/metrics record)."""
+
+    kind: str
+    seam: str
+    key: str
+
+
+class FaultPlan:
+    """Deterministic fault schedule over the vocabulary above.
+
+    ``rates`` maps fault kind → probability per draw; kinds absent from the
+    map never fire.  ``on_inject(kind, seam, key)`` is the observer hook the
+    runner wires to the chaos metrics counter and the journal.
+    ``lease_blackout`` is a scripted (holder, t_from, t_to) window during
+    which that holder's lease CAS always loses; ``watch_fault_after`` is
+    how many events a doomed watch stream delivers before its fault (a cut
+    at event 0 would just look like a failed connect).
+    """
+
+    def __init__(
+        self,
+        seed: int,
+        rates: Optional[Dict[str, float]] = None,
+        bind_delay_s: float = 0.01,
+        watch_fault_after: int = 4,
+        lease_blackout: Optional[Tuple[str, float, float]] = None,
+        on_inject=None,
+    ):
+        self.seed = seed
+        self.rates = dict(rates or {})
+        unknown = set(self.rates) - set(ALL_KINDS)
+        if unknown:
+            raise ValueError(f"unknown fault kinds: {sorted(unknown)}")
+        self.bind_delay_s = bind_delay_s
+        self.watch_fault_after = watch_fault_after
+        self.lease_blackout = lease_blackout
+        self.on_inject = on_inject
+        self.injections: List[Injection] = []
+        self._mu = threading.Lock()
+        self._fired: set = set()
+
+    # ----- core draws -------------------------------------------------------
+
+    def _roll(self, kind: str, seam: str, key) -> bool:
+        rate = self.rates.get(kind, 0.0)
+        return rate > 0.0 and _draw(self.seed, kind, seam, key) < rate
+
+    def fire(self, kind: str, seam: str, key) -> None:
+        """Record a fault that is actually being delivered."""
+        hook = self.on_inject
+        with self._mu:
+            self.injections.append(Injection(kind, seam, str(key)))
+        if hook is not None:
+            hook(kind, seam, str(key))
+
+    def injected_counts(self) -> Dict[str, int]:
+        with self._mu:
+            out: Dict[str, int] = {}
+            for inj in self.injections:
+                out[inj.kind] = out.get(inj.kind, 0) + 1
+            return out
+
+    # ----- seam: binding sink (key = pod uid, one-shot) ---------------------
+
+    def bind_fault(self, uid: str) -> Optional[str]:
+        """Conflict beats slow; fires at most once per pod so the requeued
+        retry converges (returns the kind WITHOUT recording — callers fire()
+        at the moment the fault is delivered)."""
+        for kind in (BIND_CONFLICT, BIND_SLOW):
+            if self._roll(kind, "bind", uid):
+                with self._mu:
+                    if ("bind", uid) in self._fired:
+                        return None
+                    self._fired.add(("bind", uid))
+                return kind
+        return None
+
+    # ----- seam: REST requests (key = per-family attempt ordinal) -----------
+
+    def req_fault(self, method: str, family: str, attempt: int) -> Optional[str]:
+        """Transport fault for REST attempt #attempt on (method, family).
+        Binding endpoints are exempt — bind failures are injected at the
+        sink seam (keyed by pod uid) so journal replay, which has no REST
+        tier, reproduces the identical bind-failure sequence."""
+        if "binding" in family or family.endswith("/bindings"):
+            return None
+        seam = f"req:{method}:{family}"
+        for kind in (API_ERROR, API_TIMEOUT):
+            if self._roll(kind, seam, attempt):
+                return kind
+        return None
+
+    # ----- seam: watch streams (key = per-resource stream ordinal) ----------
+
+    def watch_event_fault(
+        self, resource: str, stream_no: int, event_no: int
+    ) -> Optional[str]:
+        """Per-delivered-event draw on stream #stream_no of a resource:
+        the configured rate is a PER-EVENT hazard, so every active stream
+        eventually faults at rate-proportional intervals (a per-stream
+        draw could leave a lucky stream — and therefore the whole run —
+        fault-free).  The first ``watch_fault_after`` events of each
+        stream are exempt; sync itself is never at risk because the
+        reflector relists BEFORE each watch opens."""
+        if event_no < self.watch_fault_after:
+            return None
+        seam = f"watch:{resource}:{stream_no}"
+        for kind in (COMPACT, WATCH_CUT):
+            if self._roll(kind, seam, event_no):
+                return kind
+        return None
+
+    # ----- seam: lease CAS (key = holder + attempt, plus blackout) ----------
+
+    def lease_fault(self, holder: str, attempt: int, now: float) -> bool:
+        blackout = self.lease_blackout
+        if (
+            blackout is not None
+            and holder == blackout[0]
+            and blackout[1] <= now < blackout[2]
+        ):
+            return True
+        return self._roll(LEASE_CONTENTION, f"lease:{holder}", attempt)
+
+    # ----- seam: node heartbeats -------------------------------------------
+
+    def flap_targets(self, node_names: Sequence[str], k: int = 1) -> List[str]:
+        """The k nodes whose heartbeats this plan suppresses — a stable
+        hash order over the names, so any caller with the same node set
+        picks the same victims."""
+        ranked = sorted(
+            node_names, key=lambda n: _draw(self.seed, NODE_FLAP, "flap", n)
+        )
+        return ranked[: max(0, k)]
+
+    def clock_skew_s(self, identity: str, max_skew_s: float = 2.0) -> float:
+        """Deterministic per-identity clock offset in [-max, +max)."""
+        return (_draw(self.seed, CLOCK_SKEW, "skew", identity) * 2 - 1) * max_skew_s

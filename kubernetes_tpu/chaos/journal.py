@@ -1,0 +1,305 @@
+"""Journal: logical-timestamped record/replay for the control-plane loop.
+
+The journal is a JSONL stream of logically-timestamped entries — no wall
+clocks anywhere, so two runs of the same deterministic scenario produce
+byte-identical files:
+
+  {"t": 1, "kind": "header", "scenario": ..., "seed": ..., "rates": ...}
+  {"t": 2, "kind": "clock", "now": 1000.0}
+  {"t": 3, "kind": "delivery", "res": "nodes", "action": "add", "obj": ...}
+  {"t": 7, "kind": "fault", "fault": "bind_conflict", "seam": "bind", ...}
+  {"t": 9, "kind": "drain_start", "n": 0}
+  {"t": 12, "kind": "drain_end", "n": 0, "decisions": [{"pod": uid,
+      "node": "node-3", "code": "SUCCESS"}, ...]}
+
+``JournalRecorder.attach`` wraps a Scheduler's six informer-facing
+handlers so every delivery is journaled in the exact order the scheduler
+processed it (the wrapper records INSIDE the scheduler lock — ``_mu`` is
+reentrant — so journal order can never contradict apply order).
+
+``replay`` feeds a recorded stream to a fresh ``Scheduler`` and asserts
+its placement decisions match the journal bit-for-bit: deliveries
+recorded between a drain's start/end markers are applied *after* the
+replayed drain (they raced the live dispatch — bind confirmations,
+relist echoes — and must not be visible to the batch that preceded them).
+Bind faults are re-derived from the header's seed via ``FaultPlan``, so
+the replayed scheduler suffers the same 409s the live one did.
+
+Journals checked into ``tests/fixtures/journals/`` are regression
+corpora: a behavior change in the scheduler that alters any recorded
+placement fails the replay test and must be acknowledged by re-recording.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from kubernetes_tpu.api.codec import decode, encode
+
+JOURNAL_VERSION = 1
+
+# Lock-discipline registry (kubernetes_tpu.analysis reads this literal):
+# reflector threads, binding workers, and the scenario driver all append.
+_KTPU_GUARDED = {
+    "Journal": {
+        "lock": "_mu",
+        "guards": {"_entries": None, "_t": None},
+    },
+}
+
+
+class LogicalClock:
+    """Manually-advanced clock injected into the scheduler (and electors)
+    so backoff expiry and lease timing are scenario state, not wall time.
+    Reads are a single attribute load — safe from any thread."""
+
+    def __init__(self, start: float = 1000.0):
+        self.now = start
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, dt: float) -> float:
+        self.now += dt
+        return self.now
+
+
+class Journal:
+    """Append-only entry log with process-logical timestamps."""
+
+    def __init__(self, path: Optional[str] = None):
+        self._mu = threading.Lock()
+        self._entries: List[dict] = []
+        self._t = 0
+        self.path = path
+
+    def append(self, kind: str, **fields) -> dict:
+        with self._mu:
+            self._t += 1
+            entry = {"t": self._t, "kind": kind, **fields}
+            self._entries.append(entry)
+            return entry
+
+    def entries(self) -> List[dict]:
+        with self._mu:
+            return list(self._entries)
+
+    def serialize(self) -> str:
+        return "".join(
+            json.dumps(e, sort_keys=True) + "\n" for e in self.entries()
+        )
+
+    def dump(self, path: Optional[str] = None) -> str:
+        path = path or self.path
+        if not path:
+            raise ValueError("journal has no path")
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(self.serialize())
+        return path
+
+    @staticmethod
+    def load_entries(path: str) -> List[dict]:
+        out = []
+        with open(path, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    out.append(json.loads(line))
+        return out
+
+
+class JournalRecorder:
+    """Wraps a Scheduler's informer-facing handlers with journaling.
+
+    Must run BEFORE the cluster source reads the handlers off the
+    scheduler (``FakeCluster.connect`` / ``RemoteClusterSource.connect``
+    capture bound methods).  The wrapper takes the scheduler's reentrant
+    lock around {record + apply} so the journal order is exactly the
+    order the scheduler observed.
+    """
+
+    def __init__(self, journal: Journal):
+        self.journal = journal
+
+    def attach(self, sched) -> None:
+        journal = self.journal
+        mu = sched._mu
+
+        def wrap1(action: str, res: str, orig):
+            def handler(obj):
+                with mu:
+                    journal.append(
+                        "delivery", res=res, action=action, obj=encode(obj)
+                    )
+                    orig(obj)
+
+            return handler
+
+        def wrap2(res: str, orig):
+            def handler(old, new):
+                with mu:
+                    journal.append(
+                        "delivery",
+                        res=res,
+                        action="update",
+                        obj=encode(new),
+                        old=encode(old),
+                    )
+                    orig(old, new)
+
+            return handler
+
+        sched.on_node_add = wrap1("add", "nodes", sched.on_node_add)
+        sched.on_node_update = wrap2("nodes", sched.on_node_update)
+        sched.on_node_delete = wrap1("delete", "nodes", sched.on_node_delete)
+        sched.on_pod_add = wrap1("add", "pods", sched.on_pod_add)
+        sched.on_pod_update = wrap2("pods", sched.on_pod_update)
+        sched.on_pod_delete = wrap1("delete", "pods", sched.on_pod_delete)
+
+
+def decisions_of(outcomes) -> List[dict]:
+    """ScheduleOutcomes → canonical decision records, sorted by pod uid so
+    journal bytes don't depend on batch-internal ordering."""
+    return sorted(
+        (
+            {
+                "pod": o.pod.uid,
+                "node": o.node,
+                "code": o.status.code.name,
+            }
+            for o in outcomes
+        ),
+        key=lambda d: d["pod"],
+    )
+
+
+# ---------------------------------------------------------------------------
+# replay
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ReplayResult:
+    drains: int = 0
+    deliveries: int = 0
+    mismatches: List[str] = field(default_factory=list)
+    placements: Dict[str, Optional[str]] = field(default_factory=dict)
+    expected: Dict[str, Optional[str]] = field(default_factory=dict)
+
+    @property
+    def ok(self) -> bool:
+        return not self.mismatches
+
+
+def _apply_delivery(sched, entry: dict) -> None:
+    res, action = entry["res"], entry["action"]
+    obj = decode(entry["obj"])
+    if action == "update":
+        old = decode(entry["old"])
+        if res == "nodes":
+            sched.on_node_update(old, obj)
+        else:
+            sched.on_pod_update(old, obj)
+    elif action == "add":
+        (sched.on_node_add if res == "nodes" else sched.on_pod_add)(obj)
+    else:
+        (sched.on_node_delete if res == "nodes" else sched.on_pod_delete)(obj)
+
+
+def replay(source, scheduler_factory=None) -> ReplayResult:
+    """Feed a recorded journal to a fresh Scheduler; compare decisions.
+
+    ``source`` is a path, a list of entries, or a Journal.  The replayed
+    scheduler binds into a local dict through a chaos-wrapped sink rebuilt
+    from the header's seed, so every recorded 409 recurs on schedule.
+    """
+    from kubernetes_tpu.chaos.faults import FaultPlan
+    from kubernetes_tpu.chaos.proxy import chaos_binding_sink, chaos_binding_sink_many
+
+    if isinstance(source, Journal):
+        entries = source.entries()
+    elif isinstance(source, str):
+        entries = Journal.load_entries(source)
+    else:
+        entries = list(source)
+    if not entries or entries[0].get("kind") != "header":
+        raise ValueError("journal has no header entry")
+    header = entries[0]
+    if header.get("version") != JOURNAL_VERSION:
+        raise ValueError(f"unsupported journal version {header.get('version')}")
+
+    plan = FaultPlan(
+        seed=header["seed"],
+        rates=header.get("rates", {}),
+        bind_delay_s=0.0,  # latency faults are not semantic — skip sleeps
+        lease_blackout=tuple(header["lease_blackout"])
+        if header.get("lease_blackout")
+        else None,
+    )
+    clock = LogicalClock(header.get("clock0", 1000.0))
+    if scheduler_factory is None:
+        from kubernetes_tpu.scheduler import Scheduler
+
+        sched = Scheduler(clock=clock)
+    else:
+        sched = scheduler_factory(clock)
+
+    result = ReplayResult()
+    bound: Dict[str, str] = {}
+    sink = chaos_binding_sink(
+        lambda pod, node: bound.__setitem__(pod.uid, node), plan, sleep=lambda s: None
+    )
+    sched.binding_sink = sink
+    if header.get("sink_many"):
+
+        def sink_many_raw(pairs):
+            for pod, node in pairs:
+                bound[pod.uid] = node
+            return [None] * len(pairs)
+
+        sched.binding_sink_many = chaos_binding_sink_many(
+            sink_many_raw, plan, sleep=lambda s: None
+        )
+
+    in_drain = False
+    buffered: List[dict] = []
+    for entry in entries[1:]:
+        kind = entry["kind"]
+        if kind == "clock":
+            clock.now = entry["now"]
+        elif kind == "delivery":
+            result.deliveries += 1
+            if in_drain:
+                # raced the live dispatch (bind confirmations, relist
+                # echoes): invisible to the drain that was running
+                buffered.append(entry)
+            else:
+                _apply_delivery(sched, entry)
+        elif kind == "drain_start":
+            in_drain = True
+        elif kind == "drain_end":
+            outs = sched.schedule_pending()
+            got = decisions_of(outs)
+            want = entry.get("decisions", [])
+            if got != want:
+                result.mismatches.append(
+                    f"drain {entry.get('n')}: got {got} want {want}"
+                )
+            for d in want:
+                if d["code"] == "SUCCESS" and d["node"]:
+                    result.expected[d["pod"]] = d["node"]
+            for d in got:
+                if d["code"] == "SUCCESS" and d["node"]:
+                    result.placements[d["pod"]] = d["node"]
+            result.drains += 1
+            in_drain = False
+            for pending in buffered:
+                _apply_delivery(sched, pending)
+            buffered.clear()
+        # "fault" / "note" entries are informational
+    for pending in buffered:
+        _apply_delivery(sched, pending)
+    return result

@@ -1,0 +1,168 @@
+"""Injection wrappers at the transport seams.
+
+The chaos subsystem never mocks the scheduler's machinery — it wraps the
+real seams so the real reflector, informer-diff, relist, bind-unwind, and
+election code paths absorb the faults:
+
+  * ``ChaosClient`` — an ``ApiClient`` whose REST calls and watch streams
+    consult a ``FaultPlan``: transport errors/timeouts on requests, EOF
+    cuts and forced 410 compactions mid-watch-stream;
+  * ``chaos_binding_sink`` / ``chaos_binding_sink_many`` — binding-sink
+    wrappers injecting 409 conflicts and slow binds keyed by pod uid
+    (one-shot, so the post-unwind retry converges);
+  * ``ChaosLeaseStore`` — a LeaseStore proxy whose CAS loses on plan
+    demand (lease contention / scripted blackouts driving failover).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional, Tuple
+
+from kubernetes_tpu.chaos import faults
+from kubernetes_tpu.client.client import ApiClient, ApiError
+
+# Lock-discipline registry (kubernetes_tpu.analysis reads this literal):
+# the per-seam ordinal counters are bumped from reflector threads and
+# binding workers concurrently.
+_KTPU_GUARDED = {
+    "ChaosClient": {
+        "lock": "_chaos_mu",
+        "guards": {"_chaos_seq": None},
+    },
+    "ChaosLeaseStore": {
+        "lock": "_attempts_mu",
+        "guards": {"_attempts": None},
+    },
+}
+
+
+class ChaosClient(ApiClient):
+    """ApiClient with plan-driven transport faults.
+
+    Faults raised here surface exactly like real infrastructure failures:
+    a ``ConnectionResetError``/``TimeoutError`` from ``_req`` reaches the
+    reflector's reconnect-with-backoff loop (or the caller's error path),
+    and a mid-stream cut/410 reaches the reflector's EOF/relist handling.
+    """
+
+    def __init__(
+        self,
+        endpoint: str,
+        plan: faults.FaultPlan,
+        timeout: float = 10.0,
+        watch_timeout: Optional[float] = None,
+    ):
+        super().__init__(endpoint, timeout=timeout, watch_timeout=watch_timeout)
+        self.plan = plan
+        self._chaos_mu = threading.Lock()
+        self._chaos_seq = {}
+
+    def _seq(self, key: str) -> int:
+        with self._chaos_mu:
+            n = self._chaos_seq.get(key, 0)
+            self._chaos_seq[key] = n + 1
+            return n
+
+    def _req(self, method: str, path: str, payload=None):
+        family = path.split("?", 1)[0]
+        fault = self.plan.req_fault(method, family, self._seq(f"{method} {family}"))
+        if fault == faults.API_ERROR:
+            self.plan.fire(fault, f"req:{method}:{family}", family)
+            raise ConnectionResetError(
+                f"chaos: injected transport error on {method} {family}"
+            )
+        if fault == faults.API_TIMEOUT:
+            self.plan.fire(fault, f"req:{method}:{family}", family)
+            raise TimeoutError(f"chaos: injected timeout on {method} {family}")
+        return super()._req(method, path, payload)
+
+    def watch_stream(self, resource: str, rv: int):
+        stream_no = self._seq(f"watch {resource}")
+        n = 0
+        for evt in super().watch_stream(resource, rv):
+            kind = self.plan.watch_event_fault(resource, stream_no, n)
+            if kind is not None:
+                self.plan.fire(kind, f"watch:{resource}", f"{stream_no}:{n}")
+                if kind == faults.COMPACT:
+                    # the server's own compaction shape: the reflector
+                    # must relist and diff
+                    raise ApiError(410, "chaos: forced compaction")
+                return  # WATCH_CUT: EOF mid-stream → re-list/watch
+            yield evt
+            n += 1
+
+
+def chaos_binding_sink(sink, plan: faults.FaultPlan, sleep=time.sleep):
+    """Wrap a per-pod binding sink with plan-driven 409s / stalls."""
+
+    def bind(pod, node_name):
+        kind = plan.bind_fault(pod.uid)
+        if kind == faults.BIND_CONFLICT:
+            plan.fire(kind, "bind", pod.uid)
+            raise ApiError(409, f"chaos: conflicting bind for {pod.uid}")
+        if kind == faults.BIND_SLOW:
+            plan.fire(kind, "bind", pod.uid)
+            sleep(plan.bind_delay_s)
+        return sink(pod, node_name)
+
+    return bind
+
+
+def chaos_binding_sink_many(sink_many, plan: faults.FaultPlan, sleep=time.sleep):
+    """Wrap a bulk binding sink; injected conflicts surface as the per-item
+    error strings the API tier's /bindings endpoint produces, so the
+    scheduler unwinds exactly the faulted pods and commits the rest."""
+
+    def bind_many(pairs) -> List[Optional[str]]:
+        results: List[Optional[str]] = [None] * len(pairs)
+        todo, idxs = [], []
+        stalled = False
+        for i, (pod, node_name) in enumerate(pairs):
+            kind = plan.bind_fault(pod.uid)
+            if kind == faults.BIND_CONFLICT:
+                plan.fire(kind, "bind", pod.uid)
+                results[i] = f"HTTP 409: chaos: conflicting bind for {pod.uid}"
+                continue
+            if kind == faults.BIND_SLOW:
+                plan.fire(kind, "bind", pod.uid)
+                stalled = True
+            todo.append((pod, node_name))
+            idxs.append(i)
+        if stalled:
+            sleep(plan.bind_delay_s)
+        if todo:
+            errs = sink_many(todo)
+            for i, err in zip(idxs, errs):
+                results[i] = err
+        return results
+
+    return bind_many
+
+
+class ChaosLeaseStore:
+    """LeaseStore proxy whose updates lose the CAS on plan demand —
+    contention from a phantom competitor, or a scripted blackout window
+    that forces the holder to lapse (leader failover)."""
+
+    def __init__(self, store, plan: faults.FaultPlan, clock=time.monotonic):
+        self.store = store
+        self.plan = plan
+        self.clock = clock
+        self._attempts = {}
+        self._attempts_mu = threading.Lock()
+
+    def get(self, name: str):
+        return self.store.get(name)
+
+    def update(self, name: str, rec) -> bool:
+        with self._attempts_mu:
+            attempt = self._attempts.get(rec.holder, 0)
+            self._attempts[rec.holder] = attempt + 1
+        if self.plan.lease_fault(rec.holder, attempt, self.clock()):
+            self.plan.fire(
+                faults.LEASE_CONTENTION, f"lease:{rec.holder}", attempt
+            )
+            return False
+        return self.store.update(name, rec)

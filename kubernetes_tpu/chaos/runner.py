@@ -1,0 +1,855 @@
+"""Scenario soak runner + post-run invariant oracle.
+
+A scenario composes a workload with a seeded ``FaultPlan`` and drives the
+REAL control-plane loop through it:
+
+  * ``inproc`` scenarios connect the scheduler straight to a FakeCluster
+    (synchronous deliveries, ``parallelism=1`` bind pool) — fully
+    deterministic: same seed → byte-identical journal → identical binds;
+  * ``http`` scenarios run FakeCluster ← ApiServer ← ChaosClient-backed
+    RemoteClusterSource (real reflectors, watch caches, relists) with the
+    NodeLifecycleController / LeaseElector in the loop where the scenario
+    demands — deliveries race threads, so the journal records the order
+    the scheduler actually observed and replay reproduces the recorded
+    placements bit-for-bit.
+
+After the drive, the INVARIANT ORACLE must come back empty:
+
+  1. scheduler cache == API ground truth (CacheDebugger.compare);
+  2. no leaked assumed pods;
+  3. mirror usage rows == fresh recomputation from the cache (the
+     KTPU_SANITIZE drift probe, run explicitly);
+  4. every created pod is bound, deleted (evicted/churned), or carries a
+     FailedScheduling event;
+  5. no pod ever successfully bound to two different nodes (bind ledger);
+  6. nothing left in active/backoff queues (the drain converged);
+  7. failover scenarios: leader-handoff stall within the lease budget.
+
+``python -m kubernetes_tpu.chaos`` drives scenarios, soaks, and replays.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from kubernetes_tpu.analysis import sanitizer
+from kubernetes_tpu.api.resource import Resource
+from kubernetes_tpu.api.types import Container, Node, Pod
+from kubernetes_tpu.chaos import faults
+from kubernetes_tpu.chaos.journal import (
+    JOURNAL_VERSION,
+    Journal,
+    JournalRecorder,
+    LogicalClock,
+    decisions_of,
+)
+from kubernetes_tpu.chaos.proxy import (
+    ChaosClient,
+    ChaosLeaseStore,
+    chaos_binding_sink,
+    chaos_binding_sink_many,
+)
+
+CLOCK0 = 1000.0
+
+
+# ---------------------------------------------------------------------------
+# scenario catalogue
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Scenario:
+    name: str
+    seed: int
+    kind: str = "basic"  # basic | flap | failover
+    mode: str = "inproc"  # inproc | http
+    n_nodes: int = 6
+    n_pods: int = 36
+    rounds: int = 3
+    rates: Dict[str, float] = field(default_factory=dict)
+    unschedulable: int = 0  # pods that can never fit (FailedScheduling path)
+    bind_delay_s: float = 0.01
+    lease_duration_s: float = 8.0
+    flap_grace_s: float = 6.0
+    synthetic: bool = False  # draw pods from workloads.synthetic instead
+
+
+SCENARIOS: Dict[str, Scenario] = {
+    s.name: s
+    for s in (
+        # deterministic in-proc scenarios (same seed → byte-identical journal)
+        Scenario("bind-conflict", seed=101, rates={faults.BIND_CONFLICT: 0.25}),
+        Scenario(
+            "bind-slow",
+            seed=102,
+            rates={faults.BIND_SLOW: 0.4},
+            bind_delay_s=0.005,
+        ),
+        Scenario(
+            "unschedulable-burst",
+            seed=103,
+            rates={faults.BIND_CONFLICT: 0.15},
+            unschedulable=3,
+        ),
+        Scenario(
+            "leader-failover",
+            seed=104,
+            kind="failover",
+            rates={faults.LEASE_CONTENTION: 0.1},
+            n_pods=24,
+            rounds=2,
+        ),
+        # full-stack HTTP scenarios (reflector/relist/watch-cache in the loop)
+        Scenario(
+            "watch-cut",
+            seed=105,
+            mode="http",
+            rates={faults.WATCH_CUT: 0.06},
+        ),
+        Scenario(
+            "compaction",
+            seed=106,
+            mode="http",
+            rates={faults.COMPACT: 0.06},
+        ),
+        Scenario(
+            "api-errors",
+            seed=107,
+            mode="http",
+            # watch cuts force relists, so the list/patch request stream is
+            # busy enough for the transport faults to actually land
+            rates={
+                faults.API_ERROR: 0.25,
+                faults.API_TIMEOUT: 0.2,
+                faults.WATCH_CUT: 0.04,
+            },
+        ),
+        Scenario(
+            "node-flap",
+            seed=108,
+            kind="flap",
+            mode="http",
+            n_pods=24,
+            rounds=2,
+        ),
+        Scenario(
+            "mixed-soak",
+            seed=109,
+            mode="http",
+            n_pods=48,
+            rounds=3,
+            unschedulable=2,
+            rates={
+                faults.WATCH_CUT: 0.02,
+                faults.COMPACT: 0.02,
+                faults.API_ERROR: 0.08,
+                faults.BIND_CONFLICT: 0.15,
+                faults.BIND_SLOW: 0.15,
+            },
+        ),
+    )
+}
+
+
+# ---------------------------------------------------------------------------
+# workload factories (uids are EXPLICIT — the process-global uid counter
+# would break journal byte-determinism across runs)
+# ---------------------------------------------------------------------------
+
+
+def _mk_nodes(n: int) -> List[Node]:
+    return [
+        Node(
+            name=f"chaos-node-{i}",
+            labels={
+                "topology.kubernetes.io/zone": f"zone-{i % 3}",
+                "kubernetes.io/hostname": f"chaos-node-{i}",
+            },
+            capacity=Resource.from_map({"cpu": "8", "memory": "32Gi", "pods": 110}),
+        )
+        for i in range(n)
+    ]
+
+
+def _mk_pod(i: int, rng, unschedulable: bool = False) -> Pod:
+    if unschedulable:
+        requests = {"cpu": "64", "memory": "1Ti"}
+    else:
+        requests = {
+            "cpu": f"{rng.choice([100, 250, 500])}m",
+            "memory": f"{rng.choice([128, 256, 512])}Mi",
+        }
+    return Pod(
+        name=f"chaos-{i}",
+        uid=f"default/chaos-{i}",
+        labels={"app": f"app-{i % 5}"},
+        containers=[Container(name="c", requests=requests)],
+    )
+
+
+def _mk_synthetic_pod(i: int, rng) -> Pod:
+    from kubernetes_tpu.workloads.synthetic import make_pod
+
+    p = make_pod(rng, f"chaos-{i}")
+    p.uid = f"{p.namespace}/chaos-{i}"
+    return p
+
+
+def _wait(predicate, timeout: float = 20.0, interval: float = 0.01) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return bool(predicate())
+
+
+# ---------------------------------------------------------------------------
+# bind ledger (oracle input: no pod ever bound to two nodes)
+# ---------------------------------------------------------------------------
+
+
+class _BindLedger:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.nodes_by_uid: Dict[str, set] = {}
+
+    def record(self, uid: str, node: str) -> None:
+        with self._mu:
+            self.nodes_by_uid.setdefault(uid, set()).add(node)
+
+    def wrap(self, sink):
+        def bind(pod, node_name):
+            out = sink(pod, node_name)
+            self.record(pod.uid, node_name)
+            return out
+
+        return bind
+
+    def wrap_many(self, sink_many):
+        def bind_many(pairs):
+            errs = sink_many(pairs)
+            for (pod, node_name), err in zip(pairs, errs):
+                if err is None:
+                    self.record(pod.uid, node_name)
+            return errs
+
+        return bind_many
+
+    def double_bound(self) -> List[str]:
+        with self._mu:
+            return sorted(
+                uid for uid, nodes in self.nodes_by_uid.items() if len(nodes) > 1
+            )
+
+
+# ---------------------------------------------------------------------------
+# fault → queue-drained recovery tracking (feeds the chaos histogram)
+# ---------------------------------------------------------------------------
+
+
+class _RecoveryTracker:
+    """Opens a window at the first injection after quiescence; the runner
+    closes it when the queue next fully drains — the observed value is the
+    fault→recovered latency per kind."""
+
+    def __init__(self, hist):
+        self.hist = hist
+        self._mu = threading.Lock()
+        self._open: Dict[str, float] = {}  # kind → wall start
+
+    def mark(self, kind: str) -> None:
+        with self._mu:
+            self._open.setdefault(kind, time.perf_counter())
+
+    def drained(self) -> None:
+        now = time.perf_counter()
+        with self._mu:
+            windows, self._open = self._open, {}
+        for kind, t0 in windows.items():
+            if self.hist is not None:
+                self.hist.observe(now - t0, kind=kind)
+
+
+# ---------------------------------------------------------------------------
+# scenario context + drive
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ScenarioResult:
+    scenario: str
+    seed: int
+    problems: List[str]
+    placements: Dict[str, str]
+    injected: Dict[str, int]
+    journal: Journal
+    created: int
+    wall_s: float
+    failover_stall_s: Optional[float] = None
+    evicted: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return not self.problems
+
+
+class _Ctx:
+    def __init__(self, scn: Scenario, journal_path: Optional[str]):
+        import random
+
+        from kubernetes_tpu.events import EventBroadcaster
+        from kubernetes_tpu.framework.config import SchedulerConfiguration
+        from kubernetes_tpu.scheduler import Scheduler
+        from kubernetes_tpu.testing.fake_cluster import FakeCluster
+
+        self.scn = scn
+        self.rng = random.Random(scn.seed)
+        self.plan = faults.FaultPlan(
+            seed=scn.seed,
+            rates=scn.rates,
+            bind_delay_s=scn.bind_delay_s,
+            # failover scenarios script the incumbent's blackout HERE so the
+            # journal header (written at connect time) records it and replay
+            # reconstructs the identical plan
+            lease_blackout=("A", CLOCK0 + 6.0, 1e18)
+            if scn.kind == "failover"
+            else None,
+        )
+        self.journal = Journal(journal_path)
+        self.clock = LogicalClock(CLOCK0)
+        self.drain_no = 0
+        self.created_uids: List[str] = []
+        self.ledger = _BindLedger()
+        self.api = FakeCluster(pv_controller=False)
+        self.apiserver = None
+        self.source = None
+        self.client = None
+        self.controller = None
+        self.endpoint = None
+
+        # deterministic mode pins the bind pool to one worker so delivery
+        # order (bind confirmations) is a pure function of the seed
+        conf = (
+            SchedulerConfiguration(parallelism=1)
+            if scn.mode == "inproc"
+            else None
+        )
+        self.sched = Scheduler(
+            configuration=conf,
+            clock=self.clock,
+            event_broadcaster=EventBroadcaster(),
+        )
+        self.recovery = _RecoveryTracker(self.sched.prom.chaos_recovery)
+        journal = self.journal
+        prom = self.sched.prom
+        recovery = self.recovery
+
+        def on_inject(kind, seam, key):
+            prom.chaos_injected.inc(kind=kind)
+            recovery.mark(kind)
+            journal.append("fault", fault=kind, seam=seam, key=key)
+
+        self.plan.on_inject = on_inject
+        self.recorder = JournalRecorder(self.journal)
+
+    # -- wiring --------------------------------------------------------------
+
+    def connect(self) -> None:
+        scn = self.scn
+        self.journal.append(
+            "header",
+            version=JOURNAL_VERSION,
+            scenario=scn.name,
+            seed=scn.seed,
+            rates=scn.rates,
+            clock0=CLOCK0,
+            sink_many=scn.mode == "http",
+            lease_blackout=list(self.plan.lease_blackout)
+            if self.plan.lease_blackout
+            else None,
+        )
+        self.journal.append("clock", now=self.clock.now)
+        self.recorder.attach(self.sched)
+        # the scheduler's events land in the FakeCluster's events store
+        # whichever tier is in between (process-local broadcaster)
+        self.sched.event_broadcaster.start_recording_to_sink(self.api.record_event)
+        if scn.mode == "http":
+            from kubernetes_tpu.client import ApiClient, ApiServer, RemoteClusterSource
+
+            self.apiserver = ApiServer(self.api).start()
+            endpoint = f"http://127.0.0.1:{self.apiserver.port}"
+            self.endpoint = endpoint
+            self.client = ApiClient(endpoint)  # clean driver-side client
+            chaos_client = ChaosClient(endpoint, self.plan)
+            self.source = RemoteClusterSource(endpoint, client=chaos_client)
+            self.source.connect(self.sched)
+            self.source.start()
+        else:
+            self.api.connect(self.sched)
+        # chaos + ledger wrap whatever sink the tier installed
+        self.sched.binding_sink = chaos_binding_sink(
+            self.ledger.wrap(self.sched.binding_sink), self.plan
+        )
+        if self.sched.binding_sink_many is not None:
+            self.sched.binding_sink_many = chaos_binding_sink_many(
+                self.ledger.wrap_many(self.sched.binding_sink_many), self.plan
+            )
+
+    def close(self) -> None:
+        if self.controller is not None:
+            self.controller.stop()
+        if self.source is not None:
+            self.source.stop()
+        if self.apiserver is not None:
+            self.apiserver.stop()
+
+    # -- drive primitives ----------------------------------------------------
+
+    def create_nodes(self, nodes: List[Node]) -> None:
+        if self.client is not None:
+            self.client.create_nodes(nodes)
+        else:
+            for n in nodes:
+                self.api.create_node(n)
+
+    def create_pods(self, pods: List[Pod]) -> None:
+        self.created_uids.extend(p.uid for p in pods)
+        if self.client is not None:
+            self.client.create_pods(pods)
+        else:
+            for p in pods:
+                self.api.create_pod(p)
+
+    def advance(self, dt: float) -> None:
+        self.clock.advance(dt)
+        self.journal.append("clock", now=self.clock.now)
+
+    def queue_counts(self) -> Dict[str, int]:
+        with self.sched._mu:
+            return self.sched.queue.stats()
+
+    def wait_enqueued(self, timeout: float = 20.0) -> bool:
+        """Quiesce: every created pod is visible to the scheduler — queued,
+        assumed/bound in its cache, or gone from the API (evicted)."""
+
+        def visible():
+            with self.sched._mu:
+                known = len(self.sched.cache.pod_states) + len(self.sched.queue)
+            alive = sum(1 for uid in self.created_uids if uid in self.api.pods)
+            return known >= alive
+
+        return _wait(visible, timeout=timeout)
+
+    def drain(self, sched=None, journaled: bool = True):
+        """One journaled drain.  Correctness of the drain markers leans on
+        the drive discipline around them: every drive QUIESCES first
+        (wait_enqueued / explicit waits), so the only deliveries that can
+        land between drain_start and drain_end are echoes of this drain's
+        own binds — which never change placements and which replay
+        correctly defers past the replayed drain.  drain_end needs no
+        bind-thread synchronization: schedule_pending ends with
+        wait_for_bindings, so all worker-side journal appends (fault
+        fires, confirmations) happen-before the marker."""
+        s = sched or self.sched
+        if journaled:
+            with s._mu:
+                self.journal.append("drain_start", n=self.drain_no)
+        outs = s.schedule_pending()
+        if journaled:
+            self.journal.append(
+                "drain_end", n=self.drain_no, decisions=decisions_of(outs)
+            )
+            self.drain_no += 1
+            counts = self.queue_counts()
+            if counts.get("active", 0) == 0 and counts.get("backoff", 0) == 0:
+                # the queue fully recovered from every open fault window
+                self.recovery.drained()
+        return outs
+
+    def settle(self, rounds: int = 4) -> None:
+        """Drain until nothing actionable remains: retried pods (bind
+        faults, relist churn) re-pop after a clock advance, confirmations
+        land, and the active/backoff queues go empty."""
+        for _ in range(rounds):
+            _wait(lambda: not self.sched.cache.assumed, timeout=10.0)
+            counts = self.queue_counts()
+            if counts.get("active", 0) == 0 and counts.get("backoff", 0) == 0:
+                break
+            self.advance(30.0)
+            self.drain()
+        _wait(lambda: not self.sched.cache.assumed, timeout=10.0)
+        if self.scn.mode == "http":
+            from kubernetes_tpu.server import CacheDebugger
+
+            dbg = CacheDebugger(self.sched, ground_truth=self.api.ground_truth)
+            _wait(lambda: not dbg.compare(), timeout=10.0)
+        self.recovery.drained()
+
+
+# ---------------------------------------------------------------------------
+# invariant oracle
+# ---------------------------------------------------------------------------
+
+
+def check_invariants(ctx: _Ctx) -> List[str]:
+    problems: List[str] = []
+    sched, api = ctx.sched, ctx.api
+    from kubernetes_tpu.server import CacheDebugger
+
+    problems += CacheDebugger(sched, ground_truth=api.ground_truth).compare()
+    with sched._mu:
+        assumed = sorted(sched.cache.assumed)
+    if assumed:
+        problems.append(f"leaked assumed pods ({len(assumed)}): {assumed[:5]}")
+    try:
+        with sched._mu:
+            sanitizer.check_mirror_consistency(sched.cache, sched.mirror)
+    except AssertionError as e:
+        problems.append(str(e))
+    doubles = ctx.ledger.double_bound()
+    if doubles:
+        problems.append(f"pods bound to multiple nodes: {doubles[:5]}")
+    failed = {
+        e.regarding.uid for e in api.list_events("FailedScheduling")
+    }
+    for uid in ctx.created_uids:
+        if uid in api.bindings:
+            continue
+        if uid not in api.pods:
+            continue  # deleted (evicted / churned away)
+        if uid in failed:
+            continue
+        problems.append(
+            f"pod {uid} neither bound, deleted, nor FailedScheduling-evented"
+        )
+    counts = ctx.queue_counts()
+    stuck = counts.get("active", 0) + counts.get("backoff", 0)
+    if stuck:
+        problems.append(f"drain did not converge: {counts}")
+    return problems
+
+
+# ---------------------------------------------------------------------------
+# drives
+# ---------------------------------------------------------------------------
+
+
+def _drive_basic(ctx: _Ctx) -> None:
+    scn = ctx.scn
+    ctx.create_nodes(_mk_nodes(scn.n_nodes))
+    if ctx.source is not None:
+        ctx.source.wait_for_sync()
+    per_round = max(1, scn.n_pods // scn.rounds)
+    made = 0
+    for r in range(scn.rounds):
+        n = per_round if r < scn.rounds - 1 else scn.n_pods - made
+        pods = []
+        for i in range(made, made + n):
+            hopeless = i < scn.unschedulable
+            pods.append(
+                _mk_synthetic_pod(i, ctx.rng)
+                if scn.synthetic and not hopeless
+                else _mk_pod(i, ctx.rng, unschedulable=hopeless)
+            )
+        made += n
+        ctx.create_pods(pods)
+        ctx.wait_enqueued()
+        ctx.advance(1.0)
+        ctx.drain()
+    ctx.settle()
+
+
+def _drive_flap(ctx: _Ctx) -> None:
+    """Heartbeat suppression: the NodeLifecycleController (own client +
+    reflectors against the same API server) marks the victim NotReady,
+    taints it NoExecute, and evicts its pods; replacements reschedule on
+    healthy nodes; the heartbeat returns and the taint lifts."""
+    from kubernetes_tpu.controller.node_lifecycle import NodeLifecycleController
+
+    scn = ctx.scn
+    nodes = _mk_nodes(scn.n_nodes)
+    ctx.create_nodes(nodes)
+    ctx.source.wait_for_sync()
+    names = [n.name for n in nodes]
+    victim = ctx.plan.flap_targets(names, k=1)[0]
+    ctrl = ctx.controller = NodeLifecycleController(
+        ctx.endpoint,
+        grace_s=scn.flap_grace_s,
+        clock=ctx.clock,
+        chaos_client=ChaosClient(ctx.endpoint, ctx.plan),
+    )
+    ctrl.start(run_loop=False)  # runner ticks it deterministically
+    ctrl.wait_for_sync()
+
+    def heartbeat(skip=()):
+        for name in names:
+            if name not in skip:
+                ctx.client.patch_node_status(name, True, ctx.clock.now)
+
+    heartbeat()
+    pods = [_mk_pod(i, ctx.rng) for i in range(scn.n_pods)]
+    ctx.create_pods(pods)
+    ctx.wait_enqueued()
+    ctx.advance(1.0)
+    ctx.drain()
+    _wait(lambda: not ctx.sched.cache.assumed, timeout=10.0)
+
+    # --- flap: suppress the victim's heartbeat past the grace period ------
+    ctx.plan.fire(faults.NODE_FLAP, "heartbeat", victim)
+    ctx.advance(scn.flap_grace_s + 2.0)
+    heartbeat(skip=(victim,))
+    evicted_before = ctrl.evicted
+    _wait(lambda: (ctrl.tick() or True) and victim in ctrl.tainted, timeout=15.0)
+    # eviction storms through the controller's client; wait for the watch
+    # to carry the deletes back to the scheduler
+    _wait(
+        lambda: all(
+            uid not in ctx.api.pods
+            for uid, node in list(ctx.api.bindings.items())
+            if node == victim
+        ),
+        timeout=15.0,
+    )
+    evicted = ctrl.evicted - evicted_before
+
+    # a workload controller recreates evicted pods as pending replacements
+    gone = [uid for uid in ctx.created_uids if uid not in ctx.api.pods]
+    replacements = []
+    for j, uid in enumerate(sorted(gone)):
+        p = _mk_pod(scn.n_pods + j, ctx.rng)
+        replacements.append(p)
+    if replacements:
+        ctx.create_pods(replacements)
+        ctx.wait_enqueued()
+    ctx.advance(1.0)
+    ctx.drain()
+
+    # --- recovery: the kubelet comes back, the taint lifts -----------------
+    heartbeat()
+    _wait(
+        lambda: (ctrl.tick() or True) and victim not in ctrl.tainted, timeout=15.0
+    )
+    ctx.settle()
+    ctx.evicted = evicted
+
+
+def _drive_failover(ctx: _Ctx) -> None:
+    """Two electors over one chaos lease store: A leads and schedules;
+    a scripted blackout (plus seeded contention) lapses A's lease; B —
+    whose clock the plan skews — takes over within the lease budget.  The
+    journal tracks scheduler B, the takeover side."""
+    from kubernetes_tpu.events import EventBroadcaster
+    from kubernetes_tpu.framework.config import SchedulerConfiguration
+    from kubernetes_tpu.scheduler import Scheduler
+    from kubernetes_tpu.server import LeaseElector
+
+    scn = ctx.scn
+    ctx.create_nodes(_mk_nodes(scn.n_nodes))
+
+    # scheduler A: the incumbent (not journaled; its binds reach B's
+    # journal as deliveries through the shared store)
+    clock_a = LogicalClock(CLOCK0)
+    sched_a = Scheduler(
+        configuration=SchedulerConfiguration(parallelism=1),
+        clock=clock_a,
+        event_broadcaster=EventBroadcaster(),
+    )
+    sched_a.event_broadcaster.start_recording_to_sink(ctx.api.record_event)
+    ctx.api.connect(sched_a)
+    sched_a.binding_sink = chaos_binding_sink(
+        ctx.ledger.wrap(sched_a.binding_sink), ctx.plan
+    )
+
+    skew = ctx.plan.clock_skew_s("B")
+    ctx.plan.fire(faults.CLOCK_SKEW, "elector", f"B:{skew:+.3f}")
+    clock_b = ctx.clock  # B is the journaled scheduler — shares ctx clock
+    clock_b.now = CLOCK0 + skew
+    ctx.journal.append("clock", now=clock_b.now)
+
+    assert ctx.plan.lease_blackout is not None  # scripted at plan build
+    el_a = LeaseElector(
+        ChaosLeaseStore(ctx.api.lease_store, ctx.plan, clock=clock_a),
+        "A",
+        lease_duration_s=scn.lease_duration_s,
+        retry_period_s=1.0,
+        clock=clock_a,
+    )
+    el_b = LeaseElector(
+        ChaosLeaseStore(ctx.api.lease_store, ctx.plan, clock=clock_b),
+        "B",
+        lease_duration_s=scn.lease_duration_s,
+        retry_period_s=1.0,
+        clock=clock_b,
+    )
+
+    def tick(dt: float = 1.0):
+        clock_a.advance(dt)
+        ctx.advance(dt)
+        a = el_a.try_acquire_or_renew()
+        b = el_b.try_acquire_or_renew()
+        return a, b
+
+    assert el_a.try_acquire_or_renew(), "A failed to acquire an empty lease"
+    assert not el_b.try_acquire_or_renew(), "standby stole a held lease"
+
+    # phase 1: A leads and drains — TO COMPLETION, so a pod whose bind
+    # chaos-conflicted under A retries and lands before the handoff (the
+    # one-shot bind-fault ledger would otherwise desync replay, which
+    # re-draws B's faults from a fresh plan)
+    half = scn.n_pods // 2
+    pods = [_mk_pod(i, ctx.rng) for i in range(half)]
+    ctx.create_pods(pods)
+    ctx.advance(1.0)
+    for _ in range(4):
+        ctx.drain(sched=sched_a, journaled=False)
+        sched_a.wait_for_bindings()
+        if all(p.uid in ctx.api.bindings for p in pods):
+            break
+        clock_a.advance(30.0)
+    assert all(p.uid in ctx.api.bindings for p in pods), (
+        "incumbent failed to settle its half before the handoff"
+    )
+
+    # phase 2: blackout — A's renewals lose until its lease lapses for B.
+    # The STALL is the leaderless window: from the tick A's lease expired
+    # (it stops scheduling) to B's acquisition, on B's clock.
+    deposed_at: Optional[float] = None
+    took_over = False
+    for _ in range(int(scn.lease_duration_s + 8)):
+        a, b = tick(1.0)
+        assert not (el_a.is_leader() and el_b.is_leader()), "two leaders"
+        if deposed_at is None and not el_a.is_leader():
+            deposed_at = clock_b.now
+        if b and el_b.is_leader():
+            took_over = True
+            break
+    assert took_over, "standby never took over after the lease blackout"
+    stall = clock_b.now - (deposed_at if deposed_at is not None else clock_b.now)
+    ctx.failover_stall_s = stall
+
+    # phase 3: B schedules the rest; A must schedule nothing more
+    pods = [_mk_pod(half + i, ctx.rng) for i in range(scn.n_pods - half)]
+    ctx.create_pods(pods)
+    ctx.advance(1.0)
+    assert not el_a.is_leader(), "deposed leader still claims the lease"
+    ctx.drain()
+    ctx.settle()
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+
+
+def run_scenario(
+    scn, journal_path: Optional[str] = None, progress=None
+) -> ScenarioResult:
+    if isinstance(scn, str):
+        scn = SCENARIOS[scn]
+    ctx = _Ctx(scn, journal_path)
+    ctx.evicted = 0
+    ctx.failover_stall_s = None
+    t0 = time.perf_counter()
+    try:
+        ctx.connect()
+        if scn.kind == "flap":
+            _drive_flap(ctx)
+        elif scn.kind == "failover":
+            _drive_failover(ctx)
+        else:
+            _drive_basic(ctx)
+        problems = check_invariants(ctx)
+        if scn.kind == "failover":
+            budget = scn.lease_duration_s + 3.0
+            if ctx.failover_stall_s is None or ctx.failover_stall_s > budget:
+                problems.append(
+                    f"leader failover stall {ctx.failover_stall_s} exceeds "
+                    f"budget {budget}"
+                )
+    finally:
+        ctx.close()
+    wall = time.perf_counter() - t0
+    if journal_path:
+        ctx.journal.dump()
+    if progress:
+        progress(
+            f"{scn.name}: {len(ctx.api.bindings)} bound, "
+            f"{sum(ctx.plan.injected_counts().values())} faults, "
+            f"{len(problems)} problems, {wall:.2f}s"
+        )
+    return ScenarioResult(
+        scenario=scn.name,
+        seed=scn.seed,
+        problems=problems,
+        placements=dict(ctx.api.bindings),
+        injected=ctx.plan.injected_counts(),
+        journal=ctx.journal,
+        created=len(ctx.created_uids),
+        wall_s=wall,
+        failover_stall_s=ctx.failover_stall_s,
+        evicted=ctx.evicted,
+    )
+
+
+def run_chaos_soak(
+    n_nodes: int = 24,
+    n_pods: int = 600,
+    rounds: int = 4,
+    seed: int = 2026,
+    fault_rate: float = 0.15,
+    progress=None,
+):
+    """The bench's config7 shape: a fixed-rate mixed-fault soak over the
+    HTTP tier; reports throughput under chaos + recovery latency."""
+    scn = Scenario(
+        name="bench-soak",
+        seed=seed,
+        mode="http",
+        n_nodes=n_nodes,
+        n_pods=n_pods,
+        rounds=rounds,
+        unschedulable=0,
+        rates={
+            faults.WATCH_CUT: fault_rate / 10,
+            faults.COMPACT: fault_rate / 10,
+            faults.API_ERROR: fault_rate / 2,
+            faults.API_TIMEOUT: fault_rate / 2,
+            faults.BIND_CONFLICT: fault_rate / 2,
+            faults.BIND_SLOW: fault_rate / 2,
+        },
+    )
+    ctx = _Ctx(scn, None)
+    ctx.evicted = 0
+    ctx.failover_stall_s = None
+    t0 = time.perf_counter()
+    try:
+        ctx.connect()
+        _drive_basic(ctx)
+        problems = check_invariants(ctx)
+    finally:
+        ctx.close()
+    wall = time.perf_counter() - t0
+    bound = len(ctx.api.bindings)
+    hist = ctx.sched.prom.chaos_recovery
+    out = {
+        "pods_per_s": bound / max(wall, 1e-9),
+        "bound": bound,
+        "wall_s": wall,
+        "injected_total": sum(ctx.plan.injected_counts().values()),
+        "injected": ctx.plan.injected_counts(),
+        "recovery_p99_s": hist.percentile(0.99),
+        "problems": problems,
+    }
+    if progress:
+        progress(
+            f"chaos soak: {bound} bound in {wall:.2f}s "
+            f"({out['pods_per_s']:.1f} pods/s, "
+            f"{out['injected_total']} faults, recovery p99 "
+            f"{out['recovery_p99_s'] * 1000:.1f}ms, {len(problems)} problems)"
+        )
+    return out

@@ -67,18 +67,40 @@ class _WatchCache:
             self.cond.notify_all()
             return self.rv
 
+    def _stale(self, rv: int) -> bool:
+        """rv precedes the retained window → the watcher must relist.
+
+        With a NON-EMPTY window the oldest replayable position is
+        events[0].rv - 1.  With an EMPTY window (deque wrap at maxlen 0
+        during tests, explicit compaction, server restart) NOTHING is
+        replayable, so any rv behind the head counter is stale — returning
+        [] there would silently strand a watcher that can never catch up.
+        """
+        if self.events:
+            return rv < self.events[0][0] - 1
+        return rv < self.rv
+
     def since(self, rv: int, timeout: float) -> Optional[List[Tuple[int, bytes]]]:
         """Events with rv' > rv; None ⇒ rv fell out of the window (410)."""
         with self.cond:
-            if self.events and rv < self.events[0][0] - 1:
+            if self._stale(rv):
                 return None  # compacted away → 410 Gone
             out = [e for e in self.events if e[0] > rv]
             if out:
                 return out
             self.cond.wait(timeout)
-            if self.events and rv < self.events[0][0] - 1:
+            if self._stale(rv):
                 return None
             return [e for e in self.events if e[0] > rv]
+
+    def compact(self, keep: int = 0) -> None:
+        """Drop all but the last ``keep`` retained events (the etcd
+        compaction shape, on demand — the chaos runner's forced-410 lever).
+        Wakes blocked watchers so stale ones see the 410 immediately."""
+        with self.cond:
+            while len(self.events) > keep:
+                self.events.popleft()
+            self.cond.notify_all()
 
 
 class ApiServer:

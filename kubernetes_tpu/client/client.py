@@ -44,9 +44,20 @@ class ApiClient:
     full-stack throughput at kubemark scale (client-go pools HTTP/2
     streams for the same reason)."""
 
-    def __init__(self, endpoint: str, timeout: float = 10.0):
+    def __init__(
+        self,
+        endpoint: str,
+        timeout: float = 10.0,
+        watch_timeout: Optional[float] = None,
+    ):
         self.endpoint = endpoint.rstrip("/")
         self.timeout = timeout
+        # watch-stream read timeout (None → max(timeout, 30), the historical
+        # default).  The reflector treats an expiry as a clean EOF and
+        # re-watches at its current rv — the reference's client-side watch
+        # timeout behavior (reflector.go timeoutSeconds), so a quiet stream
+        # cycles cheaply instead of surfacing as an error + relist.
+        self.watch_timeout = watch_timeout
         parsed = urllib.parse.urlparse(self.endpoint)
         self._host = parsed.hostname or "127.0.0.1"
         self._port = parsed.port or 80
@@ -206,7 +217,12 @@ class ApiClient:
         req = urllib.request.Request(
             f"{self.endpoint}/api/v1/{resource}?watch=1&resourceVersion={rv}"
         )
-        with urllib.request.urlopen(req, timeout=max(self.timeout, 30)) as resp:
+        read_timeout = (
+            self.watch_timeout
+            if self.watch_timeout is not None
+            else max(self.timeout, 30)
+        )
+        with urllib.request.urlopen(req, timeout=read_timeout) as resp:
             for line in resp:
                 line = line.strip()
                 if not line:
@@ -282,6 +298,7 @@ class Reflector:
         self._thread: Optional[threading.Thread] = None
         self.synced = threading.Event()
         self.relists = 0
+        self.watch_timeouts = 0  # idle read expiries re-watched without relist
 
     # ----- list + diff (DeltaFIFO Replace) ---------------------------------
 
@@ -329,20 +346,32 @@ class Reflector:
     # ----- the loop ---------------------------------------------------------
 
     def run_once(self) -> None:
-        """One ListAndWatch cycle; returns on stream end or 410."""
+        """One ListAndWatch cycle; returns on stream end or 410.
+
+        An idle READ TIMEOUT is a clean EOF, not an error: the store is
+        consistent up to ``self.rv``, so the watch reopens at that rv
+        without the full relist a transport error forces (reflector.go's
+        client-side timeoutSeconds behavior)."""
         self._relist()
-        try:
-            for evt in self.client.watch_stream(self.resource, self.rv):
-                if self._stop.is_set():
-                    return
-                if evt.get("type") == "BOOKMARK":
-                    continue
-                self.rv = evt["rv"]
-                self._apply(evt["type"], decode(evt["object"]))
-        except ApiError as e:
-            if e.code != 410:
-                raise
-            # compaction: fall through — the caller relists
+        while not self._stop.is_set():
+            try:
+                for evt in self.client.watch_stream(self.resource, self.rv):
+                    if self._stop.is_set():
+                        return
+                    if evt.get("type") == "BOOKMARK":
+                        continue
+                    self.rv = evt["rv"]
+                    self._apply(evt["type"], decode(evt["object"]))
+                return  # server closed the stream: caller relists
+            except ApiError as e:
+                if e.code != 410:
+                    raise
+                return  # compaction: fall through — the caller relists
+            except (socket.timeout, TimeoutError):
+                # quiet stream outlived the read timeout; re-watch at the
+                # current rv
+                self.watch_timeouts += 1
+                continue
 
     def start(self) -> "Reflector":
         def loop():
@@ -368,8 +397,11 @@ class RemoteClusterSource:
     the in-proc FakeCluster (testing/fake_cluster.py), so `server.py
     --api-endpoint` swaps the wire tier in without touching the core."""
 
-    def __init__(self, endpoint: str):
-        self.client = ApiClient(endpoint)
+    def __init__(self, endpoint: str, client: Optional[ApiClient] = None):
+        # an injected client (e.g. the chaos subsystem's fault-wrapping
+        # ChaosClient) rides the whole tier: reflector streams, bindings,
+        # status writes
+        self.client = client or ApiClient(endpoint)
         # SHARED informers (one list/watch stream per resource, any number
         # of consumers + named indexes — shared_informer.go:459); the
         # scheduler registers as the first consumer, debuggers/metrics

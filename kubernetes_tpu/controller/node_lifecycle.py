@@ -49,10 +49,14 @@ class NodeLifecycleController:
         grace_s: float = 40.0,
         tick_s: float = 1.0,
         clock=time.time,
+        chaos_client=None,
     ):
         from kubernetes_tpu.client import ApiClient, Reflector
 
-        self.client = ApiClient(endpoint)
+        # chaos_client: a fault-injecting ApiClient (chaos subsystem) so
+        # the controller's own taint/evict writes ride the same failure
+        # plan as the scheduler's reads
+        self.client = chaos_client or ApiClient(endpoint)
         self.grace_s = grace_s
         self.tick_s = tick_s
         self.clock = clock
@@ -154,9 +158,20 @@ class NodeLifecycleController:
             except Exception:  # noqa: BLE001 — already gone
                 pass
 
-    def start(self) -> "NodeLifecycleController":
+    def tick(self) -> None:
+        """One health-check pass — the deterministic drive surface the
+        chaos runner uses instead of the wall-clock loop."""
+        self._tick()
+
+    def wait_for_sync(self, timeout: float = 10.0) -> bool:
+        return all(r.synced.wait(timeout) for r in self._reflectors)
+
+    def start(self, run_loop: bool = True) -> "NodeLifecycleController":
         for r in self._reflectors:
             r.start()
+        if not run_loop:
+            # reflectors only; the caller ticks the health check itself
+            return self
 
         def loop():
             while not self._stop.wait(self.tick_s):

@@ -503,6 +503,24 @@ class SchedulerMetrics:
                 ("kind",),
             )
         )
+        self.chaos_injected = r.register(
+            Counter(
+                "scheduler_tpu_chaos_injected_total",
+                "Faults delivered by the chaos subsystem, by kind "
+                "(watch_cut / compact / api_error / api_timeout / "
+                "bind_conflict / bind_slow / node_flap / lease_contention / "
+                "clock_skew).",
+                ("kind",),
+            )
+        )
+        self.chaos_recovery = r.register(
+            Histogram(
+                "scheduler_tpu_chaos_recovery_seconds",
+                "Latency from a fault injection to the next fully drained "
+                "scheduling queue, by fault kind.",
+                ("kind",),
+            )
+        )
         self.recorder = MetricAsyncRecorder()
 
     def expose(self) -> str:

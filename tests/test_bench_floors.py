@@ -44,6 +44,31 @@ def _bench_configs(bench):
     return out
 
 
+# Presence-without-floor is fine: newly introduced keys (config7_chaos_*
+# and friends) may ship in a recorded bench for rounds before anyone
+# ratchets a floor for them; only keys BOTH recorded and floored gate.
+# Non-scalar entries (config0_phases breakdown dicts) never gate.
+def _gateable(results, key):
+    v = results.get(key)
+    return v if isinstance(v, (int, float)) else None
+
+
+def _floor_failures(floors, results):
+    return [
+        f"{key}: {results[key]:.1f} < floor {floor}"
+        for key, floor in floors.items()
+        if _gateable(results, key) is not None and results[key] < floor
+    ]
+
+
+def _ceiling_failures(ceilings, results):
+    return [
+        f"{key}: {results[key]:.2f} > ceiling {cap}"
+        for key, cap in ceilings.items()
+        if _gateable(results, key) is not None and results[key] > cap
+    ]
+
+
 def test_floors_file_is_wellformed():
     doc = _load(os.path.join(ROOT, "BENCH_FLOORS.json"))
     floors = doc["floors"]
@@ -66,20 +91,12 @@ def test_latest_recorded_bench_clears_floors():
     # rounds; config3/4 floors reflect the round-4 kernels, so only check
     # keys present in the recorded results AND not newer than them.
     since = floors_doc.get("floors_since", {})
-    failures = [
-        f"{key}: {results[key]:.1f} < floor {floor}"
-        for key, floor in floors.items()
-        if key in results and results[key] < floor
-    ]
+    failures = _floor_failures(floors, results)
     # Ceilings: lower-is-better wall-clock budgets (the config0 north-star
     # drain).  Same since-round gating as floors, via ceilings_since.
     ceilings = floors_doc.get("ceilings", {})
     ceilings_since = floors_doc.get("ceilings_since", {})
-    ceiling_failures = [
-        f"{key}: {results[key]:.2f} > ceiling {cap}"
-        for key, cap in ceilings.items()
-        if key in results and results[key] > cap
-    ]
+    ceiling_failures = _ceiling_failures(ceilings, results)
     # Round 3's recorded results predate these floors (the floors were
     # introduced because round 3 regressed); enforcement begins with the
     # first bench recorded after this test exists — r4 and later.
@@ -114,3 +131,29 @@ def test_latest_recorded_bench_clears_floors():
         assert results["parity_total_diffs"] == 0, (
             f"parity diffs in recorded bench: {results['parity_total_diffs']}"
         )
+
+
+def test_new_keys_without_floors_are_tolerated():
+    """A bench result key with no recorded floor (or a non-scalar value)
+    must never fail the gate — new config lines land a round before their
+    floors get ratcheted in.  Exercises the REAL gate helpers against a
+    synthetic result set containing unfloored, non-scalar, and failing
+    keys."""
+    floors = {"config1": 100.0}
+    ceilings = {"config0_drain_s": 2.5}
+    results = {
+        "config1": 150.0,  # floored, passing
+        "config0_drain_s": 2.0,  # ceilinged, passing
+        "config7_chaos_soak_pods_per_s": 1.0,  # present, no floor → ignored
+        "config7_chaos_recovery_p99_ms": 1e9,  # present, no ceiling → ignored
+        "config0_phases": {"bind": 0.5},  # non-scalar → ignored
+    }
+    assert _floor_failures(floors, results) == []
+    assert _ceiling_failures(ceilings, results) == []
+    # and the gate still bites on keys that ARE floored
+    results["config1"] = 10.0
+    results["config0_drain_s"] = 9.0
+    assert _floor_failures(floors, results) == ["config1: 10.0 < floor 100.0"]
+    assert _ceiling_failures(ceilings, results) == [
+        "config0_drain_s: 9.00 > ceiling 2.5"
+    ]

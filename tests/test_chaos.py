@@ -1,0 +1,208 @@
+"""Chaos & replay subsystem: seeded fault scenarios must pass the
+invariant oracle under KTPU_SANITIZE=1, journals must be deterministic
+(same seed → byte-identical journal for in-proc scenarios), and replaying
+any recorded journal must reproduce the recorded placements bit-for-bit.
+
+The checked-in journals under tests/fixtures/journals/ are regression
+corpora: a scheduler behavior change that alters a recorded placement
+fails the replay test and must be acknowledged by re-recording (see
+tests/fixtures/journals/README.md).
+"""
+
+import glob
+import os
+
+import pytest
+
+from kubernetes_tpu.analysis import sanitizer
+from kubernetes_tpu.chaos import (
+    ALL_KINDS,
+    SCENARIOS,
+    FaultPlan,
+    Journal,
+    replay,
+    run_scenario,
+)
+from kubernetes_tpu.chaos import faults
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+JOURNAL_DIR = os.path.join(HERE, "fixtures", "journals")
+
+INPROC = [n for n, s in SCENARIOS.items() if s.mode == "inproc"]
+
+
+@pytest.fixture()
+def sanitize_on(monkeypatch):
+    monkeypatch.setenv("KTPU_SANITIZE", "1")
+    sanitizer.reset_enabled_memo()
+    yield
+    monkeypatch.delenv("KTPU_SANITIZE", raising=False)
+    sanitizer.reset_enabled_memo()
+
+
+# ---------------------------------------------------------------------------
+# fault plan determinism
+# ---------------------------------------------------------------------------
+
+
+class TestFaultPlan:
+    def test_decisions_deterministic_across_instances(self):
+        a = FaultPlan(seed=7, rates={faults.BIND_CONFLICT: 0.3})
+        b = FaultPlan(seed=7, rates={faults.BIND_CONFLICT: 0.3})
+        uids = [f"default/p-{i}" for i in range(200)]
+        assert [a.bind_fault(u) for u in uids] == [b.bind_fault(u) for u in uids]
+
+    def test_decisions_independent_of_call_order(self):
+        a = FaultPlan(seed=7, rates={faults.API_ERROR: 0.3})
+        b = FaultPlan(seed=7, rates={faults.API_ERROR: 0.3})
+        keys = [("GET", "/api/v1/pods", i) for i in range(50)]
+        fwd = [a.req_fault(*k) for k in keys]
+        rev = [b.req_fault(*k) for k in reversed(keys)]
+        assert fwd == list(reversed(rev))
+
+    def test_different_seeds_differ(self):
+        uids = [f"default/p-{i}" for i in range(400)]
+        a = FaultPlan(seed=1, rates={faults.BIND_CONFLICT: 0.5})
+        b = FaultPlan(seed=2, rates={faults.BIND_CONFLICT: 0.5})
+        assert [a.bind_fault(u) for u in uids] != [b.bind_fault(u) for u in uids]
+
+    def test_bind_faults_are_one_shot(self):
+        plan = FaultPlan(seed=3, rates={faults.BIND_CONFLICT: 1.0})
+        assert plan.bind_fault("default/x") == faults.BIND_CONFLICT
+        assert plan.bind_fault("default/x") is None  # the retry converges
+
+    def test_zero_rate_never_fires(self):
+        plan = FaultPlan(seed=3)
+        assert all(plan.bind_fault(f"u{i}") is None for i in range(100))
+        assert all(
+            plan.watch_event_fault("pods", 0, i) is None for i in range(100)
+        )
+
+    def test_unknown_kind_rejected(self):
+        with pytest.raises(ValueError):
+            FaultPlan(seed=1, rates={"meteor_strike": 1.0})
+
+    def test_lease_blackout_is_scripted(self):
+        plan = FaultPlan(seed=1, lease_blackout=("A", 10.0, 20.0))
+        assert plan.lease_fault("A", 0, 15.0)
+        assert not plan.lease_fault("A", 0, 9.0)
+        assert not plan.lease_fault("B", 0, 15.0)
+
+    def test_injection_log_and_hook(self):
+        seen = []
+        plan = FaultPlan(seed=1, on_inject=lambda k, s, key: seen.append(k))
+        plan.fire(faults.NODE_FLAP, "heartbeat", "n1")
+        assert seen == [faults.NODE_FLAP]
+        assert plan.injected_counts() == {faults.NODE_FLAP: 1}
+
+
+# ---------------------------------------------------------------------------
+# journal mechanics
+# ---------------------------------------------------------------------------
+
+
+class TestJournal:
+    def test_roundtrip(self, tmp_path):
+        j = Journal(str(tmp_path / "j.jsonl"))
+        j.append("header", version=1, seed=5)
+        j.append("clock", now=1000.0)
+        path = j.dump()
+        assert Journal.load_entries(path) == j.entries()
+
+    def test_logical_timestamps_monotonic(self):
+        j = Journal()
+        for i in range(5):
+            j.append("note", i=i)
+        ts = [e["t"] for e in j.entries()]
+        assert ts == sorted(ts) and len(set(ts)) == len(ts)
+
+    def test_replay_requires_header(self):
+        with pytest.raises(ValueError):
+            replay([{"t": 1, "kind": "clock", "now": 0.0}])
+
+
+# ---------------------------------------------------------------------------
+# scenarios: oracle + replay under the sanitizer
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("name", sorted(SCENARIOS))
+def test_scenario_passes_oracle_and_replays(name, sanitize_on, tmp_path):
+    viol0 = sanitizer.violation_count()
+    res = run_scenario(name, journal_path=str(tmp_path / f"{name}.jsonl"))
+    assert res.problems == [], f"{name} oracle: {res.problems}"
+    assert sanitizer.violation_count() == viol0, "sanitizer violations"
+    scn = SCENARIOS[name]
+    if scn.rates:
+        assert res.injected, f"{name} injected no faults"
+    # every recorded journal replays to identical placements
+    rr = replay(str(tmp_path / f"{name}.jsonl"))
+    assert rr.ok, f"{name} replay mismatches: {rr.mismatches[:2]}"
+    assert rr.drains > 0
+    if res.failover_stall_s is not None:
+        assert res.failover_stall_s <= scn.lease_duration_s + 3.0
+
+
+def test_every_fault_kind_covered_by_catalogue():
+    """The catalogue (plus the failover/flap drives' scripted fires) must
+    exercise the full vocabulary."""
+    covered = set()
+    for scn in SCENARIOS.values():
+        covered |= set(scn.rates)
+        if scn.kind == "failover":
+            covered |= {faults.LEASE_CONTENTION, faults.CLOCK_SKEW}
+        if scn.kind == "flap":
+            covered.add(faults.NODE_FLAP)
+    assert covered == set(ALL_KINDS), set(ALL_KINDS) - covered
+
+
+def test_same_seed_byte_identical_journal(sanitize_on):
+    name = "bind-conflict"
+    j1 = run_scenario(name).journal.serialize()
+    j2 = run_scenario(name).journal.serialize()
+    assert j1 == j2
+
+
+def test_different_seed_different_journal():
+    import dataclasses
+
+    scn = SCENARIOS["bind-conflict"]
+    j1 = run_scenario(scn).journal.serialize()
+    j2 = run_scenario(dataclasses.replace(scn, seed=scn.seed + 1)).journal.serialize()
+    assert j1 != j2
+
+
+def test_chaos_metrics_wired(sanitize_on):
+    """scheduler_tpu_chaos_injected_total{kind} counts every delivered
+    fault and the recovery histogram observes fault→drained windows."""
+    res = run_scenario("bind-conflict")
+    assert res.injected.get(faults.BIND_CONFLICT, 0) > 0
+    # the runner's scheduler is gone, but the journal carries the fault
+    # entries the counter hook saw — counts must agree
+    fault_entries = [
+        e for e in res.journal.entries() if e["kind"] == "fault"
+    ]
+    assert len(fault_entries) == sum(res.injected.values())
+
+
+def test_fixture_journals_replay_bit_for_bit():
+    """The checked-in regression corpora: any behavior change that alters
+    a recorded placement fails here — re-record deliberately or fix the
+    regression."""
+    paths = sorted(glob.glob(os.path.join(JOURNAL_DIR, "*.jsonl")))
+    assert paths, "no fixture journals checked in"
+    for path in paths:
+        rr = replay(path)
+        assert rr.ok, f"{os.path.basename(path)}: {rr.mismatches[:2]}"
+        assert rr.placements == rr.expected
+
+
+@pytest.mark.slow
+def test_long_chaos_soak(sanitize_on):
+    """The bench config7 shape at full size — slow tier only; tier-1
+    covers the same invariants with the short seeded scenarios above."""
+    from kubernetes_tpu.chaos import run_chaos_soak
+
+    out = run_chaos_soak(n_nodes=32, n_pods=2000, rounds=6)
+    assert out["problems"] == []
+    assert out["injected_total"] > 0

@@ -122,6 +122,144 @@ class TestListWatch:
         assert set(seen) == {f"n{i}" for i in range(12)}
 
 
+class TestWatchCacheWindow:
+    def test_since_empty_window_stale_rv_is_410(self):
+        """An EMPTY retained window (server restart, deque wrap, explicit
+        compaction) with a stale rv must 410, not return [] — the silent []
+        strands a watcher that can never catch up."""
+        from kubernetes_tpu.client.api_server import _WatchCache
+
+        cache = _WatchCache(window=4)
+        for i in range(6):
+            cache.record("ADDED", {"object": {"name": f"n{i}"}})
+        cache.events.clear()  # nothing retained, head counter at 6
+        assert cache.since(2, timeout=0.01) is None  # behind → 410
+        assert cache.since(6, timeout=0.01) == []  # caught up → just idle
+
+    def test_since_nonempty_window_unchanged(self):
+        from kubernetes_tpu.client.api_server import _WatchCache
+
+        cache = _WatchCache(window=4)
+        for i in range(6):
+            cache.record("ADDED", {"object": {"name": f"n{i}"}})
+        # window retains rv 3..6 → oldest replayable position is rv 2
+        assert cache.since(1, timeout=0.01) is None
+        assert [rv for rv, _ in cache.since(2, timeout=0.01)] == [3, 4, 5, 6]
+
+    def test_compact_helper_410s_stale_watchers(self, served):
+        api, server, endpoint = served
+        api.create_node(_node("n0"))
+        api.create_node(_node("n1"))
+        server.caches["nodes"].compact()
+        client = ApiClient(endpoint)
+        with pytest.raises(ApiError) as err:
+            for _ in client.watch_stream("nodes", 1):
+                pass
+        assert err.value.code == 410
+
+
+class TestWatchTimeout:
+    def test_watch_timeout_is_configurable(self, served):
+        api, server, endpoint = served
+        api.create_node(_node("n0"))
+        client = ApiClient(endpoint, watch_timeout=0.05)
+        rv = client.list("nodes")["resourceVersion"]
+        # the server's bookmark cadence is 0.5s, so a 50ms read timeout
+        # expires first — previously hardwired to max(timeout, 30)
+        with pytest.raises((TimeoutError, OSError)):
+            for _ in client.watch_stream("nodes", rv):
+                pass
+
+    def test_reflector_rewatches_on_read_timeout_without_relist(self, served):
+        api, server, endpoint = served
+        api.create_node(_node("n0"))
+        client = ApiClient(endpoint, watch_timeout=0.1)
+        seen = {}
+        r = Reflector(
+            client,
+            "nodes",
+            lambda n: seen.__setitem__(n.name, "add"),
+            lambda o, n: seen.__setitem__(n.name, "update"),
+            lambda n: seen.pop(n.name, None),
+        )
+        r.start()
+        assert r.synced.wait(5)
+        # idle past several read timeouts: the stream must cycle as a
+        # clean EOF (re-watch at the current rv), not an error → relist
+        assert _wait(lambda: r.watch_timeouts >= 2, timeout=5.0)
+        assert r.relists == 1
+        api.create_node(_node("n1"))
+        assert _wait(lambda: "n1" in seen)
+        assert r.relists == 1, "read timeout took the relist error path"
+        r.stop()
+
+
+class TestRelistAfter410:
+    def test_relist_diff_emits_exact_callbacks_after_blackout(self, served):
+        """Force a compaction during a watch blackout; the relist diff must
+        synthesize exactly the add/update/delete deltas — including a
+        delete that happened entirely inside the blackout."""
+        api, server, endpoint = served
+        for name in ("n0", "n1", "n2"):
+            api.create_node(_node(name))
+        client = ApiClient(endpoint)
+        log = []
+        r = Reflector(
+            client,
+            "nodes",
+            lambda n: log.append(("add", n.name)),
+            lambda o, n: log.append(("update", n.name)),
+            lambda n: log.append(("delete", n.name)),
+        )
+        r._relist()
+        assert sorted(log) == [("add", "n0"), ("add", "n1"), ("add", "n2")]
+        assert r.relists == 1
+        stale_rv = r.rv
+
+        # blackout: the stream is down while the store mutates…
+        api.update_node(_node("n1", cpu="16"))
+        api.delete_node("n2")
+        api.create_node(_node("n3"))
+        # …and the server compacts past the reflector's rv
+        server.caches["nodes"].compact()
+        with pytest.raises(ApiError) as err:
+            for _ in client.watch_stream("nodes", stale_rv):
+                pass
+        assert err.value.code == 410
+
+        log.clear()
+        r._relist()
+        assert sorted(log) == [
+            ("add", "n3"),
+            ("delete", "n2"),
+            ("update", "n1"),
+        ]
+        assert r.relists == 2
+
+    def test_live_reflector_survives_forced_compaction(self, served):
+        """End to end through the running loop: compact mid-stream, keep
+        mutating, and the reflector's store reconverges via relist."""
+        api, server, endpoint = served
+        store = {}
+        r = Reflector(
+            ApiClient(endpoint),
+            "nodes",
+            lambda n: store.__setitem__(n.name, n),
+            lambda o, n: store.__setitem__(n.name, n),
+            lambda n: store.pop(n.name, None),
+        )
+        r.start()
+        assert r.synced.wait(5)
+        for i in range(4):
+            api.create_node(_node(f"n{i}"))
+        assert _wait(lambda: len(store) == 4)
+        server.caches["nodes"].compact()
+        api.delete_node("n0")
+        api.create_node(_node("n9"))
+        assert _wait(lambda: set(store) == {"n1", "n2", "n3", "n9"})
+        r.stop()
+
+
 class TestScheduledOverWire:
     def test_scheduler_binds_through_http(self, served):
         api, _, endpoint = served
