@@ -56,7 +56,7 @@ def _drain(sched):
     return ok, dt
 
 
-def _run_workload(nodes, pods, warm=None):
+def _run_workload(nodes, pods, warm=None, trace=False):
     """Warm the jit caches at FINAL bucket shapes (two full batches cover
     both the direct and chained dispatch paths, with the capacity hint
     pre-sized to the whole workload), then time the rest — the steady-state
@@ -86,7 +86,13 @@ def _run_workload(nodes, pods, warm=None):
     # phase watermark: callers diff against this to attribute the TIMED
     # drain (the config0_phases breakdown) without warm-up noise
     sched._phases_mark = sched.phases.snapshot()
+    # trace=True: span-trace the TIMED drain only (capture_trace's
+    # --trace-out artifact) — warm-up compiles stay out of the capture
+    if trace:
+        sched.tracer.start()
     ok, dt = _drain(sched)
+    if trace:
+        sched.tracer.stop()
     return ok, max(dt, 1e-9), sched
 
 
@@ -441,18 +447,15 @@ def bench_preemption(n_nodes=500):
     return ok, max(dt, 1e-9), sched
 
 
-def bench_north_star(n_nodes=10000, n_pods=100000):
-    """Config 0: the BASELINE.json north-star shape — a 10k-node snapshot
-    with 100k pending pods, drained end to end.  Reports honest wall
-    seconds for the timed drain (first-compile excluded via the warm
-    phase; snapshot pack + queue + device/committer + binding included)
-    against the '<1 s' target."""
+def _north_star_pods(n_pods, prefix="ns"):
+    """The config0 pod template (app-sharded labels, mixed cpu/mem
+    requests) — shared by bench_north_star and capture_trace."""
     from kubernetes_tpu.api.types import Container, Pod
 
     rng = random.Random(4242)
-    pods = [
+    return [
         Pod(
-            name=f"ns-{i}",
+            name=f"{prefix}-{i}",
             labels={"app": f"app-{i % 16}"},
             containers=[
                 Container(
@@ -466,13 +469,64 @@ def bench_north_star(n_nodes=10000, n_pods=100000):
         )
         for i in range(n_pods)
     ]
-    return _run_workload(_basic_nodes(n_nodes), pods)
+
+
+def bench_north_star(n_nodes=10000, n_pods=100000):
+    """Config 0: the BASELINE.json north-star shape — a 10k-node snapshot
+    with 100k pending pods, drained end to end.  Reports honest wall
+    seconds for the timed drain (first-compile excluded via the warm
+    phase; snapshot pack + queue + device/committer + binding included)
+    against the '<1 s' target."""
+    return _run_workload(_basic_nodes(n_nodes), _north_star_pods(n_pods))
+
+
+def capture_trace(path, n_nodes=1000, n_pods=10000):
+    """--trace-out=FILE: one TRACED config0-shaped drain (warm first, then
+    trace the timed drain — _run_workload's choreography), written as
+    Chrome trace-event JSON and validated to parse — the observability
+    layer's CI artifact.  Returns the summary dict main() prints."""
+    ok, dt, sched = _run_workload(
+        _basic_nodes(n_nodes), _north_star_pods(n_pods, prefix="tr"), trace=True
+    )
+    with open(path, "w") as f:
+        json.dump(sched.tracer.export(), f)
+    # the artifact must round-trip as valid Chrome trace JSON with the
+    # expected span structure, or the capture is worthless
+    with open(path) as f:
+        loaded = json.load(f)
+    evs = loaded["traceEvents"]
+    assert any(e.get("name") == "drain" for e in evs), "no drain span"
+    assert any(e.get("cat") == "phase" for e in evs), "no phase spans"
+    assert any(e.get("cat") == "batch" for e in evs), "no batch spans"
+    for e in evs:
+        if e.get("ph") == "X":
+            assert e["ts"] >= 0 and e["dur"] >= 0
+    return {
+        "trace": path,
+        "events": len(evs),
+        "pods": ok,
+        "drain_s": round(dt, 3),
+        "pods_per_s": round(ok / dt, 1),
+        "valid": True,
+    }
 
 
 def main():
     n_nodes = int(os.environ.get("BENCH_NODES", "5000"))
     n_pods = int(os.environ.get("BENCH_PODS", "10000"))
     full = os.environ.get("BENCH_FULL", "1") != "0"
+
+    # --trace-out=FILE: standalone traced-drain capture (no full bench) —
+    # sizes via BENCH_TRACE_NODES/BENCH_TRACE_PODS
+    for a in sys.argv[1:]:
+        if a.startswith("--trace-out="):
+            out = capture_trace(
+                a.split("=", 1)[1],
+                n_nodes=int(os.environ.get("BENCH_TRACE_NODES", "1000")),
+                n_pods=int(os.environ.get("BENCH_TRACE_PODS", "10000")),
+            )
+            print(json.dumps(out))
+            return
 
     # --profile-dir=DIR (or BENCH_PROFILE_DIR): every Scheduler the bench
     # builds wraps its drains in jax.profiler.trace, one xplane artifact

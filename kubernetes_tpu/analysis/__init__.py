@@ -45,6 +45,12 @@ LOCK_MODULES = (
     os.path.join("chaos", "faults.py"),
     os.path.join("chaos", "proxy.py"),
     os.path.join("chaos", "journal.py"),
+    # observability: the span buffer and flight-recorder ring are appended
+    # from the scheduling loop, binding workers, informer threads, and HTTP
+    # debug handlers; explain holds the Scheduler lock across its prep
+    os.path.join("observability", "tracer.py"),
+    os.path.join("observability", "flightrecorder.py"),
+    os.path.join("observability", "explain.py"),
 )
 PURITY_MODULES = (
     os.path.join("framework", "plugins.py"),
@@ -55,6 +61,7 @@ PURITY_MODULES = (
 JIT_MODULES = (
     os.path.join("ops", "chain.py"),
     os.path.join("ops", "common.py"),
+    os.path.join("ops", "explain.py"),
     os.path.join("ops", "fastpath.py"),
     os.path.join("ops", "filters.py"),
     os.path.join("ops", "gang.py"),

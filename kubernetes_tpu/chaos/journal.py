@@ -83,6 +83,13 @@ class Journal:
             self._entries.append(entry)
             return entry
 
+    def now(self) -> int:
+        """Current logical timestamp (the last appended entry's ``t``) —
+        the correlation key observability spans carry so a wall-clock
+        trace can be located in the replayable journal stream."""
+        with self._mu:
+            return self._t
+
     def entries(self) -> List[dict]:
         with self._mu:
             return list(self._entries)
@@ -123,10 +130,30 @@ class JournalRecorder:
 
     def __init__(self, journal: Journal):
         self.journal = journal
+        self._originals = None  # (sched, {handler name: original}) once attached
 
     def attach(self, sched) -> None:
         journal = self.journal
         mu = sched._mu
+        # wall-clock ↔ logical-time correlation: trace spans recorded while
+        # this journal is attached carry its logical timestamp as args.lt
+        tracer = getattr(sched, "tracer", None)
+        if tracer is not None:
+            tracer.logical_time = journal.now
+        self._originals = (
+            sched,
+            {
+                name: getattr(sched, name)
+                for name in (
+                    "on_node_add",
+                    "on_node_update",
+                    "on_node_delete",
+                    "on_pod_add",
+                    "on_pod_update",
+                    "on_pod_delete",
+                )
+            },
+        )
 
         def wrap1(action: str, res: str, orig):
             def handler(obj):
@@ -158,6 +185,21 @@ class JournalRecorder:
         sched.on_pod_add = wrap1("add", "pods", sched.on_pod_add)
         sched.on_pod_update = wrap2("pods", sched.on_pod_update)
         sched.on_pod_delete = wrap1("delete", "pods", sched.on_pod_delete)
+
+    def detach(self) -> None:
+        """Restore the scheduler's original handlers and stop stamping this
+        journal's logical time into trace spans — for schedulers that
+        outlive the recorded scenario."""
+        if self._originals is None:
+            return
+        sched, originals = self._originals
+        self._originals = None
+        with sched._mu:
+            for name, orig in originals.items():
+                setattr(sched, name, orig)
+        tracer = getattr(sched, "tracer", None)
+        if tracer is not None and tracer.logical_time == self.journal.now:
+            tracer.logical_time = None
 
 
 def decisions_of(outcomes) -> List[dict]:

@@ -24,10 +24,18 @@ from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 # ---------------------------------------------------------------------------
 
 
+def _esc_label(v: str) -> str:
+    """Prometheus text-exposition label-value escaping: backslash, double
+    quote, and newline (exposition format spec).  Pod names and plugin
+    reason strings flow into labels, so raw interpolation would corrupt
+    the scrape on the first quote or newline."""
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
 def _fmt_labels(labels: Tuple[Tuple[str, str], ...]) -> str:
     if not labels:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in labels)
+    inner = ",".join(f'{k}="{_esc_label(str(v))}"' for k, v in labels)
     return "{" + inner + "}"
 
 
@@ -67,8 +75,13 @@ class Counter(Metric):
         return self._values.get(self._key(labels), 0.0)
 
     def expose(self) -> List[str]:
+        # snapshot under the metric lock: a concurrent inc from a binding
+        # worker mid-scrape would otherwise raise "dictionary changed size
+        # during iteration" (and could expose a torn series list)
+        with self._mu:
+            items = sorted(self._values.items())
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {self.kind}"]
-        for k, v in sorted(self._values.items()):
+        for k, v in items:
             out.append(f"{self.name}{_fmt_labels(k)} {v:g}")
         return out
 
@@ -81,7 +94,9 @@ class Gauge(Metric):
         self._values: Dict[Tuple, float] = {}
 
     def set(self, value: float, **labels) -> None:
-        self._values[self._key(labels)] = float(value)
+        k = self._key(labels)
+        with self._mu:
+            self._values[k] = float(value)
 
     def inc(self, amount: float = 1.0, **labels) -> None:
         k = self._key(labels)
@@ -92,8 +107,10 @@ class Gauge(Metric):
         return self._values.get(self._key(labels), 0.0)
 
     def expose(self) -> List[str]:
+        with self._mu:
+            items = sorted(self._values.items())
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {self.kind}"]
-        for k, v in sorted(self._values.items()):
+        for k, v in items:
             out.append(f"{self.name}{_fmt_labels(k)} {v:g}")
         return out
 
@@ -143,16 +160,22 @@ class Histogram(Metric):
         """Bucket-interpolated quantile (the promql histogram_quantile
         estimate) over ALL label sets when none given, else one set."""
         if self.label_names and not labels:
-            # aggregate across label sets
+            # aggregate across label sets (snapshot under the lock — a
+            # concurrent observe can add a label set mid-iteration)
             agg = [0] * (len(self.buckets) + 1)
-            for counts in self._counts.values():
+            with self._mu:
+                rows = [list(c) for c in self._counts.values()]
+            for counts in rows:
                 for i, c in enumerate(counts):
                     agg[i] += c
             counts, n = agg, sum(agg)
         else:
             k = self._key(labels)
-            counts = self._counts.get(k, [0] * (len(self.buckets) + 1))
-            n = self._n.get(k, 0)
+            with self._mu:
+                counts = list(
+                    self._counts.get(k, [0] * (len(self.buckets) + 1))
+                )
+                n = self._n.get(k, 0)
         if n == 0:
             return 0.0
         rank = q * n
@@ -169,9 +192,16 @@ class Histogram(Metric):
         return self.buckets[-1] if self.buckets else 0.0
 
     def expose(self) -> List[str]:
+        # consistent snapshot under the lock (see Counter.expose): bucket
+        # rows, _sum and _count must come from ONE moment or a concurrent
+        # observe_n mid-scrape yields sum/count that disagree with buckets
+        with self._mu:
+            snap = [
+                (k, list(self._counts[k]), self._sum[k], self._n[k])
+                for k in sorted(self._counts)
+            ]
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {self.kind}"]
-        for k in sorted(self._counts):
-            counts = self._counts[k]
+        for k, counts, total, n in snap:
             cum = 0
             for b, c in zip(self.buckets, counts):
                 cum += c
@@ -180,8 +210,8 @@ class Histogram(Metric):
             cum += counts[-1]
             lab = k + (("le", "+Inf"),)
             out.append(f"{self.name}_bucket{_fmt_labels(lab)} {cum}")
-            out.append(f"{self.name}_sum{_fmt_labels(k)} {self._sum[k]:g}")
-            out.append(f"{self.name}_count{_fmt_labels(k)} {self._n[k]}")
+            out.append(f"{self.name}_sum{_fmt_labels(k)} {total:g}")
+            out.append(f"{self.name}_count{_fmt_labels(k)} {n}")
         return out
 
 
@@ -190,6 +220,10 @@ class Registry:
         self._metrics: List[Metric] = []
 
     def register(self, metric: Metric) -> Metric:
+        # duplicate names would expose two HELP/TYPE headers for one series
+        # family — rejected by Prometheus parsers mid-scrape
+        if any(m.name == metric.name for m in self._metrics):
+            raise ValueError(f"metric {metric.name!r} already registered")
         self._metrics.append(metric)
         return metric
 
@@ -280,12 +314,19 @@ class PhaseAccumulator:
         self._mu = threading.Lock()
         self._totals: Dict[str, float] = {}
         self.hist = hist
+        # optional observability.Tracer: when tracing is enabled every
+        # accumulated phase interval ALSO lands as a complete span on the
+        # recording thread's track — one hook covers all dispatch paths
+        self.tracer = None
 
     def add(self, phase: str, dt: float) -> None:
         with self._mu:
             self._totals[phase] = self._totals.get(phase, 0.0) + dt
             if self.hist is not None:
                 self.hist.observe(dt, phase=phase)
+        tr = self.tracer
+        if tr is not None and tr.enabled:
+            tr.complete_tail(phase, dt)
 
     def timer(self, phase: str):
         """Context manager: accumulate the block's wall time."""
@@ -521,6 +562,47 @@ class SchedulerMetrics:
                 ("kind",),
             )
         )
+        # --- observability-layer overhead accounting (observability/) ---
+        # refreshed on scrape from Tracer.stats()/FlightRecorder.stats()
+        # (Scheduler.refresh_gauges) so the hot recording path never touches
+        # the registry.
+        self.trace_buffered = r.register(
+            Gauge(
+                "scheduler_tpu_trace_buffered_events",
+                "Trace events currently buffered by the span tracer.",
+            )
+        )
+        self.trace_dropped = r.register(
+            Gauge(
+                "scheduler_tpu_trace_dropped_events",
+                "Trace events dropped by the tracer's bounded buffer since "
+                "the trace started.",
+            )
+        )
+        self.tracer_overhead = r.register(
+            Gauge(
+                "scheduler_tpu_tracer_overhead_seconds",
+                "Cumulative host seconds spent appending trace events "
+                "(the tracer's own cost, for overhead audits).",
+            )
+        )
+        self.flightrec_events = r.register(
+            Gauge(
+                "scheduler_tpu_flightrecorder_events",
+                "Pod lifecycle events currently retained in the flight "
+                "recorder ring.",
+            )
+        )
+        self.flightrec_evicted = r.register(
+            Gauge(
+                # scrape-refreshed snapshot of a monotonic count — exposed
+                # as a gauge, so no _total suffix (OpenMetrics lint rejects
+                # a _total-named gauge)
+                "scheduler_tpu_flightrecorder_evicted_events",
+                "Pod lifecycle events evicted from the flight recorder "
+                "ring since process start (monotonic, sampled on scrape).",
+            )
+        )
         self.recorder = MetricAsyncRecorder()
 
     def expose(self) -> str:
@@ -530,7 +612,12 @@ class SchedulerMetrics:
 
 # ---------------------------------------------------------------------------
 # slow-cycle tracing (utiltrace: schedule_one.go:409-449 — any scheduling
-# cycle over 100ms dumps its per-step timings)
+# cycle over 100ms dumps its per-step timings).  This is the LOG-side
+# surface: one text dump per slow cycle.  The span-based tracer with
+# Perfetto export, per-batch context, and HTTP control lives in
+# kubernetes_tpu/observability/tracer.py — see OBSERVABILITY.md for how the
+# two relate (Trace stays as the always-on cheap outlier dump; the span
+# tracer is the on-demand full-timeline capture).
 # ---------------------------------------------------------------------------
 
 SLOW_CYCLE_THRESHOLD_S = 0.100

@@ -1,0 +1,43 @@
+"""Observability layer: tracing, flight recording, and explain mode.
+
+Three operator-facing surfaces the reference scheduler spreads over
+component tracing, the framework's Status/Diagnosis plumbing, and debug
+endpoints, rebuilt for the batched TPU hot loop (see OBSERVABILITY.md):
+
+  * ``Tracer`` — span-based tracing with Chrome trace-event JSON export
+    (Perfetto-loadable); spans cover drains, batch dispatch/harvest
+    halves, the per-phase breakdown, and binding-worker chunks, carrying
+    pod uids, batch ids, and (when a chaos journal is attached) the
+    journal's logical time.
+  * ``FlightRecorder`` — a bounded ring of per-pod lifecycle events
+    (enqueue → pop → assumed/unschedulable → bound/…), queryable by uid.
+  * ``explain_pod`` / ``oracle_explain`` — per-node, per-plugin rejection
+    reasons harvested from the filter kernels' feasibility masks
+    (ops/explain.py) and validated against the serial host oracle.
+
+Served over HTTP by ``server.SchedulerServer``:
+
+    /debug/trace?action=start|stop|export   (default: status)
+    /debug/flightrecorder?pod=<uid|name>    (default: stats + tail)
+    /debug/explain?pod=<uid|name>
+"""
+
+from kubernetes_tpu.observability.flightrecorder import FlightRecorder
+from kubernetes_tpu.observability.tracer import Tracer
+from kubernetes_tpu.observability.explain import (
+    DIAG_PLUGINS,
+    explain_pod,
+    find_pod,
+    oracle_explain,
+    reason_to_plugin,
+)
+
+__all__ = [
+    "Tracer",
+    "FlightRecorder",
+    "explain_pod",
+    "find_pod",
+    "oracle_explain",
+    "reason_to_plugin",
+    "DIAG_PLUGINS",
+]

@@ -1,0 +1,96 @@
+"""Per-pod flight recorder: a bounded ring of pod lifecycle events.
+
+The per-pod diagnosis surface the reference scheduler spreads over
+Diagnosis/NodeToStatusMap, FailedScheduling events, and scheduler logs,
+collapsed into one queryable ring buffer: every pod's journey through the
+queue and the batched hot loop leaves a breadcrumb trail —
+
+    enqueue      informer add reached the scheduling queue (or gated)
+    pop          popped into a gang batch (attempt N)
+    assumed      scheduling cycle chose a node (assume + reserve/permit ok)
+    verdict      an extension point rejected the pod (plugin + code)
+    unschedulable  filter failure with the per-plugin diagnosis counts
+    nominated    PostFilter nominated a node (preemption in flight)
+    requeue      parked (backoff/unschedulable) after a failure
+    bound        binding cycle wrote the binding
+    bind_failed  binding cycle failed (unwound + requeued)
+
+Querying by uid answers "where is pod X and why" without logs or replay;
+the /debug/flightrecorder endpoint serves it over HTTP.
+
+Cost model: one lock + one deque append per event; events are plain tuples.
+The ring is bounded (``capacity``) — overflow evicts the OLDEST event and
+counts it, so memory is fixed and recent history always wins.  ``enabled``
+gates every producer site with a plain attribute read.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+# Lock-discipline registry (kubernetes_tpu.analysis): the scheduling loop,
+# binding workers, informer threads, and HTTP handlers all touch the ring.
+_KTPU_GUARDED = {
+    "FlightRecorder": {
+        "lock": "_mu",
+        "guards": {"_ring": None, "_fr_seq": None, "_fr_evicted": None},
+    },
+}
+
+DEFAULT_CAPACITY = 4096
+
+
+class FlightRecorder:
+    def __init__(self, capacity: int = DEFAULT_CAPACITY, clock=time.time):
+        self.enabled = True
+        self.capacity = max(int(capacity), 1)
+        self._clock = clock
+        self._mu = threading.Lock()
+        self._ring: deque = deque()
+        self._fr_seq = 0
+        self._fr_evicted = 0
+
+    def record(self, uid: str, kind: str, detail: Optional[dict] = None) -> None:
+        if not self.enabled:
+            return
+        now = self._clock()
+        with self._mu:
+            self._fr_seq += 1
+            if len(self._ring) >= self.capacity:
+                self._ring.popleft()
+                self._fr_evicted += 1
+            self._ring.append((self._fr_seq, now, uid, kind, detail))
+
+    # -- queries -------------------------------------------------------------
+
+    def events_for(self, uid: str) -> List[dict]:
+        """All retained events for one pod uid, oldest first."""
+        with self._mu:
+            hits = [e for e in self._ring if e[2] == uid]
+        return [self._as_dict(e) for e in hits]
+
+    def tail(self, n: int = 100) -> List[dict]:
+        with self._mu:
+            hits = list(self._ring)[-n:]
+        return [self._as_dict(e) for e in hits]
+
+    def stats(self) -> dict:
+        with self._mu:
+            return {
+                "enabled": self.enabled,
+                "events": len(self._ring),
+                "capacity": self.capacity,
+                "recorded_total": self._fr_seq,
+                "evicted_total": self._fr_evicted,
+            }
+
+    @staticmethod
+    def _as_dict(e) -> dict:
+        seq, ts, uid, kind, detail = e
+        out = {"seq": seq, "ts": ts, "pod": uid, "kind": kind}
+        if detail:
+            out["detail"] = detail
+        return out

@@ -1,0 +1,252 @@
+"""Span tracer: Chrome trace-event JSON for one scheduling process.
+
+The distributed-tracing role the reference scheduler gets from component
+tracing (utiltrace + the kube-scheduler's OpenTelemetry spans) rebuilt for
+the batched hot loop: spans cover a whole drain, each batch's dispatch and
+harvest halves, the per-phase breakdown (queue_pop/pack/h2d/device/d2h/
+commit/bind — fed by metrics.PhaseAccumulator), and the binding workers'
+chunks, each on its own thread track.  The export is the Chrome trace-event
+format ("traceEvents" complete/instant events with microsecond ts/dur), so
+``chrome://tracing`` and Perfetto load it directly.
+
+Spans carry scheduler context in ``args``: pod uids (small batches), batch
+ids, pod counts — and, when a chaos journal is attached
+(``JournalRecorder.attach`` wires ``tracer.logical_time``), the journal's
+logical timestamp ``lt``, so a wall-clock span can be located in the
+replayable journal stream.
+
+Cost model: when ``enabled`` is False every instrumentation site reduces to
+one attribute load and a branch — no locks, no clock reads, no allocation,
+and ZERO device-path involvement (nothing here touches jax).  When enabled,
+each span is one lock acquisition + one dict append; the buffer is bounded
+(``max_events``), overflow increments a drop counter instead of growing.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+# Lock-discipline registry (kubernetes_tpu.analysis): the scheduling loop,
+# binding workers, and HTTP debug handlers all record/export concurrently.
+_KTPU_GUARDED = {
+    "Tracer": {
+        "lock": "_mu",
+        "guards": {
+            "_trace_events": None,
+            "_trace_dropped": None,
+            "_tid_names": None,
+            "_overhead_s": None,
+        },
+    },
+}
+
+DEFAULT_MAX_EVENTS = 200_000
+
+
+class Tracer:
+    """Bounded in-memory span recorder with Chrome trace-event export.
+
+    ``enabled`` is the single hot-path gate: instrumentation sites read it
+    as a plain attribute before doing any work.  ``start()`` resets the
+    buffer and enables; ``stop()`` disables but keeps events for export.
+    """
+
+    def __init__(
+        self,
+        max_events: int = DEFAULT_MAX_EVENTS,
+        clock=time.perf_counter,
+    ):
+        self.enabled = False
+        self.max_events = max_events
+        self._clock = clock
+        self._mu = threading.Lock()
+        self._trace_events: List[dict] = []
+        self._trace_dropped = 0
+        self._tid_names: Dict[int, str] = {}
+        self._overhead_s = 0.0
+        self._t0 = clock()
+        # optional journal logical-time source (JournalRecorder.attach sets
+        # it to Journal.now) — sampled into every span's args as "lt"
+        self.logical_time = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        with self._mu:
+            self._trace_events = []
+            self._trace_dropped = 0
+            self._tid_names = {}
+            self._overhead_s = 0.0
+            self._t0 = self._clock()
+        self.enabled = True
+
+    def stop(self) -> None:
+        self.enabled = False
+
+    def now(self) -> float:
+        return self._clock()
+
+    # -- recording -----------------------------------------------------------
+
+    def _append(self, name, cat, ph, t0, t1, args) -> None:
+        """Finalize and buffer one event.  The origin read, the clamp, and
+        the buffer append all happen under ONE lock hold: start() swaps
+        the buffer and the origin atomically, so a concurrent recorder can
+        never stamp a stale origin into the fresh buffer.  A span whose
+        work STARTED before the capture renders only its in-capture part —
+        an unclamped t0 would paint pre-trace time as a fat span at the
+        origin."""
+        t_in = self._clock()
+        tid = threading.get_ident()
+        tname = threading.current_thread().name
+        with self._mu:
+            if tid not in self._tid_names:
+                self._tid_names[tid] = tname
+            origin = self._t0
+            if t0 < origin:
+                t0 = origin
+            if t1 < t0:
+                t1 = t0
+            ev = {
+                "name": name,
+                "cat": cat,
+                "ph": ph,
+                "ts": (t0 - origin) * 1e6,
+                "pid": 1,
+                "tid": tid,
+                "args": args,
+            }
+            if ph == "X":
+                ev["dur"] = (t1 - t0) * 1e6
+            else:
+                ev["s"] = "t"
+            if len(self._trace_events) >= self.max_events:
+                self._trace_dropped += 1
+            else:
+                self._trace_events.append(ev)
+            self._overhead_s += self._clock() - t_in
+
+    def complete(self, name: str, t0: float, cat: str = "sched", **args) -> None:
+        """Record a complete ('X') event spanning [t0, now).  ``t0`` is a
+        reading of ``self.now()`` taken when the work started."""
+        if not self.enabled:
+            return
+        t1 = self._clock()
+        self._record_x(name, t0, t1, cat, args)
+
+    def complete_tail(
+        self, name: str, dur_s: float, cat: str = "phase", **args
+    ) -> None:
+        """Record a complete event of ``dur_s`` seconds ENDING now — the
+        shape PhaseAccumulator.add has (it learns the duration after the
+        fact, at the accumulate call)."""
+        if not self.enabled:
+            return
+        t1 = self._clock()
+        self._record_x(name, t1 - dur_s, t1, cat, args)
+
+    def _record_x(self, name, t0, t1, cat, args) -> None:
+        lt = self.logical_time
+        if lt is not None:
+            try:
+                args = dict(args, lt=lt())
+            except Exception:  # noqa: BLE001 — journal detached mid-trace
+                pass
+        self._append(name, cat, "X", t0, t1, args)
+
+    def instant(self, name: str, cat: str = "sched", **args) -> None:
+        if not self.enabled:
+            return
+        lt = self.logical_time
+        if lt is not None:
+            try:
+                args = dict(args, lt=lt())
+            except Exception:  # noqa: BLE001
+                pass
+        now = self._clock()
+        self._append(name, cat, "i", now, now, args)
+
+    def span(self, name: str, cat: str = "sched", **args) -> "_Span":
+        """Context manager form; a no-op singleton when disabled."""
+        if not self.enabled:
+            return _NULL_SPAN
+        return _Span(self, name, cat, args)
+
+    # -- export --------------------------------------------------------------
+
+    def export(self) -> dict:
+        """Perfetto/chrome://tracing-loadable trace object."""
+        with self._mu:
+            events = list(self._trace_events)
+            names = dict(self._tid_names)
+            dropped = self._trace_dropped
+        meta = [
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": 1,
+                "tid": 0,
+                "args": {"name": "kubernetes-tpu-scheduler"},
+            }
+        ]
+        for tid, tname in sorted(names.items()):
+            meta.append(
+                {
+                    "name": "thread_name",
+                    "ph": "M",
+                    "pid": 1,
+                    "tid": tid,
+                    "args": {"name": tname},
+                }
+            )
+        return {
+            "traceEvents": meta + events,
+            "displayTimeUnit": "ms",
+            "otherData": {"dropped_events": dropped},
+        }
+
+    def stats(self) -> dict:
+        with self._mu:
+            return {
+                "enabled": self.enabled,
+                "events": len(self._trace_events),
+                "dropped": self._trace_dropped,
+                "overhead_s": self._overhead_s,
+                "max_events": self.max_events,
+            }
+
+
+class _Span:
+    __slots__ = ("tr", "name", "cat", "args", "_t0")
+
+    def __init__(self, tr: Tracer, name: str, cat: str, args: dict):
+        self.tr = tr
+        self.name = name
+        self.cat = cat
+        self.args = args
+
+    def __enter__(self):
+        self._t0 = self.tr.now()
+        return self
+
+    def __exit__(self, *exc):
+        if self.tr.enabled:
+            self.tr._record_x(
+                self.name, self._t0, self.tr.now(), self.cat, self.args
+            )
+        return False
+
+
+class _NullSpan:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()

@@ -141,6 +141,10 @@ class SchedulingQueue:
         self._last_unsched_flush = self.clock()
         # optional queue_incoming_pods_total Counter (metrics.py)
         self.incoming_counter = None
+        # optional observability.FlightRecorder: per-pod lifecycle
+        # breadcrumbs (enqueue/pop/requeue) — every producer site gates on
+        # its `enabled` attribute so the off path is one load + branch
+        self.flight = None
 
     # ----- ordering --------------------------------------------------------
 
@@ -214,9 +218,19 @@ class SchedulingQueue:
                 self._in_queue[pod.uid] = "gated"
                 self._items[pod.uid] = qp
                 self._count_incoming("gated", "PodAdd")
+                fr = self.flight
+                if fr is not None and fr.enabled:
+                    fr.record(
+                        pod.uid,
+                        "enqueue",
+                        {"queue": "gated", "plugin": getattr(status, "plugin", "")},
+                    )
                 return
         self._push_active(qp)
         self._count_incoming("active", "PodAdd")
+        fr = self.flight
+        if fr is not None and fr.enabled:
+            fr.record(pod.uid, "enqueue", {"queue": "active"})
 
     def update(self, old: Optional[Pod], new: Pod) -> None:
         where = self._in_queue.get(new.uid)
@@ -316,6 +330,10 @@ class SchedulingQueue:
             qp.attempts += 1
             self._in_flight[qp.uid] = []
             out.append(qp)
+        fr = self.flight
+        if fr is not None and fr.enabled:
+            for qp in out:
+                fr.record(qp.uid, "pop", {"attempt": qp.attempts})
         return out
 
     def pop_batch_while(self, k, predicate) -> List[QueuedPodInfo]:
@@ -341,6 +359,10 @@ class SchedulingQueue:
             qp.attempts += 1
             self._in_flight[qp.uid] = []
             out.append(qp)
+        fr = self.flight
+        if fr is not None and fr.enabled:
+            for qp in out:
+                fr.record(qp.uid, "pop", {"attempt": qp.attempts})
         return out
 
     def pop(self) -> Optional[QueuedPodInfo]:
@@ -371,21 +393,42 @@ class SchedulingQueue:
                 and new.uid == qp.uid
             ):
                 qp.pod = new
+        fr = self.flight
         if not qp.unschedulable_plugins:
             # No failed plugin is associated — something unusual (an
             # apiserver error during binding, etc).  No queueing hint will
             # ever fire for it, so retry after backoff instead of parking
             # in the unschedulable map (scheduling_queue.go:642-647).
+            if fr is not None and fr.enabled:
+                fr.record(qp.uid, "requeue", {"to": "backoff"})
             self._requeue(qp, immediately=False, event="ScheduleAttemptFailure")
             return
         for ev, old, new in events:
             if self._is_worth_requeuing(qp, ev, old, new):
+                if fr is not None and fr.enabled:
+                    fr.record(
+                        qp.uid,
+                        "requeue",
+                        {
+                            "to": "backoff",
+                            "plugins": sorted(qp.unschedulable_plugins),
+                        },
+                    )
                 self._requeue(qp, immediately=False, event="ScheduleAttemptFailure")
                 return
         self._unschedulable[qp.uid] = qp
         self._in_queue[qp.uid] = "unschedulable"
         self._items[qp.uid] = qp
         self._count_incoming("unschedulable", "ScheduleAttemptFailure")
+        if fr is not None and fr.enabled:
+            fr.record(
+                qp.uid,
+                "requeue",
+                {
+                    "to": "unschedulable",
+                    "plugins": sorted(qp.unschedulable_plugins),
+                },
+            )
 
     def done(self, uid: str) -> None:
         """Pod's scheduling attempt fully concluded (bound or failed)."""

@@ -420,6 +420,19 @@ class Scheduler:
         # commit/bind) — the scheduler_perf-style breakdown bench.py emits
         # as config0_phases.  Feeds the phase_duration histogram too.
         self.phases = PhaseAccumulator(hist=self.prom.phase_duration)
+        # Observability layer (observability/): span tracer (off until
+        # /debug/trace?action=start — a disabled tracer is one attribute
+        # read per site, zero device-path cost) + per-pod flight recorder
+        # (bounded ring, on by default).  The phase accumulator doubles as
+        # the tracer's phase-span feed; the queue records its own
+        # enqueue/pop/requeue breadcrumbs.
+        from kubernetes_tpu.observability import FlightRecorder, Tracer
+
+        self.tracer = Tracer()
+        self.flight = FlightRecorder()
+        self.phases.tracer = self.tracer
+        self.queue.flight = self.flight
+        self._batch_seq = 0  # trace batch ids (scheduling-loop thread only)
         # jax.profiler trace hook (SURVEY §5; the --profiling/pprof analog,
         # apis/config/types.go:60): when set, schedule_pending wraps each
         # drain in jax.profiler.trace(profile_dir).
@@ -751,6 +764,10 @@ class Scheduler:
     ) -> List[ScheduleOutcome]:
         outcomes: List[ScheduleOutcome] = []
         batches = 0
+        tr = self.tracer
+        # None (not 0.0) when tracing was off at drain start: a trace
+        # STARTED mid-drain must not produce a span with a garbage origin
+        t_drain = tr.now() if tr.enabled else None
         # Pre-size the placed-pod tensor axes for the whole drain: every
         # distinct shape costs an XLA recompile of the gang pipeline.  One
         # extra batch of margin covers the chained append's bucket-stride
@@ -870,6 +887,15 @@ class Scheduler:
             # current must match a fresh recomputation from the cache
             with self._mu:
                 sanitizer.check_mirror_consistency(self.cache, self.mirror)
+        if t_drain is not None and tr.enabled:
+            tr.complete(
+                "drain",
+                t_drain,
+                cat="drain",
+                pods=len(outcomes),
+                batches=batches,
+                scheduled=sum(1 for o in outcomes if o.node is not None),
+            )
         return outcomes
 
     def _rp_can_fail(self, fwk) -> bool:
@@ -882,6 +908,26 @@ class Scheduler:
             fwk.has_reserve_or_permit()
             and not fwk.reserve_permit_covered_by_host_filters()
         )
+
+    def _trace_dispatch(self, kind: str, t0: float, batch, rec=None) -> int:
+        """Stamp a monotonically-increasing batch id and — when tracing —
+        record the dispatch-half span with pod context (batch id, pod
+        count, the first few uids).  Scheduling-loop thread only."""
+        self._batch_seq += 1
+        bid = self._batch_seq
+        if rec is not None:
+            rec["bid"] = bid
+        tr = self.tracer
+        if tr.enabled:
+            tr.complete(
+                f"dispatch.{kind}",
+                t0,
+                cat="batch",
+                bid=bid,
+                pods=len(batch),
+                uids=[qp.pod.uid for qp in batch[:8]],
+            )
+        return bid
 
     def _record_batch_metrics(self, profile, group, outs, dt: float) -> None:
         """Attempt counters + latency histograms (metrics.go:86-147).  The
@@ -931,6 +977,15 @@ class Scheduler:
         self.prom.cache_size.set(len(self.cache.real_nodes()), type="nodes")
         self.prom.cache_size.set(len(self.cache.pod_states), type="pods")
         self.prom.cache_size.set(len(self.cache.assumed), type="assumed_pods")
+        # observability-layer overhead counters, sampled on scrape so the
+        # recording hot paths never touch the registry
+        ts = self.tracer.stats()
+        self.prom.trace_buffered.set(ts["events"])
+        self.prom.trace_dropped.set(ts["dropped"])
+        self.prom.tracer_overhead.set(ts["overhead_s"])
+        fs = self.flight.stats()
+        self.prom.flightrec_events.set(fs["events"])
+        self.prom.flightrec_evicted.set(fs["evicted_total"])
 
     def expose_metrics(self) -> str:
         """Prometheus text exposition (the /metrics handler body)."""
@@ -1198,6 +1253,7 @@ class Scheduler:
             time.perf_counter() - t_gang,
             path="scan",
         )
+        self._trace_dispatch("scan", t_gang, batch)
         trace.step("Gang dispatch done")
 
         # 3. per-pod commit: assume → reserve → permit → bind
@@ -1757,7 +1813,7 @@ class Scheduler:
                 reasons.copy_to_host_async()
             except AttributeError:
                 pass
-            return {
+            rec = {
                 "fwk": fwk,
                 "state": state,
                 "batch": batch,
@@ -1765,11 +1821,15 @@ class Scheduler:
                 "reasons": reasons,
                 "t0": t0,
             }
+            self._trace_dispatch("chain", t0, batch, rec)
+            return rec
 
     def _finish_chained(self, rec) -> List[ScheduleOutcome]:
         """Harvest one pipelined batch: fetch its results and walk the
         commits (the host half that overlapped later dispatches)."""
         outcomes: List[ScheduleOutcome] = []
+        tr = self.tracer
+        t_h = tr.now() if tr.enabled else None
         t_d2h = time.perf_counter()
         both = jax.device_get(rec["results"])
         self.phases.add("d2h", time.perf_counter() - t_d2h)
@@ -1794,6 +1854,14 @@ class Scheduler:
             time.perf_counter() - rec["t0"],
         )
         self._flush_binds()
+        if t_h is not None and tr.enabled:
+            tr.complete(
+                "harvest.chain",
+                t_h,
+                cat="batch",
+                bid=rec.get("bid"),
+                pods=len(rec["batch"]),
+            )
         return outcomes
 
     def _hostname_dev(self, vocab):
@@ -2050,7 +2118,7 @@ class Scheduler:
             holder["dev"] = None  # device copy (if any) is now stale
             with self._mu:  # metrics is a registered lock-guarded field
                 self.metrics["fast_batches"] += 1
-            return {
+            rec = {
                 "kind": "fast",
                 "fwk": fwk,
                 "state": state,
@@ -2066,6 +2134,8 @@ class Scheduler:
                 "t0": t0,
                 "record_metrics": False,
             }
+            self._trace_dispatch("fast", t0, batch, rec)
+            return rec
 
         # ---- device path: the greedy commit loop runs as a lax.scan over
         # signature ids with the node-usage state resident in HBM
@@ -2153,7 +2223,7 @@ class Scheduler:
             return None
         with self._mu:  # metrics is a registered lock-guarded field
             self.metrics["fast_batches"] += 1
-        return {
+        rec = {
             "kind": "fast",
             "fwk": fwk,
             "state": state,
@@ -2169,6 +2239,8 @@ class Scheduler:
             "t0": t0,
             "record_metrics": False,
         }
+        self._trace_dispatch("fast", t0, batch, rec)
+        return rec
 
     def _finish_fast(self, rec) -> List[ScheduleOutcome]:
         """Harvest one fast batch: fetch the kernel's choices (device
@@ -2177,6 +2249,8 @@ class Scheduler:
         unschedulable pods against the committer state."""
         import numpy as np
 
+        tr = self.tracer
+        t_h = tr.now() if tr.enabled else None
         fwk = rec["fwk"]
         state = rec["state"]
         batch = rec["batch"]
@@ -2304,6 +2378,14 @@ class Scheduler:
                 time.perf_counter() - rec["t0"],
             )
             self._flush_binds()
+        if t_h is not None and tr.enabled:
+            tr.complete(
+                "harvest.fast",
+                t_h,
+                cat="batch",
+                bid=rec.get("bid"),
+                pods=len(batch),
+            )
         return outcomes
 
 
@@ -3242,12 +3324,32 @@ class Scheduler:
         plugins: Optional[set] = None,
     ) -> ScheduleOutcome:
         pod = qp.pod
+        fr = self.flight
+        if fr.enabled and status.code in (
+            Code.UNSCHEDULABLE,
+            Code.UNSCHEDULABLE_AND_UNRESOLVABLE,
+        ):
+            # the diagnosis counts the kernels already fetched ride along
+            # for free — /debug/explain is the full per-node drill-down
+            fr.record(
+                pod.uid,
+                "unschedulable",
+                {
+                    "plugins": sorted(plugins) if plugins else (
+                        [status.plugin] if status.plugin else []
+                    ),
+                    "diagnosis": diagnosis,
+                    "reasons": list(status.reasons)[:3],
+                },
+            )
         if fwk.has_post_filter() and status.code == Code.UNSCHEDULABLE:
             nominated, pf_status = fwk.run_post_filter(state, pod, None)
             if nominated:
                 pod.nominated_node_name = nominated
                 self.nominator.add(pod, nominated)
                 self.status_patcher(pod)  # schedule_one.go:1117 PatchPodStatus
+                if fr.enabled:
+                    fr.record(pod.uid, "nominated", {"node": nominated})
             elif nominated == "" and pod.nominated_node_name:
                 pod.nominated_node_name = ""
                 self.nominator.delete(pod)
@@ -3329,6 +3431,12 @@ class Scheduler:
                 self._external_mutations += 1  # committer state diverges
                 self._view_pod_removed(assumed)
                 self.cache.forget_pod(pod)
+                if self.flight.enabled:
+                    self.flight.record(
+                        pod.uid,
+                        "verdict",
+                        {"ext": "Reserve", "plugin": s.plugin, "node": node_name},
+                    )
                 self._handle_failure(qp, s)
                 return ScheduleOutcome(pod, None, s, n_feas)
 
@@ -3338,10 +3446,20 @@ class Scheduler:
                 self._external_mutations += 1  # committer state diverges
                 self._view_pod_removed(assumed)
                 self.cache.forget_pod(pod)
+                if self.flight.enabled:
+                    self.flight.record(
+                        pod.uid,
+                        "verdict",
+                        {"ext": "Permit", "plugin": s.plugin, "node": node_name},
+                    )
                 self._handle_failure(qp, s)
                 return ScheduleOutcome(pod, None, s, n_feas)
             waited = s.code == Code.WAIT
 
+        if self.flight.enabled:
+            self.flight.record(
+                pod.uid, "assumed", {"node": node_name, "waited": waited}
+            )
         outcome = ScheduleOutcome(
             pod,
             node_name,
@@ -3418,6 +3536,8 @@ class Scheduler:
                 list(zip((qp.pod for qp in run), names))
             )
             view_live = self._oracle_cache is not None
+            fr = self.flight
+            fr_on = fr.enabled
             for qp, nn, res in zip(run, names, results):
                 if isinstance(res, str):
                     # protocol violation (double assume — the multi-
@@ -3431,6 +3551,8 @@ class Scheduler:
                     continue
                 if view_live:
                     self._view_pod_added(res)
+                if fr_on:
+                    fr.record(qp.pod.uid, "assumed", {"node": nn})
                 outcome = ScheduleOutcome(
                     qp.pod,
                     nn,
@@ -3551,6 +3673,10 @@ class Scheduler:
                     if nom is not None:
                         nom.delete(pod)
                 self.metrics["scheduled"] += len(ok_items)
+            fr = self.flight
+            if fr.enabled:
+                for qp, nn, _ in ok_items:
+                    fr.record(qp.pod.uid, "bound", {"node": nn})
             if fwk.has_post_bind():
                 for qp, nn, _ in ok_items:
                     fwk.run_post_bind(state, qp.pod, nn)
@@ -3635,6 +3761,10 @@ class Scheduler:
                 self.cache.finish_binding(pod)
                 self.nominator.delete(pod)
             self.metrics["scheduled"] += len(lean_ok)
+        fr = self.flight
+        if fr.enabled:
+            for t in lean_ok:
+                fr.record(t.qp.pod.uid, "bound", {"node": t.node_name})
         for t in lean_ok:
             pod = t.qp.pod
             t.fwk.run_post_bind(t.state, pod, t.node_name)
@@ -3653,6 +3783,12 @@ class Scheduler:
         """Bind-failure unwind: Unreserve + ForgetPod + requeue under the
         cache lock (schedule_one.go:342-374), outcome patched in place."""
         pod = qp.pod
+        if self.flight.enabled:
+            self.flight.record(
+                pod.uid,
+                "bind_failed",
+                {"node": node_name, "reasons": list(s.reasons)[:3]},
+            )
         with self._mu:
             # The in-flight ledger is still intact here, so events that
             # arrived during the attempt replay through add_unschedulable.
@@ -3697,6 +3833,8 @@ class Scheduler:
             self.cache.finish_binding(pod)
             self.nominator.delete(pod)
             self.metrics["scheduled"] += 1
+        if self.flight.enabled:
+            self.flight.record(pod.uid, "bound", {"node": node_name})
         fwk.run_post_bind(state, pod, node_name)
         from kubernetes_tpu import events as ev
 

@@ -5,7 +5,11 @@ around the embeddable Scheduler:
 
   * ``SchedulerServer`` — owns the scheduling loop thread, an HTTP mux
     serving /healthz, /readyz (handler-sync gated, server.go:202-211),
-    /metrics (Prometheus text exposition) and /configz;
+    /metrics (Prometheus text exposition), /configz, and the
+    observability debug endpoints (OBSERVABILITY.md):
+    /debug/trace (start/stop/export span tracing),
+    /debug/flightrecorder?pod= (per-pod lifecycle events), and
+    /debug/explain?pod= (per-node, per-plugin rejection reasons);
   * ``LeaseElector`` — Lease-based leader election
     (client-go/tools/leaderelection/leaderelection.go:116 semantics:
     LeaseDuration/RenewDeadline/RetryPeriod over a CAS'd lease record);
@@ -23,6 +27,7 @@ import time
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
+from urllib.parse import parse_qs, urlparse
 
 from kubernetes_tpu.scheduler import Scheduler
 
@@ -218,7 +223,19 @@ class SchedulerServer:
                 self.end_headers()
                 self.wfile.write(data)
 
+            def _send_json(self, obj, code: int = 200):
+                self._send(
+                    code, json.dumps(obj), ctype="application/json"
+                )
+
             def do_GET(self):  # noqa: N802 — stdlib handler name
+                parsed = urlparse(self.path)
+                if parsed.path.startswith("/debug/"):
+                    try:
+                        self._debug_get(parsed)
+                    except Exception as e:  # noqa: BLE001 — debug surface
+                        self._send_json({"error": str(e)}, code=500)
+                    return
                 if self.path == "/healthz":
                     self._send(200, "ok")
                 elif self.path == "/readyz":
@@ -248,15 +265,94 @@ class SchedulerServer:
                         ),
                         ctype="application/json",
                     )
-                elif self.path == "/debug/cache":
+                else:
+                    self._send(404, "not found")
+
+            def _debug_get(self, parsed):
+                """The observability debug mux (OBSERVABILITY.md):
+
+                  /debug/cache                       dump + comparer (text)
+                  /debug/trace?action=start|stop|export   default: status
+                  /debug/flightrecorder?pod=<uid|name>    default: stats
+                  /debug/explain?pod=<uid|name>
+                """
+                q = parse_qs(parsed.query)
+                path = parsed.path
+                sched = srv.sched
+                if path == "/debug/cache":
                     self._send(
                         200,
                         srv.debugger.dump()
                         + "\n"
                         + "\n".join(srv.debugger.compare()),
                     )
+                elif path == "/debug/trace":
+                    action = q.get("action", ["status"])[0]
+                    tracer = sched.tracer
+                    if action == "start":
+                        tracer.start()
+                        self._send_json(tracer.stats())
+                    elif action == "stop":
+                        tracer.stop()
+                        self._send_json(tracer.stats())
+                    elif action == "export":
+                        self._send_json(tracer.export())
+                    elif action == "status":
+                        self._send_json(tracer.stats())
+                    else:
+                        self._send_json(
+                            {"error": f"unknown action {action!r}"}, code=400
+                        )
+                elif path == "/debug/flightrecorder":
+                    fr = sched.flight
+                    ref = q.get("pod", [None])[0]
+                    if ref is None:
+                        out = fr.stats()
+                        out["tail"] = fr.tail(50)
+                        self._send_json(out)
+                        return
+                    from kubernetes_tpu.observability import find_pod
+
+                    pod = find_pod(sched, ref)
+                    uid = pod.uid if pod is not None else ref
+                    events = fr.events_for(uid)
+                    if not events and pod is None:
+                        self._send_json(
+                            {"error": f"no events for pod {ref!r}"}, code=404
+                        )
+                        return
+                    self._send_json({"pod": uid, "events": events})
+                elif path == "/debug/explain":
+                    ref = q.get("pod", [None])[0]
+                    if ref is None:
+                        self._send_json(
+                            {"error": "missing ?pod= parameter"}, code=400
+                        )
+                        return
+                    from kubernetes_tpu.observability import (
+                        explain_pod,
+                        find_pod,
+                    )
+
+                    pod = find_pod(sched, ref)
+                    if pod is None:
+                        self._send_json(
+                            {"error": f"pod {ref!r} not found"}, code=404
+                        )
+                        return
+                    try:
+                        max_nodes = int(q.get("max_nodes", ["500"])[0])
+                    except ValueError:
+                        self._send_json(
+                            {"error": "max_nodes must be an integer"},
+                            code=400,
+                        )
+                        return
+                    self._send_json(
+                        explain_pod(sched, pod, max_nodes=max_nodes)
+                    )
                 else:
-                    self._send(404, "not found")
+                    self._send_json({"error": "not found"}, code=404)
 
             def log_message(self, *a):  # quiet
                 pass
