@@ -557,13 +557,35 @@ def main():
             f"(fast={s2.metrics['fast_batches']} scan={s2.metrics['scan_batches']})",
             file=sys.stderr,
         )
-        ok3, dt3, _ = bench_interpod(1000, 5000)
+        def _mix(s):
+            """fast/chain/scan/wave batch counters for a bench line."""
+            m = s.metrics
+            return (
+                f"fast={m['fast_batches']} chain={m.get('chain_batches', 0)} "
+                f"scan={m['scan_batches']} wave={m['wave_batches']}"
+            )
+
+        def _admit_rate(s):
+            return round(
+                s.metrics["wave_admitted"] / max(s.metrics["wave_pods"], 1), 4
+            )
+
+        ok3, dt3, s3 = bench_interpod(1000, 5000)
         configs["config3_interpod_1000n_5000p"] = round(ok3 / dt3, 1)
-        print(f"# config3 interpod: {ok3} pods in {dt3:.2f}s", file=sys.stderr)
+        print(
+            f"# config3 interpod: {ok3} pods in {dt3:.2f}s ({_mix(s3)} "
+            f"admit={_admit_rate(s3):.2%})",
+            file=sys.stderr,
+        )
         n4 = int(os.environ.get("BENCH_SPREAD_PODS", "50000"))
-        ok4, dt4, _ = bench_spread(5000, n4)
+        ok4, dt4, s4 = bench_spread(5000, n4)
         configs["config4_spread_5000n_50000p"] = round(ok4 / dt4, 1)
-        print(f"# config4 spread: {ok4} pods in {dt4:.2f}s", file=sys.stderr)
+        configs["config4_wave_admit_rate"] = _admit_rate(s4)
+        print(
+            f"# config4 spread: {ok4} pods in {dt4:.2f}s ({_mix(s4)} "
+            f"admit={_admit_rate(s4):.2%})",
+            file=sys.stderr,
+        )
         okp, dtp, _ = bench_preemption(500)
         configs["preemption_500n"] = round(okp / dtp, 1)
         print(f"# preemption: {okp} pods in {dtp:.2f}s", file=sys.stderr)

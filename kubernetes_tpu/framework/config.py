@@ -153,6 +153,12 @@ class SchedulerConfiguration:
     # commit on the host greedy (zero device round trips — the interactive
     # case); larger or pipelined batches take the device sig_scan kernel.
     fast_device_min: int = 1024
+    # TPU extension: speculative wave dispatch for cross-pod-constraint
+    # batches (spread / inter-pod terms): one parallel (P × N) speculation
+    # pass + a term-factored conflict-resolution pass replaces the gang
+    # scan's per-step peer contractions (ops/wave.py; bit-identical to the
+    # serial order).  Off = every such batch takes the gang scan.
+    wave_dispatch: bool = True
     # Bit-compat knobs (SURVEY §7 "decision-identical tie-breaking"):
     # full-width evaluation is the TPU-native default; these opt into the
     # reference's sampling + randomized-tie semantics.
@@ -441,6 +447,7 @@ def load_config(source) -> SchedulerConfiguration:
         batch_size=d.get("batchSize", 512),
         fast_batch_max=d.get("fastBatchMax", 4096),
         fast_device_min=d.get("fastDeviceMin", 1024),
+        wave_dispatch=d.get("waveDispatch", True),
         reference_sampling_compat=d.get("referenceSamplingCompat", False),
         tie_break_seed=d.get("tieBreakSeed"),
     )
@@ -494,6 +501,7 @@ def dump_config(cfg: SchedulerConfiguration) -> dict:
         "batchSize": cfg.batch_size,
         "fastBatchMax": cfg.fast_batch_max,
         "fastDeviceMin": cfg.fast_device_min,
+        "waveDispatch": cfg.wave_dispatch,
         "referenceSamplingCompat": cfg.reference_sampling_compat,
         "tieBreakSeed": cfg.tie_break_seed,
         "featureGates": dict(cfg.feature_gates),

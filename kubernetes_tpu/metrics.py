@@ -521,6 +521,22 @@ class SchedulerMetrics:
                 buckets=[1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024],
             )
         )
+        self.wave_admitted = r.register(
+            Counter(
+                "scheduler_tpu_wave_admitted_total",
+                "Pods whose speculative wave placement survived the "
+                "conflict-resolution pass unchanged (ops/wave.py).",
+            )
+        )
+        self.wave_conflicts = r.register(
+            Counter(
+                "scheduler_tpu_wave_conflicts_total",
+                "Pods demoted by the wave's conflict-resolution pass, by "
+                "conflicting constraint kind "
+                "(spread / affinity / fit / score).",
+                ("kind",),
+            )
+        )
         self.snapshot_pack_duration = r.register(
             Histogram(
                 "scheduler_tpu_snapshot_pack_duration_seconds",
@@ -532,7 +548,7 @@ class SchedulerMetrics:
             Histogram(
                 "scheduler_tpu_phase_duration_seconds",
                 "Per-batch hot-loop time by phase "
-                "(queue_pop/pack/h2d/device/d2h/commit/bind).",
+                "(queue_pop/pack/h2d/device/d2h/wave_resolve/commit/bind).",
                 ("phase",),
             )
         )

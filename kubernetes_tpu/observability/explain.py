@@ -270,4 +270,29 @@ def explain_pod(
     out["summary"] = summary
     out["n_feasible"] = len(feasible_names)
     out["feasible"] = feasible_names[:max_nodes]
+
+    # wave-dispatch history: a pod whose speculative placement was
+    # invalidated by the wave's conflict-resolution pass carries
+    # ``wave_demoted`` flight-recorder events — surface them so the
+    # drill-down answers "why did this pod not land where the wave first
+    # put it" alongside the per-node verdicts
+    demotions = [
+        {
+            "kind": e.get("detail", {}).get("kind"),
+            "term": e.get("detail", {}).get("term"),
+            "spec_node": e.get("detail", {}).get("spec_node"),
+            "node": e.get("detail", {}).get("node"),
+        }
+        for e in sched.flight.events_for(pod.uid)
+        if e.get("kind") == "wave_demoted"
+    ]
+    if demotions:
+        last = demotions[-1]
+        out["wave"] = {
+            "demoted": True,
+            "reason": "demoted by wave conflict",
+            "conflict_kind": last["kind"],
+            "conflict_term": last["term"],
+            "events": demotions[-8:],
+        }
     return out

@@ -81,8 +81,10 @@ def caps_compatible(dc_shapes, pb) -> bool:
         "enabled",
         "weights",
         "d_cap",
+        "d2_cap",
         "append_terms",
         "fit_strategy",
+        "wave",
     ),
 )
 def chain_dispatch(
@@ -108,6 +110,15 @@ def chain_dispatch(
     d_cap: int = 8,
     append_terms: bool = True,
     fit_strategy: tuple = gang.DEFAULT_FIT_STRATEGY,
+    wave: bool = False,
+    tid_sp=None,
+    rep_sp_p=None,
+    rep_sp_c=None,
+    tid_ip=None,
+    rep_ip_p=None,
+    rep_ip_u=None,
+    ip_cdv_tab=None,
+    d2_cap: int = 8,
 ):
     """One fused dispatch: gang schedule the batch, then append its
     committed pods into the (donated) cluster at the given cursors.
@@ -116,7 +127,13 @@ def chain_dispatch(
     affinity terms — the bucketed AT axis would otherwise burn P·AT PAD
     rows of term capacity per batch.
 
-    Returns (next_dc, stacked [2, P] (chosen, n_feas), reason_counts)."""
+    ``wave=True`` schedules via the speculative wave (ops/wave.py: one
+    parallel speculation pass + the term-factored admission pass) instead
+    of the gang scan — same decisions, a fraction of the per-step cost —
+    and appends a fourth output: the [3, P] wave stats block.
+
+    Returns (next_dc, stacked [2, P] (chosen, n_feas), reason_counts
+    [, wave_stats])."""
     g = gang.precompute(
         dc,
         db,
@@ -132,19 +149,48 @@ def chain_dispatch(
         sp_cdv_tab=sp_cdv_tab,
         ip_keys=ip_keys,
     )
-    chosen, n_feas, reason_counts, tallies = gang.gang_schedule(
-        dc,
-        db,
-        g,
-        v_cap,
-        weights=weights,
-        check_fit="NodeResourcesFit" in enabled,
-        nom_node=nom_node,
-        nom_prio=nom_prio,
-        nom_req=nom_req,
-        d_cap=d_cap,
-        fit_strategy=fit_strategy,
-    )
+    wave_stats = None
+    if wave:
+        from kubernetes_tpu.ops import wave as wave_ops
+
+        chosen, n_feas, reason_counts, tallies, wave_stats = (
+            wave_ops.wave_schedule(
+                dc,
+                db,
+                g,
+                hostname_key,
+                v_cap,
+                tid_sp,
+                rep_sp_p,
+                rep_sp_c,
+                tid_ip,
+                rep_ip_p,
+                rep_ip_u,
+                ip_cdv_tab,
+                weights=weights,
+                check_fit="NodeResourcesFit" in enabled,
+                nom_node=nom_node,
+                nom_prio=nom_prio,
+                nom_req=nom_req,
+                d_cap=d_cap,
+                d2_cap=d2_cap,
+                fit_strategy=fit_strategy,
+            )
+        )
+    else:
+        chosen, n_feas, reason_counts, tallies = gang.gang_schedule(
+            dc,
+            db,
+            g,
+            v_cap,
+            weights=weights,
+            check_fit="NodeResourcesFit" in enabled,
+            nom_node=nom_node,
+            nom_prio=nom_prio,
+            nom_req=nom_req,
+            d_cap=d_cap,
+            fit_strategy=fit_strategy,
+        )
     P = db.valid.shape[0]
     committed = (chosen >= 0) & db.valid
     upd = dict(
@@ -201,4 +247,8 @@ def chain_dispatch(
                 ),
             ),
         )
-    return replace(dc, **upd), jnp.stack([chosen, n_feas]), reason_counts
+    next_dc = replace(dc, **upd)
+    results = jnp.stack([chosen, n_feas])
+    if wave:
+        return next_dc, results, reason_counts, wave_stats
+    return next_dc, results, reason_counts

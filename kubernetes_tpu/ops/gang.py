@@ -508,6 +508,368 @@ def _broken_linear_dev(points: tuple, x):
 DEFAULT_FIT_STRATEGY = (0, (), (1, 1))
 
 
+# ---------------------------------------------------------------------------
+# Shared count→constraint algebra (one definition for every dispatch path)
+#
+# The scan step (heavy_parts), the wave kernels (ops/wave.py), and any other
+# batch-dynamic evaluator differ ONLY in how they produce the per-pod
+# BATCH-PEER count tensors; everything downstream of the counts — skew
+# checks, min-match, the inter-pod violation/escape ladder, preferred-term
+# scoring — is defined once here so the paths cannot drift apart.
+# ---------------------------------------------------------------------------
+
+
+class SpreadDyn(NamedTuple):
+    """Batch-peer contributions to pod p's spread counts (all [C, N] i32)."""
+
+    dyn_f: jnp.ndarray  # filter-side counts (bm ∧ te-at-peer ∧ same-domain)
+    dyn_host: jnp.ndarray  # score-side per-node counts (bm only)
+    dyn_dom: jnp.ndarray  # score-side domain counts (bm ∧ counting-at-peer)
+
+
+class InterpodDyn(NamedTuple):
+    """Batch-peer contributions to pod p's inter-pod state."""
+
+    ip_dyn: jnp.ndarray  # i32 [AT, N] incoming matches per term domain
+    viol_b: jnp.ndarray  # bool [N] anti-affinity of committed peers' terms
+    sym_b: jnp.ndarray  # i64 [N] symmetric score from committed peers' terms
+    any_dyn: jnp.ndarray  # bool [] any committed peer matches an aff term
+
+
+def spread_constraints(db: DeviceBatch, g: "GangStatics", p, sd: SpreadDyn):
+    """Filter verdict + score counts for pod p's spread constraints given
+    the batch-peer count contributions (filtering.go:236-362 semantics on
+    static existing counts + ``sd``).  Returns (m_spread [N], sp_cnt [C,N],
+    c_ok [C,N]) — c_ok per constraint for failure attribution."""
+    total = g.sp_dom_cnt[p] + sd.dyn_f  # [C, N]
+    big32 = jnp.iinfo(jnp.int32).max
+    min_match = jnp.min(jnp.where(g.sp_te[p], total, big32), axis=1)
+    min_match = jnp.where(
+        (db.tsc_min_domains[p] > 0) & (g.sp_ndom[p] < db.tsc_min_domains[p]),
+        0,
+        min_match,
+    )
+    skew = total + g.sp_self[p].astype(I32)[:, None] - min_match[:, None]
+    c_ok = (g.sp_dv[p] >= 0) & (
+        ~g.sp_dom_pres[p] | (skew <= db.tsc_max_skew[p][:, None])
+    )
+    m_spread = jnp.all(~g.sp_hard[p][:, None] | c_ok, axis=0)
+    sp_cnt = jnp.where(
+        g.sp_is_host[p][:, None],
+        g.sp_node_cnt[p] + sd.dyn_host,
+        g.sp_sc_dom[p] + sd.dyn_dom,
+    )  # [C, N]
+    return m_spread, sp_cnt, c_ok
+
+
+def interpod_constraints(g: "GangStatics", p, idyn: InterpodDyn):
+    """Filter verdict + raw score for pod p's inter-pod terms given the
+    batch-peer contributions (interpodaffinity filtering/scoring over
+    static existing counts + ``idyn``).  Returns (m_interpod [N],
+    ip_raw [N], anti_viol [AT, N]) — anti_viol per term for attribution."""
+    ip_total = g.ip_dom_cnt[p] + idyn.ip_dyn  # [AT, N]
+    topo_present = g.ip_dv[p] >= 0
+    anti_viol = g.ip_is_anti[p][:, None] & topo_present & (ip_total > 0)
+    viol2 = jnp.any(anti_viol, axis=0)
+    aff_ok = jnp.all(
+        ~g.ip_is_aff[p][:, None] | (topo_present & (ip_total > 0)), axis=0
+    )
+    any_match = g.ip_any_static[p] | idyn.any_dyn
+    topo_all = jnp.all(~g.ip_is_aff[p][:, None] | topo_present, axis=0)
+    escape = jnp.any(g.ip_is_aff[p]) & ~any_match & g.ip_self_all[p]
+    ok3 = aff_ok | (escape & topo_all)
+    m_interpod = ~g.ip_viol_existing[p] & ~viol2 & ok3 & ~idyn.viol_b
+    pref = jnp.sum(
+        jnp.where(
+            topo_present,
+            ip_total.astype(I64) * g.ip_pref_w[p][:, None],
+            0,
+        ),
+        axis=0,
+    )
+    ip_raw = g.ip_sym[p] + pref + idyn.sym_b.astype(I64)
+    return m_interpod, ip_raw, anti_viol
+
+
+def pod_step(
+    dc: DeviceCluster,
+    db: DeviceBatch,
+    g: "GangStatics",
+    p,
+    state,
+    hv,
+    active,
+    *,
+    check_fit: bool,
+    weights: tuple,
+    d_cap: int,
+    fit_strategy: tuple,
+    extra_score=None,
+    nom_oh=None,
+    nom_prio=None,
+    nom_req=None,
+    sample_k=None,
+    tie_key=None,
+    attempt_base=None,
+    commit: bool = True,
+):
+    """One pod's full Filter→Score→Select→commit against ``state`` — the
+    single definition of the per-pod decision shared by the gang scan, the
+    wave admission scan, and the wave speculation pass (ops/wave.py).  The
+    state-dependent constraint tensors arrive in ``hv`` (m_portb, m_spread,
+    sp_cnt, m_interpod, ip_raw); how they were produced is the caller's
+    business.  ``state`` carries requested [N,Rn] / nonzero [N,2] /
+    num_pods [N] / assigned [P] (+ sample_start in sampling mode).  With
+    ``commit=False`` the returned state is the input untouched (speculation
+    evaluates without placing).  Returns
+    (new_state, (choice, n_feas, reason_counts))."""
+    P, N = g.static_mask.shape
+    Rn = dc.requested.shape[1]
+    Rp = db.requests.shape[1]
+    C = g.sp_dv.shape[1]
+    true_n = jnp.ones((N,), bool)
+
+    # ---------------- dynamic filters ----------------
+    req = db.requests[p]  # [Rp]
+    mask = g.static_mask[p] & hv["m_portb"]
+    m_fit = true_n
+    if check_fit:
+        nom_cnt = 0
+        nom_delta = 0
+        if nom_oh is not None:
+            gate = (nom_prio >= db.priority[p]).astype(I32)  # [G]
+            nom_cnt = jnp.einsum("g,gn->n", gate, nom_oh)
+            nom_delta = jnp.einsum(
+                "gr,gn->nr", nom_req * gate[:, None], nom_oh
+            )  # [N, Rn]
+        fits = state["num_pods"] + nom_cnt + 1 <= dc.allowed_pods
+        all_zero = jnp.all(req == 0)
+        avail = dc.allocatable - state["requested"] - nom_delta  # [N, Rn]
+        if Rp > Rn:
+            avail = jnp.concatenate(
+                [avail, jnp.zeros((N, Rp - Rn), I32)], axis=1
+            )
+        conflict = req[None, :] > avail  # [N, Rp]
+        # extended-resource lanes only count when actually requested
+        scalar_lane = jnp.arange(Rp) >= N_FIXED_LANES
+        conflict = conflict & (~scalar_lane | (req > 0))[None, :]
+        lane_ok = ~jnp.any(conflict, axis=1)
+        m_fit = fits & (all_zero | lane_ok)
+        mask = mask & m_fit
+
+    m_portb = hv["m_portb"]
+    m_spread = hv["m_spread"]
+    m_interpod = hv["m_interpod"]
+    mask = mask & m_spread & m_interpod
+    feas = mask
+    if sample_k is not None:
+        # adaptive-sampling cut: keep the first sample_k feasible nodes
+        # in ZONE-ROUND-ROBIN rotation order from the carried start
+        # index — dc.visit_rank is the nodeTree order
+        # (node_tree.go:119-143) that the reference's sampling,
+        # rotation, and tie-breaks all ride
+        nv = jnp.maximum(dc.n_valid_nodes, 1)
+        start = state["sample_start"]
+        vr = dc.visit_rank
+        valid_vr = vr >= 0
+        rank = jnp.where(valid_vr, (vr - start) % nv, N)
+        rot = (
+            jnp.zeros((N + 1,), bool)
+            .at[rank]
+            .set(feas & valid_vr, mode="drop")[:N]
+        )
+        cum = jnp.cumsum(rot.astype(I32))
+        keep_rot = rot & (cum <= sample_k)
+        feas = (
+            jnp.concatenate([keep_rot, jnp.zeros((1,), bool)])[rank]
+            & feas
+        )
+        total_feas = cum[N - 1]
+        processed = jnp.where(
+            total_feas >= sample_k,
+            jnp.sum((cum < sample_k).astype(I32)) + 1,
+            nv,
+        )
+    n_feas = jnp.sum(feas.astype(I32))
+
+    # ---------------- failure diagnosis ----------------
+    # Per-kernel rejected-node counts with first-failure attribution in
+    # the reference's filter chain order (findNodesThatPassFilters
+    # early-exits per node; FitError aggregates counts per reason).
+    remaining = dc.node_valid & db.valid[p]
+    reason_counts = []
+    for comp in (
+        g.d_unsched[p],
+        g.d_nodename[p],
+        g.d_taints[p],
+        g.d_nodeaff[p],
+        g.d_ports[p] & m_portb,
+        g.d_extra[p],
+        m_fit,
+        m_spread,
+        m_interpod,
+    ):
+        rejected = remaining & ~comp
+        reason_counts.append(jnp.sum(rejected.astype(I32)))
+        remaining = remaining & comp
+    reason_counts = jnp.stack(reason_counts)  # [N_DIAG]
+
+    # ---------------- scores ----------------
+    # NodeResourcesFit scoring strategy on non-zero-defaulted requests
+    # (resource_allocation.go:37-115): LeastAllocated (default),
+    # MostAllocated, or RequestedToCapacityRatio over cpu/memory.
+    strat_id, fit_shape, fit_w = fit_strategy
+    nz = (
+        state["nonzero"].astype(I64)
+        + db.nonzero_req[p][None, :].astype(I64)
+    )  # [N, 2]
+    alloc2 = jnp.stack(
+        [dc.allocatable[:, LANE_CPU], dc.allocatable[:, LANE_MEM]], axis=1
+    ).astype(I64)
+    lane_has = alloc2 > 0
+    if strat_id == 1:  # MostAllocated (most_allocated.go)
+        frac = jnp.where(
+            nz > alloc2, 0, nz * MAX // jnp.maximum(alloc2, 1)
+        )
+    elif strat_id == 2:  # RequestedToCapacityRatio
+        util = jnp.where(
+            ~lane_has | (nz > alloc2),
+            MAX,
+            nz * MAX // jnp.maximum(alloc2, 1),
+        )
+        frac = _broken_linear_dev(fit_shape, util)
+    else:  # LeastAllocated (least_allocated.go:29-60)
+        frac = jnp.where(
+            nz > alloc2, 0, (alloc2 - nz) * MAX // jnp.maximum(alloc2, 1)
+        )
+    w2 = jnp.asarray(fit_w, I64)[None, :]
+    # RTCR only counts resources whose score is positive
+    # (requested_to_capacity_ratio.go:46-52)
+    use = lane_has & (frac > 0) if strat_id == 2 else lane_has
+    wsum = jnp.sum(jnp.where(use, w2, 0), axis=1)
+    total_fit = jnp.sum(jnp.where(use, frac * w2, 0), axis=1)
+    if strat_id == 2:  # math.Round of the weighted mean
+        least = jnp.where(
+            wsum > 0,
+            (2 * total_fit + wsum) // jnp.maximum(2 * wsum, 1),
+            0,
+        )
+    else:
+        least = jnp.where(
+            wsum > 0, total_fit // jnp.maximum(wsum, 1), 0
+        )
+
+    # BalancedAllocation on real requests
+    a0 = dc.allocatable[:, LANE_CPU].astype(I64)
+    a1 = dc.allocatable[:, LANE_MEM].astype(I64)
+    r0 = jnp.minimum(
+        state["requested"][:, LANE_CPU].astype(I64)
+        + db.requests[p, LANE_CPU].astype(I64),
+        a0,
+    )
+    r1 = jnp.minimum(
+        state["requested"][:, LANE_MEM].astype(I64)
+        + db.requests[p, LANE_MEM].astype(I64),
+        a1,
+    )
+    d = jnp.abs(r0 * a1 - r1 * a0)
+    den = jnp.maximum(a0 * a1, 1)
+    balanced = jnp.where(
+        (a0 > 0) & (a1 > 0), MAX - (50 * d + den - 1) // den, MAX
+    )
+
+    # InterPodAffinity: static symmetric + incoming preferred (with batch
+    # contributions) + symmetric from batch-assigned pods' terms —
+    # carried in hv.
+    ip_raw = hv["ip_raw"]
+
+    # PodTopologySpread score: the count rows come from hv; the
+    # log-weight normalization depends on the LIVE feasible set, so it
+    # runs here per pod.
+    if C:
+        sp_raw, sp_valid = _spread_raw(
+            dc, db, g, p, feas, hv["sp_cnt"], d_cap
+        )
+    else:
+        sp_raw = jnp.zeros((N,), I64)
+        sp_valid = feas
+
+    w_taint, w_naff, w_spread, w_ip, w_fit, w_bal, w_img = weights
+    total_score = jnp.zeros((N,), I64)
+    if w_taint:
+        total_score += w_taint * _norm_default(
+            g.sc_taint[p], feas, reverse=True
+        )
+    if w_naff:
+        total_score += w_naff * _norm_default(g.sc_nodeaff[p], feas)
+    if w_spread:
+        total_score += w_spread * _norm_spread(sp_raw, sp_valid, feas)
+    if w_ip:
+        total_score += w_ip * _norm_minmax(ip_raw, feas)
+    if w_fit:
+        total_score += w_fit * least
+    if w_bal:
+        total_score += w_bal * balanced
+    if w_img:
+        total_score += w_img * g.sc_image[p]
+    if extra_score is not None:
+        total_score += extra_score[p]
+
+    neg = jnp.iinfo(jnp.int64).min
+    if tie_key is not None:
+        # seeded uniform tie-break: lexicographic (score, hash) argmax
+        # — every max-score node equally likely, deterministic per
+        # (seed, attempt) (selectHost reservoir analogue)
+        k_p = jax.random.fold_in(tie_key, attempt_base + p)
+        h = jax.random.bits(k_p, (N,), dtype=jnp.uint32).astype(I64)
+        ranked = jnp.where(feas, total_score * (1 << 33) + h, neg)
+        choice = jnp.argmax(ranked).astype(I32)
+    elif sample_k is not None:
+        # compat first-max: among max-score nodes, pick the first in
+        # the zone-round-robin VISIT order (the reference appends
+        # feasible nodes in nodeTree walk order, so "first max" means
+        # first visited, not lowest packed slot)
+        ranked = jnp.where(feas, total_score, neg)
+        best = jnp.max(ranked)
+        tie_rank = jnp.where(feas & (ranked == best), rank, N + 1)
+        choice = jnp.argmin(tie_rank).astype(I32)
+    else:
+        ranked = jnp.where(feas, total_score, neg)
+        choice = jnp.argmax(ranked).astype(I32)
+    choice = jnp.where((n_feas > 0) & active, choice, ABSENT)
+    n_feas = jnp.where(active, n_feas, 0)
+
+    if not commit:
+        return state, (choice, n_feas, reason_counts)
+
+    # ---------------- commit ----------------
+    committed = choice >= 0
+    onehot_n = (jnp.arange(N, dtype=I32) == choice) & committed
+    new_state = dict(
+        state,
+        requested=state["requested"]
+        + onehot_n[:, None].astype(I32) * db.requests[p][None, :Rn],
+        nonzero=state["nonzero"]
+        + onehot_n[:, None].astype(I32) * db.nonzero_req[p][None, :],
+        num_pods=state["num_pods"] + onehot_n.astype(I32),
+        # inactive (pad) slots must not clobber row p's assignment
+        assigned=state["assigned"]
+        .at[p]
+        .set(jnp.where(active, choice, state["assigned"][p])),
+    )
+    if sample_k is not None:
+        # nextStartNodeIndex advances by nodes visited, per attempt
+        # (schedule_one.go:625), padded batch rows included like the
+        # reference's no-op cycles would be skipped: only real pods
+        # advance the rotation
+        new_state["sample_start"] = jnp.where(
+            db.valid[p],
+            (state["sample_start"] + processed) % nv,
+            state["sample_start"],
+        ).astype(I32)
+    return new_state, (choice, n_feas, reason_counts)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("v_cap", "weights", "check_fit", "d_cap", "fit_strategy"),
@@ -619,22 +981,6 @@ def gang_schedule(
             dyn_f = jnp.sum(
                 (eq_dom & (bm & te_at)[:, None, :]).astype(I32), axis=2
             )  # [C, N]
-            total = g.sp_dom_cnt[p] + dyn_f  # [C, N]
-            big32 = jnp.iinfo(jnp.int32).max
-            min_match = jnp.min(jnp.where(g.sp_te[p], total, big32), axis=1)
-            min_match = jnp.where(
-                (db.tsc_min_domains[p] > 0)
-                & (g.sp_ndom[p] < db.tsc_min_domains[p]),
-                0,
-                min_match,
-            )
-            skew = (
-                total + g.sp_self[p].astype(I32)[:, None] - min_match[:, None]
-            )
-            c_ok = (dv >= 0) & (
-                ~g.sp_dom_pres[p] | (skew <= db.tsc_max_skew[p][:, None])
-            )
-            m_spread = jnp.all(~g.sp_hard[p][:, None] | c_ok, axis=0)
             # score-side counts: _spread_cnt
             dyn_host = jnp.einsum("cj,jn->cn", bm.astype(I32), eqJ_i)
             cg_at = (
@@ -646,11 +992,9 @@ def gang_schedule(
             dyn_dom = jnp.sum(
                 (eq_dom & (bm & cg_at)[:, None, :]).astype(I32), axis=2
             )
-            sp_cnt = jnp.where(
-                g.sp_is_host[p][:, None],
-                g.sp_node_cnt[p] + dyn_host,
-                g.sp_sc_dom[p] + dyn_dom,
-            )  # [C, N]
+            m_spread, sp_cnt, _ = spread_constraints(
+                db, g, p, SpreadDyn(dyn_f, dyn_host, dyn_dom)
+            )
         else:
             m_spread = true_n
             sp_cnt = jnp.zeros((C, N), I32)
@@ -665,23 +1009,7 @@ def gang_schedule(
             )  # [AT, N, J]
             ip_bm = g.ip_bmatch[p] & av  # [AT, J]
             ip_dyn = jnp.sum((ip_eq & ip_bm[:, None, :]).astype(I32), axis=2)
-            ip_total = g.ip_dom_cnt[p] + ip_dyn  # [AT, N]
-
-            topo_present = ip_dv >= 0
-            viol2 = jnp.any(
-                g.ip_is_anti[p][:, None] & topo_present & (ip_total > 0), axis=0
-            )
-            aff_ok = jnp.all(
-                ~g.ip_is_aff[p][:, None] | (topo_present & (ip_total > 0)),
-                axis=0,
-            )
             any_dyn = jnp.any(g.ip_is_aff[p][:, None] & ip_bm)
-            any_match = g.ip_any_static[p] | any_dyn
-            topo_all = jnp.all(
-                ~g.ip_is_aff[p][:, None] | topo_present, axis=0
-            )
-            escape = jnp.any(g.ip_is_aff[p]) & ~any_match & g.ip_self_all[p]
-            ok3 = aff_ok | (escape & topo_all)
 
             # Batch-assigned peers' terms vs p, factored by distinct topology
             # key so the contraction reads [Kd2, N] columns instead of the
@@ -718,16 +1046,9 @@ def gang_schedule(
                     jnp.where(in_k, w_sym, 0),
                     eqk.astype(I32),
                 )
-            m_interpod = ~g.ip_viol_existing[p] & ~viol2 & ok3 & ~viol_b
-            pref = jnp.sum(
-                jnp.where(
-                    topo_present,
-                    ip_total.astype(I64) * g.ip_pref_w[p][:, None],
-                    0,
-                ),
-                axis=0,
+            m_interpod, ip_raw, _ = interpod_constraints(
+                g, p, InterpodDyn(ip_dyn, viol_b, sym_b.astype(I64), any_dyn)
             )
-            ip_raw = g.ip_sym[p] + pref + sym_b.astype(I64)
         else:
             m_interpod = true_n
             ip_raw = g.ip_sym[p]
@@ -745,241 +1066,26 @@ def gang_schedule(
         return cheap_body(state, p, hv, jnp.asarray(True))
 
     def cheap_body(state, p, hv, active):
-        # ---------------- dynamic filters ----------------
-        req = db.requests[p]  # [Rp]
-        mask = g.static_mask[p] & hv["m_portb"]
-        m_fit = true_n
-        if check_fit:
-            nom_cnt = 0
-            nom_delta = 0
-            if nom_node is not None:
-                gate = (nom_prio >= db.priority[p]).astype(I32)  # [G]
-                nom_cnt = jnp.einsum("g,gn->n", gate, nom_oh)
-                nom_delta = jnp.einsum(
-                    "gr,gn->nr", nom_req * gate[:, None], nom_oh
-                )  # [N, Rn]
-            fits = state["num_pods"] + nom_cnt + 1 <= dc.allowed_pods
-            all_zero = jnp.all(req == 0)
-            avail = dc.allocatable - state["requested"] - nom_delta  # [N, Rn]
-            if Rp > Rn:
-                avail = jnp.concatenate(
-                    [avail, jnp.zeros((N, Rp - Rn), I32)], axis=1
-                )
-            conflict = req[None, :] > avail  # [N, Rp]
-            # extended-resource lanes only count when actually requested
-            scalar_lane = jnp.arange(Rp) >= N_FIXED_LANES
-            conflict = conflict & (~scalar_lane | (req > 0))[None, :]
-            lane_ok = ~jnp.any(conflict, axis=1)
-            m_fit = fits & (all_zero | lane_ok)
-            mask = mask & m_fit
-
-        m_portb = hv["m_portb"]
-        m_spread = hv["m_spread"]
-        m_interpod = hv["m_interpod"]
-        mask = mask & m_spread & m_interpod
-        feas = mask
-        if sample_k is not None:
-            # adaptive-sampling cut: keep the first sample_k feasible nodes
-            # in ZONE-ROUND-ROBIN rotation order from the carried start
-            # index — dc.visit_rank is the nodeTree order
-            # (node_tree.go:119-143) that the reference's sampling,
-            # rotation, and tie-breaks all ride
-            nv = jnp.maximum(dc.n_valid_nodes, 1)
-            start = state["sample_start"]
-            vr = dc.visit_rank
-            valid_vr = vr >= 0
-            rank = jnp.where(valid_vr, (vr - start) % nv, N)
-            rot = (
-                jnp.zeros((N + 1,), bool)
-                .at[rank]
-                .set(feas & valid_vr, mode="drop")[:N]
-            )
-            cum = jnp.cumsum(rot.astype(I32))
-            keep_rot = rot & (cum <= sample_k)
-            feas = (
-                jnp.concatenate([keep_rot, jnp.zeros((1,), bool)])[rank]
-                & feas
-            )
-            total_feas = cum[N - 1]
-            processed = jnp.where(
-                total_feas >= sample_k,
-                jnp.sum((cum < sample_k).astype(I32)) + 1,
-                nv,
-            )
-        n_feas = jnp.sum(feas.astype(I32))
-
-        # ---------------- failure diagnosis ----------------
-        # Per-kernel rejected-node counts with first-failure attribution in
-        # the reference's filter chain order (findNodesThatPassFilters
-        # early-exits per node; FitError aggregates counts per reason).
-        remaining = dc.node_valid & db.valid[p]
-        reason_counts = []
-        for comp in (
-            g.d_unsched[p],
-            g.d_nodename[p],
-            g.d_taints[p],
-            g.d_nodeaff[p],
-            g.d_ports[p] & m_portb,
-            g.d_extra[p],
-            m_fit,
-            m_spread,
-            m_interpod,
-        ):
-            rejected = remaining & ~comp
-            reason_counts.append(jnp.sum(rejected.astype(I32)))
-            remaining = remaining & comp
-        reason_counts = jnp.stack(reason_counts)  # [N_DIAG]
-
-        # ---------------- scores ----------------
-        # NodeResourcesFit scoring strategy on non-zero-defaulted requests
-        # (resource_allocation.go:37-115): LeastAllocated (default),
-        # MostAllocated, or RequestedToCapacityRatio over cpu/memory.
-        strat_id, fit_shape, fit_w = fit_strategy
-        nz = (
-            state["nonzero"].astype(I64)
-            + db.nonzero_req[p][None, :].astype(I64)
-        )  # [N, 2]
-        alloc2 = jnp.stack(
-            [dc.allocatable[:, LANE_CPU], dc.allocatable[:, LANE_MEM]], axis=1
-        ).astype(I64)
-        lane_has = alloc2 > 0
-        if strat_id == 1:  # MostAllocated (most_allocated.go)
-            frac = jnp.where(
-                nz > alloc2, 0, nz * MAX // jnp.maximum(alloc2, 1)
-            )
-        elif strat_id == 2:  # RequestedToCapacityRatio
-            util = jnp.where(
-                ~lane_has | (nz > alloc2),
-                MAX,
-                nz * MAX // jnp.maximum(alloc2, 1),
-            )
-            frac = _broken_linear_dev(fit_shape, util)
-        else:  # LeastAllocated (least_allocated.go:29-60)
-            frac = jnp.where(
-                nz > alloc2, 0, (alloc2 - nz) * MAX // jnp.maximum(alloc2, 1)
-            )
-        w2 = jnp.asarray(fit_w, I64)[None, :]
-        # RTCR only counts resources whose score is positive
-        # (requested_to_capacity_ratio.go:46-52)
-        use = lane_has & (frac > 0) if strat_id == 2 else lane_has
-        wsum = jnp.sum(jnp.where(use, w2, 0), axis=1)
-        total_fit = jnp.sum(jnp.where(use, frac * w2, 0), axis=1)
-        if strat_id == 2:  # math.Round of the weighted mean
-            least = jnp.where(
-                wsum > 0,
-                (2 * total_fit + wsum) // jnp.maximum(2 * wsum, 1),
-                0,
-            )
-        else:
-            least = jnp.where(
-                wsum > 0, total_fit // jnp.maximum(wsum, 1), 0
-            )
-
-        # BalancedAllocation on real requests
-        a0 = dc.allocatable[:, LANE_CPU].astype(I64)
-        a1 = dc.allocatable[:, LANE_MEM].astype(I64)
-        r0 = jnp.minimum(
-            state["requested"][:, LANE_CPU].astype(I64)
-            + db.requests[p, LANE_CPU].astype(I64),
-            a0,
+        return pod_step(
+            dc,
+            db,
+            g,
+            p,
+            state,
+            hv,
+            active,
+            check_fit=check_fit,
+            weights=weights,
+            d_cap=d_cap,
+            fit_strategy=fit_strategy,
+            extra_score=extra_score,
+            nom_oh=nom_oh if nom_node is not None else None,
+            nom_prio=nom_prio,
+            nom_req=nom_req,
+            sample_k=sample_k,
+            tie_key=tie_key,
+            attempt_base=attempt_base,
         )
-        r1 = jnp.minimum(
-            state["requested"][:, LANE_MEM].astype(I64)
-            + db.requests[p, LANE_MEM].astype(I64),
-            a1,
-        )
-        d = jnp.abs(r0 * a1 - r1 * a0)
-        den = jnp.maximum(a0 * a1, 1)
-        balanced = jnp.where(
-            (a0 > 0) & (a1 > 0), MAX - (50 * d + den - 1) // den, MAX
-        )
-
-        # InterPodAffinity: static symmetric + incoming preferred (with batch
-        # contributions) + symmetric from batch-assigned pods' terms —
-        # carried in hv (see heavy_parts).
-        ip_raw = hv["ip_raw"]
-
-        # PodTopologySpread score: the count rows come from heavy_parts;
-        # the log-weight normalization depends on the LIVE feasible set,
-        # so it runs here per pod.
-        if C:
-            sp_raw, sp_valid = _spread_raw(
-                dc, db, g, p, feas, hv["sp_cnt"], d_cap
-            )
-        else:
-            sp_raw = jnp.zeros((N,), I64)
-            sp_valid = feas
-
-        w_taint, w_naff, w_spread, w_ip, w_fit, w_bal, w_img = weights
-        total_score = jnp.zeros((N,), I64)
-        if w_taint:
-            total_score += w_taint * _norm_default(
-                g.sc_taint[p], feas, reverse=True
-            )
-        if w_naff:
-            total_score += w_naff * _norm_default(g.sc_nodeaff[p], feas)
-        if w_spread:
-            total_score += w_spread * _norm_spread(sp_raw, sp_valid, feas)
-        if w_ip:
-            total_score += w_ip * _norm_minmax(ip_raw, feas)
-        if w_fit:
-            total_score += w_fit * least
-        if w_bal:
-            total_score += w_bal * balanced
-        if w_img:
-            total_score += w_img * g.sc_image[p]
-        if extra_score is not None:
-            total_score += extra_score[p]
-
-        neg = jnp.iinfo(jnp.int64).min
-        if tie_key is not None:
-            # seeded uniform tie-break: lexicographic (score, hash) argmax
-            # — every max-score node equally likely, deterministic per
-            # (seed, attempt) (selectHost reservoir analogue)
-            k_p = jax.random.fold_in(tie_key, attempt_base + p)
-            h = jax.random.bits(k_p, (N,), dtype=jnp.uint32).astype(I64)
-            ranked = jnp.where(feas, total_score * (1 << 33) + h, neg)
-            choice = jnp.argmax(ranked).astype(I32)
-        elif sample_k is not None:
-            # compat first-max: among max-score nodes, pick the first in
-            # the zone-round-robin VISIT order (the reference appends
-            # feasible nodes in nodeTree walk order, so "first max" means
-            # first visited, not lowest packed slot)
-            ranked = jnp.where(feas, total_score, neg)
-            best = jnp.max(ranked)
-            tie_rank = jnp.where(feas & (ranked == best), rank, N + 1)
-            choice = jnp.argmin(tie_rank).astype(I32)
-        else:
-            ranked = jnp.where(feas, total_score, neg)
-            choice = jnp.argmax(ranked).astype(I32)
-        choice = jnp.where((n_feas > 0) & active, choice, ABSENT)
-        n_feas = jnp.where(active, n_feas, 0)
-
-        # ---------------- commit ----------------
-        commit = choice >= 0
-        onehot_n = (jnp.arange(N, dtype=I32) == choice) & commit
-        new_state = dict(
-            requested=state["requested"]
-            + onehot_n[:, None].astype(I32) * db.requests[p][None, :Rn],
-            nonzero=state["nonzero"]
-            + onehot_n[:, None].astype(I32) * db.nonzero_req[p][None, :],
-            num_pods=state["num_pods"] + onehot_n.astype(I32),
-            # inactive (pad) slots must not clobber row p's assignment
-            assigned=state["assigned"]
-            .at[p]
-            .set(jnp.where(active, choice, state["assigned"][p])),
-        )
-        if sample_k is not None:
-            # nextStartNodeIndex advances by nodes visited, per attempt
-            # (schedule_one.go:625), padded batch rows included like the
-            # reference's no-op cycles would be skipped: only real pods
-            # advance the rotation
-            new_state["sample_start"] = jnp.where(
-                db.valid[p],
-                (state["sample_start"] + processed) % nv,
-                state["sample_start"],
-            ).astype(I32)
-        return new_state, (choice, n_feas, reason_counts)
 
     state, (chosen, n_feas, reason_counts) = jax.lax.scan(
         step, init, jnp.arange(P, dtype=I32)

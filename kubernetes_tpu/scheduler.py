@@ -75,6 +75,7 @@ _KTPU_GUARDED = {
             "_is_confirmation",
             "_repack_mirror",
             "_sync_mirror_external",
+            "_wave_tables",
         ],
     },
     "Nominator": {
@@ -454,6 +455,9 @@ class Scheduler:
             "errors": 0,
             "fast_batches": 0,
             "scan_batches": 0,
+            "wave_batches": 0,
+            "wave_pods": 0,
+            "wave_admitted": 0,
         }
 
     # ----- event handlers (eventhandlers.go:345-428) ------------------------
@@ -1135,7 +1139,6 @@ class Scheduler:
                     trace.step("Fast-path commit done")
                     trace.log_if_long()
                     return fast
-            self.metrics["scan_batches"] += 1
 
             # scan path: bring the full mirror (usage tensors included) up
             # to date — its kernels read requested/num_pods per node.
@@ -1179,6 +1182,27 @@ class Scheduler:
                 (pb.want_ppk != PAD).any() or (self.mirror.nodes.used_ppk != PAD).any()
             )
 
+            # 1a'. WAVE eligibility: batches carrying their own cross-pod
+            # constraint terms ride the speculative wave dispatch
+            # (ops/wave.py) — speculation + term-factored conflict
+            # resolution, bit-identical to the scan at a fraction of its
+            # per-step cost.  Sampling-compat / seeded-tie drains and
+            # in-batch host-port users keep the gang scan (_wave_tables
+            # also refuses batches the factored algebra cannot express).
+            wt = None
+            if (
+                self.config.wave_dispatch
+                and bool(
+                    (pb.aff_kind != PAD).any()
+                    or (pb.tsc_topo_key != PAD).any()
+                )
+                and not self._sampling_active(fwk)
+            ):
+                wt = self._wave_tables(pb)
+            self.metrics[
+                "wave_batches" if wt is not None else "scan_batches"
+            ] += 1
+
             # 1b. host-backed Filter plugins veto (pod, node) pairs the device
             # kernels can't judge (stateful plugins — volumebinding class).
             extra_mask = None
@@ -1212,14 +1236,12 @@ class Scheduler:
             else None
         )
         t_gang = time.perf_counter()
-        chosen, n_feas, reason_counts, tallies = gang.gang_run(
-            dc,
-            db,
-            hostname_key,
-            v_cap,
+        wstats_dev = None
+        # kwargs shared VERBATIM by both dispatch kernels — one dict so a
+        # future knob cannot reach one path and silently miss the other
+        shared_kw = dict(
             has_interpod=has_interpod,
             has_spread=has_spread,
-            has_ports=has_ports,
             has_images=has_images,
             enabled=enabled,
             weights=weights,
@@ -1229,12 +1251,42 @@ class Scheduler:
             nom_req=nom_req,
             extra_score=extra_score,
             fit_strategy=fwk.fit_strategy(),
-            sample_k=sample_k,
-            sample_start=sample_start,
-            tie_key=tie_key,
-            attempt_base=attempt_base,
             **tables,
         )
+        if wt is not None:
+            from kubernetes_tpu.ops import wave as wave_ops
+
+            chosen, n_feas, reason_counts, tallies, wstats_dev = (
+                wave_ops.wave_run(
+                    dc,
+                    db,
+                    hostname_key,
+                    v_cap,
+                    wt["tid_sp"],
+                    wt["rep_sp_p"],
+                    wt["rep_sp_c"],
+                    wt["tid_ip"],
+                    wt["rep_ip_p"],
+                    wt["rep_ip_u"],
+                    wt["ip_cdv_tab"],
+                    d2_cap=wt["d2_cap"],
+                    **shared_kw,
+                )
+            )
+        else:
+            chosen, n_feas, reason_counts, tallies = gang.gang_run(
+                dc,
+                db,
+                hostname_key,
+                v_cap,
+                has_ports=has_ports,
+                sample_k=sample_k,
+                sample_start=sample_start,
+                tie_key=tie_key,
+                attempt_base=attempt_base,
+                **shared_kw,
+            )
+        path = "wave" if wt is not None else "scan"
         t_d2h = time.perf_counter()
         self.phases.add("device", t_d2h - t_gang)
         both = jax.device_get(jnp.stack([chosen, n_feas]))
@@ -1251,12 +1303,18 @@ class Scheduler:
         self.prom.recorder.observe(
             self.prom.gang_dispatch_duration,
             time.perf_counter() - t_gang,
-            path="scan",
+            path=path,
         )
-        self._trace_dispatch("scan", t_gang, batch)
+        self._trace_dispatch(path, t_gang, batch)
         trace.step("Gang dispatch done")
 
-        # 3. per-pod commit: assume → reserve → permit → bind
+        # 3. per-pod commit: assume → reserve → permit → bind.  Wave
+        # batches additionally resolve their speculation stats and, when
+        # the framework allows lean binds, commit through the bulk path
+        # split by interaction group.
+        wave_groups = None
+        if wstats_dev is not None:
+            wave_groups = self._wave_resolve(fwk, batch, chosen, wstats_dev)
         self._process_results(
             fwk,
             state,
@@ -1267,6 +1325,7 @@ class Scheduler:
             outcomes,
             host_diags,
             host_plugin_sets,
+            wave_groups=wave_groups,
         )
         trace.step("Commits done")
         trace.log_if_long()
@@ -1283,10 +1342,14 @@ class Scheduler:
         outcomes,
         host_diags=None,
         host_plugin_sets=None,
+        wave_groups=None,
     ) -> None:
         """The per-pod result walk shared by the direct and chained paths:
         failures → diagnosis + PostFilter, successes → _commit (which hands
-        binding to the async workers)."""
+        binding to the async workers).  ``wave_groups`` (per-pod
+        interaction-group ids from the wave partitioner) routes successes
+        through the bulk-commit path instead, one bulk run per group, so
+        non-interacting groups' bindings flow concurrently."""
         t_commit = time.perf_counter()
         node_names = self.mirror.nodes.names
         n_nodes = len(self.cache.real_nodes())
@@ -1304,6 +1367,7 @@ class Scheduler:
         # per-op dict atomicity
         with self._mu:
             self.metrics["schedule_attempts"] += len(batch)
+        bulk_by_group: Dict[int, list] = {}
         for i, qp in enumerate(batch):
             idx = int(chosen[i])
             if idx < 0:
@@ -1334,9 +1398,29 @@ class Scheduler:
                     )
                 )
                 continue
+            if wave_groups is not None:
+                bulk_by_group.setdefault(wave_groups[i], []).append(i)
+                continue
             node_name = node_names[idx]
             outcome = self._commit(fwk, state, qp, node_name, int(n_feas[i]))
             outcomes.append(outcome)
+        # wave bulk tail: one vectorized assume + one bulk bind task per
+        # interaction group (decisions are final; non-interacting groups'
+        # binds are independent, so each group rides its own task)
+        for gidxs in bulk_by_group.values():
+            self._commit_fast_bulk(
+                fwk,
+                state,
+                batch,
+                chosen,
+                0,
+                0,
+                node_names,
+                outcomes,
+                idxs=gidxs,
+                n_feas=n_feas,
+                nonfast=True,
+            )
         self.phases.add("commit", time.perf_counter() - t_commit)
 
     # ----- the chained (pipelined) dispatch path ---------------------------
@@ -1776,8 +1860,29 @@ class Scheduler:
                 fwk.score_weights.get(n, 0) for n in gang.WEIGHT_ORDER
             )
             fit_strategy = fwk.fit_strategy()
+            # cross-pod-constraint batches ride the speculative wave inside
+            # the chained dispatch (same self-append, wave scheduling) —
+            # computed from the FINAL pb (post-PreFilter repack)
+            wt = None
+            if self.config.wave_dispatch and bool(
+                (pb.aff_kind != PAD).any() or (pb.tsc_topo_key != PAD).any()
+            ):
+                wt = self._wave_tables(pb)
+            wave_kw = {}
+            if wt is not None:
+                wave_kw = dict(
+                    wave=True,
+                    tid_sp=wt["tid_sp"],
+                    rep_sp_p=wt["rep_sp_p"],
+                    rep_sp_c=wt["rep_sp_c"],
+                    tid_ip=wt["tid_ip"],
+                    rep_ip_p=wt["rep_ip_p"],
+                    rep_ip_u=wt["rep_ip_u"],
+                    ip_cdv_tab=wt["ip_cdv_tab"],
+                    d2_cap=wt["d2_cap"],
+                )
             t0 = time.perf_counter()
-            dc2, results, reasons = chain_ops.chain_dispatch(
+            out = chain_ops.chain_dispatch(
                 ch["dc"],
                 db,
                 self._hostname_dev(vocab),
@@ -1795,22 +1900,33 @@ class Scheduler:
                 nom_req=nom_req,
                 append_terms=append_terms,
                 fit_strategy=fit_strategy,
+                **wave_kw,
                 **tables,
             )
+            if wt is not None:
+                dc2, results, reasons, wstats = out
+            else:
+                dc2, results, reasons = out
+                wstats = None
             self._chain = {
                 "dc": dc2,
                 "e": ch["e"] + P,
                 "m": ch["m"] + P * AT,
                 "epoch": epoch,
             }
-            self.metrics["chain_batches"] = (
-                self.metrics.get("chain_batches", 0) + 1
-            )
+            if wt is not None:
+                self.metrics["wave_batches"] += 1
+            else:
+                self.metrics["chain_batches"] = (
+                    self.metrics.get("chain_batches", 0) + 1
+                )
             # start the host copy of the results as soon as the device
             # finishes this batch — by harvest time it's already local
             try:
                 results.copy_to_host_async()
                 reasons.copy_to_host_async()
+                if wstats is not None:
+                    wstats.copy_to_host_async()
             except AttributeError:
                 pass
             rec = {
@@ -1819,9 +1935,10 @@ class Scheduler:
                 "batch": batch,
                 "results": results,
                 "reasons": reasons,
+                "wave_stats": wstats,
                 "t0": t0,
             }
-            self._trace_dispatch("chain", t0, batch, rec)
+            self._trace_dispatch("wave" if wt is not None else "chain", t0, batch, rec)
             return rec
 
     def _finish_chained(self, rec) -> List[ScheduleOutcome]:
@@ -1833,11 +1950,17 @@ class Scheduler:
         t_d2h = time.perf_counter()
         both = jax.device_get(rec["results"])
         self.phases.add("d2h", time.perf_counter() - t_d2h)
+        wstats = rec.get("wave_stats")
         self.prom.recorder.observe(
             self.prom.gang_dispatch_duration,
             time.perf_counter() - rec["t0"],
-            path="chain",
+            path="wave" if wstats is not None else "chain",
         )
+        wave_groups = None
+        if wstats is not None:
+            wave_groups = self._wave_resolve(
+                rec["fwk"], rec["batch"], both[0], wstats
+            )
         self._process_results(
             rec["fwk"],
             rec["state"],
@@ -1846,6 +1969,7 @@ class Scheduler:
             both[1],
             rec["reasons"],
             outcomes,
+            wave_groups=wave_groups,
         )
         self._record_batch_metrics(
             rec["fwk"].profile_name,
@@ -1856,7 +1980,7 @@ class Scheduler:
         self._flush_binds()
         if t_h is not None and tr.enabled:
             tr.complete(
-                "harvest.chain",
+                "harvest.wave" if wstats is not None else "harvest.chain",
                 t_h,
                 cat="batch",
                 bid=rec.get("bid"),
@@ -1894,6 +2018,143 @@ class Scheduler:
             )
             self._tables_key = tkey
         return self._tables
+
+    def _wave_tables(self, pb):
+        """Host half of the wave's interaction partitioner: distinct-term
+        tables for the factored admission pass (ops/wave.py).  None when
+        the batch is wave-ineligible (in-batch host ports, duplicate
+        hostname labels) — the caller falls back to the gang scan.
+
+        Memoized like _gang_tables: template-stamped drains repeat the
+        same term content batch after batch, so the np.unique row-dedup
+        and per-key domain compaction collapse to one digest check."""
+        import hashlib
+
+        import numpy as np
+
+        from kubernetes_tpu.ops import wave as wave_ops
+
+        hk_id = self.mirror.vocab.label_keys.lookup(HOSTNAME_LABEL)
+        h = hashlib.blake2b(digest_size=16)
+        for a in (
+            pb.valid,
+            pb.ns_id,
+            pb.want_ppk,
+            pb.tsc_topo_key,
+            pb.tsc_table.req_key,
+            pb.tsc_table.req_op,
+            pb.tsc_table.req_vals,
+            pb.tsc_table.req_rhs,
+            pb.tsc_table.term_valid,
+            pb.aff_kind,
+            pb.aff_topo_key,
+            pb.aff_weight,
+            pb.aff_ns_all,
+            pb.aff_ns_ids,
+            pb.aff_table.req_key,
+            pb.aff_table.req_op,
+            pb.aff_table.req_vals,
+            pb.aff_table.req_rhs,
+            pb.aff_table.term_valid,
+        ):
+            h.update(np.ascontiguousarray(a).tobytes())
+        key = (
+            self.mirror.static_generation,
+            self.mirror._full_packs,
+            len(self.mirror.vocab.label_vals),
+            hk_id,
+            h.digest(),
+        )
+        cached = getattr(self, "_wave_tables_memo", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        wt = wave_ops.wave_tables(pb, self.mirror.nodes.label_vals, hk_id)
+        self._wave_tables_memo = (key, wt)
+        return wt
+
+    def _wave_resolve(self, fwk, batch, chosen, wstats_dev):
+        """Harvest one wave's speculation stats: admitted/demoted counters,
+        a ``wave_demoted`` flight-recorder event (with the conflicting
+        term) per corrected pod, and — when the framework permits lean
+        binds — the interaction-group split the bulk commit path uses.
+        Returns the per-pod group ids, or None when commits must walk the
+        per-pod path."""
+        import numpy as np
+
+        from kubernetes_tpu.ops import wave as wave_ops
+
+        t0 = time.perf_counter()
+        stats = np.asarray(jax.device_get(wstats_dev))
+        n = len(batch)
+        spec, kinds, cterms = stats[0][:n], stats[1][:n], stats[2][:n]
+        chosen_n = np.asarray(chosen)[:n]
+        demoted = np.nonzero(chosen_n != spec)[0]
+        # "admitted" = a speculative PLACEMENT survived; pods unschedulable
+        # in both passes are neither admitted nor demoted
+        admitted = int(np.sum((chosen_n == spec) & (chosen_n >= 0)))
+        conflicts: Dict[str, int] = {}
+        fr = self.flight
+        fr_on = fr.enabled
+        names = self.mirror.nodes.names
+        for i in demoted:
+            code = int(kinds[i])
+            upgraded = code == wave_ops.DEMOTE_UPGRADE
+            if not upgraded:
+                kind = wave_ops.DEMOTE_KINDS.get(code, "score")
+                conflicts[kind] = conflicts.get(kind, 0) + 1
+            if fr_on:
+                c = int(chosen_n[i])
+                if upgraded:
+                    # infeasible alone, placed once a batch peer committed
+                    # (required affinity satisfied) — not a conflict
+                    detail = {}
+                    if 0 <= c < len(names):
+                        detail["node"] = names[c]
+                    fr.record(batch[i].pod.uid, "wave_upgraded", detail)
+                    continue
+                detail = {"kind": kind, "term": int(cterms[i])}
+                s = int(spec[i])
+                if 0 <= s < len(names):
+                    detail["spec_node"] = names[s]
+                if 0 <= c < len(names):
+                    detail["node"] = names[c]
+                fr.record(batch[i].pod.uid, "wave_demoted", detail)
+        with self._mu:
+            self.metrics["wave_pods"] += n
+            self.metrics["wave_admitted"] += admitted
+        self.prom.wave_admitted.inc(admitted)
+        for kind, cnt in conflicts.items():
+            self.prom.wave_conflicts.inc(cnt, kind=kind)
+        # Bulk-commit eligibility: lean_bind_ok()'s and the Reserve/Permit
+        # "covered by host filters" no-op guarantees are BOTH conditioned
+        # on the batch being spec-irrelevant to every host Filter plugin
+        # (the fast gate proves this for fast batches) — a wave batch can
+        # carry host-filter-relevant pods (the extra_mask route), whose
+        # Reserve/PreBind walks must run, so prove irrelevance per pod
+        # before routing anything around the per-pod commit path.
+        groups = None
+        hf = fwk.host_filter_plugins()
+        hf_clean = not hf or not any(
+            pl.maybe_relevant(qp.pod) for qp in batch for pl in hf
+        )
+        rp_ok = not fwk.has_reserve_or_permit() or (
+            fwk.reserve_permit_covered_by_host_filters() and hf_clean
+        )
+        if (
+            fwk.lean_bind_ok()
+            and hf_clean
+            and rp_ok
+            and not self.extenders
+        ):
+            groups, n_groups = wave_ops.interaction_groups(
+                [qp.pod for qp in batch]
+            )
+            with self._mu:
+                self.metrics["wave_groups"] = (
+                    self.metrics.get("wave_groups", 0) + n_groups
+                )
+        self.phases.add("wave_resolve", time.perf_counter() - t0)
+        return groups
 
     def _static_device_cluster(self) -> DeviceCluster:
         """DeviceCluster cached across batches for STATIC reads only
@@ -3487,7 +3748,18 @@ class Scheduler:
         return outcome
 
     def _commit_fast_bulk(
-        self, fwk, state, batch, choices, i, j, node_names, outcomes
+        self,
+        fwk,
+        state,
+        batch,
+        choices,
+        i,
+        j,
+        node_names,
+        outcomes,
+        idxs=None,
+        n_feas=None,
+        nonfast: bool = False,
     ) -> None:
         """Commit batch[i:j] — a contiguous run of fast-scheduled, lean
         pods — as ONE vectorized pass: bulk assume into the cache (per-node
@@ -3497,9 +3769,22 @@ class Scheduler:
         the per-pod Python of the commit tail, which the config0 phase
         breakdown showed dominating the drain.  Falls back per pod
         (_commit_under_lock) whenever reserve/permit could act or a
-        non-default binder is configured — see _finish_fast's bulk_ok."""
-        run = batch[i:j]
-        names = [node_names[choices[k]] for k in range(i, j)]
+        non-default binder is configured — see _finish_fast's bulk_ok.
+
+        ``idxs`` replaces the [i:j) slice with an explicit index list (the
+        wave path's per-interaction-group runs); ``n_feas`` supplies
+        per-pod feasible counts for the outcomes (-1 otherwise);
+        ``nonfast`` marks commits the fast committer didn't make, bumping
+        the mirror-sync epoch the way per-pod _commit does."""
+        if idxs is None:
+            idxs = range(i, j)
+        run = [batch[k] for k in idxs]
+        names = [node_names[choices[k]] for k in idxs]
+        feas = (
+            [-1] * len(run)
+            if n_feas is None
+            else [int(n_feas[k]) for k in idxs]
+        )
         # Seed the per-pod request memos from a representative keyed by RAW
         # spec identity (fastpath.spec_key — the exact request strings)
         # before the cache accounting reads them: template-stamped pods
@@ -3512,8 +3797,8 @@ class Scheduler:
         from kubernetes_tpu import fastpath as fp
 
         req_by_spec: Dict[object, tuple] = {}
-        for k in range(i, j):
-            pod = batch[k].pod
+        for qp_ in run:
+            pod = qp_.pod
             d = pod.__dict__
             if "_nzreq_memo" in d:
                 continue
@@ -3532,13 +3817,19 @@ class Scheduler:
         with self._mu:
             if self._sanitize:
                 sanitizer.assert_owned(self._mu, "_commit_fast_bulk")
+            if nonfast:
+                # scan/wave-path commits advance cache state the fast
+                # committer didn't see — its cache key must change
+                self._nonfast_commits = (
+                    getattr(self, "_nonfast_commits", 0) + len(run)
+                )
             results = self.cache.assume_pods_bulk(
                 list(zip((qp.pod for qp in run), names))
             )
             view_live = self._oracle_cache is not None
             fr = self.flight
             fr_on = fr.enabled
-            for qp, nn, res in zip(run, names, results):
+            for qp, nn, nf, res in zip(run, names, feas, results):
                 if isinstance(res, str):
                     # protocol violation (double assume — the multi-
                     # scheduler race): fail the pod AND rebuild the fast
@@ -3557,7 +3848,7 @@ class Scheduler:
                     qp.pod,
                     nn,
                     success,
-                    -1,
+                    nf,
                     pod_attempts=qp.attempts,
                     first_enqueue_time=qp.timestamp,
                 )

@@ -1,0 +1,443 @@
+"""Speculative wave dispatch must be decision-identical to the serial path.
+
+The wave (ops/wave.py) replaces the gang scan's per-step peer contractions
+with a speculation pass + a term-factored admission pass.  Its contract is
+bit-identity with the gang scan — and therefore with the serial oracle the
+scan is property-tested against.  The adversarial shapes from the issue:
+
+  * ALL pods sharing ONE topology term — maximal interaction, the wave
+    degenerates to the serial recurrence and must match the oracle
+    placement for placement;
+  * fully DISJOINT term footprints — zero interaction, one wave admits
+    every pod at its speculative placement.
+
+Both scheduler-level adversarial tests run under KTPU_SANITIZE=1.
+"""
+
+import os
+import random
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubernetes_tpu.oracle.pipeline import schedule_one
+from kubernetes_tpu.oracle.scores import HOSTNAME_LABEL
+from kubernetes_tpu.oracle.state import OracleState
+from kubernetes_tpu.ops import gang, wave
+from kubernetes_tpu.ops.common import DeviceBatch, DeviceCluster, I32
+from kubernetes_tpu.snapshot.cluster import pack_cluster
+from kubernetes_tpu.snapshot.interner import Vocab
+from kubernetes_tpu.snapshot.schema import bucket_cap, pack_pod_batch
+
+from tests.gen import make_cluster, make_pod
+
+NS_LABELS = {
+    "default": {"team": "core"},
+    "prod": {"team": "core", "env": "prod"},
+    "dev": {"env": "dev"},
+}
+
+
+def _pack(state, pending):
+    vocab = Vocab()
+    pc = pack_cluster(state, vocab, pending_pods=pending)
+    pb = pack_pod_batch(
+        pending,
+        vocab,
+        k_cap=pc.nodes.k_cap,
+        namespace_labels=state.namespace_labels,
+    )
+    dc = DeviceCluster.from_host(pc.nodes, pc.existing, vocab)
+    db = DeviceBatch.from_host(pb)
+    v_cap = bucket_cap(len(vocab.label_vals))
+    hk_id = vocab.label_keys.lookup(HOSTNAME_LABEL)
+    hostname_key = jnp.asarray(hk_id, I32)
+    tables = gang.batch_tables(
+        pb.tsc_topo_key, pb.aff_topo_key, pc.nodes.label_vals, hk_id
+    )
+    return vocab, pc, pb, dc, db, v_cap, hk_id, hostname_key, tables
+
+
+def run_wave(state, pending, with_stats=False):
+    """wave_schedule end to end — the wave analogue of run_gang."""
+    vocab, pc, pb, dc, db, v_cap, hk_id, hostname_key, tables = _pack(
+        state, pending
+    )
+    wt = wave.wave_tables(pb, pc.nodes.label_vals, hk_id)
+    assert wt is not None, "generated batch unexpectedly wave-ineligible"
+    d_cap = tables.pop("d_cap")
+    d2_cap = wt.pop("d2_cap")
+    wt.pop("n_terms")
+    g = gang.precompute(dc, db, hostname_key, v_cap, **tables)
+    chosen, n_feas, _, _, stats = wave.wave_schedule(
+        dc,
+        db,
+        g,
+        hostname_key,
+        v_cap,
+        wt["tid_sp"],
+        wt["rep_sp_p"],
+        wt["rep_sp_c"],
+        wt["tid_ip"],
+        wt["rep_ip_p"],
+        wt["rep_ip_u"],
+        wt["ip_cdv_tab"],
+        d_cap=d_cap,
+        d2_cap=d2_cap,
+    )
+    names = list(state.nodes)
+    out = [
+        names[int(c)] if int(c) >= 0 else None
+        for c in np.asarray(chosen)[: len(pending)]
+    ]
+    if with_stats:
+        return out, np.asarray(stats)[:, : len(pending)]
+    return out
+
+
+def run_gang(state, pending):
+    vocab, pc, pb, dc, db, v_cap, hk_id, hostname_key, tables = _pack(
+        state, pending
+    )
+    d_cap = tables.pop("d_cap")
+    g = gang.precompute(dc, db, hostname_key, v_cap, **tables)
+    chosen, _, _, _ = gang.gang_schedule(dc, db, g, v_cap, d_cap=d_cap)
+    names = list(state.nodes)
+    return [
+        names[int(c)] if int(c) >= 0 else None
+        for c in np.asarray(chosen)[: len(pending)]
+    ]
+
+
+def run_serial(state, pending):
+    out = []
+    for pod in pending:
+        r = schedule_one(pod, state)
+        out.append(r.node)
+        if r.node is not None:
+            pod.node_name = r.node
+            state.place(pod)
+    return out
+
+
+def _no_ports(pod):
+    return not pod.host_ports()
+
+
+@pytest.mark.parametrize(
+    "seed,n_nodes,n_placed,n_pending",
+    [(41, 10, 20, 20), (42, 10, 20, 20), (43, 12, 24, 24),
+     (111, 40, 80, 120), (222, 40, 80, 120), (333, 40, 80, 120)],
+)
+def test_wave_matches_gang_and_serial(seed, n_nodes, n_placed, n_pending):
+    rng = random.Random(seed)
+    nodes, placed = make_cluster(rng, n_nodes, n_placed)
+    pending = [make_pod(rng, f"pend-{i}") for i in range(n_pending * 2)]
+    # wave eligibility excludes in-batch host ports; filter, keep the count
+    pending = [p for p in pending if _no_ports(p)][:n_pending]
+
+    state_w = OracleState.build(nodes, placed, namespace_labels=NS_LABELS)
+    got = run_wave(state_w, pending)
+
+    state_g = OracleState.build(nodes, placed, namespace_labels=NS_LABELS)
+    want_gang = run_gang(state_g, pending)
+    assert got == want_gang, (
+        f"wave diverged from gang at "
+        f"{[i for i, (a, b) in enumerate(zip(got, want_gang)) if a != b]}:\n"
+        f"got  {got}\nwant {want_gang}"
+    )
+
+    state_s = OracleState.build(nodes, placed, namespace_labels=NS_LABELS)
+    want = run_serial(state_s, pending)
+    assert got == want
+
+
+# ---------------------------------------------------------------------------
+# Adversarial shapes (issue spec), full scheduler, KTPU_SANITIZE=1
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def sanitize_on(monkeypatch):
+    from kubernetes_tpu.analysis import sanitizer
+
+    monkeypatch.setenv("KTPU_SANITIZE", "1")
+    sanitizer.reset_enabled_memo()
+    yield
+    monkeypatch.delenv("KTPU_SANITIZE", raising=False)
+    sanitizer.reset_enabled_memo()
+
+
+def _zone_nodes(n, zones=4, extra=None):
+    from kubernetes_tpu.api.resource import Resource
+    from kubernetes_tpu.api.types import Node
+
+    return [
+        Node(
+            name=f"node-{i}",
+            labels={
+                "topology.kubernetes.io/zone": f"zone-{i % zones}",
+                "kubernetes.io/hostname": f"node-{i}",
+                **(extra(i) if extra else {}),
+            },
+            capacity=Resource.from_map(
+                {"cpu": "8", "memory": "32Gi", "pods": 110}
+            ),
+        )
+        for i in range(n)
+    ]
+
+
+def _drain_sched(nodes, pods, wave: bool):
+    import copy
+
+    from kubernetes_tpu.framework.config import SchedulerConfiguration
+    from kubernetes_tpu.scheduler import Scheduler
+
+    conf = SchedulerConfiguration()
+    conf.wave_dispatch = wave
+    conf.batch_size = 64
+    s = Scheduler(configuration=conf)
+    got = {}
+    s.binding_sink = lambda pod, node: got.__setitem__(pod.name, node)
+    for n in nodes:
+        s.on_node_add(n)
+    for p in copy.deepcopy(pods):
+        s.on_pod_add(p)
+    for o in s.schedule_pending():
+        got.setdefault(o.pod.name, o.node)
+    return got, s
+
+
+def _one_term_pods(n):
+    """ALL pods share ONE topology term (same selector, same key) —
+    maximal interaction: every placement shifts every later verdict."""
+    from kubernetes_tpu.api.types import (
+        Container,
+        LabelSelector,
+        Pod,
+        TopologySpreadConstraint,
+    )
+
+    return [
+        Pod(
+            name=f"p{i}",
+            labels={"app": "one"},
+            topology_spread_constraints=(
+                TopologySpreadConstraint(
+                    max_skew=1,
+                    topology_key="topology.kubernetes.io/zone",
+                    when_unsatisfiable="DoNotSchedule",
+                    label_selector=LabelSelector(match_labels={"app": "one"}),
+                ),
+            ),
+            containers=[
+                Container(name="c", requests={"cpu": "100m", "memory": "64Mi"})
+            ],
+        )
+        for i in range(n)
+    ]
+
+
+def test_wave_one_shared_term_degenerates_serial(sanitize_on):
+    """Degenerate case: one shared hard topology term.  The wave's
+    admission pass must replay the serial recurrence exactly — placements
+    equal the serial oracle's, pod for pod — and speculation survives for
+    almost no one (the wave honestly reports the serialization)."""
+    from kubernetes_tpu.oracle.state import OracleState as OS
+
+    nodes = _zone_nodes(12)
+    pods = _one_term_pods(40)
+
+    state = OS.build(nodes)
+    want = run_serial(state, [p for p in __import__("copy").deepcopy(pods)])
+
+    got, s = _drain_sched(nodes, pods, wave=True)
+    assert [got.get(f"p{i}") for i in range(len(pods))] == want
+    assert s.metrics["wave_batches"] >= 1
+    assert s.metrics["wave_pods"] >= len(pods)
+    # maximal interaction: the vast majority of speculative placements are
+    # demoted (corrected in-dispatch) — the wave degenerated to serial
+    assert s.metrics["wave_admitted"] <= s.metrics["wave_pods"] * 0.5
+
+    # the demotions are observable: flight-recorder events with the
+    # conflicting term, surfaced by /debug/explain as a wave conflict
+    demoted_uids = [
+        e["pod"]
+        for e in s.flight.tail(10_000)
+        if e["kind"] == "wave_demoted"
+    ]
+    assert demoted_uids, "no wave_demoted flight events recorded"
+    ev = [
+        e
+        for e in s.flight.events_for(demoted_uids[-1])
+        if e["kind"] == "wave_demoted"
+    ][-1]
+    assert ev["detail"]["kind"] in ("spread", "affinity", "fit", "score")
+    from kubernetes_tpu.observability.explain import explain_pod, find_pod
+
+    pod = find_pod(s, demoted_uids[-1])
+    assert pod is not None
+    out = explain_pod(s, pod)
+    assert out["wave"]["demoted"] is True
+    assert out["wave"]["reason"] == "demoted by wave conflict"
+
+
+def test_wave_disjoint_terms_single_wave_admits_all(sanitize_on):
+    """Fully disjoint footprints: per-pod spread terms (distinct
+    selectors) and disjoint feasible sets — one wave admits every pod at
+    its speculative placement, bit-equal to the serial oracle."""
+    from kubernetes_tpu.api.types import (
+        Container,
+        LabelSelector,
+        Pod,
+        TopologySpreadConstraint,
+    )
+    from kubernetes_tpu.oracle.state import OracleState as OS
+
+    n_pods = 24
+    # two dedicated nodes per pod (disjoint feasible sets via nodeSelector)
+    nodes = _zone_nodes(
+        2 * n_pods, zones=4, extra=lambda i: {"slot": f"s{i // 2}"}
+    )
+    pods = [
+        Pod(
+            name=f"p{i}",
+            labels={"app": f"solo-{i}"},
+            node_selector={"slot": f"s{i}"},
+            topology_spread_constraints=(
+                TopologySpreadConstraint(
+                    max_skew=1,
+                    topology_key="topology.kubernetes.io/zone",
+                    when_unsatisfiable="DoNotSchedule",
+                    label_selector=LabelSelector(
+                        match_labels={"app": f"solo-{i}"}
+                    ),
+                ),
+            ),
+            containers=[
+                Container(name="c", requests={"cpu": "100m", "memory": "64Mi"})
+            ],
+        )
+        for i in range(n_pods)
+    ]
+
+    state = OS.build(nodes)
+    want = run_serial(state, [p for p in __import__("copy").deepcopy(pods)])
+
+    got, s = _drain_sched(nodes, pods, wave=True)
+    assert [got.get(f"p{i}") for i in range(n_pods)] == want
+    assert s.metrics["wave_batches"] >= 1
+    # zero interaction ⇒ one wave admits everything as speculated
+    assert s.metrics["wave_admitted"] == s.metrics["wave_pods"]
+    assert not [
+        e for e in s.flight.tail(10_000) if e["kind"] == "wave_demoted"
+    ]
+
+
+def test_wave_bulk_commit_never_skips_relevant_reserve():
+    """The wave bulk-commit gate relies on the same 'Reserve/Permit are
+    no-ops for host-filter-irrelevant pods' contract as the fast path —
+    a wave batch carrying a host-filter-RELEVANT pod must take the
+    per-pod commit path so the plugin's Reserve actually runs."""
+    import copy
+
+    from kubernetes_tpu.api.types import (
+        Container,
+        LabelSelector,
+        Pod,
+        TopologySpreadConstraint,
+    )
+    from kubernetes_tpu.framework import config as cfg
+    from kubernetes_tpu.framework.interface import (
+        FilterPlugin,
+        ReservePlugin,
+        Status,
+    )
+    from kubernetes_tpu.framework.registry import default_registry
+    from kubernetes_tpu.scheduler import Scheduler
+
+    class CountingReserve(FilterPlugin, ReservePlugin):
+        """Host Filter + Reserve (the volumebinding shape): relevant only
+        to pods labeled pvc=yes."""
+
+        name = "CountingReserve"
+        reserve_calls = 0
+
+        def filter(self, state, pod, node_state) -> Status:
+            return Status.success()
+
+        def maybe_relevant(self, pod) -> bool:
+            return pod.labels.get("pvc") == "yes"
+
+        def reserve(self, state, pod, node_name) -> Status:
+            CountingReserve.reserve_calls += 1
+            return Status.success()
+
+    CountingReserve.reserve_calls = 0
+    reg = default_registry()
+    reg.register(
+        CountingReserve.name,
+        lambda args, handle: CountingReserve(args=args, handle=handle),
+    )
+    profile = cfg.Profile()
+    profile.plugins.filter.enabled.append(cfg.PluginRef(CountingReserve.name))
+    profile.plugins.reserve.enabled.append(cfg.PluginRef(CountingReserve.name))
+    conf = cfg.SchedulerConfiguration(profiles=[profile], batch_size=32)
+    sched = Scheduler(conf, registry=reg)
+    bound = {}
+    sched.binding_sink = lambda pod, node: bound.__setitem__(pod.name, node)
+    for n in _zone_nodes(8):
+        sched.on_node_add(n)
+
+    def spread_pod(name, labels):
+        app = labels.get("app", "x")
+        return Pod(
+            name=name,
+            labels=labels,
+            topology_spread_constraints=(
+                TopologySpreadConstraint(
+                    max_skew=3,
+                    topology_key="topology.kubernetes.io/zone",
+                    when_unsatisfiable="DoNotSchedule",
+                    label_selector=LabelSelector(match_labels={"app": app}),
+                ),
+            ),
+            containers=[
+                Container(name="c", requests={"cpu": "100m", "memory": "64Mi"})
+            ],
+        )
+
+    pods = [spread_pod(f"plain-{i}", {"app": "plain"}) for i in range(10)]
+    pods += [
+        spread_pod(f"pvc-{i}", {"app": "claims", "pvc": "yes"})
+        for i in range(4)
+    ]
+    for p in copy.deepcopy(pods):
+        sched.on_pod_add(p)
+    outs = sched.schedule_pending()
+    placed_pvc = sum(
+        1 for o in outs if o.node and o.pod.labels.get("pvc") == "yes"
+    )
+    assert placed_pvc == 4
+    # every placed relevant pod walked Reserve — the bulk path may only
+    # bypass the walk for pods the plugin is provably irrelevant to
+    assert CountingReserve.reserve_calls == placed_pvc
+
+
+def test_wave_off_matches_wave_on():
+    """The config kill-switch routes back to the gang scan — decisions
+    must not depend on the switch."""
+    import random as _r
+
+    rng = _r.Random(9)
+    nodes, placed = make_cluster(rng, 14, 10)
+    pods = [make_pod(rng, f"w-{i}") for i in range(60)]
+    pods = [p for p in pods if _no_ports(p)]
+    for p in pods:
+        p.node_name = None
+    g_on, s_on = _drain_sched(nodes, pods, wave=True)
+    g_off, s_off = _drain_sched(nodes, pods, wave=False)
+    assert g_on == g_off
+    assert s_off.metrics["wave_batches"] == 0
