@@ -558,9 +558,12 @@ def main():
             file=sys.stderr,
         )
         def _mix(s):
-            """fast/chain/scan/wave batch counters for a bench line."""
+            """resident/fast/chain/scan/wave batch counters for a bench
+            line (resident batches are also fast batches; the resident
+            count shows how many rode the resident drain loop)."""
             m = s.metrics
             return (
+                f"resident={m.get('resident_batches', 0)} "
                 f"fast={m['fast_batches']} chain={m.get('chain_batches', 0)} "
                 f"scan={m['scan_batches']} wave={m['wave_batches']}"
             )
@@ -621,10 +624,15 @@ def main():
         configs["config0_phases"] = {
             k: round(v, 3) for k, v in sorted(phases.items())
         }
+        configs["config0_resident_pods"] = s0.metrics.get("resident_pods", 0)
+        configs["config0_resident_rounds"] = s0.metrics.get(
+            "resident_rounds", 0
+        )
         print(
             f"# config0 north-star: {ok0} pods / {n0_nodes} nodes drained in "
-            f"{dt0:.2f}s (target <1s; fast={s0.metrics['fast_batches']} "
-            f"scan={s0.metrics['scan_batches']}; phases="
+            f"{dt0:.2f}s (target <1s; {_mix(s0)} "
+            f"resident_pods={s0.metrics.get('resident_pods', 0)} "
+            f"resident_rounds={s0.metrics.get('resident_rounds', 0)}; phases="
             + ",".join(f"{k}={v:.2f}" for k, v in sorted(phases.items()))
             + ")",
             file=sys.stderr,

@@ -42,6 +42,18 @@ try:
 except Exception:  # backend pinned by the embedding process — leave it be
     pass
 
+# Shape-stable counter-based PRNG: the seeded tie-break contract is that
+# ``random.bits(fold_in(key, attempt), (n,))[i]`` depends only on
+# (key, attempt, i) — the device pipeline draws over the PADDED node bucket
+# (n_cap) while the serial oracle draws over the real node count, and the
+# two must agree on the shared prefix.  The legacy threefry lowering blocks
+# counters by total shape, so the prefix differs between the two widths on
+# boxes where jax defaults partitionable=False — pin it explicitly.
+try:
+    _jax.config.update("jax_threefry_partitionable", True)
+except Exception:
+    pass
+
 # Persistent compilation cache (OPT-IN): the gang/chain pipelines compile
 # in 20-50s per (shape, static-args) variant; caching executables on disk
 # lets later processes reuse them (measured 75s -> 18s on a mixed drain).

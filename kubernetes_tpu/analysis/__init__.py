@@ -67,6 +67,7 @@ JIT_MODULES = (
     os.path.join("ops", "gang.py"),
     os.path.join("ops", "pipeline.py"),
     os.path.join("ops", "preemption.py"),
+    os.path.join("ops", "resident.py"),
     os.path.join("ops", "scores.py"),
     os.path.join("ops", "wave.py"),
     os.path.join("ops", "wire.py"),

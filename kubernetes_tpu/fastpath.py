@@ -163,6 +163,22 @@ def spec_key(pod: Pod):
         return None
 
 
+_SK_MISSING = object()
+
+
+def spec_key_memo(pod: Pod):
+    """spec_key memoized on the pod object: the tuple build itself costs
+    ~µs and the hot paths ask several times per pod.  Safe because spec
+    updates arrive as NEW Pod objects (the compute_requests memo
+    contract), so the memo can never go stale."""
+    d = pod.__dict__
+    sk = d.get("_speckey_memo", _SK_MISSING)
+    if sk is _SK_MISSING:
+        sk = spec_key(pod)
+        d["_speckey_memo"] = sk
+    return sk
+
+
 def signature_key(pod: Pod, lanes: ResourceLanes, n_lanes: int):
     """Hashable identity of everything that affects a pod's row in the
     resource-only pipeline; None when the pod is not fast-path eligible
@@ -351,60 +367,182 @@ class FastCommitter:
 
     def run(self, pod_sigs: Sequence[Signature]) -> List[int]:
         """pod_sigs[i] is pod i's signature (shared objects).  Returns the
-        chosen node index per pod (-1 unschedulable), in batch order."""
+        chosen node index per pod (-1 unschedulable), in batch order.
+
+        The argmax pop-revalidation and the post-commit push-update walk
+        inline feasible_int/score_int with hoisted locals — this loop is
+        the resident drain's host-side tail engine, so per-visit work is
+        a handful of integer ops instead of bound-method calls (the
+        formulas are byte-for-byte the same; the shadow/property tests
+        pin the equivalence)."""
         for sig in pod_sigs:
             sig.remaining += 1
         active = {id(s): s for s in pod_sigs}
+        act_list = list(active.values())
+        committed_any = False  # drives the end-of-run stale-heap eviction
         choices: List[int] = []
         heaps = self._heaps
+        known_map = self._known
+        alloc0 = self.alloc0
+        alloc1 = self.alloc1
+        alloc_rows = self.alloc_rows
+        used_rows = self.used_rows
+        nz0l = self.nz0
+        nz1l = self.nz1
+        num_pods = self.num_pods
+        allowed = self.allowed
+        rn = self.rn
+        check_fit = self.check_fit
+        w_fit = self.w_fit
+        w_bal = self.w_bal
+        w_img = self.w_img
+        touched_add = self.touched.add
+        heappop = heapq.heappop
+        heapreplace = heapq.heapreplace
+        heappush = heapq.heappush
         for sig in pod_sigs:
-            heap = heaps.get(id(sig))
+            sid = id(sig)
+            heap = heaps.get(sid)
             if heap is None:
-                heap = heaps[id(sig)] = self._build_heap(sig)
-            known = self._known[id(sig)]
+                heap = heaps[sid] = self._build_heap(sig)
+            known = known_map[sid]
             choice = -1
+            s_nz0 = sig.nz0
+            s_nz1 = sig.nz1
+            s_req = sig.req_row
+            s_az = sig.all_zero
             while heap:
                 negsc, n = heap[0]
-                if not self.feasible_int(n, sig):
-                    heapq.heappop(heap)  # monotone: never feasible again
-                    continue
-                cur = -self.score_int(n, sig)
-                known[n] = -cur
-                if cur == negsc:
+                # ---- feasible_int, inlined ----
+                if check_fit:
+                    if num_pods[n] + 1 > allowed[n]:
+                        heappop(heap)  # monotone: never feasible again
+                        continue
+                    if not s_az:
+                        used = used_rows[n]
+                        alloc = alloc_rows[n]
+                        bad = False
+                        for r, v in enumerate(s_req):
+                            if r >= N_FIXED_LANES and v == 0:
+                                continue
+                            avail = (alloc[r] - used[r]) if r < rn else 0
+                            if v > avail:
+                                bad = True
+                                break
+                        if bad:
+                            heappop(heap)
+                            continue
+                # ---- revalidate: _known IS the current score (the
+                # push-update walk below maintains it for every feasible
+                # node under every seen signature after every commit) ----
+                total = known[n]
+                if -total == negsc:
                     choice = n
                     break
-                heapq.heapreplace(heap, (cur, n))  # stale → re-rank
+                heapreplace(heap, (-total, n))  # stale → re-rank
             sig.remaining -= 1
             choices.append(choice)
             if choice < 0:
                 continue
-            # commit: one node touched
+            # ---- commit: one node touched; hoist its state once ----
             n = choice
-            used = self.used_rows[n]
-            rn = self.rn
-            for r, v in enumerate(sig.req_row):
+            used = used_rows[n]
+            for r, v in enumerate(s_req):
                 if r < rn:
                     used[r] += v
-            self.nz0[n] += sig.nz0
-            self.nz1[n] += sig.nz1
-            self.num_pods[n] += 1
-            self.touched.add(n)
+            nz0l[n] += s_nz0
+            nz1l[n] += s_nz1
+            num_pods[n] += 1
+            touched_add(n)
+            committed_any = True
             # Invariant: heap keys never stale-LOW.  Score decreases are
             # healed by pop-time revalidation; only INCREASES need a fresh
             # push (and only into still-active heaps).
-            for other in active.values():
-                oheap = heaps.get(id(other))
-                if (
-                    other.remaining <= 0
-                    or oheap is None
-                    or not other.static_ok[n]
-                ):
+            a0 = alloc0[n]
+            a1 = alloc1[n]
+            h0 = a0 > 0
+            h1 = a1 > 0
+            nzn0 = nz0l[n]
+            nzn1 = nz1l[n]
+            u0 = used[LANE_CPU]
+            u1 = used[LANE_MEM]
+            den = a0 * a1
+            fit_w = (1 if h0 else 0) + (1 if h1 else 0)
+            # usage is monotone within a lineage, so a node that no
+            # longer fits a signature never fits it again — its heap
+            # entries drain via pop-and-drop and no fresh push (or known
+            # update) is ever needed.  One pod-count compare skips the
+            # whole walk on full nodes (the drain-tail regime).
+            node_open = not check_fit or num_pods[n] < allowed[n]
+            alloc = alloc_rows[n]
+            for other in act_list:
+                oid = id(other)
+                oheap = heaps.get(oid)
+                # NOTE: no remaining-count skip — _known must stay current
+                # for every RETAINED heap through the whole run or the
+                # read-based revalidation would rank with stale scores
+                # (heaps of signatures absent from this run are evicted
+                # below, so every retained heap is walked here).
+                # Signatures with no heap yet rebuild _known from scratch
+                # on first use (_build_heap), so skipping them is safe.
+                if oheap is None or not other.static_ok[n]:
                     continue
-                oknown = self._known[id(other)]
-                new = self.score_int(n, other)
-                if new > oknown[n]:
-                    heapq.heappush(oheap, (-new, n))
-                oknown[n] = new
+                if check_fit:
+                    if not node_open:
+                        continue
+                    if not other.all_zero:
+                        bad = False
+                        for r, v in enumerate(other.req_row):
+                            if r >= N_FIXED_LANES and v == 0:
+                                continue
+                            avail = (alloc[r] - used[r]) if r < rn else 0
+                            if v > avail:
+                                bad = True
+                                break
+                        if bad:
+                            continue
+                total = 0
+                if w_fit:
+                    s = 0
+                    if h0:
+                        nzc = nzn0 + other.nz0
+                        s += 0 if nzc > a0 else (a0 - nzc) * MAX // a0
+                    if h1:
+                        nzc = nzn1 + other.nz1
+                        s += 0 if nzc > a1 else (a1 - nzc) * MAX // a1
+                    total += w_fit * (s // fit_w if fit_w else 0)
+                if w_bal:
+                    if h0 and h1:
+                        oreq = other.req_row
+                        r0 = u0 + oreq[LANE_CPU]
+                        r1 = u1 + oreq[LANE_MEM]
+                        if r0 > a0:
+                            r0 = a0
+                        if r1 > a1:
+                            r1 = a1
+                        d = r0 * a1 - r1 * a0
+                        if d < 0:
+                            d = -d
+                        total += w_bal * (MAX - (50 * d + den - 1) // den)
+                    else:
+                        total += w_bal * MAX
+                if w_img and other.img is not None:
+                    total += w_img * other.img[n]
+                oknown = known_map[oid]
+                if total > oknown[n]:
+                    heappush(oheap, (-total, n))
+                oknown[n] = total
+        # Evict heaps of signatures NOT in this run: they were not walked,
+        # so their _known went stale the moment anything committed — a
+        # later run must rebuild them from current state (_build_heap).
+        # This also bounds heap/known memory by the live signature mix
+        # instead of every signature the committer ever saw.  Retained
+        # heaps (this run's) were walked on every commit, so the
+        # read-based revalidation contract holds at the next run's start.
+        if committed_any:
+            for sid in [s for s in heaps if s not in active]:
+                del heaps[sid]
+                known_map.pop(sid, None)
         return choices
 
     # ----- failure diagnosis (per signature, lazy) --------------------------

@@ -159,6 +159,26 @@ class SchedulerConfiguration:
     # scan's per-step peer contractions (ops/wave.py; bit-identical to the
     # serial order).  Off = every such batch takes the gang scan.
     wave_dispatch: bool = True
+    # TPU extension: device-resident drain loop (ops/resident.py) for
+    # signature-gated runs — usage state stays in HBM across runs via
+    # donated buffers and whole runs place through a multi-round
+    # speculation/admission fixed point, one d2h readback of packed
+    # placements per run (bit-identical to the serial greedy; see
+    # RESIDENT.md).  Off = large fast batches take the sig_scan kernel.
+    resident_drain: bool = True
+    # resident RUN width: fast batches extend up to this many pods when
+    # the resident path is engaged (supersedes fast_batch_max there) —
+    # bigger runs amortize the per-run host round trip.
+    resident_run_max: int = 16384
+    # speculation window per fixed-point round (clamped to the node
+    # bucket): bounds the agreement prefix one round can admit.
+    resident_window: int = 2048
+    # finish unresolved run tails IN-KERNEL with the serial sig_scan
+    # replay (fully device-resident; right when serial device steps are
+    # cheap — accelerator backends).  Off = tails come back UNRESOLVED
+    # and the host committer finishes them (right when host heaps beat
+    # serial device steps — CPU backends).
+    resident_serial_tail: bool = False
     # Bit-compat knobs (SURVEY §7 "decision-identical tie-breaking"):
     # full-width evaluation is the TPU-native default; these opt into the
     # reference's sampling + randomized-tie semantics.
@@ -448,6 +468,10 @@ def load_config(source) -> SchedulerConfiguration:
         fast_batch_max=d.get("fastBatchMax", 4096),
         fast_device_min=d.get("fastDeviceMin", 1024),
         wave_dispatch=d.get("waveDispatch", True),
+        resident_drain=d.get("residentDrain", True),
+        resident_run_max=d.get("residentRunMax", 16384),
+        resident_window=d.get("residentWindow", 2048),
+        resident_serial_tail=d.get("residentSerialTail", False),
         reference_sampling_compat=d.get("referenceSamplingCompat", False),
         tie_break_seed=d.get("tieBreakSeed"),
     )
@@ -502,6 +526,10 @@ def dump_config(cfg: SchedulerConfiguration) -> dict:
         "fastBatchMax": cfg.fast_batch_max,
         "fastDeviceMin": cfg.fast_device_min,
         "waveDispatch": cfg.wave_dispatch,
+        "residentDrain": cfg.resident_drain,
+        "residentRunMax": cfg.resident_run_max,
+        "residentWindow": cfg.resident_window,
+        "residentSerialTail": cfg.resident_serial_tail,
         "referenceSamplingCompat": cfg.reference_sampling_compat,
         "tieBreakSeed": cfg.tie_break_seed,
         "featureGates": dict(cfg.feature_gates),

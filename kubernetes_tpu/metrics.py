@@ -56,6 +56,8 @@ class Metric:
         raise NotImplementedError
 
     def _key(self, labels: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
+        if not self.label_names:  # hot unlabeled counters skip the genexpr
+            return ()
         return tuple((k, str(labels.get(k, ""))) for k in self.label_names)
 
 
@@ -537,6 +539,28 @@ class SchedulerMetrics:
                 ("kind",),
             )
         )
+        self.resident_rounds = r.register(
+            Counter(
+                "scheduler_tpu_resident_rounds_total",
+                "Speculation/admission rounds run by the device-resident "
+                "drain loop (ops/resident.py) across all runs.",
+            )
+        )
+        self.host_roundtrips = r.register(
+            Counter(
+                "scheduler_tpu_host_roundtrips_total",
+                "Blocking device→host result fetches across all paths "
+                "(dispatch harvests plus static-eval / preemption-narrow / "
+                "diagnosis reads) — the traffic the resident drain "
+                "amortizes.",
+            )
+        )
+        self.d2h_bytes = r.register(
+            Counter(
+                "scheduler_tpu_d2h_bytes_total",
+                "Bytes copied device→host by blocking result fetches.",
+            )
+        )
         self.snapshot_pack_duration = r.register(
             Histogram(
                 "scheduler_tpu_snapshot_pack_duration_seconds",
@@ -547,8 +571,8 @@ class SchedulerMetrics:
         self.phase_duration = r.register(
             Histogram(
                 "scheduler_tpu_phase_duration_seconds",
-                "Per-batch hot-loop time by phase "
-                "(queue_pop/pack/h2d/device/d2h/wave_resolve/commit/bind).",
+                "Per-batch hot-loop time by phase (queue_pop/pack/h2d/"
+                "device/d2h/wave_resolve/resident_rounds/commit/bind).",
                 ("phase",),
             )
         )

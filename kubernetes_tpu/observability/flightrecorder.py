@@ -64,6 +64,28 @@ class FlightRecorder:
                 self._fr_evicted += 1
             self._ring.append((self._fr_seq, now, uid, kind, detail))
 
+    def record_many(self, events) -> None:
+        """Bulk-path record: one clock read + one lock acquisition for a
+        whole run of ``(uid, kind, detail)`` events — the per-event cost
+        of the hot bulk paths (pop/assume/bind runs) is a deque append.
+        Events share one timestamp; sequence numbers stay per-event."""
+        if not self.enabled:
+            return
+        now = self._clock()
+        ring = self._ring
+        cap = self.capacity
+        with self._mu:
+            seq = self._fr_seq
+            evicted = self._fr_evicted
+            for uid, kind, detail in events:
+                seq += 1
+                if len(ring) >= cap:
+                    ring.popleft()
+                    evicted += 1
+                ring.append((seq, now, uid, kind, detail))
+            self._fr_seq = seq
+            self._fr_evicted = evicted
+
     # -- queries -------------------------------------------------------------
 
     def events_for(self, uid: str) -> List[dict]:

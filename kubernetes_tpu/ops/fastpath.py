@@ -94,46 +94,30 @@ def static_eval(dc, db, enabled: frozenset, has_images: bool):
 
 # ---------------------------------------------------------------------------
 # Device half of the COMMIT loop: the sequential-equivalent greedy as a
-# lax.scan over signature ids.
+# lax.scan over signature ids.  The step builder is module-level so the
+# resident drain loop (ops/resident.py) replays the EXACT same verdict
+# code for its serial-fallback tail — one implementation, two kernels.
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("w_fit", "w_bal", "w_img", "check_fit"),
-    donate_argnames=("used", "nz0", "nz1", "num_pods"),
-)
-def sig_scan(
-    sig_ids,  # i32 [P]   per-pod signature id, -1 pads
-    sig_req,  # i64 [S, R] request row per signature
-    sig_nz,  # i64 [S, 2]  non-zero-defaulted cpu,mem per signature
-    sig_allzero,  # bool [S] request row entirely zero (fit check skipped)
-    sig_ok,  # bool [S, N] statics-feasible (node_valid & name & unsched
-    #                      & taints & node-affinity), from static_eval
-    sig_img,  # i64 [S, N] ImageLocality contribution (zeros when unused)
-    alloc,  # i64 [N, R]
-    allowed,  # i32 [N]
-    used,  # i64 [N, R]   — donated, evolves across batches
-    nz0,  # i64 [N]       — donated
-    nz1,  # i64 [N]       — donated
-    num_pods,  # i32 [N]  — donated
+def make_sig_step(
+    sig_req,
+    sig_nz,
+    sig_allzero,
+    sig_ok,
+    sig_img,
+    alloc,
+    allowed,
     w_fit: int,
     w_bal: int,
     w_img: int,
     check_fit: bool,
 ):
-    """One device dispatch = one batch of the signature fast path.
-
-    Replays the reference's one-pod-at-a-time argmax commit
-    (schedule_one.go:65 ScheduleOne → selectHost first-max) as a lax.scan
-    whose carried state is the node usage tensors — the device-resident
-    analogue of kubernetes_tpu.fastpath.FastCommitter, bit-identical to it
-    (property-tested in tests/test_fastpath.py).  Per step: O(N) integer
-    score + masked argmax + one-hot commit; no [P, N] tensors exist and the
-    state never leaves HBM between batches.
-
-    Returns (choices i32 [P] — node index or -1, new_state tuple).
-    """
+    """Build the one-pod greedy step ``(carry, sig_id) -> (carry, choice)``
+    over carried node-usage state ``(used, nz0, nz1, num_pods)`` — the
+    sequential-equivalent argmax commit shared by sig_scan and the
+    resident loop's tail.  Integer score/feasibility math is bit-identical
+    to kubernetes_tpu.fastpath.FastCommitter (property-tested)."""
     R = alloc.shape[1]
     N = alloc.shape[0]
     a0 = alloc[:, LANE_CPU]
@@ -207,5 +191,57 @@ def sig_scan(
         )
         return carry, choice
 
+    return step
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("w_fit", "w_bal", "w_img", "check_fit"),
+    donate_argnames=("used", "nz0", "nz1", "num_pods"),
+)
+def sig_scan(
+    sig_ids,  # i32 [P]   per-pod signature id, -1 pads
+    sig_req,  # i64 [S, R] request row per signature
+    sig_nz,  # i64 [S, 2]  non-zero-defaulted cpu,mem per signature
+    sig_allzero,  # bool [S] request row entirely zero (fit check skipped)
+    sig_ok,  # bool [S, N] statics-feasible (node_valid & name & unsched
+    #                      & taints & node-affinity), from static_eval
+    sig_img,  # i64 [S, N] ImageLocality contribution (zeros when unused)
+    alloc,  # i64 [N, R]
+    allowed,  # i32 [N]
+    used,  # i64 [N, R]   — donated, evolves across batches
+    nz0,  # i64 [N]       — donated
+    nz1,  # i64 [N]       — donated
+    num_pods,  # i32 [N]  — donated
+    w_fit: int,
+    w_bal: int,
+    w_img: int,
+    check_fit: bool,
+):
+    """One device dispatch = one batch of the signature fast path.
+
+    Replays the reference's one-pod-at-a-time argmax commit
+    (schedule_one.go:65 ScheduleOne → selectHost first-max) as a lax.scan
+    whose carried state is the node usage tensors — the device-resident
+    analogue of kubernetes_tpu.fastpath.FastCommitter, bit-identical to it
+    (property-tested in tests/test_fastpath.py).  Per step: O(N) integer
+    score + masked argmax + one-hot commit; no [P, N] tensors exist and the
+    state never leaves HBM between batches.
+
+    Returns (choices i32 [P] — node index or -1, new_state tuple).
+    """
+    step = make_sig_step(
+        sig_req,
+        sig_nz,
+        sig_allzero,
+        sig_ok,
+        sig_img,
+        alloc,
+        allowed,
+        w_fit,
+        w_bal,
+        w_img,
+        check_fit,
+    )
     carry, choices = jax.lax.scan(step, (used, nz0, nz1, num_pods), sig_ids)
     return choices, carry

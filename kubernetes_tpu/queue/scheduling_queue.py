@@ -332,8 +332,9 @@ class SchedulingQueue:
             out.append(qp)
         fr = self.flight
         if fr is not None and fr.enabled:
-            for qp in out:
-                fr.record(qp.uid, "pop", {"attempt": qp.attempts})
+            fr.record_many(
+                (qp.uid, "pop", {"attempt": qp.attempts}) for qp in out
+            )
         return out
 
     def pop_batch_while(self, k, predicate) -> List[QueuedPodInfo]:
@@ -361,8 +362,9 @@ class SchedulingQueue:
             out.append(qp)
         fr = self.flight
         if fr is not None and fr.enabled:
-            for qp in out:
-                fr.record(qp.uid, "pop", {"attempt": qp.attempts})
+            fr.record_many(
+                (qp.uid, "pop", {"attempt": qp.attempts}) for qp in out
+            )
         return out
 
     def pop(self) -> Optional[QueuedPodInfo]:

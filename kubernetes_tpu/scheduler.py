@@ -458,6 +458,9 @@ class Scheduler:
             "wave_batches": 0,
             "wave_pods": 0,
             "wave_admitted": 0,
+            "resident_batches": 0,
+            "resident_pods": 0,
+            "resident_rounds": 0,
         }
 
     # ----- event handlers (eventhandlers.go:345-428) ------------------------
@@ -859,7 +862,19 @@ class Scheduler:
                     )
                 if isinstance(frec, dict):
                     pending.append(frec)
-                    flush(1 if self._rp_can_fail(fwk) else 2)
+                    if frec.get(
+                        "rstats_dev"
+                    ) is not None and not getattr(
+                        self.config, "resident_serial_tail", False
+                    ):
+                        # a resident run may finish its conflict tail on
+                        # the HOST committer, after which the chained
+                        # device state is stale — harvest immediately so
+                        # no later dispatch rides a state that a host
+                        # tail is about to overtake
+                        flush(0)
+                    else:
+                        flush(1 if self._rp_can_fail(fwk) else 2)
                     continue
                 if frec == "handled":
                     continue
@@ -932,6 +947,22 @@ class Scheduler:
                 uids=[qp.pod.uid for qp in batch[:8]],
             )
         return bid
+
+    def _d2h(self, value):
+        """Blocking device→host fetch with round-trip accounting: every
+        harvest-side ``jax.device_get`` goes through here so
+        scheduler_tpu_host_roundtrips_total / d2h_bytes_total measure the
+        quantity the resident drain exists to minimize."""
+        out = jax.device_get(value)
+        prom = self.prom
+        prom.host_roundtrips.inc()
+        nb = sum(
+            a.nbytes
+            for a in jax.tree_util.tree_leaves(out)
+            if hasattr(a, "nbytes")
+        )
+        prom.d2h_bytes.inc(nb)
+        return out
 
     def _record_batch_metrics(self, profile, group, outs, dt: float) -> None:
         """Attempt counters + latency histograms (metrics.go:86-147).  The
@@ -1289,7 +1320,7 @@ class Scheduler:
         path = "wave" if wt is not None else "scan"
         t_d2h = time.perf_counter()
         self.phases.add("device", t_d2h - t_gang)
-        both = jax.device_get(jnp.stack([chosen, n_feas]))
+        both = self._d2h(jnp.stack([chosen, n_feas]))
         self.phases.add("d2h", time.perf_counter() - t_d2h)
         chosen, n_feas = both[0], both[1]
         if sample_k is not None:
@@ -1372,7 +1403,7 @@ class Scheduler:
             idx = int(chosen[i])
             if idx < 0:
                 if counts is None:
-                    counts = jax.device_get(reason_counts)
+                    counts = self._d2h(reason_counts)
                 diag = {
                     k: int(c)
                     for k, c in zip(gang.DIAG_KERNELS, counts[i])
@@ -1606,14 +1637,21 @@ class Scheduler:
                 return False
             if max_nom is not None and p.priority <= max_nom:
                 return False
-            if hf and any(pl.maybe_relevant(p) for pl in hf):
-                return False
-            if extenders and any(e.is_interested(p) for e in extenders):
-                return False
-            if ns_plugins and any(pl.score_relevant(p) for pl in ns_plugins):
-                return False
-            if host_scores and any(pl.score_relevant(p) for pl in host_scores):
-                return False
+            # explicit loops, not any(genexpr): this predicate runs once
+            # per extended pod and the genexpr closure allocation showed
+            # up in the drain profile
+            for pl in hf:
+                if pl.maybe_relevant(p):
+                    return False
+            for e in extenders:
+                if e.is_interested(p):
+                    return False
+            for pl in ns_plugins:
+                if pl.score_relevant(p):
+                    return False
+            for pl in host_scores:
+                if pl.score_relevant(p):
+                    return False
             if probes:
                 gk = (p.namespace, tuple(sorted(p.labels.items())))
                 hit = group_hit.get(gk)
@@ -1667,7 +1705,7 @@ class Scheduler:
         cache = getattr(self, "_speckey_cache", None)
         if cache is None:
             cache = self._speckey_cache = {}
-        sk = fp.spec_key(pod)
+        sk = fp.spec_key_memo(pod)
         if sk is not None:
             k = cache.get((params, sk), _MISSING)
             if k is not _MISSING:
@@ -1948,7 +1986,7 @@ class Scheduler:
         tr = self.tracer
         t_h = tr.now() if tr.enabled else None
         t_d2h = time.perf_counter()
-        both = jax.device_get(rec["results"])
+        both = self._d2h(rec["results"])
         self.phases.add("d2h", time.perf_counter() - t_d2h)
         wstats = rec.get("wave_stats")
         self.prom.recorder.observe(
@@ -2084,7 +2122,7 @@ class Scheduler:
         from kubernetes_tpu.ops import wave as wave_ops
 
         t0 = time.perf_counter()
-        stats = np.asarray(jax.device_get(wstats_dev))
+        stats = np.asarray(self._d2h(wstats_dev))
         n = len(batch)
         spec, kinds, cterms = stats[0][:n], stats[1][:n], stats[2][:n]
         chosen_n = np.asarray(chosen)[:n]
@@ -2255,7 +2293,7 @@ class Scheduler:
             res = ops_fp.static_eval(
                 dc, db, enabled=enabled, has_images=has_images
             )
-            res = {k: np.asarray(v) for k, v in jax.device_get(res).items()}
+            res = {k: np.asarray(v) for k, v in self._d2h(res).items()}
             for k, s in order.items():
                 row = {name: res[name][s] for name in res}
                 # Normalized static scores are argmax-neutral ONLY when
@@ -2388,6 +2426,7 @@ class Scheduler:
                 "pod_sigs": pod_sigs,
                 "choices_host": choices,
                 "choices_dev": None,
+                "rstats_dev": None,
                 "rows": cache,
                 "weights": weights,
                 "check_fit": check_fit,
@@ -2411,7 +2450,10 @@ class Scheduler:
         # and extended batches all share the fast_batch_max shape (pad
         # steps are masked inner iterations, ~0.2µs each)
         need = len(batch)
-        for level in (64, 512, getattr(self.config, "fast_batch_max", 4096)):
+        levels = [64, 512, getattr(self.config, "fast_batch_max", 4096)]
+        if getattr(self.config, "resident_drain", False):
+            levels.append(self.config.resident_run_max)
+        for level in levels:
             if need <= level:
                 need = level
                 break
@@ -2442,28 +2484,64 @@ class Scheduler:
             used, nz0, nz1, num_pods = holder["dev"]
             t_dev = time.perf_counter()
             self.phases.add("h2d", t_dev - t_h2d)
-            choices_dev, holder["dev"] = ops_fp.sig_scan(
-                jnp.asarray(ids),
-                st["req"],
-                st["nz"],
-                st["az"],
-                st["ok"],
-                st["img"],
-                holder["alloc"],
-                holder["allowed"],
-                used,
-                nz0,
-                nz1,
-                num_pods,
-                w_fit=weights[4],
-                w_bal=weights[5],
-                w_img=w_img,
-                check_fit=check_fit,
-            )
+            rstats_dev = None
+            if getattr(self.config, "resident_drain", False):
+                # resident drain loop (ops/resident.py): the whole run is
+                # placed on device through the speculation/admission fixed
+                # point — same donated usage state as sig_scan, one d2h
+                # readback of packed placements per run
+                from kubernetes_tpu.ops import resident as ops_res
+
+                choices_dev, holder["dev"], rstats_dev = ops_res.resident_run(
+                    jnp.asarray(ids),
+                    st["req"],
+                    st["nz"],
+                    st["az"],
+                    st["ok"],
+                    st["img"],
+                    holder["alloc"],
+                    holder["allowed"],
+                    used,
+                    nz0,
+                    nz1,
+                    num_pods,
+                    w_fit=weights[4],
+                    w_bal=weights[5],
+                    w_img=w_img,
+                    check_fit=check_fit,
+                    window=min(
+                        self.config.resident_window,
+                        int(holder["alloc"].shape[0]),
+                    ),
+                    serial_tail=getattr(
+                        self.config, "resident_serial_tail", False
+                    ),
+                )
+            else:
+                choices_dev, holder["dev"] = ops_fp.sig_scan(
+                    jnp.asarray(ids),
+                    st["req"],
+                    st["nz"],
+                    st["az"],
+                    st["ok"],
+                    st["img"],
+                    holder["alloc"],
+                    holder["allowed"],
+                    used,
+                    nz0,
+                    nz1,
+                    num_pods,
+                    w_fit=weights[4],
+                    w_bal=weights[5],
+                    w_img=w_img,
+                    check_fit=check_fit,
+                )
             # start the device→host result copy NOW; by harvest time the
             # data is local and the blocking fetch is cheap (the same
             # latency-hiding discipline as the chained gang pipeline)
             choices_dev.copy_to_host_async()
+            if rstats_dev is not None:
+                rstats_dev.copy_to_host_async()
             holder["dev_inflight"] += 1
             self.phases.add("device", time.perf_counter() - t_dev)
         except Exception:
@@ -2493,6 +2571,7 @@ class Scheduler:
             "pod_sigs": pod_sigs,
             "choices_host": None,
             "choices_dev": choices_dev,
+            "rstats_dev": rstats_dev,
             "rows": cache,
             "weights": weights,
             "check_fit": check_fit,
@@ -2500,7 +2579,9 @@ class Scheduler:
             "t0": t0,
             "record_metrics": False,
         }
-        self._trace_dispatch("fast", t0, batch, rec)
+        self._trace_dispatch(
+            "resident" if rstats_dev is not None else "fast", t0, batch, rec
+        )
         return rec
 
     def _finish_fast(self, rec) -> List[ScheduleOutcome]:
@@ -2522,26 +2603,82 @@ class Scheduler:
         outcomes: List[ScheduleOutcome] = []
         choices = rec["choices_host"]
         if choices is None:
+            rstats_dev = rec.get("rstats_dev")
             t_d2h = time.perf_counter()
-            choices = jax.device_get(rec["choices_dev"])[: len(batch)].tolist()
+            if rstats_dev is not None:
+                fetched = self._d2h((rec["choices_dev"], rstats_dev))
+                choices_np = np.asarray(fetched[0])[: len(batch)]
+                rstats = np.asarray(fetched[1])
+            else:
+                choices_np = np.asarray(self._d2h(rec["choices_dev"]))[
+                    : len(batch)
+                ]
+                rstats = None
+            choices = choices_np.tolist()
             self.phases.add("d2h", time.perf_counter() - t_d2h)
             holder["dev_inflight"] -= 1
+            t_res = time.perf_counter()
+            if rstats is not None:
+                rounds = int(rstats[0])
+                # resident_pods counts what the fixed point RESOLVED; the
+                # host-committer tail below covers the rest
+                resolved = min(int(rstats[1]), len(batch))
+                with self._mu:  # metrics is a registered lock-guarded field
+                    self.metrics["resident_batches"] += 1
+                    self.metrics["resident_pods"] += resolved
+                    self.metrics["resident_rounds"] += rounds
+                self.prom.resident_rounds.inc(rounds)
             # advance the host committer to the post-batch state by
-            # replaying the kernel's commits (pure host arithmetic — the
-            # device state never needs to come back over the link)
+            # replaying the kernel's commits — VECTORIZED per-node
+            # aggregates (scatter-add over the choices) + one python-int
+            # update per TOUCHED node; the old per-pod loop was O(P)
+            # interpreter work and dominated resident-run harvests
             fc = holder["fc"]
             rn = fc.rn
-            for sig, idx in zip(pod_sigs, choices):
-                if idx < 0:
-                    continue
-                used = fc.used_rows[idx]
-                for r, v in enumerate(sig.req_row):
-                    if r < rn:
-                        used[r] += v
-                fc.nz0[idx] += sig.nz0
-                fc.nz1[idx] += sig.nz1
-                fc.num_pods[idx] += 1
+            sel = choices_np >= 0
+            if sel.any():
+                st_np = holder["stack"]
+                sids = np.fromiter(
+                    (s.sid for s in pod_sigs), np.int64, len(pod_sigs)
+                )[sel]
+                nodes = choices_np[sel].astype(np.int64)
+                agg = np.zeros((fc.n, rn), np.int64)
+                np.add.at(agg, nodes, st_np["req_np"][sids][:, :rn])
+                add0 = np.zeros(fc.n, np.int64)
+                np.add.at(add0, nodes, st_np["nz_np"][sids, 0])
+                add1 = np.zeros(fc.n, np.int64)
+                np.add.at(add1, nodes, st_np["nz_np"][sids, 1])
+                cnt = np.bincount(nodes, minlength=fc.n)
+                used_rows = fc.used_rows
+                nz0l, nz1l, npods = fc.nz0, fc.nz1, fc.num_pods
+                for n in np.unique(nodes).tolist():
+                    row = used_rows[n]
+                    arow = agg[n]
+                    for r in range(rn):
+                        row[r] += int(arow[r])
+                    nz0l[n] += int(add0[n])
+                    nz1l[n] += int(add1[n])
+                    npods[n] += int(cnt[n])
             holder["heaps_dirty"] = True
+            unresolved = choices_np == -2  # ops/resident.py UNRESOLVED
+            if unresolved.any():
+                # host-committer tail: the fixed point handed back its
+                # conflict tail (adaptive stop / round cap) — finish it
+                # with the exact lazy-heap greedy, which beats serial
+                # device steps on host-backed runs.  The device state
+                # copy now lags these commits, so it re-materializes
+                # from the committer at the next dispatch.
+                fc.invalidate_heaps()
+                tail_idx = np.nonzero(unresolved)[0]
+                tail_choices = fc.run([pod_sigs[i] for i in tail_idx])
+                for i, c in zip(tail_idx.tolist(), tail_choices):
+                    choices[i] = c
+                holder["heaps_dirty"] = False
+                holder["dev"] = None
+            if rstats is not None:
+                self.phases.add(
+                    "resident_rounds", time.perf_counter() - t_res
+                )
             shadow = holder.get("shadow")
             if shadow is not None:
                 host_choices = shadow.run(pod_sigs)
@@ -2561,7 +2698,7 @@ class Scheduler:
         self.prom.recorder.observe(
             self.prom.gang_dispatch_duration,
             time.perf_counter() - rec["t0"],
-            path="fast",
+            path="resident" if rec.get("rstats_dev") is not None else "fast",
         )
 
         node_names = self.mirror.nodes.names
@@ -2641,7 +2778,9 @@ class Scheduler:
             self._flush_binds()
         if t_h is not None and tr.enabled:
             tr.complete(
-                "harvest.fast",
+                "harvest.resident"
+                if rec.get("rstats_dev") is not None
+                else "harvest.fast",
                 t_h,
                 cat="batch",
                 bid=rec.get("bid"),
@@ -2743,7 +2882,15 @@ class Scheduler:
         # amortizes the device round trip over many more pods (queue order
         # — and therefore decision sequence — is unchanged; a pod with a
         # NOVEL signature stops the extension and seeds a later batch).
-        ext = getattr(self.config, "fast_batch_max", 4096) - len(batch)
+        # resident runs extend further than plain fast batches: the whole
+        # run rides ONE dispatch + ONE d2h readback, so per-run host cost
+        # amortizes over far more pods (RESIDENT.md)
+        cap = (
+            self.config.resident_run_max
+            if getattr(self.config, "resident_drain", False)
+            else getattr(self.config, "fast_batch_max", 4096)
+        )
+        ext = cap - len(batch)
         if ext > 0:
             elig = self._fast_pod_predicate(
                 fwk, batch[0].pod.scheduler_name, known_rows=rows
@@ -2883,6 +3030,9 @@ class Scheduler:
             "ok": jnp.asarray(ok),
             "img": jnp.asarray(img),
             "any_img": any_img,
+            # numpy twins for the harvest-side vectorized committer replay
+            "req_np": req,
+            "nz_np": nz,
         }
 
     def _schedule_one_nominated(self, fwk, qp) -> List[ScheduleOutcome]:
@@ -3297,7 +3447,7 @@ class Scheduler:
                     else fwk.device_enabled(),
                     has_images=False,
                 )
-                candidates = np.asarray(jax.device_get(res["mask"]))
+                candidates = np.asarray(self._d2h(res["mask"]))
             except Exception:  # noqa: BLE001 — narrowing is best-effort
                 candidates = None
         diags: List[Dict[str, int]] = [dict() for _ in pods]
@@ -3498,7 +3648,7 @@ class Scheduler:
 
                 t = wire.device_put_packed(tree)
                 masks = np.asarray(
-                    jax.device_get(
+                    self._d2h(
                         ops_preemption.narrow_candidates(
                             dc,
                             DeviceBatch.from_host(pb),
@@ -3802,7 +3952,7 @@ class Scheduler:
             d = pod.__dict__
             if "_nzreq_memo" in d:
                 continue
-            sk = fp.spec_key(pod)
+            sk = fp.spec_key_memo(pod)
             rep = req_by_spec.get(sk) if sk is not None else None
             if rep is None:
                 rep = (pod.compute_requests(), pod.non_zero_requests())
@@ -3829,6 +3979,7 @@ class Scheduler:
             view_live = self._oracle_cache is not None
             fr = self.flight
             fr_on = fr.enabled
+            fr_events = [] if fr_on else None
             for qp, nn, nf, res in zip(run, names, feas, results):
                 if isinstance(res, str):
                     # protocol violation (double assume — the multi-
@@ -3843,7 +3994,7 @@ class Scheduler:
                 if view_live:
                     self._view_pod_added(res)
                 if fr_on:
-                    fr.record(qp.pod.uid, "assumed", {"node": nn})
+                    fr_events.append((qp.pod.uid, "assumed", {"node": nn}))
                 outcome = ScheduleOutcome(
                     qp.pod,
                     nn,
@@ -3854,6 +4005,8 @@ class Scheduler:
                 )
                 outcomes.append(outcome)
                 items.append((qp, nn, outcome))
+        if fr_events:
+            fr.record_many(fr_events)
         if items:
             self._bulk_bind_buffer.append(_BulkBindTask(fwk, state, items))
 
@@ -3966,8 +4119,10 @@ class Scheduler:
                 self.metrics["scheduled"] += len(ok_items)
             fr = self.flight
             if fr.enabled:
-                for qp, nn, _ in ok_items:
-                    fr.record(qp.pod.uid, "bound", {"node": nn})
+                fr.record_many(
+                    (qp.pod.uid, "bound", {"node": nn})
+                    for qp, nn, _ in ok_items
+                )
             if fwk.has_post_bind():
                 for qp, nn, _ in ok_items:
                     fwk.run_post_bind(state, qp.pod, nn)
@@ -4054,8 +4209,10 @@ class Scheduler:
             self.metrics["scheduled"] += len(lean_ok)
         fr = self.flight
         if fr.enabled:
-            for t in lean_ok:
-                fr.record(t.qp.pod.uid, "bound", {"node": t.node_name})
+            fr.record_many(
+                (t.qp.pod.uid, "bound", {"node": t.node_name})
+                for t in lean_ok
+            )
         for t in lean_ok:
             pod = t.qp.pod
             t.fwk.run_post_bind(t.state, pod, t.node_name)

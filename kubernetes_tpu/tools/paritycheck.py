@@ -294,6 +294,50 @@ def check_wave_vs_oracle(n_nodes=500, n_pods=2000) -> dict:
     }
 
 
+def check_resident_vs_oracle(n_nodes=1000, n_pods=5000) -> dict:
+    """Resident drain loop (ops/resident.py speculation/admission fixed
+    point + tail engine) vs the serial oracle AND vs the residentDrain:false
+    drain (sig_scan/host-greedy machinery) — the resident path's
+    bit-identity evidence at bench scale, kill switch included."""
+    import copy
+
+    from kubernetes_tpu.oracle.pipeline import schedule_one
+    from kubernetes_tpu.oracle.state import OracleState
+
+    nodes = _basic_nodes(n_nodes)
+    pods = _basic_pods(n_pods, seed=31)
+    t0 = time.perf_counter()
+    got, sched = _drain(nodes, copy.deepcopy(pods), return_sched=True)
+    resident_batches = sched.metrics["resident_batches"]
+    off = _drain(nodes, copy.deepcopy(pods), resident_drain=False)
+
+    state = OracleState.build(nodes)
+    want: Dict[str, Optional[str]] = {}
+    for pod in copy.deepcopy(pods):
+        r = schedule_one(pod, state)
+        want[pod.name] = r.node
+        if r.node is not None:
+            pod.node_name = r.node
+            state.place(pod)
+    diffs = _diff(got, want) + _diff(got, off)
+    n_diffs = len(diffs)
+    if resident_batches == 0:
+        # the check certifies the RESIDENT path; a silent fallback would
+        # make its zero-diff claim vacuous — fail loud
+        n_diffs += 1
+        diffs = [("__resident_batches__", 0, ">=1")] + diffs
+    return {
+        "nodes": n_nodes,
+        "pods": n_pods,
+        "resident_batches": resident_batches,
+        "bound_resident": sum(1 for v in got.values() if v),
+        "bound_oracle": sum(1 for v in want.values() if v),
+        "diffs": n_diffs,
+        "first_diffs": diffs[:5],
+        "wall_s": round(time.perf_counter() - t0, 1),
+    }
+
+
 def run_checks(ns_nodes=10000, ns_pods=50000) -> dict:
     checks = {
         "cross_batch_devfast_vs_hostgreedy": check_cross_batch(
@@ -301,6 +345,7 @@ def run_checks(ns_nodes=10000, ns_pods=50000) -> dict:
         ),
         "sampling_compat_vs_serial_oracle": check_compat_vs_oracle(),
         "wave_dispatch_vs_serial_oracle": check_wave_vs_oracle(),
+        "resident_drain_vs_serial_oracle": check_resident_vs_oracle(),
     }
     return {
         "checks": checks,

@@ -1,0 +1,243 @@
+"""Device-resident drain loop (ops/resident.py): bit-identity + routing.
+
+The resident run must place pods EXACTLY like the pre-existing engines —
+the sig_scan kernel, the host FastCommitter greedy, and the serial oracle
+— because all of them replay the same one-pod-at-a-time argmax.  The
+property tests here drive all three through randomized workloads under
+KTPU_SANITIZE=1 and require identical placements, including:
+
+* the speculation/admission fixed point's agreement-prefix commits,
+* the serial tail (in-kernel sig_scan replay) and the host-committer
+  tail finish (residentSerialTail off), which must agree with each other,
+* unschedulable tails (cluster full — "dead signature" admission),
+* heterogeneous nodes (cross-signature preference divergence, the case
+  that collapses agreement prefixes and exercises the adaptive stop),
+* the residentDrain:false kill switch (identical decisions, zero
+  resident batches).
+"""
+
+import os
+import random
+
+import numpy as np
+import pytest
+
+os.environ.setdefault("KTPU_SANITIZE", "1")
+
+from kubernetes_tpu.api.resource import Resource
+from kubernetes_tpu.api.types import Container, Node, Pod
+from kubernetes_tpu.framework import config as cfg
+from kubernetes_tpu.scheduler import Scheduler
+
+
+def _nodes(n, hetero=False):
+    out = []
+    for i in range(n):
+        if hetero:
+            cpu = ["4", "8", "16"][i % 3]
+            mem = ["16Gi", "32Gi", "8Gi"][i % 3]
+        else:
+            cpu, mem = "8", "32Gi"
+        out.append(
+            Node(
+                name=f"node-{i}",
+                labels={"kubernetes.io/hostname": f"node-{i}"},
+                capacity=Resource.from_map(
+                    {"cpu": cpu, "memory": mem, "pods": 32}
+                ),
+            )
+        )
+    return out
+
+
+def _pods(n, seed, n_sigs=6):
+    rng = random.Random(seed)
+    cpus = [100, 250, 500, 750][: max(2, n_sigs // 2)]
+    mems = [128, 256, 512][: max(2, n_sigs // 2)]
+    return [
+        Pod(
+            name=f"p-{i}",
+            labels={"app": f"a{i % 8}"},
+            containers=[
+                Container(
+                    name="c",
+                    requests={
+                        "cpu": f"{rng.choice(cpus)}m",
+                        "memory": f"{rng.choice(mems)}Mi",
+                    },
+                )
+            ],
+        )
+        for i in range(n)
+    ]
+
+
+def _drain(nodes, pods, **over):
+    conf = cfg.SchedulerConfiguration(
+        batch_size=64,
+        fast_device_min=32,  # force the device path at test scale
+        resident_run_max=512,
+        resident_window=64,
+        **over,
+    )
+    s = Scheduler(configuration=conf)
+    # the shadow committer replays every harvested batch on the host
+    # greedy and asserts bit-identity INSIDE the drain
+    s.fast_shadow_check = True
+    s.binding_sink = lambda pod, node: None
+    for n in nodes:
+        s.on_node_add(n)
+    for p in pods:
+        s.on_pod_add(p)
+    outs = s.schedule_pending()
+    return {o.pod.name: o.node for o in outs}, s
+
+
+def _serial_oracle(nodes, pods):
+    from kubernetes_tpu.oracle.pipeline import schedule_one
+    from kubernetes_tpu.oracle.state import OracleState
+
+    state = OracleState.build(nodes)
+    want = {}
+    for pod in pods:
+        r = schedule_one(pod, state)
+        want[pod.name] = r.node
+        if r.node is not None:
+            pod.node_name = r.node
+            state.place(pod)
+    return want
+
+
+@pytest.mark.parametrize("seed,hetero", [(1, False), (2, True), (3, False)])
+def test_resident_matches_off_and_oracle(seed, hetero):
+    import copy
+
+    nodes = _nodes(40, hetero=hetero)
+    pods = _pods(400, seed)
+    got_on, s_on = _drain(nodes, copy.deepcopy(pods))
+    got_off, s_off = _drain(
+        nodes, copy.deepcopy(pods), resident_drain=False
+    )
+    assert s_on.metrics["resident_batches"] >= 1
+    assert s_off.metrics["resident_batches"] == 0  # kill-switch identity
+    assert got_on == got_off, {
+        k: (got_on[k], got_off.get(k))
+        for k in got_on
+        if got_on[k] != got_off.get(k)
+    }
+    want = _serial_oracle(nodes, copy.deepcopy(pods))
+    assert got_on == want, {
+        k: (got_on[k], want.get(k)) for k in got_on if got_on[k] != want.get(k)
+    }
+
+
+def test_serial_tail_mode_identical():
+    """residentSerialTail (fully device-resident) and the host-committer
+    tail finish must produce the same placements."""
+    import copy
+
+    nodes = _nodes(24)
+    pods = _pods(300, 7)
+    got_host, _ = _drain(nodes, copy.deepcopy(pods))
+    got_dev, s_dev = _drain(
+        nodes, copy.deepcopy(pods), resident_serial_tail=True
+    )
+    assert s_dev.metrics["resident_batches"] >= 1
+    assert got_host == got_dev
+
+
+def test_unschedulable_tail_dead_signatures():
+    """Overfilled cluster: the drain's tail is all-unschedulable — the
+    fixed point must admit dead-signature pods as unschedulable without
+    consuming walk positions, bit-identically to the oracle."""
+    import copy
+
+    nodes = _nodes(6)
+    pods = _pods(600, 11)  # way beyond capacity
+    got, s = _drain(nodes, copy.deepcopy(pods))
+    want = _serial_oracle(nodes, copy.deepcopy(pods))
+    assert got == want
+    assert any(v is None for v in got.values())  # tail actually overflowed
+    assert s.metrics["resident_batches"] >= 1
+
+
+def test_resident_kernel_equals_sig_scan():
+    """Kernel-level: resident_run (both tail modes) == sig_scan on random
+    signature feeds, including the carried state."""
+    import jax.numpy as jnp
+
+    from kubernetes_tpu.ops import fastpath as ops_fp
+    from kubernetes_tpu.ops import resident as ops_res
+
+    rng = np.random.default_rng(5)
+    N, R, S = 32, 2, 4
+    sig_req = rng.integers(0, 800, (S, R)).astype(np.int64)
+    sig_nz = np.maximum(sig_req, 100)
+    alloc = np.zeros((N, R), np.int64)
+    alloc[:, 0] = rng.choice([4000, 8000], N)
+    alloc[:, 1] = rng.choice([16384, 32768], N)
+    allowed = np.full((N,), 12, np.int32)
+    sig_az = np.zeros((S,), bool)
+    sig_ok = rng.random((S, N)) > 0.1
+    sig_img = np.zeros((S, N), np.int64)
+    args = (
+        jnp.asarray(sig_req),
+        jnp.asarray(sig_nz),
+        jnp.asarray(sig_az),
+        jnp.asarray(sig_ok),
+        jnp.asarray(sig_img),
+        jnp.asarray(alloc),
+        jnp.asarray(allowed),
+    )
+
+    def fresh():
+        return (
+            jnp.zeros((N, R), jnp.int64),
+            jnp.zeros((N,), jnp.int64),
+            jnp.zeros((N,), jnp.int64),
+            jnp.zeros((N,), jnp.int32),
+        )
+
+    kw = dict(w_fit=1, w_bal=1, w_img=0, check_fit=True)
+    for trial in range(8):
+        P = int(rng.integers(4, 80))
+        ids = rng.integers(-1, S, P).astype(np.int32)
+        ids = np.sort(ids)[::-1].copy()  # pads (-1) must be a suffix
+        c_scan, st_scan = ops_fp.sig_scan(jnp.asarray(ids), *args, *fresh(), **kw)
+        c_res, st_res, stats = ops_res.resident_run(
+            jnp.asarray(ids), *args, *fresh(), **kw, window=16,
+            serial_tail=True,
+        )
+        live = ids >= 0
+        assert (
+            np.asarray(c_res)[live] == np.asarray(c_scan)[live]
+        ).all(), trial
+        for a, b in zip(st_res, st_scan):
+            assert (np.asarray(a) == np.asarray(b)).all()
+        # host-tail mode: unresolved stay -2, resolved prefix matches, and
+        # the returned state covers exactly the resolved prefix
+        c_part, st_part, stats2 = ops_res.resident_run(
+            jnp.asarray(ids), *args, *fresh(), **kw, window=16,
+            serial_tail=False,
+        )
+        c_part = np.asarray(c_part)
+        resolved = int(np.asarray(stats2)[1])
+        assert (c_part[:resolved][live[:resolved]] ==
+                np.asarray(c_scan)[:resolved][live[:resolved]]).all()
+        assert (c_part[resolved:][live[resolved:]] == ops_res.UNRESOLVED).all()
+
+
+def test_metrics_and_phases_present():
+    nodes = _nodes(16)
+    pods = _pods(200, 13)
+    got, s = _drain(nodes, pods)
+    assert s.metrics["resident_batches"] >= 1
+    assert s.metrics["resident_rounds"] >= 1
+    # host-roundtrip + d2h accounting ticked on the harvests
+    assert s.prom.host_roundtrips.value() >= 1
+    assert s.prom.d2h_bytes.value() > 0
+    assert s.prom.resident_rounds.value() >= 1
+    text = s.expose_metrics()
+    assert "scheduler_tpu_host_roundtrips_total" in text
+    assert "scheduler_tpu_d2h_bytes_total" in text
+    assert "scheduler_tpu_resident_rounds_total" in text
