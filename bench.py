@@ -511,10 +511,232 @@ def capture_trace(path, n_nodes=1000, n_pods=10000):
     }
 
 
+def run_arrival_harness(
+    n_nodes=500,
+    rates=(250.0, 1000.0, 4000.0),
+    duration_s=3.0,
+    dist="poisson",
+    seed=4242,
+    slo_p99_s=1.0,
+    warm_pods=2048,
+    settle_timeout_s=120.0,
+    poll_interval_s=0.002,
+    max_pods_per_rate=50_000,
+    progress=None,
+):
+    """Open-loop serving harness (--arrival): offered-load sweep.
+
+    The drain benches measure batch throughput; "millions of users" is a
+    SUSTAINED arrival stream with a latency SLO (ROADMAP item 3).  This
+    drives the real serving loop — informer-fed pods arriving at a fixed
+    offered rate (Poisson or fixed inter-arrival), the SchedulerServer's
+    own scheduling thread, async binding workers — with the steady-state
+    SLO tier installed (per-stage attribution + black-box ring live, the
+    production configuration), and reports offered-rate vs p50/p99
+    BIND latency (enqueue→bound, monotonic clock) plus the max offered
+    rate that still met the SLO.  Open-loop means arrivals do NOT wait
+    for completions: past saturation the queue grows and latency curves
+    bend up — exactly the signal a closed-loop drain hides.
+
+    Latencies are measured by the harness itself (arrival stamp → bulk
+    sink write), independent of the SLO tier under test.  Pods unbound at
+    settle are censored as +Inf samples.
+    """
+    from kubernetes_tpu.api.types import Container, Pod
+    from kubernetes_tpu.observability.slo import SLOConfig, SLOObjective
+    from kubernetes_tpu.scheduler import Scheduler
+    from kubernetes_tpu.server import SchedulerServer
+
+    def log(msg):
+        if progress:
+            progress(msg)
+
+    rng = random.Random(seed)
+    sched = Scheduler()
+    bound_at = {}
+
+    def sink_many(pairs):
+        now = time.monotonic()
+        for pod, _node in pairs:
+            bound_at[pod.uid] = now
+        return [None] * len(pairs)
+
+    sched.binding_sink = lambda pod, node: bound_at.__setitem__(
+        pod.uid, time.monotonic()
+    )
+    sched.binding_sink_many = sink_many
+    total = (
+        warm_pods
+        + sum(min(int(r * duration_s), max_pods_per_rate) for r in rates)
+        + 1024
+    )
+    sched.mirror.e_cap_hint = total + sched.config.batch_size + 128
+    for n in _basic_nodes(n_nodes):
+        sched.on_node_add(n)
+
+    counter = [0]
+
+    def mk():
+        i = counter[0]
+        counter[0] += 1
+        return Pod(
+            name=f"ar-{i}",
+            labels={"app": f"app-{i % 16}"},
+            containers=[
+                Container(
+                    name="c",
+                    requests={
+                        "cpu": f"{rng.choice([100, 250])}m",
+                        "memory": "128Mi",
+                    },
+                )
+            ],
+        )
+
+    # warm: one big drain compiles the device shapes the sweep will hit
+    # (small arrival batches ride the host greedy; backlog drains ride the
+    # device path) — compile time must not land in a latency sample
+    for _ in range(min(warm_pods, total)):
+        sched.on_pod_add(mk())
+    _drain(sched)
+    # install AFTER the warm drain: jit-compile time in the warm pods'
+    # e2e samples would trip a spurious breach before the sweep starts
+    slo = sched.install_slo(
+        SLOConfig(
+            objectives=[SLOObjective("e2e_p99", "e2e", 0.99, slo_p99_s)],
+            window_s=max(duration_s, 5.0),
+            min_samples=50,
+            eval_interval_s=0.25,
+            blackbox=True,
+            blackbox_capacity=16384,
+        )
+    )
+
+    server = SchedulerServer(sched, poll_interval_s=poll_interval_s)
+    server.start()
+    curve = []
+    try:
+        for rate in rates:
+            created = {}
+            t0 = time.monotonic()
+            t_end = t0 + duration_s
+            t_next = t0
+            while True:
+                now = time.monotonic()
+                if now >= t_end:
+                    break
+                if len(created) >= max_pods_per_rate:
+                    break  # runaway-offered-rate bound (memory, not SLO)
+                # release every arrival whose offered time has come — the
+                # open-loop discipline: a slow feeder iteration releases a
+                # burst rather than silently lowering the offered rate
+                while (
+                    t_next <= now
+                    and t_next < t_end
+                    and len(created) < max_pods_per_rate
+                ):
+                    p = mk()
+                    created[p.uid] = t_next
+                    sched.on_pod_add(p)
+                    gap = (
+                        rng.expovariate(rate)
+                        if dist == "poisson"
+                        else 1.0 / rate
+                    )
+                    t_next += gap
+                time.sleep(min(0.001, max(t_next - now, 0.0001)))
+            offered = len(created)
+            deadline = time.monotonic() + settle_timeout_s
+            # drain-out with a no-progress breakout: pods stranded
+            # UNSCHEDULABLE (capacity exhausted) would otherwise pin the
+            # settle loop to the full timeout — they're censored below
+            last_n, last_progress = -1, time.monotonic()
+            while time.monotonic() < deadline and any(
+                u not in bound_at for u in created
+            ):
+                n = len(bound_at)
+                if n != last_n:
+                    last_n, last_progress = n, time.monotonic()
+                elif time.monotonic() - last_progress > 10.0:
+                    break
+                time.sleep(0.005)
+            lats = sorted(
+                bound_at[u] - created[u] for u in created if u in bound_at
+            )
+            unbound = offered - len(lats)
+            last_bound = max(
+                (bound_at[u] for u in created if u in bound_at), default=t0
+            )
+            achieved = len(lats) / max(last_bound - t0, duration_s)
+
+            def q(p):
+                if not lats:
+                    return None
+                # censored (unbound) samples rank above every real one
+                rank = int(p * (offered - 1))
+                return lats[rank] if rank < len(lats) else None
+
+            p50, p99 = q(0.50), q(0.99)
+            ok = unbound == 0 and p99 is not None and p99 <= slo_p99_s
+            curve.append(
+                {
+                    "rate": rate,
+                    "offered": offered,
+                    "bound": len(lats),
+                    "unbound": unbound,
+                    "p50_ms": round(p50 * 1000, 2) if p50 is not None else None,
+                    "p99_ms": round(p99 * 1000, 2) if p99 is not None else None,
+                    "achieved_pods_per_s": round(achieved, 1),
+                    "met_slo": ok,
+                }
+            )
+            log(
+                f"arrival {rate:g}/s: {offered} offered, {unbound} unbound, "
+                f"p50 {curve[-1]['p50_ms']} ms, p99 {curve[-1]['p99_ms']} ms"
+                f" ({'SLO ok' if ok else 'SLO MISS'})"
+            )
+    finally:
+        server.stop()
+    max_rate = max((c["rate"] for c in curve if c["met_slo"]), default=0.0)
+    return {
+        "curve": curve,
+        "max_rate_at_slo": max_rate,
+        "slo_p99_ms": slo_p99_s * 1000,
+        "breaches": slo.snapshot()["breaches_total"],
+    }
+
+
+def _arrival_env_kwargs():
+    """BENCH_ARRIVAL_* env knobs shared by --arrival and the full bench."""
+    kw = {}
+    if "BENCH_ARRIVAL_NODES" in os.environ:
+        kw["n_nodes"] = int(os.environ["BENCH_ARRIVAL_NODES"])
+    if "BENCH_ARRIVAL_RATES" in os.environ:
+        kw["rates"] = tuple(
+            float(x) for x in os.environ["BENCH_ARRIVAL_RATES"].split(",")
+        )
+    if "BENCH_ARRIVAL_SECONDS" in os.environ:
+        kw["duration_s"] = float(os.environ["BENCH_ARRIVAL_SECONDS"])
+    if "BENCH_ARRIVAL_DIST" in os.environ:
+        kw["dist"] = os.environ["BENCH_ARRIVAL_DIST"]
+    if "BENCH_ARRIVAL_SLO_P99_S" in os.environ:
+        kw["slo_p99_s"] = float(os.environ["BENCH_ARRIVAL_SLO_P99_S"])
+    return kw
+
+
 def main():
     n_nodes = int(os.environ.get("BENCH_NODES", "5000"))
     n_pods = int(os.environ.get("BENCH_PODS", "10000"))
     full = os.environ.get("BENCH_FULL", "1") != "0"
+
+    # --arrival: standalone open-loop serving sweep (no full bench)
+    if "--arrival" in sys.argv[1:]:
+        out = run_arrival_harness(
+            progress=lambda m: print(f"# {m}", file=sys.stderr),
+            **_arrival_env_kwargs(),
+        )
+        print(json.dumps(out))
+        return
 
     # --trace-out=FILE: standalone traced-drain capture (no full bench) —
     # sizes via BENCH_TRACE_NODES/BENCH_TRACE_PODS
@@ -672,6 +894,27 @@ def main():
             f"({cs['injected_total']} faults, recovery p99 "
             f"{cs['recovery_p99_s'] * 1000:.1f} ms, "
             f"{len(cs['problems'])} oracle problems)",
+            file=sys.stderr,
+        )
+        # config9: open-loop serving tier — offered-rate vs p50/p99 bind
+        # latency through the real serving loop with the SLO tier live.
+        # Keys ride the JSON floor-less (presence-without-floor tolerance);
+        # do NOT ratchet floors or latency ceilings from a CPU-only box
+        # (BENCH_FLOORS _comment_environment_r6 discipline).
+        ar = run_arrival_harness(
+            progress=lambda m: print(f"# config9 {m}", file=sys.stderr),
+            **_arrival_env_kwargs(),
+        )
+        configs["config9_serving_curve"] = ar["curve"]
+        configs["config9_serving_max_rate_at_slo"] = ar["max_rate_at_slo"]
+        configs["config9_serving_slo_p99_ms"] = ar["slo_p99_ms"]
+        print(
+            "# config9 serving: max sustainable rate at SLO "
+            f"(p99 e2e ≤ {ar['slo_p99_ms']:g} ms) = "
+            f"{ar['max_rate_at_slo']:g} pods/s over "
+            + ", ".join(
+                f"{c['rate']:g}/s→p99 {c['p99_ms']} ms" for c in ar["curve"]
+            ),
             file=sys.stderr,
         )
 

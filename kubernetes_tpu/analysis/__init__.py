@@ -51,6 +51,9 @@ LOCK_MODULES = (
     os.path.join("observability", "tracer.py"),
     os.path.join("observability", "flightrecorder.py"),
     os.path.join("observability", "explain.py"),
+    # SLO tier: ingest runs on every flight-recorder producer thread,
+    # snapshot/evaluate on HTTP handlers and the bench harness
+    os.path.join("observability", "slo.py"),
 )
 PURITY_MODULES = (
     os.path.join("framework", "plugins.py"),

@@ -122,6 +122,58 @@ def duration_buckets() -> List[float]:
     return [0.001 * (2**i) for i in range(15)]
 
 
+# widened buckets for the serving-tier latency SLIs (0.001 → ~17.5 min):
+# at saturation the open-loop harness drives queue waits far past the
+# default 16 s ceiling, and a p99 that lands in the overflow bucket comes
+# back as +Inf (Histogram.percentile) — the SLO series use these so the
+# sentinel only fires when latency is truly off the scale
+def wide_duration_buckets() -> List[float]:
+    return [0.001 * (2**i) for i in range(21)]
+
+
+# coarse batch-size label values for the per-pod attempt-latency series:
+# one batched dispatch smears its latency uniformly over the batch, so the
+# serving analysis needs to know HOW MUCH smear a sample carries (batch=1
+# is a real per-pod latency; batch=4096+ is a drain average).  Coarse
+# powers-of-16 keep the label cardinality at 5.
+def batch_size_bucket(n: int) -> str:
+    if n <= 1:
+        return "1"
+    if n < 16:
+        return "2-15"
+    if n < 256:
+        return "16-255"
+    if n < 4096:
+        return "256-4095"
+    return "4096+"
+
+
+def bucket_quantile(bounds, counts, q: float) -> Tuple[float, int]:
+    """``(estimate, n)``: the promql histogram_quantile bucket
+    interpolation over ``counts`` aligned with ``bounds`` plus one
+    overflow slot last.  A rank landing in the overflow bucket returns
+    ``math.inf`` — an explicit sentinel, NOT the top finite bound:
+    clamping silently under-reports the quantile exactly when the series
+    saturates.  The ONE copy of this estimate — ``Histogram.percentile``
+    and the SLO evaluator's windowed quantiles both delegate here, so
+    breach decisions can never diverge from /metrics-derived values."""
+    n = int(sum(counts))
+    if n == 0:
+        return 0.0, 0
+    rank = q * n
+    cum = 0
+    for i, c in enumerate(counts):
+        cum += c
+        if cum >= rank:
+            if i >= len(bounds):
+                return math.inf, n
+            lo = bounds[i - 1] if i > 0 else 0.0
+            hi = bounds[i]
+            frac = (rank - (cum - c)) / c if c else 0.0
+            return float(lo + (hi - lo) * frac), n
+    return math.inf, n
+
+
 class Histogram(Metric):
     kind = "histogram"
 
@@ -152,6 +204,27 @@ class Histogram(Metric):
             self._sum[k] += value * n
             self._n[k] += n
 
+    def merge_counts(self, counts, sum_, n, **labels) -> None:
+        """Merge PRE-BUCKETED observations: ``counts`` aligns with
+        ``len(buckets)+1`` (overflow last).  The SLO tier's batched feed —
+        its ingest loop buckets into plain arrays off the registry lock
+        and syncs deltas here on scrape, so the hot join never pays a
+        per-observation metric-lock acquisition."""
+        if n <= 0:
+            return
+        k = self._key(labels)
+        with self._mu:
+            cur = self._counts.get(k)
+            if cur is None:
+                cur = self._counts[k] = [0] * (len(self.buckets) + 1)
+                self._sum[k] = 0.0
+                self._n[k] = 0
+            for i, c in enumerate(counts):
+                if c:
+                    cur[i] += c
+            self._sum[k] += sum_
+            self._n[k] += n
+
     def count(self, **labels) -> int:
         return self._n.get(self._key(labels), 0)
 
@@ -160,38 +233,30 @@ class Histogram(Metric):
 
     def percentile(self, q: float, **labels) -> float:
         """Bucket-interpolated quantile (the promql histogram_quantile
-        estimate) over ALL label sets when none given, else one set."""
+        estimate) over ALL label sets when none given, else one set.
+
+        A rank landing in the overflow (+Inf) bucket returns ``math.inf``
+        — an explicit sentinel, NOT the top finite bound (see
+        ``bucket_quantile``).  Callers that want a finite display value
+        clamp explicitly; latency SLIs widen their buckets
+        (``wide_duration_buckets``) instead."""
         if self.label_names and not labels:
             # aggregate across label sets (snapshot under the lock — a
             # concurrent observe can add a label set mid-iteration)
-            agg = [0] * (len(self.buckets) + 1)
+            counts = [0] * (len(self.buckets) + 1)
             with self._mu:
                 rows = [list(c) for c in self._counts.values()]
-            for counts in rows:
-                for i, c in enumerate(counts):
-                    agg[i] += c
-            counts, n = agg, sum(agg)
+            for row in rows:
+                for i, c in enumerate(row):
+                    counts[i] += c
         else:
             k = self._key(labels)
             with self._mu:
                 counts = list(
                     self._counts.get(k, [0] * (len(self.buckets) + 1))
                 )
-                n = self._n.get(k, 0)
-        if n == 0:
-            return 0.0
-        rank = q * n
-        cum = 0
-        for i, c in enumerate(counts):
-            cum += c
-            if cum >= rank:
-                if i >= len(self.buckets):
-                    return self.buckets[-1] if self.buckets else 0.0
-                lo = self.buckets[i - 1] if i > 0 else 0.0
-                hi = self.buckets[i]
-                frac = (rank - (cum - c)) / c if c else 0.0
-                return lo + (hi - lo) * frac
-        return self.buckets[-1] if self.buckets else 0.0
+        est, _ = bucket_quantile(self.buckets, counts, q)
+        return est
 
     def expose(self) -> List[str]:
         # consistent snapshot under the lock (see Counter.expose): bucket
@@ -386,8 +451,12 @@ class SchedulerMetrics:
         self.attempt_duration = r.register(
             Histogram(
                 "scheduler_scheduling_attempt_duration_seconds",
-                "Scheduling attempt latency (algorithm + binding).",
-                ("result", "profile"),
+                "Scheduling attempt latency (algorithm + binding).  The "
+                "batched dispatch amortizes one latency over the batch; "
+                "the coarse batch label (batch_size_bucket) says how much "
+                "smear a sample carries (batch=1 is a real per-pod "
+                "latency, batch=4096+ a drain average).",
+                ("result", "profile", "batch"),
             )
         )
         self.algorithm_duration = r.register(
@@ -641,6 +710,42 @@ class SchedulerMetrics:
                 "scheduler_tpu_flightrecorder_evicted_events",
                 "Pod lifecycle events evicted from the flight recorder "
                 "ring since process start (monotonic, sampled on scrape).",
+            )
+        )
+        # --- steady-state SLO tier (observability/slo.py) ---
+        self.slo_stage_duration = r.register(
+            Histogram(
+                "scheduler_tpu_slo_stage_duration_seconds",
+                "Per-pod latency attribution joined from flight-recorder "
+                "breadcrumbs by stage (queue_wait / backoff / dispatch / "
+                "commit / bind) plus the e2e SLI — monotonic-clock "
+                "durations, widened buckets.",
+                ("stage",),
+                buckets=wide_duration_buckets(),
+            )
+        )
+        self.slo_burn_rate = r.register(
+            Gauge(
+                "scheduler_tpu_slo_burn_rate",
+                "Error-budget burn rate per SLO objective over the rolling "
+                "window (1.0 = burning exactly the budget), sampled on "
+                "scrape.",
+                ("objective",),
+            )
+        )
+        self.slo_breaches = r.register(
+            Counter(
+                "scheduler_tpu_slo_breaches_total",
+                "SLO breaches that froze and dumped the black-box trace "
+                "ring, by objective.",
+                ("objective",),
+            )
+        )
+        self.trace_evicted = r.register(
+            Gauge(
+                "scheduler_tpu_trace_evicted_events",
+                "Trace events evicted from the black-box ring since it was "
+                "armed (monotonic, sampled on scrape).",
             )
         )
         self.recorder = MetricAsyncRecorder()

@@ -14,12 +14,17 @@ endpoints, rebuilt for the batched TPU hot loop (see OBSERVABILITY.md):
   * ``explain_pod`` / ``oracle_explain`` — per-node, per-plugin rejection
     reasons harvested from the filter kernels' feasibility masks
     (ops/explain.py) and validated against the serial host oracle.
+  * ``SLOEvaluator`` — the steady-state SLO tier (slo.py): streaming
+    per-stage latency attribution joined from the flight recorder's
+    breadcrumbs, objective/burn-rate evaluation over rolling windows,
+    and breach-triggered freeze+dump of the tracer's black-box ring.
 
 Served over HTTP by ``server.SchedulerServer``:
 
     /debug/trace?action=start|stop|export   (default: status)
     /debug/flightrecorder?pod=<uid|name>    (default: stats + tail)
     /debug/explain?pod=<uid|name>
+    /debug/slo?action=status|trace          (default: status)
 """
 
 from kubernetes_tpu.observability.flightrecorder import FlightRecorder
@@ -31,10 +36,18 @@ from kubernetes_tpu.observability.explain import (
     oracle_explain,
     reason_to_plugin,
 )
+from kubernetes_tpu.observability.slo import (
+    SLOConfig,
+    SLOEvaluator,
+    SLOObjective,
+)
 
 __all__ = [
     "Tracer",
     "FlightRecorder",
+    "SLOConfig",
+    "SLOEvaluator",
+    "SLOObjective",
     "explain_pod",
     "find_pod",
     "oracle_explain",

@@ -12,16 +12,26 @@ queue and the batched hot loop leaves a breadcrumb trail —
     unschedulable  filter failure with the per-plugin diagnosis counts
     nominated    PostFilter nominated a node (preemption in flight)
     requeue      parked (backoff/unschedulable) after a failure
+    bind_start   binding worker picked the pod up (sink write imminent)
     bound        binding cycle wrote the binding
     bind_failed  binding cycle failed (unwound + requeued)
 
 Querying by uid answers "where is pod X and why" without logs or replay;
 the /debug/flightrecorder endpoint serves it over HTTP.
 
+Every event is stamped with a (wall, monotonic) clock PAIR: durations
+(the SLO tier's per-stage attribution, observability/slo.py) derive from
+the monotonic stamp so a wall-clock jump — NTP step, chaos clock-skew
+scenario — can never skew a latency; the wall stamp stays for display.
+
 Cost model: one lock + one deque append per event; events are plain tuples.
 The ring is bounded (``capacity``) — overflow evicts the OLDEST event and
 counts it, so memory is fixed and recent history always wins.  ``enabled``
-gates every producer site with a plain attribute read.
+gates every producer site with a plain attribute read.  An optional
+``sink`` (the SLO evaluator's ``ingest_async``) receives ``(mono,
+events)`` after the ring append — the shared monotonic stamp plus the
+ORIGINAL ``(uid, kind, detail)`` tuples, so the hot path never rebuilds
+per-event tuples; one extra attribute check when unset.
 """
 
 from __future__ import annotations
@@ -44,25 +54,43 @@ DEFAULT_CAPACITY = 4096
 
 
 class FlightRecorder:
-    def __init__(self, capacity: int = DEFAULT_CAPACITY, clock=time.time):
+    def __init__(
+        self,
+        capacity: int = DEFAULT_CAPACITY,
+        clock=time.time,
+        mono_clock=time.monotonic,
+    ):
         self.enabled = True
         self.capacity = max(int(capacity), 1)
         self._clock = clock
+        self._mono = mono_clock
         self._mu = threading.Lock()
         self._ring: deque = deque()
         self._fr_seq = 0
         self._fr_evicted = 0
+        # optional streaming consumer
+        # (observability.slo.SLOEvaluator.ingest_async): called with
+        # (mono, [(uid, kind, detail), ...]) AFTER the ring append, so
+        # per-pod attribution joins the same breadcrumbs the ring retains
+        # without a second set of producer sites.  The sink does its own
+        # locking; per-uid causal order holds because consecutive lifecycle
+        # stages of one pod are separated by Scheduler._mu acquisitions.
+        self.sink = None
 
     def record(self, uid: str, kind: str, detail: Optional[dict] = None) -> None:
         if not self.enabled:
             return
-        now = self._clock()
+        wall = self._clock()
+        mono = self._mono()
         with self._mu:
             self._fr_seq += 1
             if len(self._ring) >= self.capacity:
                 self._ring.popleft()
                 self._fr_evicted += 1
-            self._ring.append((self._fr_seq, now, uid, kind, detail))
+            self._ring.append((self._fr_seq, wall, mono, uid, kind, detail))
+        sink = self.sink
+        if sink is not None:
+            sink(mono, ((uid, kind, detail),))
 
     def record_many(self, events) -> None:
         """Bulk-path record: one clock read + one lock acquisition for a
@@ -71,7 +99,13 @@ class FlightRecorder:
         Events share one timestamp; sequence numbers stay per-event."""
         if not self.enabled:
             return
-        now = self._clock()
+        wall = self._clock()
+        mono = self._mono()
+        sink = self.sink
+        if sink is not None:
+            events = list(events)
+            if not events:  # caller's generator yielded nothing
+                return
         ring = self._ring
         cap = self.capacity
         with self._mu:
@@ -82,16 +116,18 @@ class FlightRecorder:
                 if len(ring) >= cap:
                     ring.popleft()
                     evicted += 1
-                ring.append((seq, now, uid, kind, detail))
+                ring.append((seq, wall, mono, uid, kind, detail))
             self._fr_seq = seq
             self._fr_evicted = evicted
+        if sink is not None:
+            sink(mono, events)
 
     # -- queries -------------------------------------------------------------
 
     def events_for(self, uid: str) -> List[dict]:
         """All retained events for one pod uid, oldest first."""
         with self._mu:
-            hits = [e for e in self._ring if e[2] == uid]
+            hits = [e for e in self._ring if e[3] == uid]
         return [self._as_dict(e) for e in hits]
 
     def tail(self, n: int = 100) -> List[dict]:
@@ -111,8 +147,8 @@ class FlightRecorder:
 
     @staticmethod
     def _as_dict(e) -> dict:
-        seq, ts, uid, kind, detail = e
-        out = {"seq": seq, "ts": ts, "pod": uid, "kind": kind}
+        seq, wall, mono, uid, kind, detail = e
+        out = {"seq": seq, "ts": wall, "mono": mono, "pod": uid, "kind": kind}
         if detail:
             out["detail"] = detail
         return out

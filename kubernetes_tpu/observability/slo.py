@@ -1,0 +1,1005 @@
+"""Steady-state SLO tier: streaming latency attribution + breach handling.
+
+The always-on layer the capture-on-demand observability tier (tracer /
+flight recorder / explain) deliberately isn't: in production the question
+is *are we meeting the SLO, and which stage is burning it* — the
+`pod_scheduling_sli_duration_seconds` role the reference scheduler's
+operability story is built around, plus the per-stage decomposition the
+batched hot loop needs to attack its control-plane ceiling.
+
+Three pieces:
+
+  * **Attribution join.**  The evaluator consumes the flight recorder's
+    breadcrumbs (``FlightRecorder.sink = evaluator.ingest_async``) and
+    joins each pod's monotonic event stream into per-stage durations:
+
+        queue_wait   enqueue → first pop        (time in the activeQ)
+        backoff      requeue → re-pop           (parked after a failure)
+        dispatch     pop → assumed              (device dispatch + harvest
+                                                 + assume/reserve/permit)
+        commit       assumed → bind_start       (commit tail, bind buffer,
+                                                 worker pickup)
+        bind         bind_start → bound         (sink write + post-bind)
+        e2e          enqueue → bound            (the reference's SLI)
+
+    Durations derive ONLY from the monotonic stamps (wall time is
+    display-only).  They accumulate in plain bucket arrays and sync as
+    deltas into the registry-exposed
+    ``scheduler_tpu_slo_stage_duration_seconds{stage=}`` histogram on
+    scrape (widened buckets — the +Inf overflow sentinel of
+    ``Histogram.percentile`` instead of a silent clamp).
+
+  * **Objectives + burn rate.**  ``SLOConfig.objectives`` declare
+    quantile targets over any series (default: p99 bind ≤ 1 s, p99 e2e ≤
+    30 s).  Each objective tracks its windowed quantile estimate and its
+    error-budget burn rate (bad-fraction ÷ allowed-fraction: 1.0 = burning
+    exactly the budget, >1 = on track to exhaust it).
+
+  * **Breach → black-box dump.**  When a windowed quantile exceeds its
+    threshold (with ``min_samples``), the evaluator freezes the tracer's
+    black-box ring, exports it (optionally to ``dump_dir`` as a
+    Perfetto-loadable JSON artifact), records a breach record pointing at
+    the artifact, and re-arms the ring — the trace of the bad window
+    exists after the incident with nobody at the keyboard.  A cooldown
+    bounds dump storms.
+
+Served live at ``GET /debug/slo`` (``SchedulerServer``); installed with
+``Scheduler.install_slo``.
+
+Cost model (the ≤~2%-of-a-25k-drain budget; every line here was paid for
+by a measurement):
+
+  * producers (``ingest_async``) pay one LOCKLESS deque append per
+    flight-recorder batch — the shared mono stamp plus the recorder's
+    ORIGINAL event-tuple list.  No per-event tuples, no joining, no
+    metric locks, and (critically) no worker wakeup on the hot path: a
+    per-event ``Event.set`` is a cross-thread notify + GIL handoff that
+    measured ~15% of a 25k drain all by itself.  The worker POLLS.
+  * the join itself is VECTORIZED: per-pod open-attempt state lives in
+    numpy column arrays indexed by interned uid slots, consecutive
+    same-kind breadcrumbs (the shape the bulk paths and the enqueue feed
+    produce) coalesce into one gather → mask → ``searchsorted`` +
+    ``bincount`` pass, and only short or exotic segments take the scalar
+    loop.  A pure-python join measured ~1.3 µs/event — 0.16 s of a
+    1.75 s drain, unhideable on a host-dominated loop; the vector path
+    leaves only the per-event uid→slot dict lookup.
+  * evaluation/rotation/gc are per-drain-cycle and cadence-throttled,
+    never per event.
+
+With the tier uninstalled the producer cost is one ``sink is None`` check
+inside an already-paying flight-recorder call.  ``ingest`` (synchronous)
+joins inline through the scalar loop — the deterministic reference path
+the tests reconcile the vector path against.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import threading
+import time
+import weakref
+from bisect import bisect_left
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from kubernetes_tpu.analysis import sanitizer
+from kubernetes_tpu.metrics import (
+    Histogram,
+    bucket_quantile,
+    wide_duration_buckets,
+)
+
+# Lock-discipline registry (kubernetes_tpu.analysis): ``ingest_async`` is
+# called from every flight-recorder producer thread (scheduling loop,
+# binding workers, informer handlers) and must stay cheap — it appends to
+# a lock-free deque; the join/evaluation state under ``_mu`` is owned by
+# the worker (or a synchronous ``ingest`` caller) and read by HTTP
+# handlers via ``snapshot``.
+_KTPU_GUARDED = {
+    "SLOEvaluator": {
+        "lock": "_mu",
+        "guards": {
+            "_slo_idx": None,
+            "_slo_uids": None,
+            "_slo_st": None,
+            "_slo_free": None,
+            "_slo_alloc": None,
+            "_slo_cum": None,
+            "_win_cur": None,
+            "_win_prev": None,
+            "_slo_objs": None,
+            "_slo_rotated_at": None,
+            "_slo_last_eval": None,
+            "_slo_last_dump": None,
+            "_slo_last_gc": None,
+            "_slo_breaches": None,
+            "_slo_breaches_total": None,
+            "_slo_last_trace": None,
+            "_slo_dump_seq": None,
+            "_slo_synced": None,
+        },
+    },
+    # NOTE: _slo_buf is deliberately NOT here — it is a deque whose
+    # append/popleft are atomic under the GIL, so producers never take a
+    # lock.  _buf_mu only covers worker startup + the error counter.
+    "SLOIngestBuffer": {
+        "lock": "_buf_mu",
+        "guards": {"_slo_errors": None, "_worker": None},
+    },
+}
+
+# the joined per-pod stages, plus the end-to-end SLI
+STAGES = ("queue_wait", "backoff", "dispatch", "commit", "bind")
+SERIES = STAGES + ("e2e",)
+
+# columns of the per-slot open-attempt state matrix (NaN = unset)
+_ENQ, _POP, _REQ, _ASSUMED, _BINDSTART, _LAST = range(6)
+_NCOL = 6
+
+# breadcrumb kinds the join consumes; everything else (verdict /
+# unschedulable / nominated / bind_failed / wave_*) carries diagnosis,
+# not stage boundaries — the requeue that follows them closes the attempt
+_JOIN_KINDS = frozenset(
+    ("enqueue", "pop", "requeue", "assumed", "bind_start", "bound")
+)
+
+# segments shorter than this take the scalar loop: numpy setup costs more
+# than it saves on tiny gathers
+_VEC_MIN = 32
+
+# producers run the join inline once this many events have buffered —
+# amortized to a few ms every couple of device batches, it beats a
+# concurrent worker whose GIL contention taxes the host loop ~2× the
+# join's own CPU
+_INLINE_JOIN_EVERY = 8192
+
+
+@dataclass
+class SLOObjective:
+    """One objective: '``quantile`` of ``series`` stays ≤ ``threshold_s``'
+    — e.g. p99 bind latency ≤ 1 s.  ``series`` is any of SERIES."""
+
+    name: str
+    series: str
+    quantile: float = 0.99
+    threshold_s: float = 1.0
+
+    def validate(self) -> None:
+        if self.series not in SERIES:
+            raise ValueError(
+                f"objective {self.name!r}: unknown series {self.series!r} "
+                f"(expected one of {SERIES})"
+            )
+        if not 0.0 < self.quantile < 1.0:
+            raise ValueError(f"objective {self.name!r}: quantile must be in (0,1)")
+        if self.threshold_s <= 0:
+            raise ValueError(f"objective {self.name!r}: threshold must be positive")
+
+
+def default_objectives() -> List[SLOObjective]:
+    return [
+        SLOObjective("bind_p99", "bind", 0.99, 1.0),
+        SLOObjective("e2e_p99", "e2e", 0.99, 30.0),
+    ]
+
+
+@dataclass
+class SLOConfig:
+    objectives: List[SLOObjective] = field(default_factory=default_objectives)
+    # rolling evaluation window: quantiles/burn are estimated over the
+    # current + previous window generation (covers [window, 2·window])
+    window_s: float = 60.0
+    # a quantile judged from too few samples is noise, not a breach
+    min_samples: int = 100
+    # breach evaluation cadence (0 = every ingest batch — tests)
+    eval_interval_s: float = 1.0
+    # arm the tracer's black-box ring when the tier installs
+    blackbox: bool = True
+    blackbox_capacity: int = 65_536
+    # where breach dumps land; None keeps the frozen export in memory
+    # only (served at /debug/slo?action=trace)
+    dump_dir: Optional[str] = None
+    # minimum seconds between breach dumps (storm bound)
+    breach_cooldown_s: float = 30.0
+    # per-pod open attempts idle longer than this are swept (pods deleted
+    # mid-flight, stranded unschedulables)
+    gc_age_s: float = 600.0
+
+    def validate(self) -> None:
+        if self.window_s <= 0:
+            raise ValueError("window_s must be positive")
+        for o in self.objectives:
+            o.validate()
+
+
+class _ObjState:
+    """Windowed good/bad accounting + last evaluation results for one
+    objective."""
+
+    __slots__ = ("obj", "n_cur", "n_prev", "bad_cur", "bad_prev",
+                 "current_s", "burn_rate", "samples", "breached")
+
+    def __init__(self, obj: SLOObjective):
+        self.obj = obj
+        self.n_cur = 0
+        self.n_prev = 0
+        self.bad_cur = 0
+        self.bad_prev = 0
+        self.current_s = 0.0
+        self.burn_rate = 0.0
+        self.samples = 0
+        self.breached = False
+
+    def rotate(self) -> None:
+        self.n_prev, self.bad_prev = self.n_cur, self.bad_cur
+        self.n_cur = self.bad_cur = 0
+
+
+def _json_num(v: float) -> Optional[float]:
+    """inf → None so /debug/slo stays strict-JSON parseable."""
+    if v is None or math.isinf(v) or math.isnan(v):
+        return None
+    return round(float(v), 6)
+
+
+def _run_worker(ref: "weakref.ref") -> None:
+    """The evaluation-cadence backstop thread: joins idle tails the inline
+    threshold never reaches, evaluates objectives, handles breaches.
+    Polls — never notified per event (a per-event ``Event.set`` is a
+    cross-thread notify whose GIL handoff measured ~15% of a drain).
+    Holds only a WEAKREF to its evaluator and re-derefs every cycle, so a
+    dropped evaluator gets collected and the thread exits instead of
+    pinning the join state for the life of the process."""
+    while True:
+        ev = ref()
+        if ev is None:
+            return
+        poll = min(max(ev.config.eval_interval_s, 0.05), 1.0)
+        ev = None  # don't pin the evaluator across the sleep
+        time.sleep(poll)
+        ev = ref()
+        if ev is None:
+            return
+        ev._worker_tick()
+        ev = None
+
+
+class SLOEvaluator:
+    """The steady-state SLO tier: attribution join + objectives + breach
+    handling.  Install with ``Scheduler.install_slo``; feed with
+    ``FlightRecorder.sink = evaluator.ingest_async``."""
+
+    def __init__(
+        self,
+        config: Optional[SLOConfig] = None,
+        prom=None,
+        tracer=None,
+        mono_clock=time.monotonic,
+        wall_clock=time.time,
+    ):
+        self.config = config or SLOConfig()
+        self.config.validate()
+        self.enabled = True
+        self.prom = prom
+        self.tracer = tracer
+        self._mono = mono_clock
+        self._wall = wall_clock
+        self._mu = threading.Lock()
+        # registry-exposed cumulative histogram: fed by DELTA sync on
+        # scrape/snapshot, never per observation
+        if prom is not None:
+            self._stage_hist: Histogram = prom.slo_stage_duration
+        else:
+            self._stage_hist = Histogram(
+                "scheduler_tpu_slo_stage_duration_seconds",
+                label_names=("stage",),
+                buckets=wide_duration_buckets(),
+            )
+        self._bounds = self._stage_hist.buckets  # python list: bisect
+        self._bounds_arr = np.asarray(self._bounds)  # searchsorted
+        nb = len(self._bounds) + 1
+        self._nb = nb
+        # per-pod open-attempt state, interned: uid → slot in the [cap, 6]
+        # stamp matrix (NaN = unset).  Slots recycle through _slo_free;
+        # _slo_uids/_slo_alloc are the reverse map + liveness mask the
+        # vectorized gc sweep walks.
+        self._slo_idx: Dict[str, int] = {}
+        self._slo_uids = np.empty(0, object)  # slot → uid (reverse map)
+        self._slo_st = np.empty((0, _NCOL), np.float64)
+        self._slo_free: List[int] = []
+        self._slo_alloc = np.zeros(0, np.bool_)
+        # cumulative per-series accounting: [bucket counts, sum, n]
+        self._slo_cum: Dict[str, list] = {
+            s: [np.zeros(nb, np.int64), 0.0, 0] for s in SERIES
+        }
+        # what of _slo_cum has already been merged into the registry hist
+        self._slo_synced: Dict[str, list] = {
+            s: [np.zeros(nb, np.int64), 0.0, 0] for s in SERIES
+        }
+        # two-generation rolling window counts per series
+        self._win_cur: Dict[str, np.ndarray] = {
+            s: np.zeros(nb, np.int64) for s in SERIES
+        }
+        self._win_prev: Dict[str, np.ndarray] = {
+            s: np.zeros(nb, np.int64) for s in SERIES
+        }
+        self._slo_objs: List[_ObjState] = [
+            _ObjState(o) for o in self.config.objectives
+        ]
+        self._by_series: Dict[str, List[_ObjState]] = {s: [] for s in SERIES}
+        for st in self._slo_objs:
+            self._by_series[st.obj.series].append(st)
+        now = mono_clock()
+        self._slo_rotated_at = now
+        self._slo_last_eval = now
+        self._slo_last_dump = -math.inf
+        self._slo_last_gc = now
+        self._slo_breaches: List[dict] = []
+        self._slo_breaches_total = 0
+        self._slo_last_trace: Optional[dict] = None
+        self._slo_dump_seq = 0
+        # async ingest plumbing: producers append LOCKLESSLY (deque
+        # appends are atomic under the GIL) and run the join inline at an
+        # amortized threshold; the lazy daemon worker is only the
+        # evaluation-cadence backstop.  _buf_mu serializes worker startup
+        # and the error counter.
+        self._buf_mu = threading.Lock()
+        self._slo_buf: deque = deque()
+        self._slo_pending = 0  # advisory event count since last inline join
+        self._slo_errors = 0
+        self._worker: Optional[threading.Thread] = None
+        self._sanitize = sanitizer.enabled()
+
+    # -- ingestion ------------------------------------------------------------
+
+    def ingest_async(self, mono, events) -> None:
+        """The FlightRecorder.sink entry: ``(shared mono stamp, [(uid,
+        kind, detail), ...])`` — ``events`` must be a re-iterable,
+        KIND-HOMOGENEOUS sequence (every ``record_many`` site passes one
+        literal kind; the recorder hands over its already-built tuples).
+
+        Cost discipline, each clause bought by a measurement: one LOCKLESS
+        deque append per call (no per-event tuples); no worker wakeup (a
+        per-event ``Event.set`` is a cross-thread notify whose GIL handoff
+        measured ~15% of a drain by itself); and the join runs INLINE on
+        the producer at an amortized threshold rather than on the worker —
+        a concurrently-running join thread competes with the host loop for
+        the GIL, and the contention tax measured ~2× the join's own CPU.
+        Inline, the cost is the join itself, cache-local, a few ms per
+        ~8k events."""
+        if not self.enabled:
+            return
+        if self._sanitize and len(events) > 1:
+            kinds = {e[1] for e in events}
+            assert len(kinds) == 1, f"mixed-kind sink batch: {kinds}"
+        self._slo_buf.append((mono, events))  # deque append: GIL-atomic
+        self._slo_pending += len(events)  # advisory (racy is fine)
+        if self._worker is None:
+            with self._buf_mu:
+                if self._worker is None:
+                    # the thread holds only a WEAKREF to the evaluator —
+                    # a dropped evaluator (scheduler torn down, bench
+                    # rep finished) gets collected and its worker exits,
+                    # instead of pinning the join state forever
+                    self._worker = threading.Thread(
+                        target=_run_worker,
+                        args=(weakref.ref(self),),
+                        name="slo-eval",
+                        daemon=True,
+                    )
+                    self._worker.start()
+        if self._slo_pending >= _INLINE_JOIN_EVERY:
+            self._slo_pending = 0
+            self._drain_join(blocking=False)
+
+    def _drain_join(self, blocking: bool = True) -> None:
+        """Pop everything buffered and join it (no objective evaluation —
+        that stays on the worker's cadence so a breach's freeze/dump I/O
+        never runs on a producer thread).  Safe from any thread.
+
+        The buffer is popped UNDER ``_mu`` so concurrent drains consume
+        the stream in one global order (popping first would let two
+        threads join their halves out of order — a pod's pop ahead of its
+        enqueue).  Inline producer calls pass ``blocking=False``: when
+        another thread is already mid-join, stalling a binding worker on
+        the lock just to find an empty buffer afterwards measured as a
+        producer PILE-UP (every worker that crossed the threshold queued
+        up behind one join); leaving the buffer to the in-flight drainer
+        (plus the worker-cadence backstop) costs nothing."""
+        if not self._mu.acquire(blocking):
+            return
+        try:
+            buf = self._slo_buf
+            pairs = []
+            while True:
+                try:
+                    pairs.append(buf.popleft())
+                except IndexError:
+                    break
+            if pairs:
+                try:
+                    # ktpu: allow(lock-discipline) — _mu IS held: the
+                    # non-blocking acquire above returned True (the
+                    # checker only models `with` blocks, not try-lock)
+                    self._join_pairs_locked(pairs)
+                except Exception:
+                    # a join bug must not wedge the tier (unjoined
+                    # buffer growth, hung flush): drop the cycle,
+                    # count it
+                    with self._buf_mu:
+                        self._slo_errors += 1
+        finally:
+            self._mu.release()
+
+    def _worker_tick(self) -> None:
+        """One worker-cadence pass: join whatever the inline threshold
+        hasn't (idle tails), evaluate objectives, handle breaches.  Fully
+        exception-proof: a bug anywhere here must not kill the worker
+        thread (there is no respawn — _worker is never reset)."""
+        if self._slo_buf:
+            self._drain_join()
+        breach = None
+        try:
+            with self._mu:
+                breach = self._post_join_locked()
+        except Exception:
+            with self._buf_mu:
+                self._slo_errors += 1
+        if breach is not None:
+            try:
+                self._handle_breach(breach)
+            except Exception:
+                with self._buf_mu:
+                    self._slo_errors += 1
+
+    def flush(self) -> None:
+        """Read-your-writes barrier (snapshot() takes it before
+        reporting): ONE blocking drain pass suffices.  Pops happen under
+        ``_mu``, so by the time our acquire succeeds every event buffered
+        before this call has been popped — by us or by whichever drainer
+        we waited behind — and joined.  Events appended after the call
+        are post-flush by definition; NOT waiting for a buffer-empty
+        state keeps /debug/slo bounded under sustained load, where the
+        buffer refills every few hundred microseconds and an empty-check
+        loop would spin forever."""
+        self._drain_join()
+
+    def ingest(self, events) -> None:
+        """Synchronously join a batch of ``(mono, uid, kind, detail)``
+        breadcrumbs through the scalar loop; runs the cadence-throttled
+        objective evaluation.  The deterministic reference path — the
+        worker's vectorized path is property-tested against it."""
+        if not self.enabled:
+            return
+        breach = None
+        with self._mu:
+            self._join_scalar_locked(events)
+            breach = self._post_join_locked()
+        if breach is not None:
+            self._handle_breach(breach)
+
+    def _post_join_locked(self) -> Optional[dict]:
+        """Cadence-throttled rotation / evaluation / gc — per join CYCLE
+        (one worker drain or one sync ingest), never per event."""
+        cfg = self.config
+        breach = None
+        now = self._mono()
+        if now - self._slo_rotated_at >= cfg.window_s:
+            self._slo_rotated_at = now
+            self._rotate_locked()
+        if now - self._slo_last_eval >= cfg.eval_interval_s:
+            self._slo_last_eval = now
+            breach = self._evaluate_locked(now)
+        if now - self._slo_last_gc >= cfg.window_s:
+            self._slo_last_gc = now
+            self._gc_locked(now - cfg.gc_age_s)
+        return breach
+
+    # -- the join: slot management -------------------------------------------
+
+    def _grow_locked(self, need: int) -> None:
+        old = self._slo_st.shape[0]
+        new = max(1024, old * 2, old + need)
+        st = np.full((new, _NCOL), np.nan)
+        st[:old] = self._slo_st
+        self._slo_st = st
+        alloc = np.zeros(new, np.bool_)
+        alloc[:old] = self._slo_alloc
+        self._slo_alloc = alloc
+        uids = np.empty(new, object)
+        uids[:old] = self._slo_uids
+        self._slo_uids = uids
+        # LIFO free list: recently-freed (cache-warm) slots reuse first
+        self._slo_free.extend(range(new - 1, old - 1, -1))
+
+    def _alloc_slot_locked(self, uid: str) -> int:
+        """Claim a slot for ``uid``.  The CALLER resets the row (slots
+        recycle with stale stamps): scalar sites nan the row directly,
+        vector sites batch one ``st[idxs] = nan`` scatter — a per-alloc
+        row broadcast here measured ~half the whole join."""
+        free = self._slo_free
+        if not free:
+            self._grow_locked(1)
+        i = free.pop()
+        self._slo_idx[uid] = i
+        self._slo_uids[i] = uid
+        self._slo_alloc[i] = True
+        return i
+
+    def _free_slot_locked(self, uid: str, i: int) -> None:
+        del self._slo_idx[uid]
+        self._slo_uids[i] = None
+        self._slo_alloc[i] = False
+        self._slo_free.append(i)
+
+    def _gc_locked(self, cut: float) -> None:
+        stale = np.nonzero(
+            self._slo_alloc & (self._slo_st[:, _LAST] < cut)
+        )[0]
+        for i in stale:
+            i = int(i)
+            self._free_slot_locked(self._slo_uids[i], i)
+
+    # -- the join: scalar loop (sync path + short/exotic segments) -----------
+
+    def _join_scalar_locked(self, events) -> None:
+        """Reference join over ``(mono, uid, kind, detail)`` tuples.
+        NaN-kept per-slot stamps (``x == x`` is the not-NaN test); one
+        bisect buckets each observation."""
+        idx = self._slo_idx
+        obs = self._obs_scalar_locked
+        for mono, uid, kind, _detail in events:
+            if kind not in _JOIN_KINDS:
+                continue
+            i = idx.get(uid)
+            # NOTE: the state matrix is re-read per event, not hoisted —
+            # _alloc_slot_locked may REPLACE self._slo_st when it grows
+            if kind == "enqueue":
+                if i is None:
+                    i = self._alloc_slot_locked(uid)
+                row = self._slo_st[i]
+                row[:] = np.nan
+                row[_ENQ] = mono
+                row[_LAST] = mono
+                continue
+            if i is None:
+                if kind == "pop":
+                    # joined mid-flight (tier armed after the enqueue):
+                    # start partial — later stages still attribute
+                    i = self._alloc_slot_locked(uid)
+                    self._slo_st[i] = np.nan
+                else:
+                    continue
+            row = self._slo_st[i]
+            if kind == "bound":
+                start = row[_BINDSTART]
+                if start != start:
+                    start = row[_ASSUMED]
+                if start == start:
+                    obs("bind", mono - start)
+                enq = row[_ENQ]
+                if enq == enq:
+                    obs("e2e", mono - enq)
+                self._free_slot_locked(uid, i)
+            elif kind == "bind_start":
+                assumed = row[_ASSUMED]
+                if assumed == assumed:
+                    obs("commit", mono - assumed)
+                row[_BINDSTART] = mono
+                row[_LAST] = mono
+            elif kind == "assumed":
+                pop = row[_POP]
+                if pop == pop:
+                    obs("dispatch", mono - pop)
+                row[_ASSUMED] = mono
+                row[_LAST] = mono
+            elif kind == "pop":
+                req = row[_REQ]
+                if req == req:
+                    obs("backoff", mono - req)
+                    row[_REQ] = np.nan
+                elif row[_POP] != row[_POP] and row[_ENQ] == row[_ENQ]:
+                    obs("queue_wait", mono - row[_ENQ])
+                row[_POP] = mono
+                row[_ASSUMED] = np.nan
+                row[_BINDSTART] = np.nan
+                row[_LAST] = mono
+            else:  # requeue
+                row[_REQ] = mono
+                row[_ASSUMED] = np.nan
+                row[_BINDSTART] = np.nan
+                row[_LAST] = mono
+
+    def _obs_scalar_locked(self, series: str, dur: float) -> None:
+        if dur < 0.0:
+            dur = 0.0
+        b = bisect_left(self._bounds, dur)
+        c = self._slo_cum[series]
+        c[0][b] += 1
+        c[1] += dur
+        c[2] += 1
+        self._win_cur[series][b] += 1
+        for st in self._by_series[series]:
+            st.n_cur += 1
+            if dur > st.obj.threshold_s:
+                st.bad_cur += 1
+
+    # -- the join: vectorized path (the worker) ------------------------------
+
+    def _join_pairs_locked(self, pairs) -> None:
+        """Join ``(mono, [(uid, kind, detail), ...])`` pairs.  Consecutive
+        same-kind breadcrumbs — whole bulk pop/assume/bind runs, and the
+        enqueue feed's singleton stream — coalesce into one vectorized
+        segment; short or exotic runs take the scalar loop.  Per-uid
+        event order is preserved (only ADJACENT same-kind events merge,
+        and a vector segment never holds two events for one uid: the
+        producers interleave a requeue between re-attempts)."""
+        segs: List[tuple] = []
+        k_cur: Optional[str] = None
+        monos: List[float] = []
+        uids: List[str] = []
+        for mono, events in pairs:
+            if not events:  # a bulk site whose generator yielded nothing
+                continue
+            # bulk pairs are kind-homogeneous (the record_many contract,
+            # sanitizer-checked at the sink): one C-speed extend per pair
+            # instead of a per-event python pass
+            k = events[0][1]
+            if k not in _JOIN_KINDS:
+                continue
+            if k != k_cur:
+                k_cur = k
+                monos = []
+                uids = []
+                segs.append((k, monos, uids))
+            n = len(events)
+            if n == 1:
+                monos.append(mono)
+                uids.append(events[0][0])
+            else:
+                monos += [mono] * n
+                uids += [e[0] for e in events]
+        for k, monos, uids in segs:
+            if len(uids) < _VEC_MIN:
+                self._join_scalar_locked(
+                    [(m, u, k, None) for m, u in zip(monos, uids)]
+                )
+            else:
+                self._vec_segment_locked(k, np.asarray(monos), uids)
+
+    def _lookup_locked(self, uids, create: bool) -> np.ndarray:
+        """uid → slot gather; missing uids allocate (create=True: the
+        pop-mid-flight case) or stay -1 for the caller to mask off."""
+        idx = self._slo_idx
+        raw = [idx.get(u, -1) for u in uids]
+        if create and -1 in raw:
+            created = []
+            for j, i in enumerate(raw):
+                if i < 0:
+                    raw[j] = self._alloc_slot_locked(uids[j])
+                    created.append(raw[j])
+            # one batched reset for all freshly-claimed (stale) rows
+            self._slo_st[np.asarray(created, np.int64)] = np.nan
+        return np.asarray(raw, np.int64)
+
+    def _vec_segment_locked(self, kind: str, monos: np.ndarray, uids) -> None:
+        # NOTE: self._slo_st is read only AFTER any allocation —
+        # _alloc_slot_locked REPLACES the matrix when it grows
+        if kind == "enqueue":
+            idx = self._slo_idx
+            if any(u in idx for u in uids):
+                # rare: a uid re-enqueued while still open — reuse slots
+                raw = []
+                for u in uids:
+                    i = idx.get(u)
+                    raw.append(self._alloc_slot_locked(u) if i is None else i)
+                idxs = np.asarray(raw, np.int64)
+            else:
+                # bulk-alloc fast path (the feed stream): slice the free
+                # list, one dict.update, vectorized reverse-map writes
+                m = len(uids)
+                free = self._slo_free
+                if len(free) < m:
+                    self._grow_locked(m - len(free))
+                    free = self._slo_free
+                take = free[len(free) - m:]
+                del free[len(free) - m:]
+                idx.update(zip(uids, take))
+                idxs = np.asarray(take, np.int64)
+                self._slo_uids[idxs] = uids
+                self._slo_alloc[idxs] = True
+            st = self._slo_st
+            st[idxs] = np.nan
+            st[idxs, _ENQ] = monos
+            st[idxs, _LAST] = monos
+            return
+        if kind == "pop":
+            idxs = self._lookup_locked(uids, create=True)
+            st = self._slo_st
+            req = st[idxs, _REQ]
+            has_req = req == req
+            if has_req.any():
+                self._obs_vec_locked("backoff", monos[has_req] - req[has_req])
+            enq = st[idxs, _ENQ]
+            pop = st[idxs, _POP]
+            first = ~has_req & (pop != pop) & (enq == enq)
+            if first.any():
+                self._obs_vec_locked("queue_wait", monos[first] - enq[first])
+            st[idxs, _POP] = monos
+            st[idxs, _REQ] = np.nan
+            st[idxs, _ASSUMED] = np.nan
+            st[idxs, _BINDSTART] = np.nan
+            st[idxs, _LAST] = monos
+            return
+        idxs = self._lookup_locked(uids, create=False)
+        st = self._slo_st
+        known = idxs >= 0
+        if not known.all():
+            idxs = idxs[known]
+            monos = monos[known]
+            if idxs.size == 0:
+                return
+        if kind == "assumed":
+            pop = st[idxs, _POP]
+            m = pop == pop
+            if m.any():
+                self._obs_vec_locked("dispatch", monos[m] - pop[m])
+            st[idxs, _ASSUMED] = monos
+            st[idxs, _LAST] = monos
+        elif kind == "bind_start":
+            assumed = st[idxs, _ASSUMED]
+            m = assumed == assumed
+            if m.any():
+                self._obs_vec_locked("commit", monos[m] - assumed[m])
+            st[idxs, _BINDSTART] = monos
+            st[idxs, _LAST] = monos
+        elif kind == "bound":
+            bs = st[idxs, _BINDSTART]
+            start = np.where(bs == bs, bs, st[idxs, _ASSUMED])
+            m = start == start
+            if m.any():
+                self._obs_vec_locked("bind", monos[m] - start[m])
+            enq = st[idxs, _ENQ]
+            m = enq == enq
+            if m.any():
+                self._obs_vec_locked("e2e", monos[m] - enq[m])
+            # bulk free: per-uid dict deletes (unavoidable), vectorized
+            # liveness/reverse-map writes, one extend onto the free list
+            idx_map = self._slo_idx
+            if known.all():
+                for u in uids:
+                    del idx_map[u]
+            else:
+                for u in self._slo_uids[idxs]:
+                    del idx_map[u]
+            self._slo_uids[idxs] = None
+            self._slo_alloc[idxs] = False
+            self._slo_free.extend(idxs.tolist())
+        else:  # requeue
+            st[idxs, _REQ] = monos
+            st[idxs, _ASSUMED] = np.nan
+            st[idxs, _BINDSTART] = np.nan
+            st[idxs, _LAST] = monos
+
+    def _obs_vec_locked(self, series: str, durs: np.ndarray) -> None:
+        durs = np.maximum(durs, 0.0)
+        bc = np.bincount(
+            np.searchsorted(self._bounds_arr, durs, side="left"),
+            minlength=self._nb,
+        )
+        c = self._slo_cum[series]
+        c[0] += bc
+        c[1] += float(durs.sum())
+        c[2] += durs.size
+        self._win_cur[series] += bc
+        for st in self._by_series[series]:
+            st.n_cur += durs.size
+            if durs.size:
+                st.bad_cur += int((durs > st.obj.threshold_s).sum())
+
+    # -- windows / registry sync ---------------------------------------------
+
+    def _rotate_locked(self) -> None:
+        for s in SERIES:
+            self._win_prev[s] = self._win_cur[s]
+            self._win_cur[s] = np.zeros(self._nb, np.int64)
+        for st in self._slo_objs:
+            st.rotate()
+
+    def _sync_registry_locked(self) -> None:
+        """Merge the cumulative deltas since the last sync into the
+        registry histogram — the scrape-time flush that keeps the hot
+        join off the metric locks."""
+        for s in SERIES:
+            counts, total, n = self._slo_cum[s]
+            synced = self._slo_synced[s]
+            dn = n - synced[2]
+            if not dn:
+                continue
+            self._stage_hist.merge_counts(
+                (counts - synced[0]).tolist(), total - synced[1], dn, stage=s
+            )
+            self._slo_synced[s] = [counts.copy(), total, n]
+
+    # -- evaluation + breach --------------------------------------------------
+
+    def _evaluate_locked(self, now: float) -> Optional[dict]:
+        """Refresh every objective's windowed estimate; return a breach
+        record for the first newly-dumpable breach (cooldown-gated)."""
+        cfg = self.config
+        breach = None
+        for st in self._slo_objs:
+            o = st.obj
+            merged = self._win_cur[o.series] + self._win_prev[o.series]
+            est, n = bucket_quantile(self._bounds, merged, o.quantile)
+            bad = st.bad_cur + st.bad_prev
+            total = st.n_cur + st.n_prev
+            budget = 1.0 - o.quantile
+            st.current_s = est
+            st.samples = n
+            st.burn_rate = (
+                (bad / total) / budget if total and budget > 0 else 0.0
+            )
+            st.breached = n >= cfg.min_samples and est > o.threshold_s
+            if (
+                st.breached
+                and breach is None
+                and now - self._slo_last_dump >= cfg.breach_cooldown_s
+            ):
+                self._slo_last_dump = now
+                self._slo_dump_seq += 1
+                breach = {
+                    "objective": o.name,
+                    "series": o.series,
+                    "quantile": o.quantile,
+                    "threshold_s": o.threshold_s,
+                    "measured_s": _json_num(est),
+                    "window_samples": n,
+                    "burn_rate": _json_num(st.burn_rate),
+                    "wall_time": self._wall(),
+                    "mono": now,
+                    "seq": self._slo_dump_seq,
+                }
+        self._sync_registry_locked()
+        return breach
+
+    def _handle_breach(self, record: dict) -> None:
+        """Freeze → export → dump → re-arm the black-box ring, then file
+        the breach record.  Runs OUTSIDE the evaluator lock: the tracer
+        export and the artifact write are slow, and the tracer has its own
+        lock."""
+        if self.prom is not None:
+            self.prom.slo_breaches.inc(objective=record["objective"])
+        tr = self.tracer
+        frozen = tr.blackbox_freeze() if tr is not None else None
+        trace = None
+        if frozen is None and tr is not None and self.config.blackbox:
+            # breach with the ring unarmed (a manual capture was started
+            # and abandoned without its export re-arming it): this
+            # breach's trace is lost, but re-arm NOW — idle tracer only,
+            # never clobber a manual capture in flight — so the next
+            # incident is covered again
+            if not tr.enabled:
+                tr.blackbox_start(self.config.blackbox_capacity)
+        if frozen is not None:
+            trace = frozen["trace"]
+            record["breach_offset_us"] = frozen["freeze_offset_us"]
+            record["trace_events"] = sum(
+                1 for e in trace["traceEvents"] if e.get("ph") != "M"
+            )
+            path = None
+            if self.config.dump_dir:
+                # an unwritable/full dump_dir must not kill the breach
+                # path (or the worker thread): fall back to the in-memory
+                # retention the no-dump_dir config gets
+                try:
+                    os.makedirs(self.config.dump_dir, exist_ok=True)
+                    path = os.path.join(
+                        self.config.dump_dir,
+                        f"blackbox-{record['seq']:04d}-"
+                        f"{record['objective']}.json",
+                    )
+                    with open(path, "w") as f:
+                        json.dump(trace, f)
+                except OSError:
+                    path = None
+                    with self._buf_mu:
+                        self._slo_errors += 1
+            record["trace"] = path
+            # resume recording for the next incident
+            tr.blackbox_start(self.config.blackbox_capacity)
+        with self._mu:
+            self._slo_breaches_total += 1
+            self._slo_breaches.append(record)
+            del self._slo_breaches[:-8]  # keep the recent history bounded
+            if trace is not None:
+                # retain in memory ONLY when no artifact landed on disk
+                # (/debug/slo?action=trace serves it); with a dumped file
+                # the copy would pin the whole ring export per process —
+                # and a successful dump CLEARS any older failed-dump
+                # retention, so action=trace never serves a stale
+                # incident's ring alongside a newer breach record
+                self._slo_last_trace = (
+                    trace if record.get("trace") is None else None
+                )
+
+    # -- introspection (/debug/slo) ------------------------------------------
+
+    def evaluate(self) -> Optional[dict]:
+        """Flush buffered breadcrumbs and force one evaluation pass
+        (bypasses the cadence throttle); returns the breach record it
+        dumped, if any."""
+        self.flush()
+        with self._mu:
+            breach = self._evaluate_locked(self._mono())
+        if breach is not None:
+            self._handle_breach(breach)
+        return breach
+
+    def last_breach_trace(self) -> Optional[dict]:
+        with self._mu:
+            return self._slo_last_trace
+
+    def gauge_rows(self) -> List[Tuple[str, float]]:
+        """(objective, burn_rate) pairs — the scrape-refresh feed for
+        scheduler_tpu_slo_burn_rate (Scheduler.refresh_gauges).  Also
+        syncs the stage histogram so /metrics is current."""
+        with self._mu:
+            self._sync_registry_locked()
+            return [(st.obj.name, st.burn_rate) for st in self._slo_objs]
+
+    def snapshot(self) -> dict:
+        """The live SLI snapshot /debug/slo serves: per-objective state,
+        per-stage decomposition, and the last breach record."""
+        self.flush()
+        with self._mu:
+            self._sync_registry_locked()
+            objectives = [
+                {
+                    "name": st.obj.name,
+                    "series": st.obj.series,
+                    "quantile": st.obj.quantile,
+                    "threshold_s": st.obj.threshold_s,
+                    "current_s": _json_num(st.current_s),
+                    "burn_rate": _json_num(st.burn_rate),
+                    "window_samples": st.samples,
+                    "breached": st.breached,
+                }
+                for st in self._slo_objs
+            ]
+            breaches_total = self._slo_breaches_total
+            last_breach = (
+                dict(self._slo_breaches[-1]) if self._slo_breaches else None
+            )
+            open_attempts = len(self._slo_idx)
+            stages = {}
+            for s in SERIES:
+                counts, total, n = self._slo_cum[s]
+                p50, _ = bucket_quantile(self._bounds, counts, 0.5)
+                p99, _ = bucket_quantile(self._bounds, counts, 0.99)
+                stages[s] = {
+                    "count": n,
+                    "sum_s": _json_num(total),
+                    "p50_s": _json_num(p50) if n else None,
+                    "p99_s": _json_num(p99) if n else None,
+                }
+        out = {
+            "enabled": self.enabled,
+            "window_s": self.config.window_s,
+            "min_samples": self.config.min_samples,
+            "objectives": objectives,
+            "stages": stages,
+            "open_attempts": open_attempts,
+            "breaches_total": breaches_total,
+            "last_breach": last_breach,
+            "ingest_errors": self._slo_errors,
+        }
+        tr = self.tracer
+        if tr is not None:
+            out["blackbox"] = tr.stats()
+        return out

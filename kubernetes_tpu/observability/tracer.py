@@ -20,12 +20,21 @@ one attribute load and a branch — no locks, no clock reads, no allocation,
 and ZERO device-path involvement (nothing here touches jax).  When enabled,
 each span is one lock acquisition + one dict append; the buffer is bounded
 (``max_events``), overflow increments a drop counter instead of growing.
+
+Black-box mode (``blackbox_start``): the same recorder as an ALWAYS-ON
+bounded rolling ring — overflow evicts the OLDEST event (counted) instead
+of dropping the newest, so the buffer always holds the trailing window of
+spans.  An SLO breach (observability/slo.py) freezes the ring
+(``blackbox_freeze``) and exports it, so the trace of the bad window
+exists *after* the incident without anyone having started a capture.
+The hot-path discipline is identical: off is one attribute read per site.
 """
 
 from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from typing import Dict, List, Optional
 
 # Lock-discipline registry (kubernetes_tpu.analysis): the scheduling loop,
@@ -36,6 +45,9 @@ _KTPU_GUARDED = {
         "guards": {
             "_trace_events": None,
             "_trace_dropped": None,
+            "_trace_evicted": None,
+            "_ring_mode": None,
+            "_ring_cap": None,
             "_tid_names": None,
             "_overhead_s": None,
         },
@@ -43,6 +55,10 @@ _KTPU_GUARDED = {
 }
 
 DEFAULT_MAX_EVENTS = 200_000
+# black-box ring default: deep enough that a multi-second bad window of
+# batch/phase spans survives until the breach evaluator fires, small
+# enough (~15 MB of dicts) to sit resident in a serving process forever
+DEFAULT_BLACKBOX_EVENTS = 65_536
 
 
 class Tracer:
@@ -62,8 +78,13 @@ class Tracer:
         self.max_events = max_events
         self._clock = clock
         self._mu = threading.Lock()
-        self._trace_events: List[dict] = []
+        self._trace_events: deque = deque()
         self._trace_dropped = 0
+        self._trace_evicted = 0
+        # black-box ring mode: overflow evicts OLDEST instead of dropping
+        # the newest — the buffer becomes a rolling trailing window
+        self._ring_mode = False
+        self._ring_cap = DEFAULT_BLACKBOX_EVENTS
         self._tid_names: Dict[int, str] = {}
         self._overhead_s = 0.0
         self._t0 = clock()
@@ -74,9 +95,13 @@ class Tracer:
     # -- lifecycle -----------------------------------------------------------
 
     def start(self) -> None:
+        """Begin a MANUAL capture (drop-newest on overflow).  Overrides an
+        active black-box ring until ``blackbox_start`` re-arms it."""
         with self._mu:
-            self._trace_events = []
+            self._trace_events = deque()
             self._trace_dropped = 0
+            self._trace_evicted = 0
+            self._ring_mode = False
             self._tid_names = {}
             self._overhead_s = 0.0
             self._t0 = self._clock()
@@ -84,6 +109,45 @@ class Tracer:
 
     def stop(self) -> None:
         self.enabled = False
+
+    def blackbox_start(self, capacity: int = DEFAULT_BLACKBOX_EVENTS) -> None:
+        """Arm (or re-arm after a freeze/dump) the always-on black-box
+        ring: recording on, evict-oldest at ``capacity`` events."""
+        with self._mu:
+            self._trace_events = deque()
+            self._trace_dropped = 0
+            self._trace_evicted = 0
+            self._ring_mode = True
+            self._ring_cap = max(int(capacity), 1)
+            self._tid_names = {}
+            self._overhead_s = 0.0
+            self._t0 = self._clock()
+        self.enabled = True
+
+    def blackbox_freeze(self) -> Optional[dict]:
+        """Freeze the black-box ring (stop recording, keep events) and
+        return ``{"trace": <export>, "freeze_offset_us": <ring-relative
+        freeze time>}`` — None when the ring isn't armed.  The caller
+        (the SLO breach handler) dumps the trace and calls
+        ``blackbox_start`` again to resume recording."""
+        with self._mu:
+            if not self._ring_mode:
+                return None
+            # armed-check, recording stop, freeze stamp, and ring snapshot
+            # in ONE critical section: a concurrent manual start() (the
+            # /debug/trace HTTP thread) serializes either before us (ring
+            # disarmed — we return None, the operator's capture survives)
+            # or after us (it swaps in a fresh buffer — our snapshot is
+            # still the bad window, and its capture keeps recording)
+            self.enabled = False
+            freeze_offset_us = (self._clock() - self._t0) * 1e6
+            events = list(self._trace_events)
+            names = dict(self._tid_names)
+            dropped = self._trace_dropped
+        return {
+            "trace": self._build_trace(events, names, dropped),
+            "freeze_offset_us": freeze_offset_us,
+        }
 
     def now(self) -> float:
         return self._clock()
@@ -122,7 +186,13 @@ class Tracer:
                 ev["dur"] = (t1 - t0) * 1e6
             else:
                 ev["s"] = "t"
-            if len(self._trace_events) >= self.max_events:
+            if self._ring_mode:
+                # black-box ring: recent history always wins
+                if len(self._trace_events) >= self._ring_cap:
+                    self._trace_events.popleft()
+                    self._trace_evicted += 1
+                self._trace_events.append(ev)
+            elif len(self._trace_events) >= self.max_events:
                 self._trace_dropped += 1
             else:
                 self._trace_events.append(ev)
@@ -182,6 +252,10 @@ class Tracer:
             events = list(self._trace_events)
             names = dict(self._tid_names)
             dropped = self._trace_dropped
+        return self._build_trace(events, names, dropped)
+
+    @staticmethod
+    def _build_trace(events, names, dropped) -> dict:
         meta = [
             {
                 "name": "process_name",
@@ -211,10 +285,14 @@ class Tracer:
         with self._mu:
             return {
                 "enabled": self.enabled,
+                "mode": "blackbox" if self._ring_mode else "capture",
                 "events": len(self._trace_events),
                 "dropped": self._trace_dropped,
+                "evicted": self._trace_evicted,
                 "overhead_s": self._overhead_s,
-                "max_events": self.max_events,
+                "max_events": (
+                    self._ring_cap if self._ring_mode else self.max_events
+                ),
             }
 
 

@@ -88,7 +88,11 @@ class QueuedPodInfo:
     """framework.QueuedPodInfo (types.go:234)."""
 
     pod: Pod
-    timestamp: float = 0.0  # first enqueue time
+    timestamp: float = 0.0  # first enqueue time (queue clock — ordering)
+    # first enqueue on the REAL monotonic clock: every latency/SLI duration
+    # derives from this, never from the (injectable, wall-or-manual) queue
+    # clock — a clock jump must not skew a latency delta
+    mono_timestamp: float = 0.0
     attempts: int = 0
     unschedulable_plugins: set = field(default_factory=set)
     pending_plugins: set = field(default_factory=set)
@@ -114,6 +118,7 @@ class SchedulingQueue:
         unschedulable_timeout_s: float = DEFAULT_UNSCHEDULABLE_TIMEOUT,
         clock: Callable[[], float] = time.monotonic,
         key_fn: Optional[Callable[[QueuedPodInfo], Any]] = None,
+        mono_clock: Callable[[], float] = time.monotonic,
     ):
         self.less = less_fn or self._default_less
         # optional totally-ordered tuple key consistent with less —
@@ -125,6 +130,10 @@ class SchedulingQueue:
         self.max_backoff = max_backoff_s
         self.unschedulable_timeout = unschedulable_timeout_s
         self.clock = clock
+        # durations/SLIs stamp against this, independent of the injectable
+        # ordering clock (tests inject manual clocks to skip backoff waits;
+        # latency metrics must not inherit those jumps)
+        self.mono_clock = mono_clock
 
         self._active: List[Tuple[Any, int, QueuedPodInfo]] = []  # heap
         self._backoff: List[Tuple[float, int, QueuedPodInfo]] = []  # heap
@@ -208,7 +217,11 @@ class SchedulingQueue:
         scheduling_queue.go:499-538)."""
         if pod.uid in self._in_queue or pod.uid in self._in_flight:
             return
-        qp = QueuedPodInfo(pod=pod, timestamp=self.clock())
+        qp = QueuedPodInfo(
+            pod=pod,
+            timestamp=self.clock(),
+            mono_timestamp=self.mono_clock(),
+        )
         if self.pre_enqueue_check is not None:
             status = self.pre_enqueue_check(pod)
             if status is not None and not getattr(status, "ok", True):

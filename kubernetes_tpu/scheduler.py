@@ -130,9 +130,12 @@ class ScheduleOutcome:
     # plugin name → count of nodes it rejected (Diagnosis.NodeToStatus
     # aggregate, framework/types.go:367)
     diagnosis: Optional[Dict[str, int]] = None
-    # metrics context (pod_scheduling_sli/attempts series)
+    # metrics context (pod_scheduling_sli/attempts series).  The SLI
+    # duration derives from the MONOTONIC pair (a wall/manual-clock jump
+    # must not skew it); the queue-clock stamp stays for display/ordering.
     pod_attempts: int = 1
     first_enqueue_time: Optional[float] = None
+    first_enqueue_mono: Optional[float] = None
 
 
 # FitError reason strings keyed by diagnosis kernel (types.go:420-465 /
@@ -433,6 +436,9 @@ class Scheduler:
         self.flight = FlightRecorder()
         self.phases.tracer = self.tracer
         self.queue.flight = self.flight
+        # steady-state SLO tier (observability/slo.py) — None until
+        # install_slo wires it; /debug/slo serves {"enabled": false} then
+        self.slo = None
         self._batch_seq = 0  # trace batch ids (scheduling-loop thread only)
         # jax.profiler trace hook (SURVEY §5; the --profiling/pprof analog,
         # apis/config/types.go:60): when set, schedule_pending wraps each
@@ -974,7 +980,11 @@ class Scheduler:
         prom.batch_size_hist.observe(len(group))
         prom.recorder.observe(prom.algorithm_duration, dt, profile=profile)
         per_pod = dt / max(len(outs), 1)
-        now = self.clock()
+        # one batched dispatch smears its latency over the batch: the
+        # coarse batch label lets the serving analysis separate real
+        # per-pod samples (batch=1) from drain averages (batch=4096+)
+        bsz = M.batch_size_bucket(len(group))
+        now_mono = time.monotonic()
         # Aggregate per-pod series by (result / attempts) before touching
         # the registry: the batch shares one latency, so one bucket update
         # per distinct label set replaces len(batch) walks.
@@ -985,9 +995,13 @@ class Scheduler:
                 result = M.SCHEDULED
                 a = o.pod_attempts or 1
                 by_attempts[a] = by_attempts.get(a, 0) + 1
-                if o.first_enqueue_time is not None:
+                # e2e SLI from the MONOTONIC enqueue stamp: the queue
+                # clock is injectable (manual/wall), and a clock jump —
+                # NTP step, chaos skew, a test skipping backoff — must
+                # not skew the latency distribution
+                if o.first_enqueue_mono is not None:
                     prom.pod_scheduling_sli_duration.observe(
-                        max(now - o.first_enqueue_time, 0.0),
+                        max(now_mono - o.first_enqueue_mono, 0.0),
                         attempts=str(min(a, 16)),
                     )
             elif o.status.code == Code.ERROR:
@@ -998,7 +1012,7 @@ class Scheduler:
         for result, n in by_result.items():
             prom.schedule_attempts.inc(n, result=result, profile=profile)
             prom.attempt_duration.observe_n(
-                per_pod, n, result=result, profile=profile
+                per_pod, n, result=result, profile=profile, batch=bsz
             )
         for a, n in by_attempts.items():
             prom.pod_scheduling_attempts.observe_n(a, n)
@@ -1017,10 +1031,37 @@ class Scheduler:
         ts = self.tracer.stats()
         self.prom.trace_buffered.set(ts["events"])
         self.prom.trace_dropped.set(ts["dropped"])
+        self.prom.trace_evicted.set(ts["evicted"])
         self.prom.tracer_overhead.set(ts["overhead_s"])
         fs = self.flight.stats()
         self.prom.flightrec_events.set(fs["events"])
         self.prom.flightrec_evicted.set(fs["evicted_total"])
+        slo = self.slo
+        if slo is not None:
+            for objective, burn in slo.gauge_rows():
+                self.prom.slo_burn_rate.set(burn, objective=objective)
+
+    def install_slo(self, slo_config=None):
+        """Install the steady-state SLO tier (observability/slo.py): wires
+        the evaluator as the flight recorder's streaming sink (per-stage
+        latency attribution + objective/burn-rate tracking) and, unless
+        disabled in the config, arms the tracer's always-on black-box ring
+        so an SLO breach can freeze and dump the trace of the bad window.
+        Returns the evaluator (also at ``self.slo``; served at
+        /debug/slo)."""
+        from kubernetes_tpu.observability.slo import SLOConfig, SLOEvaluator
+
+        cfg = slo_config or SLOConfig()
+        ev = SLOEvaluator(cfg, prom=self.prom, tracer=self.tracer)
+        self.slo = ev
+        # attribution needs the breadcrumbs flowing; the async sink keeps
+        # producer threads at one buffer append — joining runs inline at
+        # an amortized threshold, with the worker as the idle-tail backstop
+        self.flight.enabled = True
+        self.flight.sink = ev.ingest_async
+        if cfg.blackbox:
+            self.tracer.blackbox_start(cfg.blackbox_capacity)
+        return ev
 
     def expose_metrics(self) -> str:
         """Prometheus text exposition (the /metrics handler body)."""
@@ -3878,6 +3919,7 @@ class Scheduler:
             n_feas,
             pod_attempts=qp.attempts,
             first_enqueue_time=qp.timestamp,
+            first_enqueue_mono=qp.mono_timestamp or None,
         )
         task = _BindTask(
             fwk, state, qp, node_name, waited, binder_override, outcome, lean
@@ -4002,6 +4044,7 @@ class Scheduler:
                     nf,
                     pod_attempts=qp.attempts,
                     first_enqueue_time=qp.timestamp,
+                    first_enqueue_mono=qp.mono_timestamp or None,
                 )
                 outcomes.append(outcome)
                 items.append((qp, nn, outcome))
@@ -4074,6 +4117,13 @@ class Scheduler:
 
         t0 = time.perf_counter()
         fwk, state, items = t.fwk, t.state, t.items
+        fr = self.flight
+        if fr.enabled:
+            # worker picked the slice up: closes the commit stage (assumed
+            # → bind_start) in the SLO tier's attribution join
+            fr.record_many(
+                (qp.pod.uid, "bind_start", None) for qp, _, _ in items
+            )
         ok_items = []
         sink_many = self.binding_sink_many
         if sink_many is not None and len(items) > 1:
@@ -4155,6 +4205,13 @@ class Scheduler:
         t_bind = time.perf_counter()
         lean_ok = []
         lean_tasks = [t for t in part if t.lean_eligible()]
+        fr = self.flight
+        if fr.enabled and lean_tasks:
+            # lean tasks bind inline below; non-lean ones route through
+            # _binding_cycle, which records its own bind_start
+            fr.record_many(
+                (t.qp.pod.uid, "bind_start", None) for t in lean_tasks
+            )
         sink_many = getattr(self, "binding_sink_many", None)
         if sink_many is not None and len(lean_tasks) > 1:
             # BULK sink (the API tier's /bindings endpoint): the whole
@@ -4259,6 +4316,8 @@ class Scheduler:
         fwk, state, qp, node_name = t.fwk, t.state, t.qp, t.node_name
         waited, binder_override, outcome = t.waited, t.binder_override, t.outcome
         pod = qp.pod
+        if self.flight.enabled:
+            self.flight.record(pod.uid, "bind_start", None)
         try:
             if t.lean_eligible():
                 s = fwk.run_bind_direct(state, pod, node_name)

@@ -8,8 +8,10 @@ around the embeddable Scheduler:
     /metrics (Prometheus text exposition), /configz, and the
     observability debug endpoints (OBSERVABILITY.md):
     /debug/trace (start/stop/export span tracing),
-    /debug/flightrecorder?pod= (per-pod lifecycle events), and
-    /debug/explain?pod= (per-node, per-plugin rejection reasons);
+    /debug/flightrecorder?pod= (per-pod lifecycle events),
+    /debug/explain?pod= (per-node, per-plugin rejection reasons), and
+    /debug/slo (live SLI snapshot, per-stage latency breakdown,
+    last-breach record + black-box trace);
   * ``LeaseElector`` — Lease-based leader election
     (client-go/tools/leaderelection/leaderelection.go:116 semantics:
     LeaseDuration/RenewDeadline/RetryPeriod over a CAS'd lease record);
@@ -275,6 +277,7 @@ class SchedulerServer:
                   /debug/trace?action=start|stop|export   default: status
                   /debug/flightrecorder?pod=<uid|name>    default: stats
                   /debug/explain?pod=<uid|name>
+                  /debug/slo?action=status|trace          default: status
                 """
                 q = parse_qs(parsed.query)
                 path = parsed.path
@@ -296,7 +299,21 @@ class SchedulerServer:
                         tracer.stop()
                         self._send_json(tracer.stats())
                     elif action == "export":
-                        self._send_json(tracer.export())
+                        out = tracer.export()
+                        # a manual start() overrides an armed black-box
+                        # ring; export is the terminal step of the manual
+                        # start→stop→export flow, so RE-ARM here — without
+                        # this, one manual capture silently disarms the
+                        # "always-on" breach-dump guarantee until the next
+                        # install_slo
+                        slo = getattr(sched, "slo", None)
+                        if (
+                            slo is not None
+                            and slo.config.blackbox
+                            and not tracer.enabled
+                        ):
+                            tracer.blackbox_start(slo.config.blackbox_capacity)
+                        self._send_json(out)
                     elif action == "status":
                         self._send_json(tracer.stats())
                     else:
@@ -351,6 +368,31 @@ class SchedulerServer:
                     self._send_json(
                         explain_pod(sched, pod, max_nodes=max_nodes)
                     )
+                elif path == "/debug/slo":
+                    # the steady-state SLO tier (observability/slo.py):
+                    # live SLI snapshot + per-stage breakdown + last-breach
+                    # record; ?action=trace serves the last breach's frozen
+                    # black-box export when no dump_dir was configured
+                    slo = getattr(sched, "slo", None)
+                    if slo is None:
+                        self._send_json({"enabled": False})
+                        return
+                    action = q.get("action", ["status"])[0]
+                    if action == "status":
+                        self._send_json(slo.snapshot())
+                    elif action == "trace":
+                        trace = slo.last_breach_trace()
+                        if trace is None:
+                            self._send_json(
+                                {"error": "no breach trace captured"},
+                                code=404,
+                            )
+                        else:
+                            self._send_json(trace)
+                    else:
+                        self._send_json(
+                            {"error": f"unknown action {action!r}"}, code=400
+                        )
                 else:
                     self._send_json({"error": "not found"}, code=404)
 

@@ -12,6 +12,17 @@ Covers the PR-4 acceptance surface:
   * a DISABLED tracer is a no-op (no events, no device-path cost);
   * /metrics exposition survives concurrent writes, escapes label
     values, and rejects duplicate metric registration.
+
+Plus the PR-7 steady-state SLO tier:
+  * per-stage attribution reconciles with a synthetic flight-recorder
+    event stream;
+  * an SLO breach freezes the black-box ring and auto-dumps a
+    Perfetto-loadable trace whose window covers the breach;
+  * /debug/slo serves the live SLI snapshot schema;
+  * black-box mode off is a no-op (one attribute read per site);
+  * Histogram.percentile returns the +Inf sentinel at saturation;
+  * a small deterministic --arrival run shows latency monotone in
+    offered load.
 """
 
 import json
@@ -585,3 +596,463 @@ def test_observability_gauges_on_metrics_endpoint():
     assert "scheduler_tpu_flightrecorder_events" in text
     assert "scheduler_tpu_trace_buffered_events" in text
     assert "scheduler_tpu_tracer_overhead_seconds" in text
+
+
+# ---------------------------------------------------------------------------
+# steady-state SLO tier (observability/slo.py) + black-box ring
+# ---------------------------------------------------------------------------
+
+
+def _slo_cfg(**kw):
+    from kubernetes_tpu.observability.slo import SLOConfig, SLOObjective
+
+    defaults = dict(
+        objectives=[
+            SLOObjective("bind_p99", "bind", 0.99, 1.0),
+            SLOObjective("e2e_p99", "e2e", 0.99, 30.0),
+        ],
+        min_samples=4,
+        eval_interval_s=0.0,
+        breach_cooldown_s=0.0,
+    )
+    defaults.update(kw)
+    return SLOConfig(**defaults)
+
+
+def test_histogram_percentile_overflow_is_inf_sentinel():
+    import math
+
+    from kubernetes_tpu.metrics import Histogram, wide_duration_buckets
+
+    h = Histogram("obs_sat_test", "", buckets=[0.1, 1.0])
+    h.observe(0.05)
+    h.observe(50.0)  # overflow bucket
+    # p50 interpolates inside a finite bucket; p99's rank lands in the
+    # overflow bucket and must NOT silently clamp to 1.0
+    assert h.percentile(0.5) <= 0.1
+    assert math.isinf(h.percentile(0.99))
+    # the SLO tier widens its buckets so the sentinel only fires when
+    # latency is truly off the scale
+    assert wide_duration_buckets()[-1] > 1000.0
+
+
+def test_slo_attribution_reconciles_with_flight_events():
+    """Feed the evaluator a hand-built breadcrumb stream and check every
+    stage duration it joins against the arithmetic of the stream."""
+    from kubernetes_tpu.observability.slo import SLOEvaluator
+
+    ev = SLOEvaluator(_slo_cfg())
+    t = 100.0
+    # pod A: clean first-attempt flight
+    ev.ingest([(t + 0.0, "A", "enqueue", None)])
+    ev.ingest([(t + 1.0, "A", "pop", None)])
+    ev.ingest([(t + 1.5, "A", "assumed", None)])
+    ev.ingest([(t + 1.7, "A", "bind_start", None)])
+    ev.ingest([(t + 2.0, "A", "bound", None)])
+    # pod B: fails once (requeue → backoff → re-pop), then binds
+    ev.ingest([(t + 0.0, "B", "enqueue", None)])
+    ev.ingest([(t + 0.5, "B", "pop", None)])
+    ev.ingest([(t + 0.6, "B", "unschedulable", {"plugins": ["X"]})])
+    ev.ingest([(t + 0.6, "B", "requeue", {"to": "backoff"})])
+    ev.ingest([(t + 2.6, "B", "pop", None)])
+    ev.ingest([(t + 3.0, "B", "assumed", None)])
+    ev.ingest([(t + 3.1, "B", "bind_start", None)])
+    ev.ingest([(t + 3.2, "B", "bound", None)])
+    h = ev._stage_hist
+    # queue_wait: A 1.0, B 0.5 (first pop only)
+    assert h.count(stage="queue_wait") == 2
+    assert h.total_sum(stage="queue_wait") == pytest.approx(1.5)
+    # backoff: B 2.0 (requeue → re-pop)
+    assert h.count(stage="backoff") == 1
+    assert h.total_sum(stage="backoff") == pytest.approx(2.0)
+    # dispatch: A 0.5, B(attempt1) 0.1... no — B's first attempt never
+    # reached assumed; B's second pop→assumed is 0.4
+    assert h.count(stage="dispatch") == 2
+    assert h.total_sum(stage="dispatch") == pytest.approx(0.5 + 0.4)
+    # commit: A 0.2, B 0.1
+    assert h.total_sum(stage="commit") == pytest.approx(0.3)
+    # bind: A 0.3, B 0.1
+    assert h.total_sum(stage="bind") == pytest.approx(0.4)
+    # e2e: A 2.0, B 3.2
+    assert h.count(stage="e2e") == 2
+    assert h.total_sum(stage="e2e") == pytest.approx(5.2)
+    # terminal events close the open-attempt state
+    assert ev.snapshot()["open_attempts"] == 0
+
+
+def test_slo_vectorized_join_matches_scalar_reference():
+    """The worker's vectorized join (coalesced same-kind segments, numpy
+    gather/scatter) must produce bit-identical cumulative accounting to
+    the scalar reference loop on a randomized lifecycle stream —
+    including requeue/backoff cycles, mid-flight joins (pop before any
+    enqueue was seen), and bulk runs sharing one stamp."""
+    import random
+
+    from kubernetes_tpu.observability.slo import SLOEvaluator, SERIES
+
+    rng = random.Random(1234)
+    t = [100.0]
+
+    def tick():
+        t[0] += rng.random() * 0.05
+        return t[0]
+
+    # build (mono, [(uid, kind, detail)...]) pairs: interleave singleton
+    # enqueues with bulk stage runs, some pods failing into backoff
+    pairs = []
+    flying = []
+    for wave in range(6):
+        new = [f"w{wave}-p{i}" for i in range(rng.randrange(30, 120))]
+        for u in new:
+            pairs.append((tick(), [(u, "enqueue", None)]))
+        flying.extend(new)
+        rng.shuffle(flying)
+        batch, flying = flying[:96], flying[96:]
+        if not batch:
+            continue
+        m = tick()
+        pairs.append((m, [(u, "pop", None) for u in batch]))
+        fail = [u for u in batch if rng.random() < 0.25]
+        ok = [u for u in batch if u not in fail]
+        if fail:
+            m = tick()
+            pairs.append(
+                (m, [(u, "unschedulable", {"plugins": ["X"]}) for u in fail])
+            )
+            pairs.append((tick(), [(u, "requeue", {"to": "backoff"}) for u in fail]))
+            flying.extend(fail)  # re-pop next wave
+        if ok:
+            pairs.append((tick(), [(u, "assumed", None) for u in ok]))
+            pairs.append((tick(), [(u, "bind_start", None) for u in ok]))
+            pairs.append((tick(), [(u, "bound", None) for u in ok]))
+    # a pod the tier never saw enqueue for (armed mid-flight)
+    pairs.append((tick(), [("midflight", "pop", None)]))
+    pairs.append((tick(), [("midflight", "assumed", None)]))
+    pairs.append((tick(), [("midflight", "bound", None)]))
+
+    ref = SLOEvaluator(_slo_cfg(eval_interval_s=3600.0))
+    vec = SLOEvaluator(_slo_cfg(eval_interval_s=3600.0))
+    for mono, events in pairs:
+        ref.ingest([(mono, u, k, d) for u, k, d in events])
+    with vec._mu:
+        vec._join_pairs_locked(pairs)
+    for s in SERIES:
+        rc, rsum, rn = ref._slo_cum[s]
+        vc, vsum, vn = vec._slo_cum[s]
+        assert rn == vn, (s, rn, vn)
+        assert list(rc) == list(vc), s
+        assert rsum == pytest.approx(vsum, abs=1e-9)
+        assert list(ref._win_cur[s]) == list(vec._win_cur[s]), s
+    for ro, vo in zip(ref._slo_objs, vec._slo_objs):
+        assert (ro.n_cur, ro.bad_cur) == (vo.n_cur, vo.bad_cur)
+    assert len(ref._slo_idx) == len(vec._slo_idx)
+    assert set(ref._slo_idx) == set(vec._slo_idx)
+
+
+def test_slo_attribution_on_real_drain_matches_ring():
+    """On a real scheduled batch, the joined stage durations must
+    reconcile with the mono stamps retained in the flight-recorder ring."""
+    s, bound = _mk_sched()
+    s.install_slo(_slo_cfg())
+    for n in _nodes(3):
+        s.on_node_add(n)
+    pods = [_pod(f"sp{i}") for i in range(6)]
+    for p in pods:
+        s.on_pod_add(p)
+    s.schedule_pending()
+    s.slo.flush()  # read-your-writes barrier for the async sink
+    s.slo.gauge_rows()  # sync the registry histogram
+    h = s.slo._stage_hist
+    assert h.count(stage="e2e") == 6
+    assert h.count(stage="dispatch") == 6
+    for p in pods:
+        evs = {e["kind"]: e["mono"] for e in s.flight.events_for(p.uid)}
+        assert {"enqueue", "pop", "assumed", "bind_start", "bound"} <= set(evs)
+        assert evs["enqueue"] <= evs["pop"] <= evs["assumed"] <= evs["bound"]
+    # the cumulative e2e sum equals the per-pod ring deltas (same stamps)
+    ring_e2e = sum(
+        next(e["mono"] for e in s.flight.events_for(p.uid) if e["kind"] == "bound")
+        - next(e["mono"] for e in s.flight.events_for(p.uid) if e["kind"] == "enqueue")
+        for p in pods
+    )
+    assert h.total_sum(stage="e2e") == pytest.approx(ring_e2e, abs=1e-6)
+
+
+def test_slo_breach_freezes_and_dumps_blackbox_ring(tmp_path):
+    """An impossible SLO during a throttled run must auto-dump a
+    Perfetto-loadable black-box trace whose window covers the breach —
+    with nobody having started a capture."""
+    from kubernetes_tpu.observability.slo import SLOObjective
+
+    s, bound = _mk_sched()
+    s.install_slo(
+        _slo_cfg(
+            objectives=[SLOObjective("bind_p99", "bind", 0.99, 1e-9)],
+            dump_dir=str(tmp_path),
+            # one breach only: the ring frozen MID-DRAIN holds the spans
+            # of the window leading up to it (a cooldown of 0 would dump
+            # and re-arm repeatedly, leaving the last ring near-empty)
+            breach_cooldown_s=3600.0,
+        )
+    )
+    assert s.tracer.stats()["mode"] == "blackbox"
+    for n in _nodes(3):
+        s.on_node_add(n)
+    for i in range(12):
+        s.on_pod_add(_pod(f"bb{i}"))
+    s.schedule_pending()
+    s.slo.evaluate()  # settle any cadence race — breach is deterministic
+    snap = s.slo.snapshot()
+    assert snap["breaches_total"] >= 1
+    rec = snap["last_breach"]
+    assert rec["objective"] == "bind_p99"
+    assert rec["measured_s"] > rec["threshold_s"]
+    assert rec["window_samples"] >= 4
+    assert rec["burn_rate"] > 1.0
+    # the artifact was dumped without any manual capture and parses as a
+    # Chrome trace whose events all precede the freeze point
+    assert rec["trace"] and os.path.exists(rec["trace"])
+    with open(rec["trace"]) as f:
+        trace = json.load(f)
+    evs = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+    assert evs, "ring dump contains no spans"
+    for e in evs:
+        assert e["ts"] >= 0 and e["dur"] >= 0
+        assert e["ts"] + e["dur"] <= rec["breach_offset_us"] + 1e4
+    # the ring re-armed itself for the next incident
+    assert s.tracer.stats()["mode"] == "blackbox"
+    assert s.tracer.enabled
+    # with the artifact on disk the export is NOT also pinned in memory
+    assert s.slo.last_breach_trace() is None
+
+
+def test_breach_dump_failure_falls_back_and_keeps_tier_alive(tmp_path):
+    """An unwritable dump_dir must not kill the breach path (or the
+    worker thread it runs on): the record files with trace=None, the
+    export is retained in memory instead, the ring re-arms, and the
+    error is counted."""
+    from kubernetes_tpu.observability.slo import SLOObjective
+
+    blocker = tmp_path / "not-a-dir"
+    blocker.write_text("a file where makedirs expects a directory")
+    s, bound = _mk_sched()
+    s.install_slo(
+        _slo_cfg(
+            objectives=[SLOObjective("bind_p99", "bind", 0.99, 1e-9)],
+            dump_dir=str(blocker),
+            breach_cooldown_s=3600.0,
+        )
+    )
+    for n in _nodes(2):
+        s.on_node_add(n)
+    for i in range(8):
+        s.on_pod_add(_pod(f"df{i}"))
+    s.schedule_pending()
+    s.slo.evaluate()
+    snap = s.slo.snapshot()
+    assert snap["breaches_total"] == 1
+    assert snap["last_breach"]["trace"] is None
+    assert snap["ingest_errors"] >= 1
+    # the in-memory fallback serves what the disk couldn't take
+    assert s.slo.last_breach_trace() is not None
+    # and the tier is still alive: ring re-armed, evaluation still runs
+    assert s.tracer.stats()["mode"] == "blackbox" and s.tracer.enabled
+    assert s.slo.evaluate() is None  # cooldown holds; no crash
+
+
+def test_manual_capture_rearms_blackbox_on_export():
+    """The documented manual flow (start → stop → export) overrides the
+    always-on ring; export is its terminal step and must RE-ARM the ring
+    so the breach-dump guarantee survives operator captures."""
+    from kubernetes_tpu.server import SchedulerServer
+    from kubernetes_tpu.testing.fake_cluster import FakeCluster
+
+    api = FakeCluster()
+    sched = Scheduler()
+    api.connect(sched)
+    sched.install_slo(_slo_cfg())
+    assert sched.tracer.stats()["mode"] == "blackbox"
+    server = SchedulerServer(sched, ground_truth=api.ground_truth)
+    server.start()
+    try:
+        port = server.port
+        _get_json(port, "/debug/trace?action=start")
+        assert sched.tracer.stats()["mode"] == "capture"
+        _get_json(port, "/debug/trace?action=stop")
+        code, trace = _get_json(port, "/debug/trace?action=export")
+        assert code == 200 and "traceEvents" in trace
+        st = sched.tracer.stats()
+        assert st["mode"] == "blackbox" and st["enabled"]
+    finally:
+        server.stop()
+
+
+def test_blackbox_ring_evicts_oldest():
+    tr = Tracer()
+    tr.blackbox_start(capacity=5)
+    for i in range(9):
+        tr.instant(f"e{i}")
+    st = tr.stats()
+    assert st["mode"] == "blackbox"
+    assert st["events"] == 5 and st["evicted"] == 4 and st["dropped"] == 0
+    names = [e["name"] for e in tr.export()["traceEvents"] if e.get("ph") == "i"]
+    assert names == ["e4", "e5", "e6", "e7", "e8"]  # recent history wins
+    # freeze keeps the window and stops recording; manual start() leaves
+    # ring mode entirely
+    frozen = tr.blackbox_freeze()
+    assert not tr.enabled and frozen["freeze_offset_us"] > 0
+    tr.start()
+    assert tr.stats()["mode"] == "capture"
+    assert tr.blackbox_freeze() is None
+
+
+def test_blackbox_mode_off_is_noop():
+    """Without install_slo nothing records: the tracer stays disabled
+    (one attribute read per site), the flight recorder has no sink, and
+    /debug-visible SLO state reports uninstalled."""
+    s, bound = _mk_sched()
+    assert s.slo is None
+    assert s.flight.sink is None
+    for n in _nodes(2):
+        s.on_node_add(n)
+    for i in range(4):
+        s.on_pod_add(_pod(f"nb{i}"))
+    s.schedule_pending()
+    st = s.tracer.stats()
+    assert st["events"] == 0 and st["evicted"] == 0
+    assert not s.tracer.enabled
+    # installing with blackbox=False attributes latency but records no spans
+    s2, _ = _mk_sched()
+    s2.install_slo(_slo_cfg(blackbox=False))
+    for n in _nodes(2):
+        s2.on_node_add(n)
+    s2.on_pod_add(_pod("nb-attr"))
+    s2.schedule_pending()
+    assert s2.tracer.stats()["events"] == 0
+    assert not s2.tracer.enabled
+    s2.slo.flush()  # read-your-writes barrier for the async sink
+    s2.slo.gauge_rows()  # sync the registry histogram
+    assert s2.slo._stage_hist.count(stage="e2e") == 1
+
+
+def test_debug_slo_endpoint_schema():
+    from kubernetes_tpu.server import SchedulerServer
+    from kubernetes_tpu.testing.fake_cluster import FakeCluster
+
+    api = FakeCluster()
+    sched = Scheduler()
+    api.connect(sched)
+    for n in _nodes(3):
+        api.create_node(n)
+    server = SchedulerServer(sched, ground_truth=api.ground_truth)
+    server.start()
+    try:
+        port = server.port
+        # uninstalled: explicit "not enabled" body, still JSON
+        code, body = _get_json(port, "/debug/slo")
+        assert code == 200 and body == {"enabled": False}
+        sched.install_slo(_slo_cfg())
+        api.create_pod(_pod("slo-pod"))
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if sched.slo._stage_hist.count(stage="e2e") >= 1:
+                break
+            time.sleep(0.05)
+        code, snap = _get_json(port, "/debug/slo")
+        assert code == 200
+        assert snap["enabled"] is True
+        assert {"objectives", "stages", "breaches_total", "last_breach",
+                "blackbox", "window_s"} <= set(snap)
+        for o in snap["objectives"]:
+            assert {"name", "series", "quantile", "threshold_s",
+                    "current_s", "burn_rate", "window_samples",
+                    "breached"} <= set(o)
+        for stage in ("queue_wait", "backoff", "dispatch", "commit",
+                      "bind", "e2e"):
+            st = snap["stages"][stage]
+            assert {"count", "sum_s", "p50_s", "p99_s"} <= set(st)
+        assert snap["stages"]["e2e"]["count"] >= 1
+        assert snap["blackbox"]["mode"] == "blackbox"
+        # no breach yet → trace action 404s with a JSON error
+        code, err = _get_json(port, "/debug/slo?action=trace")
+        assert code == 404 and "error" in err
+        code, err = _get_json(port, "/debug/slo?action=bogus")
+        assert code == 400 and "error" in err
+        # burn-rate gauge rides the scrape
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10
+        ) as r:
+            text = r.read().decode()
+        assert "scheduler_tpu_slo_burn_rate" in text
+        assert "scheduler_tpu_slo_stage_duration_seconds" in text
+    finally:
+        server.stop()
+
+
+def test_sli_duration_immune_to_queue_clock_jumps():
+    """The e2e SLI derives from the monotonic enqueue stamp: a manual /
+    wall clock jumping forward 1e6 s between enqueue and drain must not
+    smear the latency histogram (satellite: scheduler.py computed it on
+    the injectable clock before)."""
+    now = [1000.0]
+    s = Scheduler(clock=lambda: now[0])
+    bound = {}
+    s.binding_sink = lambda pod, node: bound.__setitem__(pod.uid, node)
+    for n in _nodes(2):
+        s.on_node_add(n)
+    s.on_pod_add(_pod("jump"))
+    now[0] += 1e6  # the clock jump
+    s.schedule_pending()
+    assert bound
+    h = s.prom.pod_scheduling_sli_duration
+    assert h.count(attempts="1") == 1
+    assert h.total_sum(attempts="1") < 60.0  # real seconds, not the 1e6 jump
+
+
+def test_attempt_duration_carries_batch_size_label():
+    s, bound = _mk_sched()
+    for n in _nodes(3):
+        s.on_node_add(n)
+    for i in range(4):
+        s.on_pod_add(_pod(f"bl{i}"))
+    s.schedule_pending()
+    text = s.expose_metrics()
+    line = next(
+        l for l in text.splitlines()
+        if l.startswith("scheduler_scheduling_attempt_duration_seconds_bucket")
+    )
+    assert 'batch="' in line
+    from kubernetes_tpu.metrics import batch_size_bucket
+
+    assert batch_size_bucket(1) == "1"
+    assert batch_size_bucket(4) == "2-15"
+    assert batch_size_bucket(100) == "16-255"
+    assert batch_size_bucket(5000) == "4096+"
+
+
+def test_arrival_harness_latency_monotone_in_offered_load():
+    """A deterministic (seeded) two-point --arrival run: offered load far
+    past the serving capacity must show strictly worse p99 than a lightly
+    loaded run, and the curve schema must match what config9 publishes."""
+    bench = _load_bench()
+    out = bench.run_arrival_harness(
+        n_nodes=150,
+        rates=(40.0, 4000.0),
+        duration_s=1.2,
+        seed=7,
+        slo_p99_s=1.0,
+        warm_pods=512,
+        settle_timeout_s=60.0,
+    )
+    curve = out["curve"]
+    assert [c["rate"] for c in curve] == [40.0, 4000.0]
+    for c in curve:
+        assert {"rate", "offered", "bound", "unbound", "p50_ms", "p99_ms",
+                "achieved_pods_per_s", "met_slo"} <= set(c)
+    lo, hi = curve
+    assert lo["unbound"] == 0 and lo["p99_ms"] is not None
+    # saturation: either the p99 blew past the light-load p99, or pods
+    # didn't even finish (censored +Inf ranks above every finite sample)
+    assert hi["p99_ms"] is None or hi["p99_ms"] > lo["p99_ms"]
+    assert out["max_rate_at_slo"] in (40.0, 4000.0, 0.0)
+    assert out["slo_p99_ms"] == 1000.0
