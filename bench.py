@@ -724,10 +724,38 @@ def _arrival_env_kwargs():
     return kw
 
 
+def analyze_preflight(err=None) -> bool:
+    """`--analyze`: static-analysis preflight.  Bench JSON is ratchet
+    input (BENCH_FLOORS) — numbers recorded from a tree that violates the
+    lock/purity/jit/d2h/donation/clamp/retrace invariants are numbers
+    from a tree whose correctness story is broken, so a finding refuses
+    the run.  Returns True when the tree is clean."""
+    err = err if err is not None else sys.stderr
+    from kubernetes_tpu.analysis import render_text, run_analysis
+
+    findings = run_analysis()
+    if findings:
+        print(render_text(findings), file=err)
+        print(
+            f"# bench: refusing to record bench JSON — {len(findings)} "
+            "analyzer finding(s); fix them (or suppress with a reason) "
+            "and re-run",
+            file=err,
+        )
+        return False
+    print("# bench: analysis preflight clean", file=err)
+    return True
+
+
 def main():
     n_nodes = int(os.environ.get("BENCH_NODES", "5000"))
     n_pods = int(os.environ.get("BENCH_PODS", "10000"))
     full = os.environ.get("BENCH_FULL", "1") != "0"
+
+    # --analyze: refuse to emit any bench artifact from a dirty tree
+    if "--analyze" in sys.argv[1:]:
+        if not analyze_preflight():
+            sys.exit(1)
 
     # --arrival: standalone open-loop serving sweep (no full bench)
     if "--arrival" in sys.argv[1:]:

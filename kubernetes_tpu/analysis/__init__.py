@@ -1,17 +1,30 @@
 """Invariant analyzers for the TPU scheduler (``python -m kubernetes_tpu.analysis``).
 
-Three AST checkers guard the contracts PR 1's concurrency layering relies
-on (the race-detector/vet role the reference scheduler gets from the Go
-toolchain):
+Seven AST checkers guard the contracts the concurrency layering and the
+device boundary rely on (the race-detector/vet role the reference
+scheduler gets from the Go toolchain):
 
   * ``lock-discipline`` — registered lock-guarded fields are only mutated
     under their lock or in callers-verified ``*_under_lock`` methods;
   * ``plugin-purity`` — ``pre_filter_spec_pure`` plugins keep their spec
     path free of state reads/writes;
   * ``jit-boundary`` — nothing reachable from the jitted pipelines in
-    ``ops/`` host-syncs or branches on tracers.
+    ``ops/`` host-syncs or branches on tracers;
+  * ``d2h-leak`` — every BLOCKING device→host fetch on the host side
+    routes through ``Scheduler._d2h`` (the round-trip accounting choke
+    point), nothing coerces/truth-tests a device value ad hoc;
+  * ``donation`` — no caller reads a buffer after donating it to a
+    ``donate_argnums`` kernel, and every donating kernel is documented
+    in RESIDENT.md's donation/aliasing contract;
+  * ``slice-clamp`` — ``dynamic_update_slice``/``.at[...].set`` with a
+    traced start is only allowed with a padded destination, a provably
+    static start, or a justified suppression (XLA clamps/drops
+    out-of-range window writes SILENTLY);
+  * ``retrace`` — no weak-typed Python scalars or unbucketed
+    shape-derived static args leak into jit signatures.
 
-Plus a runtime sanitizer (``KTPU_SANITIZE=1``, see ``sanitizer.py``).
+Plus a runtime sanitizer (``KTPU_SANITIZE=1``, see ``sanitizer.py``),
+including the jit recompile hook (``scheduler_tpu_jit_recompiles_total``).
 Suppressions: ``# ktpu: allow(<rule>) — <reason>`` (reason mandatory).
 """
 
@@ -27,11 +40,16 @@ from kubernetes_tpu.analysis.core import (
     render_json,
     render_text,
 )
+from kubernetes_tpu.analysis.clamp import ClampChecker
+from kubernetes_tpu.analysis.d2h import D2HChecker
+from kubernetes_tpu.analysis.donation import DonationChecker
 from kubernetes_tpu.analysis.jit import JitChecker
 from kubernetes_tpu.analysis.locks import LockChecker
 from kubernetes_tpu.analysis.purity import PurityChecker
+from kubernetes_tpu.analysis.retrace import RetraceChecker
 
 _PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_REPO_ROOT = os.path.dirname(_PKG_ROOT)
 
 # the shipped tree's checker targets
 LOCK_MODULES = (
@@ -75,13 +93,50 @@ JIT_MODULES = (
     os.path.join("ops", "wave.py"),
     os.path.join("ops", "wire.py"),
 )
+# host modules that handle device values — the d2h-leak surface.
+# ops/pipeline.py is targeted but allowlisted inside the checker (the
+# standalone parity harness has no Scheduler, hence no counters to feed).
+D2H_MODULES = (
+    "scheduler.py",
+    "fastpath.py",
+    os.path.join("cache", "mirror.py"),
+    os.path.join("cache", "device_mirror.py"),
+    os.path.join("observability", "explain.py"),
+    os.path.join("ops", "pipeline.py"),
+    os.path.join("ops", "wire.py"),
+)
+# donation roots live in the kernels; the callers that can hold dead
+# references are the scheduler and the device-mirror glue
+DONATION_MODULES = JIT_MODULES + (
+    os.path.join("cache", "device_mirror.py"),
+    "scheduler.py",
+    "fastpath.py",
+)
+CLAMP_MODULES = JIT_MODULES + (os.path.join("cache", "device_mirror.py"),)
+RETRACE_MODULES = JIT_MODULES + (
+    os.path.join("cache", "device_mirror.py"),
+    "scheduler.py",
+    "fastpath.py",
+    os.path.join("observability", "explain.py"),
+)
+# the repo-root bench driver fetches through the Scheduler's public API —
+# checked when running from a source tree
+_BENCH = os.path.join(_REPO_ROOT, "bench.py")
+DONATION_CONTRACT_DOC = os.path.join(_REPO_ROOT, "RESIDENT.md")
 
 
 def default_targets() -> Dict[str, List[str]]:
+    d2h = [os.path.join(_PKG_ROOT, p) for p in D2H_MODULES]
+    if os.path.exists(_BENCH):
+        d2h.append(_BENCH)
     return {
         "locks": [os.path.join(_PKG_ROOT, p) for p in LOCK_MODULES],
         "purity": [os.path.join(_PKG_ROOT, p) for p in PURITY_MODULES],
         "jit": [os.path.join(_PKG_ROOT, p) for p in JIT_MODULES],
+        "d2h": d2h,
+        "donation": [os.path.join(_PKG_ROOT, p) for p in DONATION_MODULES],
+        "clamp": [os.path.join(_PKG_ROOT, p) for p in CLAMP_MODULES],
+        "retrace": [os.path.join(_PKG_ROOT, p) for p in RETRACE_MODULES],
     }
 
 
@@ -90,9 +145,13 @@ def run_analysis(
 ) -> List[Finding]:
     """Run every checker over its target file set; returns ALL findings
     (post-suppression), sorted by path/line.  ``targets`` maps checker key
-    ('locks'/'purity'/'jit') → file paths; defaults to the shipped tree.
+    ('locks'/'purity'/'jit'/'d2h'/'donation'/'clamp'/'retrace') → file
+    paths; defaults to the shipped tree.  The donation contract document
+    (RESIDENT.md) is only consulted on shipped-tree runs — fixture runs
+    override 'donation' and skip it.
     """
     t = dict(default_targets())
+    fixture_donation = targets is not None and "donation" in targets
     if targets is not None:
         t.update({k: list(v) for k, v in targets.items()})
 
@@ -120,6 +179,26 @@ def run_analysis(
     jc = JitChecker()
     jc.run(load(t.get("jit", ())))
     findings.extend(jc.findings)
+
+    dc = D2HChecker()
+    dc.run(load(t.get("d2h", ())), root_mods=load(t.get("jit", ())))
+    findings.extend(dc.findings)
+
+    contract = None
+    if not fixture_donation and os.path.exists(DONATION_CONTRACT_DOC):
+        with open(DONATION_CONTRACT_DOC, "r", encoding="utf-8") as f:
+            contract = f.read()
+    nc = DonationChecker()
+    nc.run(load(t.get("donation", ())), contract_text=contract)
+    findings.extend(nc.findings)
+
+    cc = ClampChecker()
+    cc.run(load(t.get("clamp", ())))
+    findings.extend(cc.findings)
+
+    rc = RetraceChecker()
+    rc.run(load(t.get("retrace", ())))
+    findings.extend(rc.findings)
 
     findings.extend(collect_bare_suppressions(loaded.values()))
     findings.sort(key=lambda f: (f.path, f.line, f.rule))

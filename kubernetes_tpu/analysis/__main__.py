@@ -31,7 +31,8 @@ def main(argv: List[str] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m kubernetes_tpu.analysis",
         description="Static invariant analysis (lock-discipline, "
-        "plugin-purity, jit-boundary).",
+        "plugin-purity, jit-boundary, d2h-leak, donation, slice-clamp, "
+        "retrace).",
     )
     ap.add_argument("paths", nargs="*", help="files to analyze (default: shipped tree)")
     ap.add_argument("--json", action="store_true", help="JSON report on stdout")
@@ -49,6 +50,10 @@ def main(argv: List[str] = None) -> int:
                 "locks": args.paths,
                 "purity": args.paths,
                 "jit": args.paths,
+                "d2h": args.paths,
+                "donation": args.paths,
+                "clamp": args.paths,
+                "retrace": args.paths,
             }
             findings = run_analysis(targets)
         else:

@@ -1,0 +1,207 @@
+"""Slice-clamp hazard checker (rule: ``slice-clamp``).
+
+XLA CLAMPS an out-of-range ``dynamic_update_slice`` start index: a window
+write whose traced start would run past the array end silently shifts
+backwards onto earlier rows and overwrites them — the PR 6 bug class
+(``ops/resident.py`` once corrupted earlier results this way, fixed by
+padding the choices buffer).  ``.at[...].set`` is the sibling hazard:
+its out-of-bounds writes are silently DROPPED unless the author spells
+an explicit ``mode=``.
+
+The checker rides the jit checker's staticness machinery (same
+reachability from the ``jax.jit`` roots, same abstract interpretation of
+which values are trace-time constants), and flags:
+
+  * ``jax.lax.dynamic_update_slice(dst, delta, start)`` where any start
+    component is traced, and
+  * ``x.at[idx].set(...)`` where ``idx`` is traced and no explicit
+    ``mode=`` keyword is given,
+
+UNLESS the hazard is discharged by one of the accepted proofs:
+
+  * the start/index is provably static (trace-time constant — the
+    staticness fixpoint says so), or
+  * the destination is provably padded: it was constructed in the same
+    function by ``jnp.full/zeros/ones/empty`` with a leading dimension
+    spelled as a SUM (``(P + W,)``) — the sanctioned padded-buffer idiom
+    from the resident fixed point, or
+  * a ``# ktpu: allow(slice-clamp) — <why the start is bounded>``
+    suppression, which forces the boundedness argument into the diff
+    (see ops/chain.py: the append cursors are bounded by the scheduler's
+    host-side capacity check before dispatch).
+
+``.at[...].add`` scatter-adds and ``dynamic_slice`` READS are out of
+scope: a clamped read duplicates a value, it does not corrupt committed
+state.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set, Tuple
+
+from kubernetes_tpu.analysis.core import RULE_CLAMP, SourceModule, dotted_name
+from kubernetes_tpu.analysis.jit import JitChecker, _FuncInfo
+
+PADDED_CTORS = {"full", "zeros", "ones", "empty"}
+
+
+class ClampChecker(JitChecker):
+    rule = RULE_CLAMP
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._seen: Set[Tuple[str, int, str]] = set()
+
+    # jit-boundary emission is jit.py's job — this subclass only reuses
+    # the reachability + staticness machinery
+    def _violation(self, f: _FuncInfo, line: int, message: str) -> None:
+        pass
+
+    def _check_call(self, f, base, node, env) -> None:
+        func = node.func
+        dn = dotted_name(func)
+        if dn is not None and dn.split(".")[-1] == "dynamic_update_slice":
+            start = None
+            if len(node.args) >= 3:
+                start = node.args[2]
+            else:
+                for kw in node.keywords:
+                    if kw.arg == "start_indices":
+                        start = kw.value
+            dest = node.args[0] if node.args else None
+            if (
+                start is not None
+                and not self._static(f, base, start, env)
+                and not self._padded_dest(f, dest)
+            ):
+                self._clamp(
+                    f,
+                    node.lineno,
+                    "dynamic_update_slice with a traced start — XLA clamps "
+                    "an out-of-range start and the window write silently "
+                    "shifts onto earlier rows; pad the destination by the "
+                    "window size or prove the start bounded",
+                )
+            return
+        if (
+            isinstance(func, ast.Attribute)
+            and func.attr == "set"
+            and isinstance(func.value, ast.Subscript)
+            and isinstance(func.value.value, ast.Attribute)
+            and func.value.value.attr == "at"
+        ):
+            if any(kw.arg == "mode" for kw in node.keywords):
+                return  # explicit out-of-bounds semantics — author chose
+            idx = func.value.slice
+            dest = func.value.value.value
+            if not self._static(f, base, idx, env) and not self._padded_dest(
+                f, dest
+            ):
+                self._clamp(
+                    f,
+                    node.lineno,
+                    ".at[...].set with a traced index silently DROPS "
+                    "out-of-bounds writes — pass an explicit mode= or "
+                    "prove the index bounded",
+                )
+
+    def _clamp(self, f: _FuncInfo, line: int, message: str) -> None:
+        if not self._emit_mode:
+            return
+        key = (f.mod.path, line, message)
+        if key in self._seen:
+            return  # nested fns are analyzed from several contexts
+        self._seen.add(key)
+        fn_name = f.key.split(":", 1)[1]
+        self.emit(f.mod, line, f"{fn_name}: {message}")
+
+    def _padded_dest(self, f: _FuncInfo, dest: Optional[ast.expr]) -> bool:
+        """True when ``dest`` is a local name constructed (in this function
+        or an enclosing one) with a padded leading dimension — either
+        directly, or through a ``lax.while_loop`` carry whose matching
+        init element is padded (the resident fixed point's idiom: the
+        loop body unpacks ``choices`` from the carry, and the init tuple
+        seeds it with ``jnp.full((P + W,), …)``)."""
+        if not isinstance(dest, ast.Name):
+            return False
+        if self._padded_binding(f, dest.id):
+            return True
+        return self._padded_carry(f, dest.id)
+
+    def _padded_binding(self, f: _FuncInfo, name: str) -> bool:
+        scope: Optional[_FuncInfo] = f
+        while scope is not None:
+            for n in ast.walk(scope.node):
+                if isinstance(n, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == name
+                    for t in n.targets
+                ):
+                    if self._is_padded_ctor(n.value):
+                        return True
+            scope = scope.enclosing
+        return False
+
+    def _padded_carry(self, f: _FuncInfo, name: str) -> bool:
+        """``name`` unpacked at position i from this loop-body function's
+        carry parameter, and some enclosing scope runs
+        ``while_loop(cond, <this body>, (..., init_i, ...))`` with a
+        padded init at position i."""
+        if len(f.params) != 1:
+            return False
+        carry = f.params[0]
+        idx = None
+        for n in f.node.body:
+            if (
+                isinstance(n, ast.Assign)
+                and len(n.targets) == 1
+                and isinstance(n.targets[0], ast.Tuple)
+                and isinstance(n.value, ast.Name)
+                and n.value.id == carry
+            ):
+                for i, el in enumerate(n.targets[0].elts):
+                    if isinstance(el, ast.Name) and el.id == name:
+                        idx = i
+                        break
+        if idx is None:
+            return False
+        body_name = f.node.name
+        scope = f.enclosing
+        while scope is not None:
+            for n in ast.walk(scope.node):
+                if not isinstance(n, ast.Call):
+                    continue
+                dn = dotted_name(n.func)
+                if dn is None or dn.split(".")[-1] != "while_loop":
+                    continue
+                if len(n.args) < 3:
+                    continue
+                if not (
+                    isinstance(n.args[1], ast.Name)
+                    and n.args[1].id == body_name
+                ):
+                    continue
+                init = n.args[2]
+                if isinstance(init, ast.Tuple) and idx < len(init.elts):
+                    el = init.elts[idx]
+                    if self._is_padded_ctor(el):
+                        return True
+                    if isinstance(el, ast.Name) and self._padded_binding(
+                        scope, el.id
+                    ):
+                        return True
+            scope = scope.enclosing
+        return False
+
+    @staticmethod
+    def _is_padded_ctor(value: ast.expr) -> bool:
+        if not isinstance(value, ast.Call):
+            return False
+        dn = dotted_name(value.func)
+        if dn is None or dn.split(".")[-1] not in PADDED_CTORS:
+            return False
+        if not value.args:
+            return False
+        shape = value.args[0]
+        lead = shape.elts[0] if isinstance(shape, ast.Tuple) and shape.elts else shape
+        return isinstance(lead, ast.BinOp) and isinstance(lead.op, ast.Add)

@@ -23,9 +23,22 @@ from typing import Dict, Iterable, List, Optional, Sequence
 RULE_LOCK = "lock-discipline"
 RULE_PURITY = "plugin-purity"
 RULE_JIT = "jit-boundary"
+RULE_D2H = "d2h-leak"
+RULE_DONATION = "donation"
+RULE_CLAMP = "slice-clamp"
+RULE_RETRACE = "retrace"
 RULE_BARE_SUPPRESSION = "bare-suppression"
 
-ALL_RULES = (RULE_LOCK, RULE_PURITY, RULE_JIT, RULE_BARE_SUPPRESSION)
+ALL_RULES = (
+    RULE_LOCK,
+    RULE_PURITY,
+    RULE_JIT,
+    RULE_D2H,
+    RULE_DONATION,
+    RULE_CLAMP,
+    RULE_RETRACE,
+    RULE_BARE_SUPPRESSION,
+)
 
 # `# ktpu: allow(rule[, rule...]) — reason`  (em/en/double/single dash or
 # colon all accepted as the reason separator; the reason is mandatory)
@@ -123,6 +136,78 @@ def dotted_name(node: ast.AST) -> Optional[str]:
 
 def call_name(node: ast.Call) -> Optional[str]:
     return dotted_name(node.func)
+
+
+class ImportRefs:
+    """Module-wide import tables (module-level AND function-local imports —
+    the scheduler defers most ops imports into the methods that use them).
+
+    ``mod_alias`` maps a local name to an in-package MODULE's base name
+    (``from kubernetes_tpu.ops import fastpath as ops_fp`` → ``ops_fp`` →
+    ``'fastpath'``); ``sym_alias`` maps a local name to ``(module base,
+    symbol)`` for direct symbol imports.  Module-vs-symbol is decided by
+    the package's own convention: modules are lowercase and imported from
+    a package path at most two levels deep.
+    """
+
+    def __init__(self, tree: ast.Module):
+        self.mod_alias: Dict[str, str] = {}
+        self.sym_alias: Dict[str, tuple] = {}
+        self.np_roots: set = set()
+        self.jnp_roots: set = set()
+        self.jax_roots: set = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    local = a.asname or a.name.split(".")[0]
+                    if a.name == "numpy":
+                        self.np_roots.add(local)
+                    elif a.name == "jax.numpy" and a.asname:
+                        self.jnp_roots.add(a.asname)
+                    elif a.name == "jax" or a.name.startswith("jax."):
+                        self.jax_roots.add(local)
+            elif isinstance(node, ast.ImportFrom):
+                m = node.module or ""
+                for a in node.names:
+                    local = a.asname or a.name
+                    if m == "numpy":
+                        self.np_roots.add(local)
+                    elif m == "jax" and a.name == "numpy":
+                        self.jnp_roots.add(local)
+                    elif m == "jax":
+                        self.jax_roots.add(local)
+                    elif m == "kubernetes_tpu" or m.startswith("kubernetes_tpu."):
+                        if a.name[:1].islower() and m.count(".") <= 1:
+                            self.mod_alias[local] = a.name
+                        else:
+                            self.sym_alias[local] = (m.rsplit(".", 1)[-1], a.name)
+
+
+def resolve_root(refs: ImportRefs, self_roots: dict, roots_by_base: dict,
+                 func: ast.AST):
+    """Resolve a call target to a registered root through the import
+    alias tables — shared by the donation and retrace checkers.
+
+    ``self_roots`` is the CURRENT module's own name→root table, scoped by
+    PATH (two target modules sharing a basename — ops/explain.py and
+    observability/explain.py — must not resolve each other's bare names);
+    ``roots_by_base`` is the module-base-keyed table the sym/mod alias
+    lookups go through (import paths only carry the base)."""
+    dn = dotted_name(func)
+    if dn is None:
+        return None
+    parts = dn.split(".")
+    if len(parts) == 1:
+        r = self_roots.get(parts[0])
+        if r is not None:
+            return r
+        if parts[0] in refs.sym_alias:
+            m, s = refs.sym_alias[parts[0]]
+            return roots_by_base.get(m, {}).get(s)
+        return None
+    if len(parts) == 2 and parts[0] in refs.mod_alias:
+        return roots_by_base.get(refs.mod_alias[parts[0]], {}).get(parts[1])
+    return None
 
 
 def module_literal(tree: ast.Module, name: str):

@@ -1,0 +1,413 @@
+"""Device-boundary fetch checker (rule: ``d2h-leak``).
+
+PR 6 made ``Scheduler._d2h`` the choke point for every BLOCKING
+device→host fetch: it wraps ``jax.device_get`` with round-trip accounting
+(``scheduler_tpu_host_roundtrips_total`` / ``scheduler_tpu_d2h_bytes_total``)
+— the quantity the resident drain loop exists to minimize.  A fetch that
+bypasses the choke point undercounts the very metric used to judge that
+work, and usually marks an accidental sync on the hot path.
+
+The checker runs a small DEVICE-RESIDENCE taint analysis over the host
+modules that handle device values (the harvest half of the scheduler,
+the fast-path glue, the snapshot mirrors, debug explain):
+
+  * sources — calls into the jit roots indexed from ``ops/`` (resolved
+    through import aliases, the same reachability the jit checker uses),
+    ``jnp.*`` constructors, ``jax.device_put`` / ``jax.random.*``,
+    ``DeviceCluster.from_host``-style packers, and the repo's ``*_dev``
+    naming convention (names, attributes, and dict keys);
+  * propagation — through arithmetic, subscripts, tuple unpacking, and
+    methods of device values; if/else branches merge by union;
+  * cleanser — ``…._d2h(x)`` results are host values.
+
+Violations (all ``d2h-leak``): ``jax.device_get`` anywhere outside
+``Scheduler._d2h``; ``np.asarray``/``np.array`` (any host-numpy call) on
+a device value; ``.item()`` / ``.tolist()`` / ``.block_until_ready()``;
+``int()/float()/bool()`` coercions; and implicit truthiness (``if x:``,
+``while x:``, ``assert x``, ``not x``, ``and``/``or``) of a device value
+— each of those blocks on the device and dodges the accounting.
+``x is None`` identity checks and ``.copy_to_host_async()`` (the
+non-blocking prefetch) are exempt by design.
+
+Bench/debug harnesses with no Scheduler (hence no counters to feed) are
+allowlisted by basename — today only ``ops/pipeline.py``, the standalone
+parity pipeline.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Sequence, Set
+
+from kubernetes_tpu.analysis.core import (
+    RULE_D2H,
+    Checker,
+    ImportRefs,
+    SourceModule,
+    dotted_name,
+)
+from kubernetes_tpu.analysis.jit import _jit_decoration
+
+NEUTRAL_ATTRS = {"shape", "ndim", "dtype", "size", "nbytes", "itemsize"}
+BLOCKING_METHODS = {"item", "tolist", "block_until_ready"}
+NONBLOCKING_METHODS = {"copy_to_host_async"}
+CAST_BUILTINS = {"int", "float", "bool"}
+CHOKE_POINT = "_d2h"
+DEVICE_SUFFIX = "_dev"
+DEVICE_KEYS = {"dev"}
+# standalone bench/debug harnesses: no Scheduler exists there, so there
+# are no counters a routed fetch could feed
+ALLOW_BASENAMES = frozenset({"pipeline.py"})
+
+
+def _module_base(path: str) -> str:
+    return os.path.basename(path).rsplit(".", 1)[0]
+
+
+class D2HChecker(Checker):
+    rule = RULE_D2H
+
+    def __init__(self, allow_basenames: frozenset = ALLOW_BASENAMES):
+        super().__init__()
+        self.allow_basenames = frozenset(allow_basenames)
+        self.roots: Dict[str, Set[str]] = {}  # module base → jit-root names
+        # path-scoped view for each module's OWN bare names: two target
+        # modules sharing a basename (ops/explain.py and
+        # observability/explain.py) must not resolve each other's
+        self.roots_by_path: Dict[str, Set[str]] = {}
+        self._base = ""
+        self._path = ""
+        self._refs: Optional[ImportRefs] = None
+
+    # ----- entry point ------------------------------------------------------
+
+    def run(
+        self,
+        mods: Sequence[SourceModule],
+        root_mods: Sequence[SourceModule] = (),
+    ) -> None:
+        seen = set()
+        for mod in list(mods) + list(root_mods):
+            if mod.path in seen:
+                continue
+            seen.add(mod.path)
+            self._index_roots(mod)
+        for mod in mods:
+            if os.path.basename(mod.path) in self.allow_basenames:
+                continue
+            self._base = _module_base(mod.path)
+            self._path = mod.path
+            self._refs = ImportRefs(mod.tree)
+            self._check_module(mod)
+
+    def _index_roots(self, mod: SourceModule) -> None:
+        base = _module_base(mod.path)
+        merged = self.roots.setdefault(base, set())
+        per = self.roots_by_path.setdefault(mod.path, set())
+
+        def walk(fn: ast.AST) -> None:
+            for node in ast.iter_child_nodes(fn):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    if isinstance(node, ast.FunctionDef) and _jit_decoration(
+                        node
+                    ):
+                        merged.add(node.name)
+                        per.add(node.name)
+                    walk(node)
+                elif isinstance(node, (ast.ClassDef, ast.If, ast.Try)):
+                    walk(node)
+
+        walk(mod.tree)
+
+    # ----- module / function walk -------------------------------------------
+
+    def _check_module(self, mod: SourceModule) -> None:
+        for node in mod.tree.body:
+            if isinstance(node, ast.ClassDef):
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        self._check_function(mod, item)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._check_function(mod, node)
+
+    def _check_function(self, mod: SourceModule, fn: ast.FunctionDef) -> None:
+        if fn.name == CHOKE_POINT:
+            return  # the choke point itself is where the fetch belongs
+        if isinstance(fn, ast.FunctionDef) and _jit_decoration(fn):
+            return  # traced bodies are the jit-boundary checker's domain
+        env: Dict[str, bool] = {}
+        for a in fn.args.args + fn.args.kwonlyargs:
+            env[a.arg] = a.arg.endswith(DEVICE_SUFFIX)
+        self._walk_block(mod, fn.body, env)
+
+    def _walk_block(
+        self, mod: SourceModule, stmts: List[ast.stmt], env: Dict[str, bool]
+    ) -> None:
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._check_function(mod, st)
+                env[st.name] = False
+                continue
+            self._scan_stmt(mod, st, env)
+            if isinstance(st, ast.Assign):
+                self._bind(st.targets, st.value, env)
+            elif isinstance(st, ast.AnnAssign):
+                if st.value is not None:
+                    self._bind([st.target], st.value, env)
+            elif isinstance(st, ast.AugAssign):
+                if isinstance(st.target, ast.Name):
+                    env[st.target.id] = env.get(
+                        st.target.id, False
+                    ) or self._device(st.value, env)
+            elif isinstance(st, ast.If):
+                e1, e2 = dict(env), dict(env)
+                self._walk_block(mod, st.body, e1)
+                self._walk_block(mod, st.orelse, e2)
+                for k in set(e1) | set(e2):
+                    env[k] = e1.get(k, False) or e2.get(k, False)
+            elif isinstance(st, (ast.For, ast.While)):
+                e1 = dict(env)
+                if isinstance(st, ast.For):
+                    self._bind([st.target], st.iter, e1)
+                self._walk_block(mod, st.body, e1)
+                self._walk_block(mod, st.orelse, e1)
+                for k in set(e1):
+                    env[k] = env.get(k, False) or e1.get(k, False)
+            else:
+                for attr in ("body", "orelse", "finalbody"):
+                    sub = getattr(st, attr, None)
+                    if sub:
+                        self._walk_block(mod, sub, env)
+                for handler in getattr(st, "handlers", ()) or ():
+                    self._walk_block(mod, handler.body, env)
+
+    def _bind(
+        self,
+        targets: List[ast.expr],
+        value: ast.expr,
+        env: Dict[str, bool],
+    ) -> None:
+        dev = self._device(value, env)
+        for t in targets:
+            if isinstance(t, (ast.Tuple, ast.List)):
+                if isinstance(value, (ast.Tuple, ast.List)) and len(
+                    value.elts
+                ) == len(t.elts):
+                    for el, v in zip(t.elts, value.elts):
+                        self._bind([el], v, env)
+                else:
+                    for el in t.elts:
+                        if isinstance(el, ast.Name):
+                            env[el.id] = dev
+            elif isinstance(t, ast.Name):
+                env[t.id] = dev
+            # attribute/subscript stores: tracked via the *_dev / ["dev"]
+            # naming convention on the read side
+
+    # ----- sinks ------------------------------------------------------------
+
+    def _scan_stmt(
+        self, mod: SourceModule, st: ast.stmt, env: Dict[str, bool]
+    ) -> None:
+        if isinstance(st, (ast.If, ast.While)):
+            self._check_truthiness(mod, st.test, env)
+            self._scan_expr(mod, st.test, env)
+            return  # bodies are statements — handled by _walk_block
+        if isinstance(st, ast.Assert):
+            self._check_truthiness(mod, st.test, env)
+            self._scan_expr(mod, st.test, env)
+            return
+        for child in ast.iter_child_nodes(st):
+            if isinstance(child, ast.expr):
+                self._scan_expr(mod, child, env)
+            elif isinstance(child, ast.withitem):
+                # `with f(x_dev):` — withitems are not exprs, and a fetch
+                # hiding in a context header blocks like any other
+                self._scan_expr(mod, child.context_expr, env)
+
+    def _check_truthiness(
+        self, mod: SourceModule, test: ast.expr, env: Dict[str, bool]
+    ) -> None:
+        if self._device(test, env):
+            self.emit(
+                mod,
+                test.lineno,
+                "implicit truthiness of a device value blocks on the device "
+                "(and bypasses Scheduler._d2h accounting)",
+            )
+
+    def _scan_expr(
+        self, mod: SourceModule, expr: ast.expr, env: Dict[str, bool]
+    ) -> None:
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                self._check_call(mod, node, env)
+            elif isinstance(node, ast.IfExp):
+                self._check_truthiness(mod, node.test, env)
+            elif isinstance(node, ast.BoolOp):
+                for v in node.values:
+                    self._check_truthiness(mod, v, env)
+            elif isinstance(node, ast.UnaryOp) and isinstance(
+                node.op, ast.Not
+            ):
+                self._check_truthiness(mod, node.operand, env)
+
+    def _check_call(
+        self, mod: SourceModule, node: ast.Call, env: Dict[str, bool]
+    ) -> None:
+        refs = self._refs
+        func = node.func
+        dn = dotted_name(func)
+        if dn is not None:
+            parts = dn.split(".")
+            root, last = parts[0], parts[-1]
+            if root in refs.jax_roots and last == "device_get":
+                self.emit(
+                    mod,
+                    node.lineno,
+                    "blocking jax.device_get outside Scheduler._d2h — "
+                    "route the fetch through _d2h so "
+                    "host_roundtrips_total/d2h_bytes_total see it",
+                )
+                return
+            if root in refs.np_roots and any(
+                self._device(a, env)
+                for a in list(node.args)
+                + [kw.value for kw in node.keywords]
+            ):
+                self.emit(
+                    mod,
+                    node.lineno,
+                    f"{dn}(...) coerces a device value through host numpy — "
+                    "a blocking fetch outside Scheduler._d2h",
+                )
+                return
+        if isinstance(func, ast.Attribute):
+            if func.attr in BLOCKING_METHODS and self._device(
+                func.value, env
+            ):
+                self.emit(
+                    mod,
+                    node.lineno,
+                    f".{func.attr}() on a device value is a blocking fetch "
+                    "outside Scheduler._d2h",
+                )
+                return
+        elif isinstance(func, ast.Name):
+            if (
+                func.id in CAST_BUILTINS
+                and func.id not in env  # not shadowed
+                and node.args
+                and self._device(node.args[0], env)
+            ):
+                self.emit(
+                    mod,
+                    node.lineno,
+                    f"{func.id}() on a device value is a blocking fetch "
+                    "outside Scheduler._d2h",
+                )
+
+    # ----- device-residence taint -------------------------------------------
+
+    def _device(self, node: ast.expr, env: Dict[str, bool]) -> bool:
+        if isinstance(node, (ast.Constant, ast.JoinedStr)):
+            return False
+        if isinstance(node, ast.Name):
+            return env.get(node.id, node.id.endswith(DEVICE_SUFFIX))
+        if isinstance(node, ast.Attribute):
+            if node.attr in NEUTRAL_ATTRS:
+                return False
+            if node.attr.endswith(DEVICE_SUFFIX):
+                return True
+            return self._device(node.value, env)
+        if isinstance(node, ast.Subscript):
+            sl = node.slice
+            if isinstance(sl, ast.Constant) and isinstance(sl.value, str):
+                if sl.value.endswith(DEVICE_SUFFIX) or sl.value in DEVICE_KEYS:
+                    return True
+            return self._device(node.value, env)
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return any(self._device(el, env) for el in node.elts)
+        if isinstance(node, ast.BinOp):
+            return self._device(node.left, env) or self._device(
+                node.right, env
+            )
+        if isinstance(node, ast.UnaryOp):
+            return self._device(node.operand, env)
+        if isinstance(node, ast.BoolOp):
+            return any(self._device(v, env) for v in node.values)
+        if isinstance(node, ast.Compare):
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+                return False  # identity check — no __bool__, no sync
+            return self._device(node.left, env) or any(
+                self._device(c, env) for c in node.comparators
+            )
+        if isinstance(node, ast.IfExp):
+            return self._device(node.body, env) or self._device(
+                node.orelse, env
+            )
+        if isinstance(node, ast.Starred):
+            return self._device(node.value, env)
+        if isinstance(node, ast.Call):
+            return self._device_call(node, env)
+        return False
+
+    def _device_call(self, node: ast.Call, env: Dict[str, bool]) -> bool:
+        refs = self._refs
+        func = node.func
+        dn = dotted_name(func)
+        if dn is not None:
+            parts = dn.split(".")
+            root, last = parts[0], parts[-1]
+            if last == CHOKE_POINT:
+                return False  # routed fetch → host value
+            if root in refs.jnp_roots:
+                return True
+            if root in refs.np_roots:
+                return False
+            if root in refs.jax_roots:
+                if last == "device_put":
+                    return True
+                if len(parts) >= 2 and parts[1] == "random":
+                    return True
+                return False  # device_get and friends return host values
+            if "device_put" in last:
+                return True
+            if (
+                last == "from_host"
+                and len(parts) == 2
+                and parts[0] in refs.sym_alias
+            ):
+                return True  # DeviceCluster.from_host / DeviceBatch.from_host
+            # jit-root resolution through the alias tables
+            if len(parts) == 2 and root in refs.mod_alias:
+                if last in self.roots.get(refs.mod_alias[root], ()):
+                    return True
+            if len(parts) == 1:
+                if dn in refs.sym_alias:
+                    m, s = refs.sym_alias[dn]
+                    if s in self.roots.get(m, ()):
+                        return True
+                if dn in self.roots_by_path.get(self._path, ()):
+                    return True
+        if isinstance(func, ast.Attribute):
+            if func.attr in NONBLOCKING_METHODS | BLOCKING_METHODS:
+                return False
+            if (
+                func.attr == "get"
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+                and (
+                    node.args[0].value.endswith(DEVICE_SUFFIX)
+                    or node.args[0].value in DEVICE_KEYS
+                )
+            ):
+                return True  # rec.get("rstats_dev")
+            # a method of a device value yields a device value
+            if func.attr not in NEUTRAL_ATTRS and self._device(
+                func.value, env
+            ):
+                return True
+        return False

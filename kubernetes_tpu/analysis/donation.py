@@ -1,0 +1,369 @@
+"""Use-after-donation checker (rule: ``donation``).
+
+The device pipelines donate their big HBM buffers back to XLA
+(``donate_argnums``/``donate_argnames`` on ``chain_dispatch``,
+``sig_scan``, ``resident_run``, the device-mirror delta applier): the
+callee may write its outputs into the donated storage, so the caller's
+reference is DEAD the moment the call is issued.  Reading it afterwards
+is use-after-free that jax only sometimes catches (a deleted-buffer
+error on some backends, silently stale data on others).
+
+Two checks:
+
+  * caller-side liveness — for every intra-package call site of a
+    donating root (resolved through import aliases), any argument bound
+    to a donated parameter that is a plain local NAME kills that name
+    (and every alias of it, tracked like the lock checker's alias
+    tainting: ``b = a`` then donate ``a`` kills ``b`` too).  A later
+    read of a dead name — before a rebinding revives it — is a finding.
+    If/else branches are walked independently and merged, so the
+    resident/sig_scan either-or dispatch does not cross-contaminate.
+
+  * contract documentation — every donating root must be named in the
+    donation/aliasing contract (RESIDENT.md §"Donation / aliasing
+    contract"): the text is the API contract callers code against, and
+    an undocumented donation is a contract change that shipped silently.
+    (Checked only on shipped-tree runs, where the doc is present.)
+
+Limits (by design): donated arguments reached through attributes or
+subscripts (``ch["dc"]``) are not tracked — the chain holder's dict
+handoff rebinds atomically; and loop bodies are walked once, so a
+donate-then-read across iterations of the same loop is caught only when
+the name is not rebound first.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from kubernetes_tpu.analysis.core import (
+    RULE_DONATION,
+    Checker,
+    ImportRefs,
+    SourceModule,
+    dotted_name,
+    resolve_root,
+)
+
+from kubernetes_tpu.analysis.d2h import _module_base
+
+
+def _donation_spec(fn: ast.FunctionDef) -> Optional[Set[str]]:
+    """Donated PARAM NAMES when ``fn`` is jitted with donate_argnums /
+    donate_argnames; None otherwise."""
+    for dec in fn.decorator_list:
+        if not isinstance(dec, ast.Call):
+            continue
+        dnc = dotted_name(dec.func)
+        if dnc is None:
+            continue
+        tail = dnc.split(".")[-1]
+        target = dec
+        if tail == "partial":
+            if not dec.args:
+                continue
+            first = dotted_name(dec.args[0])
+            if first is None or first.split(".")[-1] != "jit":
+                continue
+        elif tail != "jit":
+            continue
+        params = [a.arg for a in fn.args.args]
+        donated: Set[str] = set()
+        for kw in target.keywords:
+            if kw.arg == "donate_argnums":
+                try:
+                    v = ast.literal_eval(kw.value)
+                except (ValueError, SyntaxError):
+                    continue
+                idxs = (v,) if isinstance(v, int) else tuple(v)
+                donated |= {params[i] for i in idxs if i < len(params)}
+            elif kw.arg == "donate_argnames":
+                try:
+                    v = ast.literal_eval(kw.value)
+                except (ValueError, SyntaxError):
+                    continue
+                names = (v,) if isinstance(v, str) else tuple(v)
+                donated |= set(names)
+        if donated:
+            return donated
+    return None
+
+
+_CONTRACT_HEADING = re.compile(
+    r"^#+\s*Donation\s*/\s*aliasing contract\s*$", re.IGNORECASE | re.M
+)
+
+
+def _contract_section(text: str) -> str:
+    """The §"Donation / aliasing contract" body — the roster the check
+    greps.  Prose mentions elsewhere in the doc must not satisfy it, so
+    the section is cut at the next heading; a doc without the heading
+    yields the empty string (every donating root is then undocumented,
+    which is the honest verdict)."""
+    m = _CONTRACT_HEADING.search(text)
+    if m is None:
+        return ""
+    rest = text[m.end():]
+    nxt = re.search(r"^#+\s", rest, re.M)
+    return rest[: nxt.start()] if nxt else rest
+
+
+class _Root:
+    def __init__(self, base: str, qual: str, node: ast.FunctionDef,
+                 donated: Set[str]):
+        self.base = base
+        self.qual = qual
+        self.name = node.name
+        self.node = node
+        self.params = [a.arg for a in node.args.args]
+        self.donated = donated
+
+
+class DonationChecker(Checker):
+    rule = RULE_DONATION
+
+    def __init__(self) -> None:
+        super().__init__()
+        # module base → fn name → _Root (alias-table lookups), plus the
+        # path-scoped view for each module's OWN bare names (two modules
+        # sharing a basename must not resolve each other's)
+        self.roots: Dict[str, Dict[str, _Root]] = {}
+        self.roots_by_path: Dict[str, Dict[str, _Root]] = {}
+
+    # ----- entry point ------------------------------------------------------
+
+    def run(
+        self,
+        mods: Sequence[SourceModule],
+        contract_text: Optional[str] = None,
+    ) -> None:
+        root_mods: List[Tuple[SourceModule, _Root]] = []
+        for mod in mods:
+            base = _module_base(mod.path)
+            merged = self.roots.setdefault(base, {})
+            per = self.roots_by_path.setdefault(mod.path, {})
+
+            def index(fn: ast.AST, qual: str) -> None:
+                for node in ast.iter_child_nodes(fn):
+                    if isinstance(node, ast.FunctionDef):
+                        q = f"{qual}.{node.name}" if qual else node.name
+                        donated = _donation_spec(node)
+                        if donated:
+                            r = _Root(base, q, node, donated)
+                            per[node.name] = r
+                            merged[node.name] = r
+                            root_mods.append((mod, r))
+                        index(node, q)
+                    elif isinstance(node, (ast.ClassDef, ast.If, ast.Try)):
+                        index(node, qual)
+
+            index(mod.tree, "")
+
+        if contract_text is not None:
+            roster = _contract_section(contract_text)
+            for mod, r in root_mods:
+                if not re.search(rf"\b{re.escape(r.name)}\b", roster):
+                    self.emit(
+                        mod,
+                        r.node.lineno,
+                        f"donating kernel {r.qual!r} is not documented in "
+                        "the donation/aliasing contract (RESIDENT.md) — "
+                        "callers code against that text",
+                    )
+
+        for mod in mods:
+            refs = ImportRefs(mod.tree)
+            self._check_module(
+                mod, refs, self.roots_by_path.get(mod.path, {})
+            )
+
+    # ----- caller-side liveness ---------------------------------------------
+
+    def _check_module(
+        self, mod: SourceModule, refs: ImportRefs,
+        self_roots: Dict[str, _Root],
+    ) -> None:
+        def walk_fns(container: ast.AST) -> None:
+            for node in ast.iter_child_nodes(container):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    self._check_function(mod, refs, self_roots, node)
+                    walk_fns(node)
+                elif isinstance(node, ast.ClassDef):
+                    walk_fns(node)
+
+        walk_fns(mod.tree)
+
+    def _resolve_root(
+        self, refs: ImportRefs, self_roots: Dict[str, _Root],
+        func: ast.expr
+    ) -> Optional[_Root]:
+        return resolve_root(refs, self_roots, self.roots, func)
+
+    def _check_function(
+        self,
+        mod: SourceModule,
+        refs: ImportRefs,
+        self_roots: Dict[str, _Root],
+        fn: ast.FunctionDef,
+    ) -> None:
+        # dead name → the donating call that killed it ("fn@line")
+        dead: Dict[str, str] = {}
+        aliases: Dict[str, str] = {}  # name → root name it aliases
+        self._walk_block(mod, refs, self_roots, fn.body, dead, aliases)
+
+    def _walk_block(
+        self,
+        mod: SourceModule,
+        refs: ImportRefs,
+        self_roots: Dict[str, _Root],
+        stmts: List[ast.stmt],
+        dead: Dict[str, str],
+        aliases: Dict[str, str],
+    ) -> None:
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue  # nested defs run later — fresh liveness scope
+            self._flag_dead_reads(mod, st, dead)
+            self._apply_donations(mod, refs, self_roots, st, dead, aliases)
+            if isinstance(st, ast.Assign):
+                self._track(st, dead, aliases)
+            elif isinstance(st, ast.If):
+                d1, d2 = dict(dead), dict(dead)
+                a1, a2 = dict(aliases), dict(aliases)
+                self._walk_block(mod, refs, self_roots, st.body, d1, a1)
+                self._walk_block(mod, refs, self_roots, st.orelse, d2, a2)
+                # a name donated on EITHER path is suspect afterwards;
+                # revived only when both paths rebound it
+                dead.clear()
+                dead.update(d2)
+                dead.update(d1)
+                aliases.clear()
+                aliases.update(a2)
+                aliases.update(a1)
+                continue
+            elif isinstance(st, (ast.For, ast.While)):
+                if isinstance(st, ast.For):
+                    # the loop target is rebound every iteration — revive
+                    self._revive(st.target, dead, aliases)
+                self._walk_block(mod, refs, self_roots, st.body, dead, aliases)
+                self._walk_block(mod, refs, self_roots, st.orelse, dead, aliases)
+                continue
+            if isinstance(st, (ast.With, ast.AsyncWith)):
+                for it in st.items:
+                    if it.optional_vars is not None:
+                        self._revive(it.optional_vars, dead, aliases)
+            for attr in ("body", "orelse", "finalbody"):
+                sub = getattr(st, attr, None)
+                if sub:
+                    self._walk_block(mod, refs, self_roots, sub, dead, aliases)
+            for handler in getattr(st, "handlers", ()) or ():
+                self._walk_block(
+                    mod, refs, self_roots, handler.body, dead, aliases
+                )
+
+    @staticmethod
+    def _revive(target: ast.expr, dead: Dict[str, str],
+                aliases: Dict[str, str]) -> None:
+        """A binding target (for-loop variable, `with ... as` name,
+        unpacked tuple) revives the names it rebinds."""
+        for node in ast.walk(target):
+            if isinstance(node, ast.Name):
+                dead.pop(node.id, None)
+                aliases.pop(node.id, None)
+
+    @staticmethod
+    def _expr_children(st: ast.stmt):
+        """Direct expression children of a statement, including `with`
+        context expressions (withitem nodes are not exprs and would
+        otherwise hide their headers from the scan)."""
+        for child in ast.iter_child_nodes(st):
+            if isinstance(child, ast.expr):
+                yield child
+            elif isinstance(child, ast.withitem):
+                yield child.context_expr
+
+    def _flag_dead_reads(
+        self, mod: SourceModule, st: ast.stmt, dead: Dict[str, str]
+    ) -> None:
+        if not dead:
+            return
+        for child in self._expr_children(st):
+            for node in ast.walk(child):
+                if (
+                    isinstance(node, ast.Name)
+                    and isinstance(node.ctx, ast.Load)
+                    and node.id in dead
+                ):
+                    self.emit(
+                        mod,
+                        node.lineno,
+                        f"read of {node.id!r} after it was donated to "
+                        f"{dead[node.id]} — the buffer may already hold "
+                        "the callee's outputs",
+                    )
+
+    def _apply_donations(
+        self,
+        mod: SourceModule,
+        refs: ImportRefs,
+        self_roots: Dict[str, _Root],
+        st: ast.stmt,
+        dead: Dict[str, str],
+        aliases: Dict[str, str],
+    ) -> None:
+        for child in self._expr_children(st):
+            for node in ast.walk(child):
+                if not isinstance(node, ast.Call):
+                    continue
+                root = self._resolve_root(refs, self_roots, node.func)
+                if root is None:
+                    continue
+                killed: Set[str] = set()
+                for i, a in enumerate(node.args):
+                    if i < len(root.params) and root.params[i] in root.donated:
+                        if isinstance(a, ast.Name):
+                            killed.add(a.id)
+                for kw in node.keywords:
+                    if kw.arg in root.donated and isinstance(
+                        kw.value, ast.Name
+                    ):
+                        killed.add(kw.value.id)
+                if not killed:
+                    continue
+                # alias closure: killing a root name kills its aliases
+                groups: Set[str] = set(killed)
+                for k in killed:
+                    groups.add(aliases.get(k, k))
+                tag = f"{root.name}() at line {node.lineno}"
+                for name, rootname in list(aliases.items()):
+                    if rootname in groups or name in groups:
+                        dead[name] = tag
+                for name in groups:
+                    dead[name] = tag
+
+    def _track(
+        self,
+        st: ast.Assign,
+        dead: Dict[str, str],
+        aliases: Dict[str, str],
+    ) -> None:
+        # rebinding revives; `b = a` aliases b to a's root
+        targets: List[ast.expr] = []
+        for t in st.targets:
+            if isinstance(t, (ast.Tuple, ast.List)):
+                targets.extend(t.elts)
+            else:
+                targets.append(t)
+        for t in targets:
+            if isinstance(t, ast.Name):
+                dead.pop(t.id, None)
+                aliases.pop(t.id, None)
+        if (
+            len(st.targets) == 1
+            and isinstance(st.targets[0], ast.Name)
+            and isinstance(st.value, ast.Name)
+        ):
+            src = st.value.id
+            aliases[st.targets[0].id] = aliases.get(src, src)

@@ -1,0 +1,202 @@
+"""Retrace-hygiene checker (rule: ``retrace``).
+
+A jit root's compilation cache is keyed by the SIGNATURE of each call:
+abstract shapes/dtypes of the traced arguments (where Python scalars
+enter as weak-typed avals) plus the concrete values of the static ones.
+Two habits quietly turn that cache into a recompile storm:
+
+  * **weak-typed Python scalars as traced arguments** — a call site that
+    passes a bare ``0``/``0.5``/``True`` to a traced parameter commits a
+    weak-typed aval; the same root called elsewhere with a committed
+    ``jnp`` array of the "same" value has a different signature, and the
+    pair ping-pongs the cache.  Wrap the literal (``jnp.asarray(x,
+    dtype)``) or make the parameter static.
+
+  * **shape-derived static arguments** — a ``static_argnames`` parameter
+    fed inline from ``len(...)``/``.shape`` recompiles once per distinct
+    runtime size.  The sanctioned idiom is to BUCKET the size first
+    (``bucket_cap(...)`` — a handful of shapes instead of one per batch).
+
+Call sites INSIDE jit-decorated functions are exempt (they execute under
+the outer trace; their cache behavior is the outer root's signature).
+
+The static rules catch the two leak shapes visible in the AST; the
+dynamic complement lives in ``sanitizer.py``: under ``KTPU_SANITIZE=1``
+a jax compile-event hook sweeps every registered jit root's compilation
+cache and counts POST-WARMUP growth as unexpected recompiles
+(``scheduler_tpu_jit_recompiles_total{fn=}``), which is what catches
+shape-dependent Python branching that static analysis cannot see.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from kubernetes_tpu.analysis.core import (
+    RULE_RETRACE,
+    Checker,
+    ImportRefs,
+    SourceModule,
+    dotted_name,
+    resolve_root,
+)
+from kubernetes_tpu.analysis.d2h import _module_base
+from kubernetes_tpu.analysis.jit import _jit_decoration
+
+# size-bucketing helpers: a static argument routed through one of these
+# hits a handful of shapes, not one per call
+BUCKET_FNS = {"bucket_cap"}
+
+
+class _Root:
+    def __init__(self, base: str, node: ast.FunctionDef, static: Set[str]):
+        self.base = base
+        self.name = node.name
+        self.params = [a.arg for a in node.args.args]
+        self.static = static
+
+
+class RetraceChecker(Checker):
+    rule = RULE_RETRACE
+
+    def __init__(self) -> None:
+        super().__init__()
+        # module base → fn name → _Root (alias-table lookups), plus the
+        # path-scoped view for each module's OWN bare names (two modules
+        # sharing a basename must not resolve each other's)
+        self.roots: Dict[str, Dict[str, _Root]] = {}
+        self.roots_by_path: Dict[str, Dict[str, _Root]] = {}
+
+    # ----- entry point ------------------------------------------------------
+
+    def run(self, mods: Sequence[SourceModule]) -> None:
+        for mod in mods:
+            base = _module_base(mod.path)
+            merged = self.roots.setdefault(base, {})
+            per = self.roots_by_path.setdefault(mod.path, {})
+
+            def index(container: ast.AST) -> None:
+                for node in ast.iter_child_nodes(container):
+                    if isinstance(node, ast.FunctionDef):
+                        jd = _jit_decoration(node)
+                        if jd is not None:
+                            r = _Root(base, node, jd[1])
+                            per[node.name] = r
+                            merged[node.name] = r
+                        index(node)
+                    elif isinstance(node, (ast.ClassDef, ast.If, ast.Try)):
+                        index(node)
+
+            index(mod.tree)
+
+        for mod in mods:
+            refs = ImportRefs(mod.tree)
+            self._check_module(
+                mod, refs, self.roots_by_path.get(mod.path, {})
+            )
+
+    def _resolve_root(
+        self, refs: ImportRefs, self_roots: Dict[str, _Root],
+        func: ast.expr
+    ) -> Optional[_Root]:
+        return resolve_root(refs, self_roots, self.roots, func)
+
+    # ----- call-site scan ---------------------------------------------------
+
+    def _check_module(
+        self, mod: SourceModule, refs: ImportRefs,
+        self_roots: Dict[str, _Root],
+    ) -> None:
+        def walk_fns(container: ast.AST) -> None:
+            for node in ast.iter_child_nodes(container):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    if isinstance(node, ast.FunctionDef) and _jit_decoration(
+                        node
+                    ):
+                        continue  # call sites under the outer trace
+                    self._check_function(mod, refs, self_roots, node)
+                    walk_fns(node)
+                elif isinstance(node, ast.ClassDef):
+                    walk_fns(node)
+
+        walk_fns(mod.tree)
+
+    def _check_function(
+        self,
+        mod: SourceModule,
+        refs: ImportRefs,
+        self_roots: Dict[str, _Root],
+        fn: ast.FunctionDef,
+    ) -> None:
+        stack: List[ast.AST] = list(ast.iter_child_nodes(fn))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue  # nested defs visited by the module walk (pruned)
+            stack.extend(ast.iter_child_nodes(node))
+            if not isinstance(node, ast.Call):
+                continue
+            root = self._resolve_root(refs, self_roots, node.func)
+            if root is None:
+                continue
+            bound: List[Tuple[str, ast.expr]] = []
+            for i, a in enumerate(node.args):
+                if i < len(root.params):
+                    bound.append((root.params[i], a))
+            for kw in node.keywords:
+                if kw.arg is not None:
+                    bound.append((kw.arg, kw.value))
+            for pname, expr in bound:
+                if pname in root.static:
+                    bad = self._unbucketed_shape_use(expr)
+                    if bad is not None:
+                        self.emit(
+                            mod,
+                            expr.lineno,
+                            f"static argument {pname!r} of {root.name}() is "
+                            f"derived inline from {bad} — one recompile per "
+                            "distinct size; bucket it (bucket_cap) first",
+                        )
+                else:
+                    if isinstance(expr, ast.Constant) and isinstance(
+                        expr.value, (int, float, bool)
+                    ):
+                        self.emit(
+                            mod,
+                            expr.lineno,
+                            f"weak-typed Python scalar {expr.value!r} passed "
+                            f"to traced parameter {pname!r} of {root.name}() "
+                            "— commit the dtype (jnp.asarray) or make the "
+                            "parameter static",
+                        )
+
+    def _unbucketed_shape_use(self, expr: ast.expr) -> Optional[str]:
+        """'len(...)' / "'.shape'" when the expression derives a size from
+        runtime data without routing it through a bucketing helper."""
+
+        def scan(node: ast.expr) -> Optional[str]:
+            if isinstance(node, ast.Call):
+                dn = dotted_name(node.func)
+                if dn is not None and dn.split(".")[-1] in BUCKET_FNS:
+                    return None  # bucketed subtree — sanctioned
+                if (
+                    isinstance(node.func, ast.Name)
+                    and node.func.id == "len"
+                ):
+                    return "len(...)"
+                for child in ast.iter_child_nodes(node):
+                    hit = scan(child) if isinstance(child, ast.expr) else None
+                    if hit:
+                        return hit
+                return None
+            if isinstance(node, ast.Attribute) and node.attr == "shape":
+                return "'.shape'"
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.expr):
+                    hit = scan(child)
+                    if hit:
+                        return hit
+            return None
+
+        return scan(expr)

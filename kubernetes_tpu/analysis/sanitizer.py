@@ -89,6 +89,146 @@ def assert_owned(lock, what: str = "guarded state") -> None:
         )
 
 
+# ----- retrace hook (jit recompile accounting) -------------------------------
+#
+# The static `retrace` rule catches the leak shapes visible in the AST;
+# this is the dynamic complement: under KTPU_SANITIZE=1 a jax compile
+# event triggers a sweep of every known jit root's compilation-cache
+# size.  Growth past the `mark_jit_warm()` watermark is an UNEXPECTED
+# recompile (steady state re-used a signature that should have been
+# warm) and bumps scheduler_tpu_jit_recompiles_total{fn=} on every
+# registered metrics counter.  Cache sizes are swept (not inferred from
+# the event alone) because jax's compile events carry no function name.
+
+_jit_roots: dict = {}
+_warm_sizes: Optional[dict] = None
+_recompile_counts: dict = {}
+_recompile_counters: "weakref.WeakSet" = weakref.WeakSet()
+_retrace_hook_installed = False
+_retrace_lock = threading.Lock()
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+
+def _discover_jit_roots() -> dict:
+    """Module-level jit roots of the shipped kernels (objects exposing
+    jax's per-jit ``_cache_size``), keyed ``module.fn``.  Import errors
+    are skipped — discovery must work on partial trees."""
+    import importlib
+
+    from kubernetes_tpu.analysis import JIT_MODULES
+
+    rels = list(JIT_MODULES) + [os.path.join("cache", "device_mirror.py")]
+    roots: dict = {}
+    for rel in rels:
+        modname = "kubernetes_tpu." + rel[:-3].replace(os.sep, ".")
+        try:
+            mod = importlib.import_module(modname)
+        except Exception:  # noqa: BLE001 — partial trees analyze fine
+            continue
+        short = modname.rsplit(".", 1)[-1]
+        for attr, obj in vars(mod).items():
+            if attr.startswith("__"):
+                continue
+            if callable(getattr(obj, "_cache_size", None)):
+                roots[f"{short}.{attr}"] = obj
+    return roots
+
+
+def register_recompile_counter(counter) -> None:
+    """Wire a metrics Counter (scheduler_tpu_jit_recompiles_total{fn=});
+    idempotent per instance, weakly held."""
+    if counter is not None:
+        _recompile_counters.add(counter)
+
+
+def register_jit_root(name: str, fn) -> None:
+    """Track an extra jit root (one created at runtime rather than at
+    module scope).  If a warm watermark is already set, the root joins it
+    at its CURRENT cache size — its history so far counts as warmup."""
+    if not callable(getattr(fn, "_cache_size", None)):
+        return
+    with _retrace_lock:
+        _jit_roots[name] = fn
+        if _warm_sizes is not None:
+            _warm_sizes.setdefault(name, fn._cache_size())
+
+
+def install_retrace_hook() -> None:
+    """Register the jax compile-event listener (once per process).  A
+    no-op unless KTPU_SANITIZE is on — the listener itself costs nothing
+    when no warm watermark is set."""
+    global _retrace_hook_installed
+    if not enabled() or _retrace_hook_installed:
+        return
+    from jax import monitoring
+
+    monitoring.register_event_duration_secs_listener(_on_compile_event)
+    _retrace_hook_installed = True
+
+
+def _on_compile_event(event: str, duration: float, **kw) -> None:
+    if event != _COMPILE_EVENT or _warm_sizes is None:
+        return
+    _sweep_recompiles()
+
+
+def mark_jit_warm() -> None:
+    """Snapshot every jit root's compilation-cache size as the warm
+    watermark: compiles after this point count as unexpected recompiles.
+    Call it after the warmup drain, before the steady-state window."""
+    global _warm_sizes
+    install_retrace_hook()
+    with _retrace_lock:
+        _jit_roots.update(_discover_jit_roots())
+        _warm_sizes = {
+            name: fn._cache_size() for name, fn in _jit_roots.items()
+        }
+        _recompile_counts.clear()
+
+
+def _sweep_recompiles() -> None:
+    with _retrace_lock:
+        if _warm_sizes is None:
+            return
+        for name, fn in _jit_roots.items():
+            base = _warm_sizes.get(name)
+            if base is None:
+                continue
+            try:
+                cur = fn._cache_size()
+            except Exception:  # noqa: BLE001 — a torn-down backend is fine
+                continue
+            seen = _recompile_counts.get(name, 0)
+            delta = cur - base - seen
+            if delta > 0:
+                _recompile_counts[name] = seen + delta
+                for c in list(_recompile_counters):
+                    try:
+                        c.inc(delta, fn=name)
+                    except Exception:  # noqa: BLE001 — accounting only
+                        pass
+
+
+def unexpected_recompiles() -> dict:
+    """{``module.fn`` → post-warmup recompile count}; empty before
+    ``mark_jit_warm()``.  Sweeps before reporting (the compile event
+    fires while the new executable is still being installed, so the
+    event-driven count can trail by one until the next compile)."""
+    if _warm_sizes is None:
+        return {}
+    _sweep_recompiles()
+    with _retrace_lock:
+        return {k: v for k, v in _recompile_counts.items() if v}
+
+
+def reset_retrace() -> None:
+    """Drop the warm watermark (tests re-arm per case)."""
+    global _warm_sizes
+    with _retrace_lock:
+        _warm_sizes = None
+        _recompile_counts.clear()
+
+
 def check_mirror_consistency(cache, mirror) -> None:
     """Snapshot↔mirror drift probe, run after each drain.
 

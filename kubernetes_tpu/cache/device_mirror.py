@@ -36,6 +36,9 @@ def _dus(full, delta, start):
     start = jnp.asarray(start, I32)
     zero = jnp.zeros((), I32)
     starts = (start,) + (zero,) * (full.ndim - 1)
+    # ktpu: allow(slice-clamp) — e0/m0 are clamped HOST-side before upload
+    # (_row_range: start = min(lo, cap - size)), so start + size <= cap by
+    # construction and the device splice can never reach the array end
     return jax.lax.dynamic_update_slice(full, delta, starts)
 
 

@@ -653,6 +653,14 @@ class SchedulerMetrics:
                 ("kind",),
             )
         )
+        self.jit_recompiles = r.register(
+            Counter(
+                "scheduler_tpu_jit_recompiles_total",
+                "Unexpected post-warmup jit compilation-cache misses per "
+                "root (KTPU_SANITIZE=1 retrace hook; fn: module.function).",
+                ("fn",),
+            )
+        )
         self.chaos_injected = r.register(
             Counter(
                 "scheduler_tpu_chaos_injected_total",

@@ -120,7 +120,6 @@ def explain_pod(
 
     ``max_nodes`` caps the per-node detail in the result; the summary
     counts always cover every node."""
-    import jax
     import numpy as np
 
     from kubernetes_tpu.framework.interface import CycleState
@@ -236,8 +235,12 @@ def explain_pod(
         check_fit="NodeResourcesFit" in enabled,
         **tables,
     )
-    stack = np.asarray(jax.device_get(stack))[:, 0, :]  # [N_DIAG, N]
-    feasible = np.asarray(jax.device_get(feasible))[0]  # [N]
+    # one accounted fetch for both artifacts: explain IS a host round
+    # trip, and it must show up in host_roundtrips_total/d2h_bytes_total
+    # like every other blocking fetch (Scheduler._d2h choke point)
+    fetched = sched._d2h((stack, feasible))
+    stack = np.asarray(fetched[0])[:, 0, :]  # [N_DIAG, N]
+    feasible = np.asarray(fetched[1])[0]  # [N]
 
     allowed_set = frozenset(allowed) if allowed is not None else None
     nodes: Dict[str, List[str]] = {}

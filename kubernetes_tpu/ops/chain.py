@@ -43,6 +43,11 @@ def _dus(full, delta, start):
     start = jnp.asarray(start, I32)
     zero = jnp.zeros((), I32)
     starts = (start,) + (zero,) * (full.ndim - 1)
+    # ktpu: allow(slice-clamp) — e_cursor/m_cursor are host ints checked
+    # against the CHAINED cluster's own capacity before every dispatch
+    # (scheduler._chain_dispatch: `ch["e"] + P > E or ch["m"] + P*AT > M`
+    # compacts-and-grows or falls back to the direct path), so start +
+    # delta rows <= len(full) holds for every splice XLA ever sees
     return jax.lax.dynamic_update_slice(full, delta, starts)
 
 

@@ -852,10 +852,13 @@ def pod_step(
         nonzero=state["nonzero"]
         + onehot_n[:, None].astype(I32) * db.nonzero_req[p][None, :],
         num_pods=state["num_pods"] + onehot_n.astype(I32),
-        # inactive (pad) slots must not clobber row p's assignment
+        # inactive (pad) slots must not clobber row p's assignment.
+        # p is the scan/vmap index over the batch axis — in range by
+        # construction; mode="drop" (the default, spelled out) documents
+        # the out-of-bounds semantics for the slice-clamp rule
         assigned=state["assigned"]
         .at[p]
-        .set(jnp.where(active, choice, state["assigned"][p])),
+        .set(jnp.where(active, choice, state["assigned"][p]), mode="drop"),
     )
     if sample_k is not None:
         # nextStartNodeIndex advances by nodes visited, per attempt

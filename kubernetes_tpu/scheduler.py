@@ -420,6 +420,11 @@ class Scheduler:
         self.prom = SchedulerMetrics()
         if self._sanitize:
             sanitizer.register_counter(self.prom.sanitizer_violations)
+            # retrace hook: post-warmup compilation-cache misses land in
+            # scheduler_tpu_jit_recompiles_total{fn=} once a caller marks
+            # the warm watermark (sanitizer.mark_jit_warm)
+            sanitizer.register_recompile_counter(self.prom.jit_recompiles)
+            sanitizer.install_retrace_hook()
         # Per-phase hot-loop attribution (queue_pop/pack/h2d/device/d2h/
         # commit/bind) — the scheduler_perf-style breakdown bench.py emits
         # as config0_phases.  Feeds the phase_duration histogram too.
@@ -1366,7 +1371,7 @@ class Scheduler:
         chosen, n_feas = both[0], both[1]
         if sample_k is not None:
             self._next_start_node_index = int(
-                jax.device_get(tallies["sample_start"])
+                self._d2h(tallies["sample_start"])
             )
         if tie_key is not None or sample_k is not None:
             self._attempt_counter = (
@@ -2550,6 +2555,10 @@ class Scheduler:
                     w_bal=weights[5],
                     w_img=w_img,
                     check_fit=check_fit,
+                    # ktpu: allow(retrace) — alloc's leading axis is the
+                    # committer's node count, fixed for the holder's whole
+                    # lineage (any node change rebuilds the holder): one
+                    # compile per lineage, not one per batch
                     window=min(
                         self.config.resident_window,
                         int(holder["alloc"].shape[0]),
@@ -3375,7 +3384,7 @@ class Scheduler:
             import numpy as np
 
             h = np.asarray(
-                jax.random.bits(k_p, (n_nodes,), dtype=jnp.uint32)
+                self._d2h(jax.random.bits(k_p, (n_nodes,), dtype=jnp.uint32))
             )
             idx_of = {n: i for i, n in enumerate(st.nodes)}
             node = max(totals, key=lambda n: (totals[n], int(h[idx_of[n]])))

@@ -1,0 +1,30 @@
+"""Seeded slice-clamp violations — every marked line MUST be found.
+
+Never imported: the analyzer parses it (tests/test_static_analysis.py).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("width",))
+def window_write(dst, delta, start, width: int):
+    out = jax.lax.dynamic_update_slice(dst, delta, (start,))  # VIOLATION: traced start, unpadded dst
+    return out
+
+
+@jax.jit
+def scatter_write(dst, idx, vals):
+    return dst.at[idx].set(vals)  # VIOLATION: traced index, no explicit mode=
+
+
+@jax.jit
+def helper_write(dst, delta, q):
+    return _dus(dst, delta, q)
+
+
+def _dus(full, delta, start):
+    starts = (start, jnp.zeros((), jnp.int32))
+    return jax.lax.dynamic_update_slice(full, delta, starts)  # VIOLATION: traced start through the helper

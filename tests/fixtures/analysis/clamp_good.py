@@ -1,0 +1,52 @@
+"""Negative fixture: bounded/padded window writes — must stay silent.
+
+Never imported: the analyzer parses it (tests/test_static_analysis.py).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def padded_write(delta, start, n: int):
+    # destination padded by the window size — the sanctioned idiom: an
+    # out-of-range start cannot exist for this buffer
+    buf = jnp.full((n + 8,), -1, jnp.int32)
+    return jax.lax.dynamic_update_slice(buf, delta, (start,))
+
+
+@jax.jit
+def static_start(dst, delta):
+    return jax.lax.dynamic_update_slice(dst, delta, (0,))
+
+
+@jax.jit
+def explicit_mode(dst, idx, vals):
+    # the author chose the out-of-bounds semantics explicitly
+    return dst.at[idx].set(vals, mode="drop")
+
+
+@functools.partial(jax.jit, static_argnames=("w",))
+def carry_padded(xs, w: int):
+    # the resident fixed point's shape: the write target rides a
+    # while_loop carry whose INIT buffer is padded by the window
+    n = xs.shape[0]
+    buf0 = jnp.full((n + 8,), 0, jnp.int32)
+
+    def body(carry):
+        q, buf = carry
+        buf = jax.lax.dynamic_update_slice(
+            buf, jnp.zeros((8,), jnp.int32), (q,)
+        )
+        return (q + 1, buf)
+
+    def cond(carry):
+        q, _ = carry
+        return q < n
+
+    q, buf = jax.lax.while_loop(
+        cond, body, (jnp.zeros((), jnp.int32), buf0)
+    )
+    return buf[:n]

@@ -1,0 +1,29 @@
+"""Seeded d2h-leak violations — every marked line MUST be found.
+
+Never imported: the analyzer parses it (tests/test_static_analysis.py).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def kernel(x):
+    return x * 2
+
+
+class Scheduler:
+    def _d2h(self, value):
+        # the choke point itself — the ONE place a raw fetch belongs
+        return jax.device_get(value)
+
+    def harvest(self, batch):
+        out = kernel(batch)
+        host = np.asarray(out)  # VIOLATION: numpy coerces a device value
+        peek = out.item()  # VIOLATION: blocking .item()
+        raw = jax.device_get(out)  # VIOLATION: device_get outside _d2h
+        if out:  # VIOLATION: implicit truthiness blocks on the device
+            host = host + 1
+        flag = bool(out)  # VIOLATION: bool() coercion of a device value
+        return host, peek, raw, flag

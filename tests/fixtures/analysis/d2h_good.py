@@ -1,0 +1,32 @@
+"""Negative fixture: disciplined device-boundary code — must stay silent.
+
+Never imported: the analyzer parses it (tests/test_static_analysis.py).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def kernel(x):
+    return x + 1
+
+
+class Scheduler:
+    def _d2h(self, value):
+        # the accounted choke point (counters elided in the fixture)
+        return jax.device_get(value)
+
+    def harvest(self, batch):
+        results_dev = kernel(batch)
+        results_dev.copy_to_host_async()  # non-blocking prefetch is fine
+        both = self._d2h(results_dev)  # routed: this is the contract
+        if both is None:  # identity check — no device sync
+            return None
+        host = np.asarray(both)  # host value by now — plain numpy
+        return int(host[0])
+
+    def host_math(self, rows):
+        arr = np.asarray(rows)  # pure host numpy — never device-resident
+        return arr.tolist()  # host .tolist() is not a fetch

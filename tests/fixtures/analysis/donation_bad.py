@@ -1,0 +1,32 @@
+"""Seeded donation violations — every marked line MUST be found.
+
+Never imported: the analyzer parses it (tests/test_static_analysis.py).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, donate_argnames=("used",))
+def commit(used, delta):
+    return used + delta
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def splice(dst, rows):
+    return jnp.concatenate([dst, rows])
+
+
+def caller(used, delta):
+    alias = used
+    new_used = commit(used, delta)
+    stale = used + 1  # VIOLATION: read after donating `used`
+    worse = alias.sum()  # VIOLATION: alias of the donated buffer
+    return new_used, stale, worse
+
+
+def positional(dst, rows):
+    out = splice(dst, rows)
+    return out, dst.shape, dst  # VIOLATION: `dst` donated by argnum 0

@@ -1,0 +1,34 @@
+"""Negative fixture: donation discipline — must stay silent.
+
+Never imported: the analyzer parses it (tests/test_static_analysis.py).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, donate_argnames=("used",))
+def commit(used, delta):
+    return used + delta
+
+
+def caller(used, delta):
+    total = used.sum()  # reads BEFORE the donation are fine
+    used = commit(used, delta)  # the rebind revives the name
+    after = used.sum()  # ...so this reads the fresh buffer
+    return after, total
+
+
+def branches(used, delta, fast):
+    if fast:
+        used = commit(used, delta)
+    else:
+        used = commit(used, delta)
+    return used  # rebound on both paths — alive
+
+
+def untouched(state, delta):
+    out = commit(state["used"], delta)  # non-name handoffs are the
+    return out, state  # holder-dict discipline, not tracked here

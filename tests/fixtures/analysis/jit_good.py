@@ -44,4 +44,4 @@ def host_wrapper(host_rows):
     # NOT reachable from a jitted root — host numpy/casts are fine here
     arr = np.asarray(host_rows, np.int32)
     total = int(arr.sum())
-    return jax.device_get(kernel(arr, arr, total))
+    return kernel(arr, arr, total)

@@ -1,0 +1,21 @@
+"""Seeded retrace violations — every marked line MUST be found.
+
+Never imported: the analyzer parses it (tests/test_static_analysis.py).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def kernel(x, scale, n: int):
+    return x[:n] * scale
+
+
+def dispatch(batch):
+    a = kernel(batch, 0.5, n=8)  # VIOLATION: weak-typed scalar into the signature
+    b = kernel(batch, batch[0], n=len(batch))  # VIOLATION: unbucketed len() static arg
+    c = kernel(batch, batch[0], n=batch.shape[0])  # VIOLATION: unbucketed .shape static arg
+    return a, b, c

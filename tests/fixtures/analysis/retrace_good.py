@@ -1,0 +1,29 @@
+"""Negative fixture: committed dtypes + bucketed static sizes — silent.
+
+Never imported: the analyzer parses it (tests/test_static_analysis.py).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def bucket_cap(n, floor=1):
+    cap = max(int(floor), 1)
+    while cap < n:
+        cap *= 2
+    return cap
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def kernel(x, scale, n: int):
+    return x[:n] * scale
+
+
+def dispatch(batch):
+    scale = jnp.asarray(0.5, jnp.float32)  # committed dtype — no weak type
+    n = bucket_cap(len(batch))  # bucketed before it reaches the signature
+    a = kernel(batch, scale, n=n)
+    b = kernel(batch, scale, n=bucket_cap(len(batch), 16))  # bucketed inline
+    return a, b

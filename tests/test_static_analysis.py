@@ -17,13 +17,20 @@ import pytest
 from kubernetes_tpu.analysis import default_targets, run_analysis
 from kubernetes_tpu.analysis.__main__ import main as cli_main
 from kubernetes_tpu.analysis.core import (
+    ALL_RULES,
     RULE_BARE_SUPPRESSION,
+    RULE_CLAMP,
+    RULE_D2H,
+    RULE_DONATION,
     RULE_JIT,
     RULE_LOCK,
     RULE_PURITY,
+    RULE_RETRACE,
 )
 
 FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures", "analysis")
+
+CHECKER_KEYS = ("locks", "purity", "jit", "d2h", "donation", "clamp", "retrace")
 
 
 def fixture(name: str) -> str:
@@ -32,7 +39,15 @@ def fixture(name: str) -> str:
 
 def analyze_fixture(name: str):
     path = fixture(name)
-    return run_analysis({"locks": [path], "purity": [path], "jit": [path]})
+    return run_analysis({key: [path] for key in CHECKER_KEYS})
+
+
+def analyze_paths(**overrides):
+    """Run with every checker EMPTY except the given keys — keeps the
+    suppression unit tests off the shipped tree."""
+    targets = {key: [] for key in CHECKER_KEYS}
+    targets.update({k: list(v) for k, v in overrides.items()})
+    return run_analysis(targets)
 
 
 def marked_lines(name: str):
@@ -55,7 +70,7 @@ def test_shipped_tree_is_clean():
 
 def test_default_targets_exist_and_are_nontrivial():
     t = default_targets()
-    for key in ("locks", "purity", "jit"):
+    for key in CHECKER_KEYS:
         assert t[key], key
         for p in t[key]:
             assert os.path.exists(p), p
@@ -91,6 +106,25 @@ def test_cli_rule_filter(capsys):
     capsys.readouterr()
 
 
+def test_cli_rule_filter_new_rules(capsys):
+    assert cli_main(["--rule", RULE_D2H, fixture("d2h_bad.py")]) == 1
+    out = capsys.readouterr().out
+    assert RULE_D2H in out
+    assert cli_main(["--rule", RULE_CLAMP, fixture("clamp_bad.py")]) == 1
+    capsys.readouterr()
+
+
+def test_cli_help_lists_all_rules(capsys):
+    # `--rule` must advertise every rule, the new families included —
+    # the CLI is the discovery surface for the suppression names
+    with pytest.raises(SystemExit) as e:
+        cli_main(["--help"])
+    assert e.value.code == 0
+    out = capsys.readouterr().out
+    for rule in ALL_RULES:
+        assert rule in out, rule
+
+
 # ----- per-checker fixtures --------------------------------------------------
 
 
@@ -100,6 +134,10 @@ def test_cli_rule_filter(capsys):
         ("lock_bad.py", RULE_LOCK),
         ("purity_bad.py", RULE_PURITY),
         ("jit_bad.py", RULE_JIT),
+        ("d2h_bad.py", RULE_D2H),
+        ("donation_bad.py", RULE_DONATION),
+        ("clamp_bad.py", RULE_CLAMP),
+        ("retrace_bad.py", RULE_RETRACE),
     ],
 )
 def test_positive_fixture_caught(name, rule):
@@ -112,7 +150,16 @@ def test_positive_fixture_caught(name, rule):
 
 
 @pytest.mark.parametrize(
-    "name", ["lock_good.py", "purity_good.py", "jit_good.py"]
+    "name",
+    [
+        "lock_good.py",
+        "purity_good.py",
+        "jit_good.py",
+        "d2h_good.py",
+        "donation_good.py",
+        "clamp_good.py",
+        "retrace_good.py",
+    ],
 )
 def test_negative_fixture_silent(name):
     findings = analyze_fixture(name)
@@ -133,7 +180,7 @@ def test_justified_suppression_silences(tmp_path):
     )
     p = tmp_path / "suppressed.py"
     p.write_text(src)
-    findings = run_analysis({"locks": [str(p)], "purity": [], "jit": []})
+    findings = analyze_paths(locks=[str(p)])
     assert findings == [], "\n" + "\n".join(f.format() for f in findings)
 
 
@@ -147,7 +194,7 @@ def test_trailing_suppression_silences(tmp_path):
     )
     p = tmp_path / "trailing.py"
     p.write_text(src)
-    findings = run_analysis({"locks": [str(p)], "purity": [], "jit": []})
+    findings = analyze_paths(locks=[str(p)])
     assert findings == []
 
 
@@ -166,7 +213,7 @@ def test_stacked_suppressions_all_attach(tmp_path):
     )
     p = tmp_path / "stacked.py"
     p.write_text(src)
-    findings = run_analysis({"locks": [str(p)], "purity": [], "jit": []})
+    findings = analyze_paths(locks=[str(p)])
     assert findings == [], "\n" + "\n".join(f.format() for f in findings)
 
 
@@ -180,7 +227,7 @@ def test_bare_suppression_is_itself_a_finding(tmp_path):
     )
     p = tmp_path / "bare.py"
     p.write_text(src)
-    findings = run_analysis({"locks": [str(p)], "purity": [], "jit": []})
+    findings = analyze_paths(locks=[str(p)])
     rules = {f.rule for f in findings}
     # the reasonless comment does NOT silence, and is flagged itself
     assert rules == {RULE_LOCK, RULE_BARE_SUPPRESSION}
@@ -197,8 +244,81 @@ def test_wrong_rule_suppression_does_not_silence(tmp_path):
     )
     p = tmp_path / "wrong.py"
     p.write_text(src)
-    findings = run_analysis({"locks": [str(p)], "purity": [], "jit": []})
+    findings = analyze_paths(locks=[str(p)])
     assert {f.rule for f in findings} == {RULE_LOCK}
+
+
+def test_donation_loop_and_with_targets_revive(tmp_path):
+    # rebinding a donated name via a for-loop target or `with ... as`
+    # revives it — only the read BEFORE the rebinding is a violation
+    src = (
+        "import functools\n"
+        "import jax\n"
+        "\n"
+        "@functools.partial(jax.jit, donate_argnames=('used',))\n"
+        "def commit(used, delta):\n"
+        "    return used + delta\n"
+        "\n"
+        "def loops(used, delta, runs, cm):\n"
+        "    out = commit(used, delta)\n"
+        "    for used in runs:\n"
+        "        out = out + used  # rebound by the loop target: fine\n"
+        "    with cm() as used:\n"
+        "        out = out + used  # rebound by `as`: fine\n"
+        "    return out\n"
+    )
+    p = tmp_path / "revive.py"
+    p.write_text(src)
+    findings = analyze_paths(donation=[str(p)])
+    assert findings == [], "\n" + "\n".join(f.format() for f in findings)
+
+    # control: without the rebindings the same reads ARE violations
+    bad = src.replace("for used in runs:", "for other in runs:").replace(
+        "as used:", "as other:"
+    )
+    p2 = tmp_path / "no_revive.py"
+    p2.write_text(bad)
+    findings = analyze_paths(donation=[str(p2)])
+    assert len(findings) == 2, "\n".join(f.format() for f in findings)
+    assert {f.rule for f in findings} == {RULE_DONATION}
+
+
+def test_d2h_with_header_fetch_caught(tmp_path):
+    # withitem nodes are not exprs — a blocking fetch hiding in a `with`
+    # context header must still be scanned
+    src = (
+        "def harvest(span, count_dev):\n"
+        "    with span(int(count_dev)):\n"
+        "        return 1\n"
+    )
+    p = tmp_path / "withhdr.py"
+    p.write_text(src)
+    findings = analyze_paths(d2h=[str(p)])
+    assert len(findings) == 1 and findings[0].rule == RULE_D2H, findings
+
+
+def test_same_basename_modules_do_not_cross_resolve(tmp_path):
+    # ops/explain.py and observability/explain.py share a basename: a
+    # host module must not resolve ANOTHER module's jit roots through its
+    # own bare names (path-scoped self tables)
+    d1 = tmp_path / "ops"
+    d2 = tmp_path / "obs"
+    d1.mkdir()
+    d2.mkdir()
+    (d1 / "explain.py").write_text(
+        "import jax\n\n@jax.jit\ndef kernel(x):\n    return x\n"
+    )
+    (d2 / "explain.py").write_text(
+        "import numpy as np\n"
+        "def kernel():\n"
+        "    return [1, 2]\n"
+        "def host():\n"
+        "    return np.asarray(kernel())  # local host fn, same name\n"
+    )
+    findings = analyze_paths(
+        jit=[str(d1 / "explain.py")], d2h=[str(d2 / "explain.py")]
+    )
+    assert findings == [], "\n" + "\n".join(f.format() for f in findings)
 
 
 # ----- runtime sanitizer -----------------------------------------------------
@@ -313,3 +433,230 @@ def test_mirror_consistency_noop_when_disabled(monkeypatch):
     monkeypatch.delenv("KTPU_SANITIZE", raising=False)
     sanitizer.reset_enabled_memo()
     sanitizer.check_mirror_consistency(None, None)  # gated off → no touch
+
+
+# ----- retrace hook (jit recompile accounting) -------------------------------
+
+
+@pytest.fixture
+def retrace_armed(sanitize_on):
+    yield sanitize_on
+    sanitize_on.reset_retrace()
+
+
+def test_retrace_hook_counts_post_warm_recompiles(retrace_armed):
+    import jax
+    import jax.numpy as jnp
+
+    san = retrace_armed
+
+    @jax.jit
+    def toy(x):
+        return x + 1
+
+    toy(jnp.ones(3))  # warmup compile
+    san.mark_jit_warm()
+    san.register_jit_root("test.toy", toy)
+    assert san.unexpected_recompiles() == {}
+    toy(jnp.ones(3))  # warm signature — cache hit
+    assert san.unexpected_recompiles() == {}
+    toy(jnp.ones(5))  # new shape → unexpected recompile
+    toy(jnp.ones(7))
+    got = san.unexpected_recompiles()
+    assert got.get("test.toy") == 2, got
+
+
+def test_retrace_counter_lands_in_metrics(retrace_armed):
+    import jax
+    import jax.numpy as jnp
+
+    from kubernetes_tpu.metrics import SchedulerMetrics
+
+    san = retrace_armed
+    prom = SchedulerMetrics()
+    san.register_recompile_counter(prom.jit_recompiles)
+
+    @jax.jit
+    def toy2(x):
+        return x * 3
+
+    toy2(jnp.ones(3))
+    san.mark_jit_warm()
+    san.register_jit_root("test.toy2", toy2)
+    toy2(jnp.ones(9))  # post-warm recompile
+    assert san.unexpected_recompiles().get("test.toy2") == 1
+    try:
+        assert prom.jit_recompiles.value(fn="test.toy2") == 1.0
+        assert "scheduler_tpu_jit_recompiles_total" in prom.registry.expose()
+    finally:
+        san._recompile_counters.discard(prom.jit_recompiles)
+
+
+def test_retrace_discovers_shipped_roots(retrace_armed):
+    san = retrace_armed
+    roots = san._discover_jit_roots()
+    for want in (
+        "fastpath.sig_scan",
+        "resident.resident_run",
+        "chain.chain_dispatch",
+        "gang.gang_run",
+        "wave.wave_run",
+    ):
+        assert want in roots, sorted(roots)
+
+
+def test_retrace_empty_before_warm_mark(retrace_armed):
+    assert retrace_armed.unexpected_recompiles() == {}
+
+
+# ----- warm config0 drain: zero unexpected recompiles ------------------------
+
+
+def _recompile_nodes(n):
+    from kubernetes_tpu.api.resource import Resource
+    from kubernetes_tpu.api.types import Node
+
+    return [
+        Node(
+            name=f"node-{i}",
+            labels={
+                "kubernetes.io/hostname": f"node-{i}",
+                "topology.kubernetes.io/zone": f"z{i % 3}",
+            },
+            capacity=Resource.from_map(
+                {"cpu": "16", "memory": "64Gi", "pods": 64}
+            ),
+        )
+        for i in range(n)
+    ]
+
+
+def _recompile_pods(n, tag):
+    """Mixed workload: signature pods (resident/fast path) + topology-
+    spread pods (wave/chain path) — same SHAPES for every `tag`."""
+    from kubernetes_tpu.api.types import (
+        Container,
+        LabelSelector,
+        Pod,
+        TopologySpreadConstraint,
+    )
+
+    pods = []
+    for i in range(n):
+        app = f"a{i % 4}"
+        spread = ()
+        # segregate: the first 2/3 are plain signature pods (resident /
+        # fast path batches), the last 1/3 carry a spread term (wave /
+        # chain path) — interleaving them would put a cross-pod term in
+        # EVERY batch and route the whole drain through the wave path
+        if i >= (2 * n) // 3:
+            spread = (
+                TopologySpreadConstraint(
+                    max_skew=5,
+                    topology_key="topology.kubernetes.io/zone",
+                    when_unsatisfiable="DoNotSchedule",
+                    label_selector=LabelSelector(match_labels={"app": app}),
+                ),
+            )
+        pods.append(
+            Pod(
+                name=f"{tag}-p{i}",
+                labels={"app": app},
+                topology_spread_constraints=spread,
+                containers=[
+                    Container(
+                        name="c",
+                        requests={
+                            "cpu": ["100m", "250m"][i % 2],
+                            "memory": "64Mi",
+                        },
+                    )
+                ],
+            )
+        )
+    return pods
+
+
+def _recompile_drain(nodes, pods):
+    from kubernetes_tpu.framework import config as cfg
+    from kubernetes_tpu.scheduler import Scheduler
+
+    conf = cfg.SchedulerConfiguration(
+        batch_size=64,
+        fast_device_min=32,
+        resident_run_max=256,
+        resident_window=32,
+    )
+    s = Scheduler(configuration=conf)
+    s.binding_sink = lambda pod, node: None
+    for n in nodes:
+        s.on_node_add(n)
+    for p in pods:
+        s.on_pod_add(p)
+    s.schedule_pending()
+    return s
+
+
+def test_warm_config0_drain_zero_unexpected_recompiles(retrace_armed):
+    """Satellite gate: after a warmup drain compiled every shape the
+    steady state needs, a second same-shaped drain must hit the jit
+    caches exactly — 0 unexpected recompiles across the resident, wave/
+    chain, and fast paths (KTPU_SANITIZE=1 retrace hook)."""
+    san = retrace_armed
+    nodes = _recompile_nodes(16)
+    warm = _recompile_drain(nodes, _recompile_pods(192, "warm"))
+    mix_keys = ("resident_batches", "fast_batches", "wave_batches",
+                "chain_batches")
+    warm_mix = {k: warm.metrics.get(k, 0) for k in mix_keys}
+    san.mark_jit_warm()
+
+    steady = _recompile_drain(nodes, _recompile_pods(192, "steady"))
+    got = san.unexpected_recompiles()
+    assert got == {}, f"unexpected recompiles in a warm drain: {got}"
+    # the run must actually have exercised the paths the gate claims:
+    # resident (signature feed), wave or chain (spread terms), and the
+    # fast committer path
+    mix = {k: steady.metrics.get(k, 0) for k in mix_keys}
+    assert mix["resident_batches"] > 0 or warm_mix["resident_batches"] > 0, (
+        mix,
+        warm_mix,
+    )
+    assert (
+        mix["wave_batches"] + mix["chain_batches"] > 0
+        or warm_mix["wave_batches"] + warm_mix["chain_batches"] > 0
+    ), (mix, warm_mix)
+    assert mix["fast_batches"] > 0 or warm_mix["fast_batches"] > 0, (
+        mix,
+        warm_mix,
+    )
+
+
+# ----- bench --analyze preflight ---------------------------------------------
+
+
+def test_bench_analyze_preflight_refuses_findings(monkeypatch):
+    import io
+    import sys as _sys
+
+    _sys.path.insert(0, os.path.dirname(os.path.dirname(__file__)))
+    try:
+        import bench
+    finally:
+        _sys.path.pop(0)
+
+    import kubernetes_tpu.analysis as analysis_mod
+    from kubernetes_tpu.analysis.core import Finding
+
+    err = io.StringIO()
+    assert bench.analyze_preflight(err=err) is True
+    assert "preflight clean" in err.getvalue()
+
+    def fake_run_analysis():
+        return [Finding("d2h-leak", "x.py", 1, "seeded")]
+
+    monkeypatch.setattr(analysis_mod, "run_analysis", fake_run_analysis)
+    err = io.StringIO()
+    assert bench.analyze_preflight(err=err) is False
+    out = err.getvalue()
+    assert "refusing to record bench JSON" in out
+    assert "d2h-leak" in out
