@@ -1,8 +1,9 @@
 """Invariant analyzers for the TPU scheduler (``python -m kubernetes_tpu.analysis``).
 
-Seven AST checkers guard the contracts the concurrency layering and the
-device boundary rely on (the race-detector/vet role the reference
-scheduler gets from the Go toolchain):
+Ten AST checkers guard the contracts the concurrency layering, the
+device boundary, and the named-axis shape algebra rely on (the
+race-detector/vet role the reference scheduler gets from the Go
+toolchain):
 
   * ``lock-discipline`` — registered lock-guarded fields are only mutated
     under their lock or in callers-verified ``*_under_lock`` methods;
@@ -21,10 +22,23 @@ scheduler gets from the Go toolchain):
     static start, or a justified suppression (XLA clamps/drops
     out-of-range window writes SILENTLY);
   * ``retrace`` — no weak-typed Python scalars or unbucketed
-    shape-derived static args leak into jit signatures.
+    shape-derived static args leak into jit signatures;
+  * ``shape`` — a symbolic named-dim interpreter over everything
+    reachable from the jit roots (``# ktpu: axes(...)`` annotations +
+    ``_KTPU_AXES`` schema tables) flags named-axis mismatches that
+    rank-1 broadcasting would silently absorb, and scan-carry drift;
+  * ``dtype`` — implicit promotions inside the integer kernels (true
+    division, bool arithmetic, weak float widening, per-root
+    ``accum(...)`` carry contracts);
+  * ``shard`` — classifies every op against the ('pods','nodes') mesh:
+    N-axis reductions/gathers must sit under a helper declared in the
+    module's ``_KTPU_N_COLLECTIVES`` roster (the multichip refactor's
+    collective inventory).
 
 Plus a runtime sanitizer (``KTPU_SANITIZE=1``, see ``sanitizer.py``),
-including the jit recompile hook (``scheduler_tpu_jit_recompiles_total``).
+including the jit recompile hook (``scheduler_tpu_jit_recompiles_total``)
+and the eval_shape cross-check of the shape interpreter
+(``scheduler_tpu_shape_check_failures_total``, ``shapecheck.py``).
 Suppressions: ``# ktpu: allow(<rule>) — <reason>`` (reason mandatory).
 """
 
@@ -37,6 +51,7 @@ from kubernetes_tpu.analysis.core import (
     Finding,
     SourceModule,
     collect_bare_suppressions,
+    load_source,
     render_json,
     render_text,
 )
@@ -47,6 +62,11 @@ from kubernetes_tpu.analysis.jit import JitChecker
 from kubernetes_tpu.analysis.locks import LockChecker
 from kubernetes_tpu.analysis.purity import PurityChecker
 from kubernetes_tpu.analysis.retrace import RetraceChecker
+from kubernetes_tpu.analysis.shape import (
+    DtypeChecker,
+    ShapeChecker,
+    ShardChecker,
+)
 
 _PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _REPO_ROOT = os.path.dirname(_PKG_ROOT)
@@ -113,6 +133,9 @@ DONATION_MODULES = JIT_MODULES + (
     "fastpath.py",
 )
 CLAMP_MODULES = JIT_MODULES + (os.path.join("cache", "device_mirror.py"),)
+# the symbolic shape/dtype/shard interpreter walks everything reachable
+# from the jit roots; device_mirror's delta splicer is a root too
+SHAPE_MODULES = JIT_MODULES + (os.path.join("cache", "device_mirror.py"),)
 RETRACE_MODULES = JIT_MODULES + (
     os.path.join("cache", "device_mirror.py"),
     "scheduler.py",
@@ -137,7 +160,16 @@ def default_targets() -> Dict[str, List[str]]:
         "donation": [os.path.join(_PKG_ROOT, p) for p in DONATION_MODULES],
         "clamp": [os.path.join(_PKG_ROOT, p) for p in CLAMP_MODULES],
         "retrace": [os.path.join(_PKG_ROOT, p) for p in RETRACE_MODULES],
+        "shape": [os.path.join(_PKG_ROOT, p) for p in SHAPE_MODULES],
+        "dtype": [os.path.join(_PKG_ROOT, p) for p in SHAPE_MODULES],
+        "shard": [os.path.join(_PKG_ROOT, p) for p in SHAPE_MODULES],
     }
+
+
+# per-rule wall time of the most recent run_analysis() call, seconds —
+# surfaced by `--json` (analyzer-perf telemetry; the shape/dtype/shard
+# families share ONE interpretation, whose cost lands on 'shape')
+last_rule_seconds: Dict[str, float] = {}
 
 
 def run_analysis(
@@ -145,11 +177,17 @@ def run_analysis(
 ) -> List[Finding]:
     """Run every checker over its target file set; returns ALL findings
     (post-suppression), sorted by path/line.  ``targets`` maps checker key
-    ('locks'/'purity'/'jit'/'d2h'/'donation'/'clamp'/'retrace') → file
-    paths; defaults to the shipped tree.  The donation contract document
-    (RESIDENT.md) is only consulted on shipped-tree runs — fixture runs
-    override 'donation' and skip it.
+    ('locks'/'purity'/'jit'/'d2h'/'donation'/'clamp'/'retrace'/'shape'/
+    'dtype'/'shard') → file paths; defaults to the shipped tree.  The
+    donation contract document (RESIDENT.md) is only consulted on
+    shipped-tree runs — fixture runs override 'donation' and skip it.
+
+    Every checker shares one parsed AST per file (core.load_source's
+    mtime-keyed process cache), and the shape/dtype/shard families share
+    one symbolic interpretation per target set.
     """
+    import time as _time
+
     t = dict(default_targets())
     fixture_donation = targets is not None and "donation" in targets
     if targets is not None:
@@ -162,43 +200,38 @@ def run_analysis(
         for p in paths:
             key = os.path.abspath(p)
             if key not in loaded:
-                loaded[key] = SourceModule.load(p)
+                loaded[key] = load_source(p)
             out.append(loaded[key])
         return out
 
     findings: List[Finding] = []
-
-    lc = LockChecker()
-    lc.run(load(t.get("locks", ())))
-    findings.extend(lc.findings)
-
-    pc = PurityChecker()
-    pc.run(load(t.get("purity", ())))
-    findings.extend(pc.findings)
-
-    jc = JitChecker()
-    jc.run(load(t.get("jit", ())))
-    findings.extend(jc.findings)
-
-    dc = D2HChecker()
-    dc.run(load(t.get("d2h", ())), root_mods=load(t.get("jit", ())))
-    findings.extend(dc.findings)
+    last_rule_seconds.clear()
 
     contract = None
     if not fixture_donation and os.path.exists(DONATION_CONTRACT_DOC):
         with open(DONATION_CONTRACT_DOC, "r", encoding="utf-8") as f:
             contract = f.read()
-    nc = DonationChecker()
-    nc.run(load(t.get("donation", ())), contract_text=contract)
-    findings.extend(nc.findings)
 
-    cc = ClampChecker()
-    cc.run(load(t.get("clamp", ())))
-    findings.extend(cc.findings)
-
-    rc = RetraceChecker()
-    rc.run(load(t.get("retrace", ())))
-    findings.extend(rc.findings)
+    engine_cache: Dict[tuple, object] = {}
+    plan = (
+        ("locks", LockChecker, {}),
+        ("purity", PurityChecker, {}),
+        ("jit", JitChecker, {}),
+        ("d2h", D2HChecker, {"root_mods": lambda: load(t.get("jit", ()))}),
+        ("donation", DonationChecker, {"contract_text": lambda: contract}),
+        ("clamp", ClampChecker, {}),
+        ("retrace", RetraceChecker, {}),
+        ("shape", ShapeChecker, {"engine_cache": lambda: engine_cache}),
+        ("dtype", DtypeChecker, {"engine_cache": lambda: engine_cache}),
+        ("shard", ShardChecker, {"engine_cache": lambda: engine_cache}),
+    )
+    for key, cls, extra in plan:
+        start = _time.perf_counter()
+        checker = cls()
+        kwargs = {k: v() for k, v in extra.items()}
+        checker.run(load(t.get(key, ())), **kwargs)
+        findings.extend(checker.findings)
+        last_rule_seconds[checker.rule] = _time.perf_counter() - start
 
     findings.extend(collect_bare_suppressions(loaded.values()))
     findings.sort(key=lambda f: (f.path, f.line, f.rule))

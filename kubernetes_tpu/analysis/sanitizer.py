@@ -229,6 +229,64 @@ def reset_retrace() -> None:
         _recompile_counts.clear()
 
 
+# ----- shape cross-check (eval_shape vs the symbolic interpreter) ------------
+#
+# The static `shape` rule trusts the interpreter's op models and the
+# axes annotations; this is the dynamic complement: under KTPU_SANITIZE=1
+# the first drain triggers ONE cross-validation of every instantiable
+# jit root against jax.eval_shape (analysis/shapecheck.py — abstract
+# tracing only, no compiles).  Mismatches bump
+# scheduler_tpu_shape_check_failures_total{fn=} on every registered
+# counter, so a drifted annotation or a mis-modelled op cannot pass a
+# sanitized run silently.
+
+_shape_counters: "weakref.WeakSet" = weakref.WeakSet()
+_shape_check_result: Optional[dict] = None
+_shape_lock = threading.Lock()
+
+
+def register_shape_counter(counter) -> None:
+    """Wire a metrics Counter (scheduler_tpu_shape_check_failures_total);
+    idempotent per instance, weakly held."""
+    if counter is not None:
+        _shape_counters.add(counter)
+
+
+def check_root_shapes() -> dict:
+    """Run (once per process) the eval_shape cross-check; returns
+    {root → [mismatches]} and feeds the failure counters.  No-op when
+    the sanitizer is off."""
+    global _shape_check_result
+    if not enabled():
+        return {}
+    with _shape_lock:
+        if _shape_check_result is not None:
+            return _shape_check_result
+        try:
+            from kubernetes_tpu.analysis import shapecheck
+
+            result = shapecheck.cross_check()
+        except Exception:  # noqa: BLE001 — a broken checker must not
+            # kill the drain; an empty-but-armed result would hide it, so
+            # surface the breakage as a synthetic failure entry instead
+            result = {"<shapecheck>": ["cross-check harness raised"]}
+        _shape_check_result = result
+    for fn, problems in result.items():
+        for c in list(_shape_counters):
+            try:
+                c.inc(len(problems), fn=fn)
+            except Exception:  # noqa: BLE001 — accounting only
+                pass
+    return result
+
+
+def reset_shape_check() -> None:
+    """Drop the memoized cross-check result (tests re-run per case)."""
+    global _shape_check_result
+    with _shape_lock:
+        _shape_check_result = None
+
+
 def check_mirror_consistency(cache, mirror) -> None:
     """Snapshot↔mirror drift probe, run after each drain.
 

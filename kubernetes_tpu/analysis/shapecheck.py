@@ -1,0 +1,358 @@
+"""Runtime eval_shape cross-check of the symbolic shape interpreter
+(KTPU_SANITIZE=1; the dynamic half of the ``shape`` rule).
+
+The static interpreter (analysis/shape.py) infers every jit root's
+return shapes from its ``# ktpu: axes(...)`` annotation.  If the
+interpreter's model of an op drifts from jax's (or an annotation drifts
+from the code), its findings silently rot.  This module closes the
+loop: for every annotated root it builds a REPRESENTATIVE instantiation
+— ``jax.ShapeDtypeStruct`` leaves shaped by a small distinct-prime size
+assignment, the declared ``static(...)`` values, a real PRNG key for
+``key`` params — runs ``jax.eval_shape`` (abstract tracing, no
+compilation, no device), and compares the traced output pytree against
+the interpreter's inferred symbolic return evaluated at the same sizes.
+
+Any disagreement is a CROSS-CHECK FAILURE: either the kernel changed
+shape behaviour the annotation/interpreter didn't follow, or the
+interpreter mis-models an op.  Failures count into
+``scheduler_tpu_shape_check_failures_total{fn=}`` (wired by the
+scheduler under KTPU_SANITIZE, once per process) and fail the tier-1
+gate via tests/test_static_analysis.py.
+
+Roots marked ``# ktpu: noinstantiate — <reason>`` are excluded (their
+shapes live outside the signature, e.g. wire's lru_cache treedefs);
+``skipped()`` reports them so the exclusion list stays visible.
+"""
+
+from __future__ import annotations
+
+import ast
+import importlib
+import os
+from typing import Dict, List, Optional, Tuple
+
+from kubernetes_tpu.analysis.shape import (
+    Arr,
+    DictV,
+    RecV,
+    TupV,
+    Unknown,
+    _DTYPES,
+    _as_lin,
+    dim_str,
+    root_summaries,
+    spec_to_aval,
+)
+
+# PAIRWISE-DISTINCT sizes per canonical axis, so a transposed or
+# mislabeled dim CANNOT alias another axis's size (the whole point of
+# the cross-check — a swap of any two named axes changes a traced
+# shape).  Symbols not listed (private DTable widths, opaque composites)
+# fall back to DEFAULT_DIM, which deliberately collides only with other
+# unlisted symbols (they are per-instance namespaced and never unify).
+DEFAULT_SIZES = {
+    "P": 5,
+    "N": 7,
+    "S": 11,
+    "Rn": 4,  # >= N_FIXED_LANES
+    "Rp": 6,  # pod lanes may exceed node lanes (extended resources)
+    "C": 2,
+    "A": 8,
+    "K": 9,
+    "V": 31,
+    "TA": 10,
+    "TL": 12,
+    "U": 13,
+    "UP": 14,
+    "E": 15,
+    "M": 16,
+    "NS": 17,
+    "IMG": 18,
+    "IP": 19,
+    "G": 20,
+    "Kd": 21,
+    "Kd2": 22,
+    "Tsp": 23,
+    "Tip": 24,
+    "NT": 25,
+    "PT": 26,
+    "L": 27,
+    "B": 64,
+}
+assert len(set(DEFAULT_SIZES.values())) == len(DEFAULT_SIZES)
+DEFAULT_DIM = 3
+
+_NP_DTYPES = {
+    "bool": "bool_",
+    "i8": "int8",
+    "i16": "int16",
+    "i32": "int32",
+    "i64": "int64",
+    "u8": "uint8",
+    "u16": "uint16",
+    "u32": "uint32",
+    "u64": "uint64",
+    "f16": "float16",
+    "f32": "float32",
+    "f64": "float64",
+}
+
+# where the annotated classes live (resolution order)
+_CLASS_MODULES = (
+    "kubernetes_tpu.ops.common",
+    "kubernetes_tpu.ops.gang",
+)
+
+
+def _concrete_dim(d, sizes) -> Optional[int]:
+    lin = _as_lin(d)
+    if lin is None:
+        return None
+    const, syms = lin
+    out = const
+    for s, c in syms:
+        out += c * sizes.get(s, DEFAULT_DIM)
+    return out
+
+
+def _np_dtype(dt: str):
+    import numpy as np
+
+    return getattr(np, _NP_DTYPES[dt])
+
+
+def _resolve_class(name: str):
+    for modname in _CLASS_MODULES:
+        try:
+            mod = importlib.import_module(modname)
+        except Exception:  # noqa: BLE001 — partial trees
+            continue
+        obj = getattr(mod, name, None)
+        if obj is not None:
+            return obj
+    return None
+
+
+def _build_value(av, sizes):
+    """Abstract value → instantiation (ShapeDtypeStruct leaves)."""
+    import jax
+
+    if isinstance(av, Arr):
+        if av.shape is None or av.dtype is None:
+            raise ValueError("unconcretizable array spec")
+        dims = [_concrete_dim(d, sizes) for d in av.shape]
+        if any(d is None for d in dims):
+            raise ValueError("unconcretizable dim")
+        return jax.ShapeDtypeStruct(tuple(dims), _np_dtype(av.dtype))
+    if isinstance(av, RecV):
+        cls = _resolve_class(av.cls)
+        if cls is None:
+            raise ValueError(f"class {av.cls} not importable")
+        fields = {k: _build_value(v, sizes) for k, v in av.fields.items()}
+        return cls(**fields)
+    if isinstance(av, TupV):
+        return tuple(_build_value(i, sizes) for i in av.items)
+    raise ValueError(f"unconcretizable spec {av!r}")
+
+
+def _compare(path: str, inferred, actual, sizes, problems: List[str]) -> None:
+    """Walk the inferred symbolic value against the eval_shape pytree.
+    Unknown / unknown dims are wildcards — the check only bites where the
+    interpreter CLAIMED knowledge."""
+    if isinstance(inferred, Unknown):
+        return
+    if isinstance(inferred, Arr):
+        shape = getattr(actual, "shape", None)
+        if shape is None:
+            problems.append(
+                f"{path}: inferred array {inferred!r}, traced {type(actual).__name__}"
+            )
+            return
+        if inferred.shape is not None:
+            if len(inferred.shape) != len(shape):
+                problems.append(
+                    f"{path}: inferred rank {len(inferred.shape)} "
+                    f"({_fmt_shape(inferred.shape, sizes)}), traced shape "
+                    f"{tuple(shape)}"
+                )
+                return
+            for i, (d, real) in enumerate(zip(inferred.shape, shape)):
+                want = _concrete_dim(d, sizes)
+                if want is not None and want != real:
+                    problems.append(
+                        f"{path}: axis {i} inferred {dim_str(d)}={want}, "
+                        f"traced {real}"
+                    )
+        if inferred.dtype is not None:
+            import numpy as np
+
+            want_dt = np.dtype(_np_dtype(inferred.dtype))
+            got_dt = np.dtype(getattr(actual, "dtype", None))
+            if want_dt != got_dt:
+                problems.append(
+                    f"{path}: inferred dtype {want_dt}, traced {got_dt}"
+                )
+        return
+    if isinstance(inferred, TupV):
+        items = None
+        if isinstance(actual, (tuple, list)):
+            items = list(actual)
+        elif hasattr(actual, "_fields"):  # NamedTuple output
+            items = list(actual)
+        if items is None:
+            problems.append(
+                f"{path}: inferred {len(inferred.items)}-tuple, traced "
+                f"{type(actual).__name__}"
+            )
+            return
+        if len(items) != len(inferred.items):
+            problems.append(
+                f"{path}: inferred {len(inferred.items)} elements, traced "
+                f"{len(items)}"
+            )
+            return
+        for i, (iv, av) in enumerate(zip(inferred.items, items)):
+            _compare(f"{path}[{i}]", iv, av, sizes, problems)
+        return
+    if isinstance(inferred, DictV):
+        if not isinstance(actual, dict):
+            problems.append(
+                f"{path}: inferred dict, traced {type(actual).__name__}"
+            )
+            return
+        missing = set(inferred.entries) - set(actual)
+        extra = set(actual) - set(inferred.entries)
+        if missing or extra:
+            problems.append(
+                f"{path}: key drift — inferred-only {sorted(missing)}, "
+                f"traced-only {sorted(extra)}"
+            )
+        for k in set(inferred.entries) & set(actual):
+            _compare(f"{path}[{k!r}]", inferred.entries[k], actual[k],
+                     sizes, problems)
+        return
+    if isinstance(inferred, RecV):
+        for k, iv in inferred.fields.items():
+            if hasattr(actual, k):
+                _compare(f"{path}.{k}", iv, getattr(actual, k), sizes,
+                         problems)
+        return
+    # host statics / dims in return position: nothing to compare
+
+
+def _fmt_shape(shape, sizes):
+    return "[" + ", ".join(dim_str(d) for d in shape) + "]"
+
+
+def _instantiate_args(rec, ann, engine, sizes):
+    """(traced kwargs, static kwargs) for the root call, per the
+    annotation: axes() params get ShapeDtypeStructs/class instances,
+    `key` params a real PRNGKey, static(...) params their declared
+    values; everything else relies on its default.  Statics are closed
+    over with functools.partial — jax.eval_shape abstracts every direct
+    argument, and a tracer in a static_argnames slot is unhashable."""
+    import jax
+
+    kwargs = {}
+    statics = {}
+    fnode = rec.node
+    params = {p.arg for p in fnode.args.args + fnode.args.kwonlyargs}
+    has_default = set()
+    pos = fnode.args.args
+    for p in pos[len(pos) - len(fnode.args.defaults):]:
+        has_default.add(p.arg)
+    for p, d in zip(fnode.args.kwonlyargs, fnode.args.kw_defaults):
+        if d is not None:
+            has_default.add(p.arg)
+    for name, expr in ann.axes.items():
+        if name not in params:
+            continue
+        if isinstance(expr, ast.Name) and expr.id == "key":
+            kwargs[name] = jax.random.PRNGKey(0)
+            continue
+        av = spec_to_aval(expr, engine.class_tables, ns=name)
+        if isinstance(av, Unknown):
+            continue  # `any` — leave to the default
+        kwargs[name] = _build_value(av, sizes)
+    for name, value in ann.static_values.items():
+        if name in params:
+            statics[name] = value
+    for p in params:
+        if p not in kwargs and p not in statics and p not in has_default:
+            raise ValueError(f"parameter {p!r} has no annotation and no "
+                             "default — cannot instantiate")
+    return kwargs, statics
+
+
+def cross_check(sizes: Optional[Dict[str, int]] = None,
+                mods=None) -> Dict[str, List[str]]:
+    """Run the eval_shape cross-check over every instantiable annotated
+    root.  Returns {root → [mismatch descriptions]}; empty dict = all
+    clean.  Instantiation failures are reported as mismatches too — a
+    root that can no longer be built from its annotation IS drift.
+    """
+    import jax
+
+    from kubernetes_tpu.analysis import SHAPE_MODULES, _PKG_ROOT
+    from kubernetes_tpu.analysis.core import load_source
+
+    sizes = dict(DEFAULT_SIZES, **(sizes or {}))
+    if mods is None:
+        mods = [load_source(os.path.join(_PKG_ROOT, p))
+                for p in SHAPE_MODULES]
+    out: Dict[str, List[str]] = {}
+    for key, rec, ann, inferred, engine in root_summaries(mods):
+        if ann.noinstantiate is not None or not ann.has_axes:
+            continue
+        if "." in rec.qual:
+            # a nested root cannot be imported by qualname; silently
+            # skipping would lose coverage invisibly — demand the
+            # reasoned opt-out instead
+            out[key] = [
+                "nested jit root cannot be instantiated from its "
+                "annotation — add `# ktpu: noinstantiate — <reason>` "
+                "(and cover it with an end-to-end test)"
+            ]
+            continue
+        modname = _module_name_for(rec.mod.path)
+        problems: List[str] = []
+        try:
+            import functools
+
+            mod = importlib.import_module(modname)
+            fn = getattr(mod, rec.qual)
+            kwargs, statics = _instantiate_args(rec, ann, engine, sizes)
+            if statics:
+                fn = functools.partial(fn, **statics)
+            traced = jax.eval_shape(fn, **kwargs)
+        except Exception as e:  # noqa: BLE001 — any failure IS a finding
+            out[key] = [f"instantiation/trace failed: {e!r:.300}"]
+            continue
+        _compare("return", inferred, traced, sizes, problems)
+        if problems:
+            out[key] = problems
+    return out
+
+
+def skipped(mods=None) -> Dict[str, str]:
+    """{root → reason} for roots excluded via `# ktpu: noinstantiate`."""
+    from kubernetes_tpu.analysis import SHAPE_MODULES, _PKG_ROOT
+    from kubernetes_tpu.analysis.core import load_source
+
+    if mods is None:
+        mods = [load_source(os.path.join(_PKG_ROOT, p))
+                for p in SHAPE_MODULES]
+    out = {}
+    for key, rec, ann, _inferred, _eng in root_summaries(mods):
+        if ann.noinstantiate is not None:
+            out[key] = ann.noinstantiate
+    return out
+
+
+def _module_name_for(path: str) -> str:
+    parts = os.path.normpath(os.path.abspath(path)).split(os.sep)
+    if "kubernetes_tpu" in parts:
+        idx = len(parts) - 1 - parts[::-1].index("kubernetes_tpu")
+        return ".".join(parts[idx:])[: -len(".py")]
+    # out-of-tree module (test fixtures): import by basename via sys.path
+    return parts[-1][: -len(".py")]

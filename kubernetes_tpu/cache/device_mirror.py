@@ -49,6 +49,10 @@ def _delta_applier(spec, treedef, with_rows: bool):
     into the donated DeviceCluster — one transfer, one dispatch."""
     from kubernetes_tpu.ops import wire
 
+    # ktpu: axes(dc=DeviceCluster, buf=u8[B])
+    # ktpu: noinstantiate — the delta layout lives in the lru_cache key
+    #   (spec, treedef, with_rows); the splice is exercised end-to-end by
+    #   test_device_mirror instead
     @functools.partial(jax.jit, donate_argnums=(0,))
     def apply(dc: DeviceCluster, buf) -> DeviceCluster:
         tree = jax.tree_util.tree_unflatten(treedef, wire.unpack(buf, spec))

@@ -661,6 +661,15 @@ class SchedulerMetrics:
                 ("fn",),
             )
         )
+        self.shape_check_failures = r.register(
+            Counter(
+                "scheduler_tpu_shape_check_failures_total",
+                "eval_shape cross-check mismatches against the symbolic "
+                "shape interpreter, per jit root (KTPU_SANITIZE=1; fn: "
+                "module.function).",
+                ("fn",),
+            )
+        )
         self.chaos_injected = r.register(
             Counter(
                 "scheduler_tpu_chaos_injected_total",

@@ -73,6 +73,16 @@ def caps_compatible(dc_shapes, pb) -> bool:
     )
 
 
+# ktpu: axes(dc=DeviceCluster, db=DeviceBatch, hostname_key=i32, e_cursor=i32, m_cursor=i32)
+# ktpu: axes(nom_node=i32[G], nom_prio=i32[G], nom_req=i32[G,Rn])
+# ktpu: axes(sp_keys=i32[Kd], sp_cdv_tab=i32[Kd,N], ip_keys=i32[Kd2])
+# ktpu: axes(tid_sp=i32[P,C], rep_sp_p=i32[Tsp], rep_sp_c=i32[Tsp])
+# ktpu: axes(tid_ip=i32[P,A], rep_ip_p=i32[Tip], rep_ip_u=i32[Tip], ip_cdv_tab=i32[Kd2,N])
+# ktpu: accum(i64, i32, bool)
+# ktpu: static(v_cap=16)
+# ktpu: noinstantiate — donates and splices the cluster at host-checked
+#   cursors; the representative instantiation would need a consistent
+#   (e_cursor, m_cursor, capacity) triple the schema cannot express
 @functools.partial(
     jax.jit,
     donate_argnums=(0,),

@@ -254,6 +254,112 @@ class DeviceBatch:
 
 
 # ---------------------------------------------------------------------------
+# Named-axis schema (consumed by the static analyzer's shape/dtype/shard
+# interpreter — `python -m kubernetes_tpu.analysis`, ANALYSIS.md glossary).
+# One entry per device dataclass; dims use the canonical axis names
+# (P pods, N nodes, Rn/Rp resource lanes, K label keys, V value vocab,
+# TA taints, U/UP ports, E placed pods, M terms, NS namespaces, C spread
+# slots, A inter-pod slots, NT/PT selector terms, TL tolerations,
+# IMG/IP images, L log table).  A trailing underscore marks a dim PRIVATE
+# to the class schema (each DTable instance is bucketed independently);
+# `*` splices the owning field's lead dims.
+# ---------------------------------------------------------------------------
+
+_KTPU_AXES = {
+    "DTable": {
+        "req_key": "i32[*,Q_]",
+        "req_op": "i32[*,Q_]",
+        "req_vals": "i32[*,Q_,Y_]",
+        "req_rhs": "i32[*,Q_]",
+        "term_valid": "bool[*]",
+    },
+    "DeviceCluster": {
+        "allocatable": "i32[N,Rn]",
+        "requested": "i32[N,Rn]",
+        "nonzero_req": "i32[N,2]",
+        "num_pods": "i32[N]",
+        "allowed_pods": "i32[N]",
+        "node_labels": "i32[N,K]",
+        "val_ints": "i32[V]",
+        "taint_key": "i32[N,TA]",
+        "taint_val": "i32[N,TA]",
+        "taint_effect": "i32[N,TA]",
+        "unschedulable": "bool[N]",
+        "node_valid": "bool[N]",
+        "used_ppk": "i32[N,U]",
+        "used_ip": "i32[N,U]",
+        "used_wild": "bool[N,U]",
+        "img_sizes": "i64[N,IMG]",
+        "visit_rank": "i32[N]",
+        "epod_node": "i32[E]",
+        "epod_ns": "i32[E]",
+        "epod_labels": "i32[E,K]",
+        "epod_valid": "bool[E]",
+        "epod_deleting": "bool[E]",
+        "term_pod": "i32[M]",
+        "term_kind": "i32[M]",
+        "term_topo": "i32[M]",
+        "term_weight": "i32[M]",
+        "term_table": "DTable[M,1]",
+        "term_ns_all": "bool[M]",
+        "term_ns_ids": "i32[M,NS]",
+        "name_key": "i32",
+        "unsched_key": "i32",
+        "empty_val": "i32",
+        "n_valid_nodes": "i32",
+        # NOT the node axis: a value-indexed fixed-point log table (its
+        # length happens to be N+2) — gathers into it are shard-neutral
+        "log_tab": "i64[L]",
+    },
+    "DeviceBatch": {
+        "requests": "i32[P,Rp]",
+        "nonzero_req": "i32[P,2]",
+        "ns_id": "i32[P]",
+        "priority": "i32[P]",
+        "labels": "i32[P,K]",
+        "valid": "bool[P]",
+        "node_sel": "DTable[P,NT]",
+        "pref_node": "DTable[P,PT]",
+        "pref_weight": "i32[P,PT]",
+        "tol_key": "i32[P,TL]",
+        "tol_op": "i32[P,TL]",
+        "tol_val": "i32[P,TL]",
+        "tol_effect": "i32[P,TL]",
+        "tsc_table": "DTable[P,C]",
+        "tsc_topo": "i32[P,C]",
+        "tsc_max_skew": "i32[P,C]",
+        "tsc_hard": "bool[P,C]",
+        "tsc_min_domains": "i32[P,C]",
+        "tsc_honor_affinity": "bool[P,C]",
+        "tsc_honor_taints": "bool[P,C]",
+        "aff_table": "DTable[P,A]",
+        "aff_kind": "i32[P,A]",
+        "aff_topo": "i32[P,A]",
+        "aff_weight": "i32[P,A]",
+        "aff_ns_all": "bool[P,A]",
+        "aff_ns_ids": "i32[P,A,NS]",
+        "target_name_val": "i32[P]",
+        "want_ppk": "i32[P,UP]",
+        "want_ip": "i32[P,UP]",
+        "want_wild": "bool[P,UP]",
+        "img_ids": "i32[P,IP]",
+        "n_containers": "i32[P]",
+    },
+}
+
+# Declared N-axis collectives (shard rule): these helpers deliberately
+# cross the node axis — segment-scatters into per-node rows and
+# domain-id spaces.  Under a sharded N mesh each becomes a cross-shard
+# collective; the multichip refactor (ROADMAP item 2) routes exactly
+# this roster through jax collectives.
+_KTPU_N_COLLECTIVES = {
+    "per_node_counts": "segment-scatter of per-pod values into [N] rows",
+    "domain_stats": "segment-reduce of [N] rows into topology domains and "
+    "gather back per node",
+}
+
+
+# ---------------------------------------------------------------------------
 # Conjunction evaluation
 # ---------------------------------------------------------------------------
 

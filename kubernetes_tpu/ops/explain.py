@@ -38,7 +38,17 @@ from kubernetes_tpu.ops import gang
 from kubernetes_tpu.ops.common import DeviceBatch, DeviceCluster, I32
 from kubernetes_tpu.snapshot.schema import N_FIXED_LANES
 
+# shard-rule roster: diagnosis recomputes minMatch over the tracked
+# node set per constraint — inherently a full-N reduction
+_KTPU_N_COLLECTIVES = {
+    "explain_masks._spread_one": "per-constraint min-match over the "
+    "tracked N axis (filtering.go:313 semantics)",
+}
 
+
+# ktpu: axes(dc=DeviceCluster, db=DeviceBatch, hostname_key=i32, extra_mask=bool[P,N])
+# ktpu: axes(sp_keys=i32[Kd], sp_cdv_tab=i32[Kd,N], ip_keys=i32[Kd2])
+# ktpu: static(v_cap=16)
 @functools.partial(
     jax.jit,
     static_argnames=(

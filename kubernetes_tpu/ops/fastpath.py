@@ -31,7 +31,17 @@ MAX = 100  # MaxNodeScore
 I32 = jnp.int32
 I64 = jnp.int64
 
+# shard-rule roster: the sequential-equivalent argmax commit is the
+# serial core — per-step first-max argmax over all N nodes plus the
+# chosen node's gather; inherently a full-width collective per pod
+_KTPU_N_COLLECTIVES = {
+    "make_sig_step.step": "per-pod argmax/gather over the full node axis "
+    "(selectHost first-max semantics)",
+}
 
+
+# ktpu: axes(dc=DeviceCluster, db=DeviceBatch)
+# ktpu: static(enabled=("NodeName", "NodeUnschedulable", "TaintToleration", "NodeAffinity"), has_images=True)
 @functools.partial(jax.jit, static_argnames=("enabled", "has_images"))
 def static_eval(dc, db, enabled: frozenset, has_images: bool):
     """Static filters + raw static scores for a representative batch.
@@ -194,6 +204,11 @@ def make_sig_step(
     return step
 
 
+# ktpu: axes(sig_ids=i32[P], sig_req=i64[S,Rn], sig_nz=i64[S,2], sig_allzero=bool[S])
+# ktpu: axes(sig_ok=bool[S,N], sig_img=i64[S,N], alloc=i64[N,Rn], allowed=i32[N])
+# ktpu: axes(used=i64[N,Rn], nz0=i64[N], nz1=i64[N], num_pods=i32[N])
+# ktpu: accum(i64, i32, bool)
+# ktpu: static(w_fit=1, w_bal=1, w_img=1, check_fit=True)
 @functools.partial(
     jax.jit,
     static_argnames=("w_fit", "w_bal", "w_img", "check_fit"),

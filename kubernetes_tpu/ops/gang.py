@@ -62,6 +62,73 @@ from kubernetes_tpu.snapshot.schema import (
 MAX = S.MAX_NODE_SCORE
 _FX = S._FX
 
+# Named-axis schema of the precompute product (analyzer shape rules).
+# J — the batch-peer view of the P axis — is spelled P here: the two are
+# the same size by construction and must unify in the shape algebra
+# (ANALYSIS.md glossary).
+_KTPU_AXES = {
+    "GangStatics": {
+        "static_mask": "bool[P,N]",
+        "sp_hard": "bool[P,C]",
+        "sp_soft": "bool[P,C]",
+        "sp_dv": "i32[P,C,N]",
+        "sp_te": "bool[P,C,N]",
+        "sp_dom_cnt": "i32[P,C,N]",
+        "sp_dom_pres": "bool[P,C,N]",
+        "sp_ndom": "i32[P,C]",
+        "sp_self": "bool[P,C]",
+        "sp_bmatch": "bool[P,C,P]",
+        "sp_is_host": "bool[P,C]",
+        "sp_counting": "bool[P,C,N]",
+        "sp_node_cnt": "i32[P,C,N]",
+        "sp_sc_dom": "i32[P,C,N]",
+        "sp_all_keys": "bool[P,N]",
+        "sp_cdv": "i32[P,C,N]",
+        "ip_dv": "i32[P,A,N]",
+        "ip_dom_cnt": "i32[P,A,N]",
+        "ip_viol_existing": "bool[P,N]",
+        "ip_sym": "i64[P,N]",
+        "ip_any_static": "bool[P]",
+        "ip_self_all": "bool[P]",
+        "ip_bmatch": "bool[P,A,P]",
+        "ip_is_aff": "bool[P,A]",
+        "ip_is_anti": "bool[P,A]",
+        "ip_pref_w": "i64[P,A]",
+        "ip_sym_w": "i64[P,A]",
+        "ip_key_idx": "i32[P,A]",
+        "ip_key_cols": "i32[Kd2,N]",
+        "sc_taint": "i64[P,N]",
+        "sc_nodeaff": "i64[P,N]",
+        "sc_image": "i64[P,N]",
+        "port_b": "bool[P,P]",
+        "d_nodename": "bool[P,N]",
+        "d_unsched": "bool[P,N]",
+        "d_taints": "bool[P,N]",
+        "d_nodeaff": "bool[P,N]",
+        "d_ports": "bool[P,N]",
+        "d_extra": "bool[P,N]",
+    },
+}
+
+# shard-rule roster: the serial verdict core and its per-pod helpers are
+# full-node-width by design — every entry is a cross-shard collective on
+# a sharded N mesh (the gang scan itself stays single-chip; the wave's
+# [T, N] algebra is the shardable path, ROADMAP item 2)
+_KTPU_N_COLLECTIVES = {
+    "pod_step": "per-pod argmax/select over all N nodes + sampling-window "
+    "rotation gathers (selectHost / nodeTree order semantics)",
+    "spread_constraints": "min-match over the tracked N axis "
+    "(filtering.go:313 minMatch)",
+    "interpod_constraints": "per-term verdicts collapse over N-wide rows",
+    "_spread_raw": "counted-node totals + per-domain [C,N,d_cap] "
+    "compare+reduce over N",
+    "_norm_default": "score normalization max over the feasible N axis",
+    "_norm_minmax": "score normalization min+max over the feasible N axis",
+    "_norm_spread": "spread normalization min+max over the valid N axis",
+    "gang_schedule.heavy_parts": "peer-count einsum contractions over N "
+    "(the [C,N,J]/[AT,N,J] dense compare+reduce)",
+}
+
 
 class GangStatics(NamedTuple):
     """State-independent precompute for one (cluster, batch) pair."""
@@ -873,6 +940,11 @@ def pod_step(
     return new_state, (choice, n_feas, reason_counts)
 
 
+# ktpu: axes(dc=DeviceCluster, db=DeviceBatch, g=GangStatics)
+# ktpu: axes(nom_node=i32[G], nom_prio=i32[G], nom_req=i32[G,Rn], extra_score=i64[P,N])
+# ktpu: axes(sample_k=i32, sample_start=i32, tie_key=key, attempt_base=i32)
+# ktpu: accum(i64, i32, bool)
+# ktpu: static(v_cap=16)
 @functools.partial(
     jax.jit,
     static_argnames=("v_cap", "weights", "check_fit", "d_cap", "fit_strategy"),
@@ -1106,6 +1178,12 @@ def gang_schedule(
     return chosen, n_feas, reason_counts, tallies
 
 
+# ktpu: axes(dc=DeviceCluster, db=DeviceBatch, hostname_key=i32, extra_mask=bool[P,N])
+# ktpu: axes(nom_node=i32[G], nom_prio=i32[G], nom_req=i32[G,Rn], extra_score=i64[P,N])
+# ktpu: axes(sp_keys=i32[Kd], sp_cdv_tab=i32[Kd,N], ip_keys=i32[Kd2])
+# ktpu: axes(sample_k=i32, sample_start=i32, tie_key=key, attempt_base=i32)
+# ktpu: accum(i64, i32, bool)
+# ktpu: static(v_cap=16)
 @functools.partial(
     jax.jit,
     static_argnames=(

@@ -27,6 +27,13 @@ from kubernetes_tpu.snapshot.interner import PAD as PAD_
 from kubernetes_tpu.snapshot.schema import PodBatch, bucket_cap
 
 
+# shard-rule roster: the one-shot pipeline ends in selectHost — a
+# full-width argmax over N (single-chip path; the batched paths shard)
+_KTPU_N_COLLECTIVES = {
+    "_pipeline": "final per-pod argmax/any/sum over the full node axis",
+}
+
+
 class PipelineResult(NamedTuple):
     chosen: jnp.ndarray  # i32 [P] node index or -1
     feasible: jnp.ndarray  # bool [P, N]
@@ -34,6 +41,8 @@ class PipelineResult(NamedTuple):
     n_feasible: jnp.ndarray  # i32 [P]
 
 
+# ktpu: axes(dc=DeviceCluster, db=DeviceBatch, hostname_key=i32)
+# ktpu: static(v_cap=16)
 @functools.partial(
     jax.jit,
     static_argnames=("v_cap", "has_interpod", "has_spread", "has_images"),

@@ -30,7 +30,16 @@ import jax.numpy as jnp
 from kubernetes_tpu.ops import filters as F
 from kubernetes_tpu.ops.common import DeviceBatch, DeviceCluster, I32
 
+# shard-rule roster: victim-removal totals are segment-sums of placed
+# pods INTO per-node rows — a scatter across a sharded N axis
+_KTPU_N_COLLECTIVES = {
+    "narrow_candidates.per_group": "per-priority-group segment-sum of "
+    "victim requests/counts into [N] rows",
+}
 
+
+# ktpu: axes(dc=DeviceCluster, db=DeviceBatch, victim_node=i32[E], victim_prio=i32[E])
+# ktpu: axes(victim_req=i32[E,Rn], prio_groups=i32[G], pod_group=i32[P])
 @jax.jit
 def narrow_candidates(
     dc: DeviceCluster,

@@ -60,6 +60,17 @@ from kubernetes_tpu.snapshot.schema import LANE_CPU, LANE_MEM, N_FIXED_LANES
 MAX = 100  # MaxNodeScore
 I32 = jnp.int32
 I64 = jnp.int64
+
+# shard-rule roster: the resident fixed point is the serial core made
+# wide — per-round it sorts/gathers the node axis wholesale and commits
+# with scatters into the N-resident usage rows.  Single-chip by design;
+# sharding N means replacing exactly these with collectives.
+_KTPU_N_COLLECTIVES = {
+    "_upd_keys": "gathers committed nodes' usage/alloc rows ([W]-indexed "
+    "reads of N-leading state)",
+    "resident_run.round_body": "walk-order argsort/gather over N + "
+    "scatter-add commits into the N-resident usage rows",
+}
 NEG = jnp.iinfo(jnp.int64).min // 4  # "no committed node yet" threshold
 UNRESOLVED = -2  # choice sentinel: pod not reached before the round cap
 
@@ -226,6 +237,11 @@ STOP_GRACE = 4
 MIN_YIELD = 64
 
 
+# ktpu: axes(sig_ids=i32[P], sig_req=i64[S,Rn], sig_nz=i64[S,2], sig_allzero=bool[S])
+# ktpu: axes(sig_ok=bool[S,N], sig_img=i64[S,N], alloc=i64[N,Rn], allowed=i32[N])
+# ktpu: axes(used=i64[N,Rn], nz0=i64[N], nz1=i64[N], num_pods=i32[N])
+# ktpu: accum(i64, i32, bool)
+# ktpu: static(w_fit=1, w_bal=1, w_img=1, check_fit=True, window=8, serial_tail=True)
 @functools.partial(
     jax.jit,
     static_argnames=(

@@ -39,6 +39,19 @@ from kubernetes_tpu.snapshot.schema import (
 MAX_NODE_SCORE = 100
 _FX = 32  # fixed-point fractional bits for the spread log weights
 
+# shard-rule roster (ANALYSIS.md): score NORMALIZATION is defined over
+# the full feasible node set — min/max over N is inherent to the
+# reference semantics (normalize_score.go) and becomes a cross-shard
+# reduce on a sharded mesh; image spread counts nodes holding each image
+_KTPU_N_COLLECTIVES = {
+    "default_normalize": "max over the feasible N axis (DefaultNormalizeScore)",
+    "normalize_interpod": "min+max over the feasible N axis (scoring.go:265)",
+    "normalize_spread": "min+max over the valid N axis (scoring.go:227)",
+    "score_image_locality": "image spread counts nodes per image ([N] sum)",
+    "score_spread": "counted-node totals over the feasible N axis "
+    "(topologyNormalizingWeight)",
+}
+
 
 def default_normalize(raw, feasible, reverse: bool = False):
     """plugins/helper/normalize_score.go DefaultNormalizeScore over the

@@ -86,6 +86,16 @@ DEMOTE_KINDS = {
     DEMOTE_FIT: "fit",
 }
 
+# shard-rule roster: the admission scan's per-step work contracts the
+# factored [T, N] carries over N ([C, N, d_cap] compare+reduce) and
+# gathers the speculative node's row for demotion attribution.  These
+# are the per-term reductions ROADMAP item 2 reduces ACROSS shards —
+# the roster is the inventory of exactly where those collectives go.
+_KTPU_N_COLLECTIVES = {
+    "wave_schedule.step": "term-factored domain compare+reduce over N + "
+    "speculative-node row gathers (demotion attribution)",
+}
+
 
 # ---------------------------------------------------------------------------
 # Host-side interaction partitioner
@@ -357,6 +367,12 @@ def _rep_rows(mat, rp, rc):
     )
 
 
+# ktpu: axes(dc=DeviceCluster, db=DeviceBatch, g=GangStatics, hostname_key=i32)
+# ktpu: axes(tid_sp=i32[P,C], rep_sp_p=i32[Tsp], rep_sp_c=i32[Tsp])
+# ktpu: axes(tid_ip=i32[P,A], rep_ip_p=i32[Tip], rep_ip_u=i32[Tip], ip_cdv_tab=i32[Kd2,N])
+# ktpu: axes(nom_node=i32[G], nom_prio=i32[G], nom_req=i32[G,Rn], extra_score=i64[P,N])
+# ktpu: accum(i64, i32, bool)
+# ktpu: static(v_cap=16)
 @functools.partial(
     jax.jit,
     static_argnames=(
@@ -551,7 +567,7 @@ def wave_schedule(
             dvip = g.ip_dv[p]
             is_host_u = db.aff_topo[p] == hostname_key  # [AT]
             ip_dyn = jnp.where(
-                is_host_u[:, None], fcnt * (dvip >= 0), ip_dyn_dom
+                is_host_u[:, None], fcnt * (dvip >= 0).astype(I32), ip_dyn_dom
             )
             any_dyn = jnp.any(
                 g.ip_is_aff[p] & (jnp.sum(fcnt, axis=1) > 0)
@@ -689,6 +705,13 @@ def wave_schedule(
     return chosen, n_feas, reason_counts, tallies, stats
 
 
+# ktpu: axes(dc=DeviceCluster, db=DeviceBatch, hostname_key=i32, extra_mask=bool[P,N])
+# ktpu: axes(tid_sp=i32[P,C], rep_sp_p=i32[Tsp], rep_sp_c=i32[Tsp])
+# ktpu: axes(tid_ip=i32[P,A], rep_ip_p=i32[Tip], rep_ip_u=i32[Tip], ip_cdv_tab=i32[Kd2,N])
+# ktpu: axes(nom_node=i32[G], nom_prio=i32[G], nom_req=i32[G,Rn], extra_score=i64[P,N])
+# ktpu: axes(sp_keys=i32[Kd], sp_cdv_tab=i32[Kd,N], ip_keys=i32[Kd2])
+# ktpu: accum(i64, i32, bool)
+# ktpu: static(v_cap=16)
 @functools.partial(
     jax.jit,
     static_argnames=(

@@ -70,6 +70,9 @@ def unpack(buf, spec):
 
 @functools.lru_cache(maxsize=512)
 def _unpacker(spec, treedef):
+    # ktpu: axes(buf=u8[B])
+    # ktpu: noinstantiate — shapes live in the lru_cache key (spec,
+    #   treedef), not in the signature; nothing to instantiate statically
     @jax.jit
     def run(buf):
         return jax.tree_util.tree_unflatten(treedef, unpack(buf, spec))

@@ -425,6 +425,10 @@ class Scheduler:
             # the warm watermark (sanitizer.mark_jit_warm)
             sanitizer.register_recompile_counter(self.prom.jit_recompiles)
             sanitizer.install_retrace_hook()
+            # eval_shape cross-check failures (run once per process at
+            # the first sanitized drain) land in
+            # scheduler_tpu_shape_check_failures_total{fn=}
+            sanitizer.register_shape_counter(self.prom.shape_check_failures)
         # Per-phase hot-loop attribution (queue_pop/pack/h2d/device/d2h/
         # commit/bind) — the scheduler_perf-style breakdown bench.py emits
         # as config0_phases.  Feeds the phase_duration histogram too.
@@ -917,6 +921,10 @@ class Scheduler:
             # current must match a fresh recomputation from the cache
             with self._mu:
                 sanitizer.check_mirror_consistency(self.cache, self.mirror)
+            # one-shot per process: the symbolic shape interpreter's root
+            # summaries must agree with jax.eval_shape on representative
+            # instantiations (mismatches count into the shape_check metric)
+            sanitizer.check_root_shapes()
         if t_drain is not None and tr.enabled:
             tr.complete(
                 "drain",

@@ -9,17 +9,20 @@ import jax
 import jax.numpy as jnp
 
 
+# ktpu: axes()
 @functools.partial(jax.jit, static_argnames=("width",))
 def window_write(dst, delta, start, width: int):
     out = jax.lax.dynamic_update_slice(dst, delta, (start,))  # VIOLATION: traced start, unpadded dst
     return out
 
 
+# ktpu: axes()
 @jax.jit
 def scatter_write(dst, idx, vals):
     return dst.at[idx].set(vals)  # VIOLATION: traced index, no explicit mode=
 
 
+# ktpu: axes()
 @jax.jit
 def helper_write(dst, delta, q):
     return _dus(dst, delta, q)

@@ -9,6 +9,7 @@ import jax
 import jax.numpy as jnp
 
 
+# ktpu: axes()
 @functools.partial(jax.jit, static_argnames=("n",))
 def padded_write(delta, start, n: int):
     # destination padded by the window size — the sanctioned idiom: an
@@ -17,17 +18,20 @@ def padded_write(delta, start, n: int):
     return jax.lax.dynamic_update_slice(buf, delta, (start,))
 
 
+# ktpu: axes()
 @jax.jit
 def static_start(dst, delta):
     return jax.lax.dynamic_update_slice(dst, delta, (0,))
 
 
+# ktpu: axes()
 @jax.jit
 def explicit_mode(dst, idx, vals):
     # the author chose the out-of-bounds semantics explicitly
     return dst.at[idx].set(vals, mode="drop")
 
 
+# ktpu: axes()
 @functools.partial(jax.jit, static_argnames=("w",))
 def carry_padded(xs, w: int):
     # the resident fixed point's shape: the write target rides a

@@ -8,6 +8,7 @@ import jax.numpy as jnp
 import numpy as np
 
 
+# ktpu: axes()
 @jax.jit
 def kernel(x):
     return x + 1

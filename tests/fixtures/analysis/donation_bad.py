@@ -9,11 +9,13 @@ import jax
 import jax.numpy as jnp
 
 
+# ktpu: axes()
 @functools.partial(jax.jit, donate_argnames=("used",))
 def commit(used, delta):
     return used + delta
 
 
+# ktpu: axes()
 @functools.partial(jax.jit, donate_argnums=(0,))
 def splice(dst, rows):
     return jnp.concatenate([dst, rows])

@@ -9,6 +9,7 @@ import jax
 import jax.numpy as jnp
 
 
+# ktpu: axes()
 @functools.partial(jax.jit, donate_argnames=("used",))
 def commit(used, delta):
     return used + delta

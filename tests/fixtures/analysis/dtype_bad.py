@@ -1,0 +1,28 @@
+"""Seeded `dtype`-rule violations: implicit promotions inside integer
+kernels — true division, bool arithmetic, weak float widening, and a
+loop carry outside the root's declared accumulation contract."""
+
+import jax
+import jax.numpy as jnp
+
+
+# ktpu: axes(scores=i64[P,N], feas=bool[P,N])
+@jax.jit
+def promotions(scores, feas):
+    halved = scores / 2  # VIOLATION
+    counted = feas * 3  # VIOLATION
+    scaled = scores * 0.5  # VIOLATION
+    return halved, counted, scaled
+
+
+# ktpu: axes(rows=i64[S,N])
+# ktpu: accum(i64, i32, bool)
+@jax.jit
+def float_accumulator(rows):
+    acc = jnp.zeros((rows.shape[1],), jnp.float32)
+
+    def step(carry, row):
+        return carry + row.astype(jnp.float32), 0
+
+    out, _ = jax.lax.scan(step, acc, rows)  # VIOLATION
+    return out

@@ -1,0 +1,32 @@
+"""Negative twin of dtype_bad.py: the same arithmetic with the
+promotions spelled out — floor division, explicit astype on bool
+operands, integer rescaling, and contract-conforming carries."""
+
+import jax
+import jax.numpy as jnp
+
+I32 = jnp.int32
+I64 = jnp.int64
+
+
+# ktpu: axes(scores=i64[P,N], feas=bool[P,N])
+@jax.jit
+def exact_arithmetic(scores, feas):
+    halved = scores // 2
+    counted = feas.astype(I32) * 3
+    scaled = (scores * 5) // 10
+    masked = jnp.where(feas, scores, 0)
+    return halved, counted, scaled, masked
+
+
+# ktpu: axes(rows=i64[S,N])
+# ktpu: accum(i64, i32, bool)
+@jax.jit
+def integer_accumulator(rows):
+    acc = jnp.zeros((rows.shape[1],), I64)
+
+    def step(carry, row):
+        return carry + row, 0
+
+    out, _ = jax.lax.scan(step, acc, rows)
+    return out

@@ -15,6 +15,7 @@ def _helper_syncs(x):
     return x.item()  # VIOLATION: host sync
 
 
+# ktpu: axes()
 @functools.partial(jax.jit, static_argnames=("n",))
 def kernel(values, mask, n: int):
     total = jnp.sum(values)
@@ -29,6 +30,7 @@ def kernel(values, mask, n: int):
     return out, host, flag, peek
 
 
+# ktpu: axes()
 @jax.jit
 def loops_on_tracer(xs):
     acc = jnp.zeros_like(xs)

@@ -24,6 +24,7 @@ def _accumulate(table, label_vals):
     return ok
 
 
+# ktpu: axes()
 @functools.partial(jax.jit, static_argnames=("v_cap", "extra"))
 def kernel(dc, batch, v_cap: int, extra=None):
     n = len(batch)  # len() of a tracer is its static leading dim

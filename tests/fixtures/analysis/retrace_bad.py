@@ -9,6 +9,7 @@ import jax
 import jax.numpy as jnp
 
 
+# ktpu: axes()
 @functools.partial(jax.jit, static_argnames=("n",))
 def kernel(x, scale, n: int):
     return x[:n] * scale

@@ -16,6 +16,7 @@ def bucket_cap(n, floor=1):
     return cap
 
 
+# ktpu: axes()
 @functools.partial(jax.jit, static_argnames=("n",))
 def kernel(x, scale, n: int):
     return x[:n] * scale

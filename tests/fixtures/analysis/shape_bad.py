@@ -1,0 +1,39 @@
+"""Seeded `shape`-rule findings: named-dim algebra breaks that rank-1
+broadcasting would silently absorb whenever the bucketed sizes
+coincide.  Markers sit on the lines the analyzer must flag."""
+
+import jax
+import jax.numpy as jnp
+
+I64 = jnp.int64
+
+
+# ktpu: axes(spec=i64[P,N], term_counts=i64[T,N])
+@jax.jit
+def mixed_axes(spec, term_counts):
+    # a [P, N] speculation tensor combined with the [T, N] term counts:
+    # valid to jax whenever P == T happens to hold after bucketing
+    return spec + term_counts  # VIOLATION
+
+
+# ktpu: axes(spec=i64[P,N], term_counts=i64[T,N])
+@jax.jit
+def mixed_contraction(spec, term_counts):
+    return jnp.einsum("pn,pn->n", spec, term_counts)  # VIOLATION
+
+
+# ktpu: axes(term_counts=i64[T,N], readback=i64[C,N])
+@jax.jit
+def carry_drift(term_counts, readback):
+    def step(carry, _):
+        return readback, carry[0]
+
+    out, ys = jax.lax.scan(  # VIOLATION
+        step, term_counts, jnp.zeros((4,), I64)
+    )
+    return out, ys
+
+
+@jax.jit
+def unannotated(state):  # VIOLATION
+    return state * 2

@@ -1,0 +1,37 @@
+"""Negative twin of shape_bad.py: the same algebra done consistently —
+named dims propagate through broadcasting, shape-derived constructors,
+einsum, and a stable scan carry without findings."""
+
+import jax
+import jax.numpy as jnp
+
+I32 = jnp.int32
+I64 = jnp.int64
+
+
+# ktpu: axes(spec=i64[P,N], row=i64[N])
+@jax.jit
+def consistent_axes(spec, row):
+    N = spec.shape[1]
+    ids = jnp.arange(N, dtype=I32)
+    onehot = (ids == 3).astype(I64)
+    outer = spec * row[None, :] + onehot[None, :]
+    return outer
+
+
+# ktpu: axes(spec=i64[P,N], term_counts=i64[T,N])
+@jax.jit
+def proper_einsum(spec, term_counts):
+    # distinct named dims on distinct letters, and n stays in the output
+    # (no cross-shard contraction) — neither rule fires
+    return jnp.einsum("pn,tn->ptn", spec, term_counts)
+
+
+# ktpu: axes(term_counts=i64[T,N])
+@jax.jit
+def stable_carry(term_counts):
+    def step(carry, _):
+        return carry + 1, carry[0]
+
+    out, ys = jax.lax.scan(step, term_counts, jnp.zeros((4,), I64))
+    return out, ys

@@ -1,0 +1,18 @@
+"""Seeded `shard`-rule violations: ops that cross the sharded N axis
+(mesh ('pods', 'nodes')) outside any declared collective helper — the
+multichip refactor must see every one of these in a roster."""
+
+import jax
+import jax.numpy as jnp
+
+I32 = jnp.int32
+
+
+# ktpu: axes(term_counts=i64[T,N], choice=i32, spec=i64[P,N])
+@jax.jit
+def crossings(term_counts, choice, spec):
+    totals = jnp.sum(term_counts, axis=1)  # VIOLATION
+    safe = jnp.maximum(choice, 0)
+    row = term_counts[:, safe]  # VIOLATION
+    crossed = jnp.einsum("tn,pn->tp", term_counts, spec)  # VIOLATION
+    return totals, row, crossed

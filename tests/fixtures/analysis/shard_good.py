@@ -1,0 +1,34 @@
+"""Negative twin of shard_bad.py: the same N-crossings under a declared
+``_KTPU_N_COLLECTIVES`` roster entry, plus genuinely shard-local work
+(elementwise over N, reductions over non-N axes) outside any roster."""
+
+import jax
+import jax.numpy as jnp
+
+I32 = jnp.int32
+
+# the declared collective inventory for this module — the analyzer
+# sanctions N-crossings under these functions only
+_KTPU_N_COLLECTIVES = {
+    "reduce_nodes": "term totals + chosen-node gather are cross-shard by "
+    "design (admission readback)",
+}
+
+
+# ktpu: axes(term_counts=i64[T,N], choice=i32, spec=i64[P,N])
+@jax.jit
+def reduce_nodes(term_counts, choice, spec):
+    totals = jnp.sum(term_counts, axis=1)
+    safe = jnp.maximum(choice, 0)
+    row = term_counts[:, safe]
+    crossed = jnp.einsum("tn,pn->tp", term_counts, spec)
+    return totals, row, crossed
+
+
+# ktpu: axes(term_counts=i64[T,N], spec=i64[P,N])
+@jax.jit
+def shard_local(term_counts, spec):
+    # elementwise over N keeps the shard layout; reducing T does too
+    per_node = jnp.sum(term_counts, axis=0)
+    masked = spec * (per_node > 0)[None, :].astype(spec.dtype)
+    return masked

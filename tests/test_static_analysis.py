@@ -22,15 +22,21 @@ from kubernetes_tpu.analysis.core import (
     RULE_CLAMP,
     RULE_D2H,
     RULE_DONATION,
+    RULE_DTYPE,
     RULE_JIT,
     RULE_LOCK,
     RULE_PURITY,
     RULE_RETRACE,
+    RULE_SHAPE,
+    RULE_SHARD,
 )
 
 FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures", "analysis")
 
-CHECKER_KEYS = ("locks", "purity", "jit", "d2h", "donation", "clamp", "retrace")
+CHECKER_KEYS = (
+    "locks", "purity", "jit", "d2h", "donation", "clamp", "retrace",
+    "shape", "dtype", "shard",
+)
 
 
 def fixture(name: str) -> str:
@@ -138,6 +144,9 @@ def test_cli_help_lists_all_rules(capsys):
         ("donation_bad.py", RULE_DONATION),
         ("clamp_bad.py", RULE_CLAMP),
         ("retrace_bad.py", RULE_RETRACE),
+        ("shape_bad.py", RULE_SHAPE),
+        ("dtype_bad.py", RULE_DTYPE),
+        ("shard_bad.py", RULE_SHARD),
     ],
 )
 def test_positive_fixture_caught(name, rule):
@@ -159,6 +168,9 @@ def test_positive_fixture_caught(name, rule):
         "donation_good.py",
         "clamp_good.py",
         "retrace_good.py",
+        "shape_good.py",
+        "dtype_good.py",
+        "shard_good.py",
     ],
 )
 def test_negative_fixture_silent(name):
@@ -660,3 +672,438 @@ def test_bench_analyze_preflight_refuses_findings(monkeypatch):
     out = err.getvalue()
     assert "refusing to record bench JSON" in out
     assert "d2h-leak" in out
+
+
+# ----- symbolic shape interpreter (shape / dtype / shard) --------------------
+
+
+def test_shape_engine_infers_root_returns():
+    """The interpreter must produce CONCRETE summaries for the major
+    roots — an all-Unknown inference would make the zero-findings gate
+    vacuous and the eval_shape cross-check a no-op."""
+    from kubernetes_tpu.analysis import SHAPE_MODULES, _PKG_ROOT
+    from kubernetes_tpu.analysis.core import load_source
+    from kubernetes_tpu.analysis.shape import Arr, ShapeEngine, TupV, dim_of_sym
+
+    mods = [load_source(os.path.join(_PKG_ROOT, p)) for p in SHAPE_MODULES]
+    eng = ShapeEngine().run(mods)
+    P = dim_of_sym("P")
+    N = dim_of_sym("N")
+
+    def leading(key, idx):
+        v = eng.root_returns[key]
+        assert isinstance(v, TupV), (key, v)
+        el = v.items[idx]
+        assert isinstance(el, Arr) and el.shape is not None, (key, el)
+        return el.shape
+
+    assert leading("gang.gang_schedule", 0) == (P,)
+    assert leading("gang.gang_schedule", 2) == (P, 9)  # reason_counts
+    assert leading("wave.wave_schedule", 4) == (3, P)  # wave stats
+    assert leading("resident.resident_run", 0) == (P,)
+    assert leading("fastpath.sig_scan", 0) == (P,)
+    stack = eng.root_returns["explain.explain_masks"].items[0]
+    assert stack.shape == (9, P, N)
+
+
+def test_shape_engine_every_ops_root_annotated():
+    """Acceptance gate: every jit root in ops/ (and the device-mirror
+    splicer) carries an axes annotation — enforced by the shape rule, so
+    the tree-is-clean test covers it; this asserts the roster of roots
+    itself so a silently-unDISCOVERED root would also fail."""
+    from kubernetes_tpu.analysis import SHAPE_MODULES, _PKG_ROOT
+    from kubernetes_tpu.analysis.core import load_source
+    from kubernetes_tpu.analysis.shape import ShapeEngine
+
+    mods = [load_source(os.path.join(_PKG_ROOT, p)) for p in SHAPE_MODULES]
+    eng = ShapeEngine().run(mods)
+    annotated = {f"{rec.base}.{rec.qual}" for rec, _ann in eng.roots}
+    for want in (
+        "fastpath.static_eval",
+        "fastpath.sig_scan",
+        "gang.gang_schedule",
+        "gang.gang_run",
+        "wave.wave_schedule",
+        "wave.wave_run",
+        "chain.chain_dispatch",
+        "resident.resident_run",
+        "explain.explain_masks",
+        "preemption.narrow_candidates",
+        "pipeline._pipeline",
+        "wire._unpacker.run",
+        "device_mirror._delta_applier.apply",
+    ):
+        assert want in annotated, sorted(annotated)
+
+
+def test_shape_rule_rosters_document_reasons():
+    """Every _KTPU_N_COLLECTIVES entry must carry a non-empty reason —
+    the roster is the multichip refactor's collective inventory, not an
+    escape hatch."""
+    from kubernetes_tpu.analysis import SHAPE_MODULES, _PKG_ROOT
+    from kubernetes_tpu.analysis.core import load_source
+    from kubernetes_tpu.analysis.shape import ShapeEngine
+
+    mods = [load_source(os.path.join(_PKG_ROOT, p)) for p in SHAPE_MODULES]
+    eng = ShapeEngine().run(mods)
+    total = 0
+    for mi in eng.mods.values():
+        for fn, reason in mi.roster.items():
+            assert reason.strip(), (mi.base, fn)
+            # a rostered name must resolve to a real function (typo guard)
+            assert fn in mi.funcs, (mi.base, fn, sorted(mi.funcs))
+            total += 1
+    assert total >= 10, total
+
+
+# ----- eval_shape cross-check (runtime complement) ---------------------------
+
+
+def test_shapecheck_tree_is_clean():
+    from kubernetes_tpu.analysis import shapecheck
+
+    res = shapecheck.cross_check()
+    assert res == {}, res
+
+
+def test_shapecheck_skips_are_reasoned():
+    from kubernetes_tpu.analysis import shapecheck
+
+    skips = shapecheck.skipped()
+    assert set(skips) == {
+        "chain.chain_dispatch",
+        "wire._unpacker.run",
+        "device_mirror._delta_applier.apply",
+    }, skips
+    assert all(reason.strip() for reason in skips.values())
+
+
+def test_shapecheck_randomized_sizes_property():
+    """Property: the interpreter and jax.eval_shape agree on every
+    instantiable ops/ root across randomized distinct axis sizes —
+    transposed or mislabeled dims cannot hide behind coincident sizes."""
+    import random
+
+    from kubernetes_tpu.analysis import shapecheck
+
+    rng = random.Random(0xC0FFEE)
+    for _ in range(2):
+        axes = ["P", "N", "S", "C", "A", "G", "Tsp", "Tip", "E", "M"]
+        pool = rng.sample(range(2, 23), len(axes))
+        sizes = dict(zip(axes, pool))
+        sizes["Rn"] = rng.randint(3, 6)
+        sizes["Rp"] = sizes["Rn"] + rng.randint(0, 2)
+        res = shapecheck.cross_check(sizes=sizes)
+        assert res == {}, (sizes, res)
+
+
+@pytest.mark.slow
+def test_shapecheck_randomized_sizes_property_deep():
+    import random
+
+    from kubernetes_tpu.analysis import shapecheck
+
+    rng = random.Random(7)
+    for _ in range(5):
+        sizes = {
+            k: rng.randint(2, 31)
+            for k in ("P", "N", "S", "C", "A", "G", "Tsp", "Tip", "E", "M",
+                      "K", "V", "NS", "TA", "TL", "U", "UP", "IMG", "IP",
+                      "NT", "PT", "Kd", "Kd2")
+        }
+        sizes["Rn"] = rng.randint(3, 8)
+        sizes["Rp"] = sizes["Rn"] + rng.randint(0, 3)
+        res = shapecheck.cross_check(sizes=sizes)
+        assert res == {}, (sizes, res)
+
+
+def test_shapecheck_detects_seeded_annotation_drift(tmp_path):
+    """A root whose axes annotation no longer matches its code must
+    surface as a cross-check mismatch (the anti-rot guarantee)."""
+    from kubernetes_tpu.analysis import shapecheck
+    from kubernetes_tpu.analysis.core import SourceModule
+
+    # the annotation CLAIMS the output keeps [P, N]; the kernel transposes
+    src = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "\n"
+        "# ktpu: axes(x=i64[P,N])\n"
+        "@jax.jit\n"
+        "def transposer(x):\n"
+        "    return jnp.zeros((x.shape[0], x.shape[1]), jnp.int64).T\n"
+    )
+    p = tmp_path / "drifty.py"
+    p.write_text(src)
+    import sys
+
+    sys.path.insert(0, str(tmp_path))
+    try:
+        mods = [SourceModule.load(str(p))]
+        # engine infers [N, P]; eval_shape also gives [N, P] — clean
+        res = shapecheck.cross_check(mods=mods)
+        assert res == {}, res
+    finally:
+        sys.path.pop(0)
+
+    # now a file whose INFERRED shape disagrees with trace: the engine
+    # is fed a stale copy of the source while jax traces the new one
+    stale = src.replace(".T\n", "\n")  # stale inference: [P, N]
+    p2 = tmp_path / "drifty2.py"
+    p2.write_text(src.replace("transposer", "transposer2"))
+    import importlib
+
+    sys.path.insert(0, str(tmp_path))
+    try:
+        importlib.import_module("drifty2")
+        mods = [SourceModule(str(p2), stale.replace("transposer",
+                                                    "transposer2"))]
+        res = shapecheck.cross_check(mods=mods)
+        assert "drifty2.transposer2" in res, res
+        assert any("axis" in m for m in res["drifty2.transposer2"]), res
+    finally:
+        sys.path.pop(0)
+
+
+def test_sanitizer_shape_check_memoized_and_counts(sanitize_on, monkeypatch):
+    from kubernetes_tpu.analysis import sanitizer, shapecheck
+    from kubernetes_tpu.metrics import SchedulerMetrics
+
+    sanitizer.reset_shape_check()
+    calls = []
+
+    def fake_cross_check(sizes=None, mods=None):
+        calls.append(1)
+        return {"ops.fake_root": ["axis 0 inferred N=7, traced 5"]}
+
+    monkeypatch.setattr(shapecheck, "cross_check", fake_cross_check)
+    prom = SchedulerMetrics()
+    sanitizer.register_shape_counter(prom.shape_check_failures)
+    try:
+        got = sanitizer.check_root_shapes()
+        assert got == {"ops.fake_root": ["axis 0 inferred N=7, traced 5"]}
+        assert prom.shape_check_failures.value(fn="ops.fake_root") == 1.0
+        assert (
+            "scheduler_tpu_shape_check_failures_total"
+            in prom.registry.expose()
+        )
+        # memoized: a second drain neither re-runs nor double-counts
+        sanitizer.check_root_shapes()
+        assert len(calls) == 1
+        assert prom.shape_check_failures.value(fn="ops.fake_root") == 1.0
+    finally:
+        sanitizer._shape_counters.discard(prom.shape_check_failures)
+        sanitizer.reset_shape_check()
+
+
+def test_sanitizer_shape_check_noop_when_disabled(monkeypatch):
+    from kubernetes_tpu.analysis import sanitizer
+
+    monkeypatch.delenv("KTPU_SANITIZE", raising=False)
+    sanitizer.reset_enabled_memo()
+    sanitizer.reset_shape_check()
+    assert sanitizer.check_root_shapes() == {}
+
+
+def test_warm_drain_shape_check_zero_mismatches(sanitize_on):
+    """Acceptance gate: a config0-shaped drain under KTPU_SANITIZE=1 runs
+    the eval_shape cross-check and reports ZERO mismatches (wired through
+    Scheduler → sanitizer.check_root_shapes at drain end)."""
+    from kubernetes_tpu.analysis import sanitizer
+    from kubernetes_tpu.metrics import SchedulerMetrics
+
+    sanitizer.reset_shape_check()
+    try:
+        s = _recompile_drain(_recompile_nodes(8), _recompile_pods(48, "sc"))
+        res = sanitizer.check_root_shapes()
+        assert res == {}, res
+        # the drain itself must have armed the check (memo populated)
+        assert sanitizer._shape_check_result == {}
+        expo = s.prom.registry.expose()
+        assert "scheduler_tpu_shape_check_failures_total" in expo
+    finally:
+        sanitizer.reset_shape_check()
+
+
+# ----- baseline workflow -----------------------------------------------------
+
+
+def test_baseline_roundtrip_suppresses_known_findings(tmp_path, capsys):
+    base = tmp_path / "baseline.json"
+    bad = fixture("shape_bad.py")
+    assert cli_main(["--write-baseline", str(base), bad]) == 0
+    capsys.readouterr()
+    # with the baseline, the same dirty file now exits clean
+    assert cli_main(["--baseline", str(base), bad]) == 0
+    out = capsys.readouterr().out
+    assert "no findings" in out
+    assert "baselined" in out
+
+
+def test_baseline_fails_on_new_findings(tmp_path, capsys):
+    import shutil
+
+    base = tmp_path / "baseline.json"
+    work = tmp_path / "work.py"
+    shutil.copy(fixture("dtype_bad.py"), work)
+    assert cli_main(["--write-baseline", str(base), str(work)]) == 0
+    # introduce a NEW finding on top of the baselined ones
+    src = work.read_text()
+    src += (
+        "\n\n# ktpu: axes(z=i64[P,N])\n"
+        "@jax.jit\n"
+        "def fresh(z):\n"
+        "    return z / 4\n"
+    )
+    work.write_text(src)
+    capsys.readouterr()
+    assert cli_main(["--baseline", str(base), str(work)]) == 1
+    out = capsys.readouterr().out
+    assert "true division" in out
+    # exactly the new finding survives; the baselined ones stay hidden
+    assert out.count("[dtype]") == 1
+
+
+def test_baseline_line_churn_does_not_resurrect(tmp_path, capsys):
+    import shutil
+
+    base = tmp_path / "baseline.json"
+    work = tmp_path / "work.py"
+    shutil.copy(fixture("shard_bad.py"), work)
+    assert cli_main(["--write-baseline", str(base), str(work)]) == 0
+    # shift every finding by a few lines — the (rule, path, message) key
+    # must keep matching
+    work.write_text("# moved\n# moved\n# moved\n" + work.read_text())
+    capsys.readouterr()
+    assert cli_main(["--baseline", str(base), str(work)]) == 0
+
+
+def test_json_report_carries_rule_seconds(capsys):
+    import json
+
+    assert cli_main(["--json", fixture("shape_bad.py")]) == 1
+    report = json.loads(capsys.readouterr().out)
+    secs = report["rule_seconds"]
+    for rule in ("shape", "dtype", "shard", "lock-discipline"):
+        assert rule in secs and secs[rule] >= 0, secs
+
+
+def test_cli_rule_filter_shape_families(capsys):
+    assert cli_main(["--rule", RULE_SHAPE, fixture("shape_bad.py")]) == 1
+    out = capsys.readouterr().out
+    assert RULE_SHAPE in out
+    assert cli_main(["--rule", RULE_DTYPE, fixture("dtype_bad.py")]) == 1
+    assert cli_main(["--rule", RULE_SHARD, fixture("shard_bad.py")]) == 1
+    assert cli_main(["--rule", RULE_SHARD, fixture("shard_good.py")]) == 0
+    capsys.readouterr()
+
+
+def test_shape_engine_negative_slice_bounds(tmp_path):
+    """Review regression: x[-k:] is k elements, not length+k — a wrong
+    tail-slice model would fail correct kernels in the cross-check."""
+    from kubernetes_tpu.analysis.core import SourceModule
+    from kubernetes_tpu.analysis.shape import ShapeEngine, dim_str
+
+    p = tmp_path / "slices.py"
+    p.write_text(
+        "import jax\nimport jax.numpy as jnp\n"
+        "# ktpu: axes(x=i32[N])\n"
+        "@jax.jit\n"
+        "def tail(x):\n"
+        "    return x[-2:], x[:-2], x[1:]\n"
+    )
+    eng = ShapeEngine().run([SourceModule.load(str(p))])
+    a, b, c = eng.root_returns["slices.tail"].items
+    assert a.shape == (2,)
+    assert dim_str(b.shape[0]) == "N-2"
+    assert dim_str(c.shape[0]) == "N-1"
+
+
+def test_accum_contract_not_erased_by_summary_reuse(tmp_path):
+    """Review regression: a helper first analyzed under a contract-free
+    root must still report its float carry when reached from a root
+    declaring accum(i64) — the summary memo key carries the contract."""
+    from kubernetes_tpu.analysis.core import RULE_DTYPE, SourceModule
+    from kubernetes_tpu.analysis.shape import ShapeEngine
+
+    p = tmp_path / "accum_reuse.py"
+    p.write_text(
+        "import jax\nimport jax.numpy as jnp\n\n"
+        "def helper(x):\n"
+        "    acc = jnp.zeros((), jnp.float32)\n"
+        "    def body(c):\n"
+        "        return c + 1.0\n"
+        "    return jax.lax.while_loop(lambda c: c < 10.0, body, acc)\n\n"
+        "# ktpu: axes(x=i64[N])\n"
+        "@jax.jit\n"
+        "def a_root(x):\n"
+        "    return helper(x)\n\n"
+        "# ktpu: axes(x=i64[N])\n"
+        "# ktpu: accum(i64)\n"
+        "@jax.jit\n"
+        "def b_root(x):\n"
+        "    return helper(x)\n"
+    )
+    eng = ShapeEngine().run([SourceModule.load(str(p))])
+    msgs = [m for r, _mod, _l, m in eng.raw_findings if r == RULE_DTYPE]
+    assert any("accum(i64)" in m for m in msgs), msgs
+
+
+def test_duplicate_basename_targets_both_analyzed(tmp_path):
+    """Review regression: two analyzed files sharing a basename must BOTH
+    be visited — the shadowed one used to drop out of shape analysis."""
+    d1 = tmp_path / "a"
+    d2 = tmp_path / "b"
+    d1.mkdir()
+    d2.mkdir()
+    bad = "import jax\n\n@jax.jit\ndef kernel(x):\n    return x\n"
+    (d1 / "util.py").write_text(bad)
+    (d2 / "util.py").write_text(bad)
+    findings = analyze_paths(shape=[str(d1 / "util.py"),
+                                    str(d2 / "util.py")])
+    missing = [f for f in findings if "axes" in f.message]
+    assert {os.path.basename(os.path.dirname(f.path)) for f in missing} == \
+        {"a", "b"}, findings
+
+
+def test_load_source_rewrite_within_mtime_granularity(tmp_path):
+    """Review regression: a rewrite inside the filesystem timestamp
+    granularity must not serve the stale AST (content-keyed cache)."""
+    from kubernetes_tpu.analysis.core import load_source
+
+    p = tmp_path / "churn.py"
+    p.write_text("x = 1\n")
+    first = load_source(str(p))
+    p.write_text("y = 2\n")  # same instant on coarse-mtime filesystems
+    second = load_source(str(p))
+    assert second.source == "y = 2\n"
+    assert first.source == "x = 1\n"
+
+
+def test_shapecheck_nested_root_requires_noinstantiate(tmp_path):
+    """Review regression: a nested annotated root without noinstantiate
+    must surface as a cross-check failure, not vanish from coverage."""
+    from kubernetes_tpu.analysis import shapecheck
+    from kubernetes_tpu.analysis.core import SourceModule
+
+    p = tmp_path / "nested.py"
+    p.write_text(
+        "import jax\n\n"
+        "def factory():\n"
+        "    # ktpu: axes(x=i64[N])\n"
+        "    @jax.jit\n"
+        "    def inner(x):\n"
+        "        return x\n"
+        "    return inner\n"
+    )
+    res = shapecheck.cross_check(mods=[SourceModule.load(str(p))])
+    assert "nested.factory.inner" in res, res
+    assert "noinstantiate" in res["nested.factory.inner"][0]
+
+
+def test_shapecheck_default_sizes_pairwise_distinct():
+    from kubernetes_tpu.analysis import shapecheck
+
+    vals = list(shapecheck.DEFAULT_SIZES.values())
+    assert len(set(vals)) == len(vals)
+    assert shapecheck.DEFAULT_DIM not in vals
