@@ -280,6 +280,130 @@ def bench_spread(n_nodes, n_pods):
     return _run_workload(_basic_nodes(n_nodes, zones=8), pods, warm=576)
 
 
+def bench_gang(n_nodes=1000, n_pods=20000, gang_size=8):
+    """Config 10: coscheduling gang bin-packing drain (BASELINE.json's
+    "coscheduling gang bin-packing" shape) — gangs of ``gang_size`` with a
+    full-size minMember quorum, admitted all-or-nothing by the workloads
+    dispatch (ops/coscheduling.py).  Returns (ok, dt, sched)."""
+    from kubernetes_tpu.api.types import Container, Pod
+    from kubernetes_tpu.workloads.gang import PodGroup
+
+    sched, _ = _mk_sched()
+    sched.mirror.e_cap_hint = n_pods + sched.config.batch_size + 128
+    for n in _basic_nodes(n_nodes, zones=8):
+        sched.on_node_add(n)
+    n_gangs = n_pods // gang_size
+    with sched._mu:
+        for g in range(n_gangs):
+            sched.gangs.upsert(
+                PodGroup(name=f"gang-{g}", min_member=gang_size)
+            )
+    pods = []
+    for g in range(n_gangs):
+        for m in range(gang_size):
+            pods.append(
+                Pod(
+                    name=f"g{g}-m{m}",
+                    pod_group=f"gang-{g}",
+                    labels={"app": f"gang-{g % 32}"},
+                    containers=[
+                        Container(
+                            name="c",
+                            requests={"cpu": "100m", "memory": "64Mi"},
+                        )
+                    ],
+                )
+            )
+    warm = max(0, min(sched.config.batch_size + 64, len(pods) - 64))
+    warm -= warm % gang_size  # whole gangs only: no split-quorum warm-up
+    for p in pods[:warm]:
+        sched.on_pod_add(p)
+    _drain(sched)
+    for p in pods[warm:]:
+        sched.on_pod_add(p)
+    sched._phases_mark = sched.phases.snapshot()
+    ok, dt = _drain(sched)
+    return ok, max(dt, 1e-9), sched
+
+
+def bench_dra(n_nodes=500, n_pods=2000, devices_per_node=4):
+    """Config 11: DRA claim-allocation drain — every pod carries one
+    ResourceClaim (ExactCount=1, class-selector matching) allocated by the
+    batched device-matching kernel (ops/dra.py) inside the workloads
+    admission scan.  Returns (ok, dt, sched)."""
+    from kubernetes_tpu.api import dra
+    from kubernetes_tpu.api.types import Container, Pod
+    from kubernetes_tpu.framework.config import SchedulerConfiguration
+    from kubernetes_tpu.framework.interface import EventResource
+    from kubernetes_tpu.scheduler import Scheduler
+
+    cfg = SchedulerConfiguration()
+    cfg.feature_gates["DynamicResourceAllocation"] = True
+    sched = Scheduler(configuration=cfg)
+    bindings = {}
+    sched.binding_sink = lambda pod, node: bindings.__setitem__(pod.uid, node)
+    sched.mirror.e_cap_hint = n_pods + sched.config.batch_size + 128
+    for n in _basic_nodes(n_nodes, zones=8):
+        sched.on_node_add(n)
+    cls_add, _, _ = sched.storage_handlers(EventResource.DEVICE_CLASS)
+    cls_add(
+        dra.DeviceClass(
+            name="gpu",
+            selectors=(dra.DeviceSelector("vendor", "In", ("bench",)),),
+        )
+    )
+    sl_add, _, _ = sched.storage_handlers(EventResource.RESOURCE_SLICE)
+    for i in range(n_nodes):
+        sl_add(
+            dra.ResourceSlice(
+                name=f"sl-{i}",
+                node_name=f"node-{i}",
+                driver="drv",
+                pool=f"pool-{i}",
+                devices=tuple(
+                    dra.Device(
+                        name=f"dev-{i}-{j}",
+                        attributes=(("vendor", "bench"), ("slot", str(j))),
+                    )
+                    for j in range(devices_per_node)
+                ),
+            )
+        )
+    claim_add, _, _ = sched.storage_handlers(EventResource.RESOURCE_CLAIM)
+    pods = []
+    for i in range(n_pods):
+        claim_add(
+            dra.ResourceClaim(
+                name=f"claim-{i}",
+                requests=(
+                    dra.DeviceRequest(
+                        name="g", device_class_name="gpu", count=1
+                    ),
+                ),
+            )
+        )
+        pods.append(
+            Pod(
+                name=f"dra-{i}",
+                containers=[
+                    Container(
+                        name="c", requests={"cpu": "50m", "memory": "32Mi"}
+                    )
+                ],
+                resource_claims=(f"claim-{i}",),
+            )
+        )
+    warm = max(0, min(sched.config.batch_size + 64, len(pods) - 64))
+    for p in pods[:warm]:
+        sched.on_pod_add(p)
+    _drain(sched)
+    for p in pods[warm:]:
+        sched.on_pod_add(p)
+    sched._phases_mark = sched.phases.snapshot()
+    ok, dt = _drain(sched)
+    return ok, max(dt, 1e-9), sched
+
+
 def bench_density_churn(n_nodes=5000, n_pods=10000, waves=10):
     """Config 5: density replay with CHURN during scheduling
     (SchedulingWithMixedChurn, performance-config.yaml:769, floor 265
@@ -943,6 +1067,32 @@ def main():
             + ", ".join(
                 f"{c['rate']:g}/s→p99 {c['p99_ms']} ms" for c in ar["curve"]
             ),
+            file=sys.stderr,
+        )
+        # config10/config11: the workloads tier (gang coscheduling + DRA;
+        # WORKLOADS.md) — floor-less on this CPU-only box per the
+        # BENCH_FLOORS discipline (presence-without-floor tolerance)
+        n10 = int(os.environ.get("BENCH_GANG_PODS", "20000"))
+        ok10, dt10, s10 = bench_gang(1000, n10)
+        configs["config10_gang_1000n_pods_per_s"] = round(ok10 / dt10, 1)
+        configs["config10_gang_admit_rate"] = round(
+            s10.metrics["gang_admitted"] / max(n10, 1), 4
+        )
+        print(
+            f"# config10 gang: {ok10} pods in {dt10:.2f}s "
+            f"(workload_batches={s10.metrics['workload_batches']} "
+            f"admitted={s10.metrics['gang_admitted']} "
+            f"rolled_back={s10.metrics['gang_rolled_back']})",
+            file=sys.stderr,
+        )
+        n11 = int(os.environ.get("BENCH_DRA_PODS", "2000"))
+        ok11, dt11, s11 = bench_dra(500, n11)
+        configs["config11_dra_500n_pods_per_s"] = round(ok11 / dt11, 1)
+        configs["config11_dra_pods_allocated"] = s11.metrics["dra_pods"]
+        print(
+            f"# config11 dra: {ok11} pods in {dt11:.2f}s "
+            f"(workload_batches={s11.metrics['workload_batches']} "
+            f"dra_pods={s11.metrics['dra_pods']})",
             file=sys.stderr,
         )
 

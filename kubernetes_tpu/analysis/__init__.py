@@ -92,6 +92,9 @@ LOCK_MODULES = (
     # SLO tier: ingest runs on every flight-recorder producer thread,
     # snapshot/evaluate on HTTP handlers and the bench harness
     os.path.join("observability", "slo.py"),
+    # workloads tier: the GangDirectory registry/bookkeeping is mutated by
+    # informer handlers, the workloads dispatch, and bind-failure unwinds
+    os.path.join("workloads", "gang.py"),
 )
 PURITY_MODULES = (
     os.path.join("framework", "plugins.py"),
@@ -102,6 +105,8 @@ PURITY_MODULES = (
 JIT_MODULES = (
     os.path.join("ops", "chain.py"),
     os.path.join("ops", "common.py"),
+    os.path.join("ops", "coscheduling.py"),
+    os.path.join("ops", "dra.py"),
     os.path.join("ops", "explain.py"),
     os.path.join("ops", "fastpath.py"),
     os.path.join("ops", "filters.py"),

@@ -77,6 +77,19 @@ DEFAULT_SIZES = {
     "NT": 25,
     "PT": 26,
     "L": 27,
+    # workloads tier (ops/coscheduling.py, ops/dra.py): device slots per
+    # node, attribute slots, DRA request/selector/value slots, claim
+    # slots, per-pod claim refs, gang slots, per-pod PV slots
+    "DD": 28,
+    "DA": 29,
+    "DQ": 33,
+    "DS": 34,
+    "DV": 35,
+    "CL": 37,
+    "CQ": 38,
+    "G2": 39,
+    "PV2": 40,
+    "VT": 41,
     "B": 64,
 }
 assert len(set(DEFAULT_SIZES.values())) == len(DEFAULT_SIZES)
@@ -328,7 +341,14 @@ def cross_check(sizes: Optional[Dict[str, int]] = None,
         except Exception as e:  # noqa: BLE001 — any failure IS a finding
             out[key] = [f"instantiation/trace failed: {e!r:.300}"]
             continue
-        _compare("return", inferred, traced, sizes, problems)
+        # an int-valued static (g_cap=4) IS the concrete size of any
+        # return dim the interpreter named after it — bind it for the
+        # comparison (canonical axis sizes still win)
+        local_sizes = dict(sizes)
+        for sname, sval in statics.items():
+            if isinstance(sval, int) and not isinstance(sval, bool):
+                local_sizes.setdefault(sname, sval)
+        _compare("return", inferred, traced, local_sizes, problems)
         if problems:
             out[key] = problems
     return out

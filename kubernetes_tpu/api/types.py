@@ -281,6 +281,10 @@ class Pod:
     volumes: Tuple[Volume, ...] = ()
     # spec.resourceClaims[*].resourceClaimName (DRA)
     resource_claims: Tuple[str, ...] = ()
+    # gang membership (coscheduling): PodGroup name in the pod's namespace
+    # (the pod-group.scheduling.sigs.k8s.io/name label works too — see
+    # workloads/gang.py group_key_of)
+    pod_group: str = ""
     host_network: bool = False
     images: Tuple[str, ...] = ()
 

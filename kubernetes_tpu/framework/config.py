@@ -179,6 +179,14 @@ class SchedulerConfiguration:
     # and the host committer finishes them (right when host heaps beat
     # serial device steps — CPU backends).
     resident_serial_tail: bool = False
+    # TPU extension: the workloads tier (ops/coscheduling.py) — gang/
+    # coscheduling all-or-nothing admission + batched DRA claim allocation
+    # + volume-topology kernel masks ride one fused dispatch with
+    # device-side gang rollback (see WORKLOADS.md).  Off = gang pods
+    # schedule individually (no quorum semantics) and DRA/volume pods fall
+    # back to the serial one-pod host-plugin path — decision-identical for
+    # DRA/volume (kill-switch identity, tests/test_coscheduling.py).
+    gang_dispatch: bool = True
     # Bit-compat knobs (SURVEY §7 "decision-identical tie-breaking"):
     # full-width evaluation is the TPU-native default; these opt into the
     # reference's sampling + randomized-tie semantics.
@@ -472,6 +480,7 @@ def load_config(source) -> SchedulerConfiguration:
         resident_run_max=d.get("residentRunMax", 16384),
         resident_window=d.get("residentWindow", 2048),
         resident_serial_tail=d.get("residentSerialTail", False),
+        gang_dispatch=d.get("gangDispatch", True),
         reference_sampling_compat=d.get("referenceSamplingCompat", False),
         tie_break_seed=d.get("tieBreakSeed"),
     )
@@ -530,6 +539,7 @@ def dump_config(cfg: SchedulerConfiguration) -> dict:
         "residentRunMax": cfg.resident_run_max,
         "residentWindow": cfg.resident_window,
         "residentSerialTail": cfg.resident_serial_tail,
+        "gangDispatch": cfg.gang_dispatch,
         "referenceSamplingCompat": cfg.reference_sampling_compat,
         "tieBreakSeed": cfg.tie_break_seed,
         "featureGates": dict(cfg.feature_gates),

@@ -325,6 +325,11 @@ class Framework:
     def has_post_filter(self) -> bool:
         return bool(self._by_point.get("postFilter"))
 
+    def post_filter_plugins(self) -> List:
+        """The profile's PostFilter plugins (preemption what-if explain
+        reaches the DefaultPreemption evaluator through this)."""
+        return list(self._by_point.get("postFilter", []))
+
     def lean_bind_ok(self) -> bool:
         """True when the binding cycle can take the direct-sink path for a
         fast-gated batch: every PreBind plugin is also a host Filter (a

@@ -608,6 +608,28 @@ class SchedulerMetrics:
                 ("kind",),
             )
         )
+        self.gang_admitted = r.register(
+            Counter(
+                "scheduler_tpu_gang_admitted_total",
+                "Gang (PodGroup) member pods admitted by the workloads "
+                "tier's all-or-nothing admission pass (ops/coscheduling.py).",
+            )
+        )
+        self.gang_rollbacks = r.register(
+            Counter(
+                "scheduler_tpu_gang_rollbacks_total",
+                "Gangs whose members could not cover the remaining "
+                "minMember quorum this batch — every member placement, "
+                "topology count, and device grant restored in-kernel.",
+            )
+        )
+        self.dra_allocations = r.register(
+            Counter(
+                "scheduler_tpu_dra_allocations_total",
+                "ResourceClaims allocated through the batched DRA "
+                "device-matching kernel (ops/dra.py).",
+            )
+        )
         self.resident_rounds = r.register(
             Counter(
                 "scheduler_tpu_resident_rounds_total",

@@ -32,6 +32,7 @@ from kubernetes_tpu.observability.tracer import Tracer
 from kubernetes_tpu.observability.explain import (
     DIAG_PLUGINS,
     explain_pod,
+    explain_whatif,
     find_pod,
     oracle_explain,
     reason_to_plugin,
@@ -49,6 +50,7 @@ __all__ = [
     "SLOEvaluator",
     "SLOObjective",
     "explain_pod",
+    "explain_whatif",
     "find_pod",
     "oracle_explain",
     "reason_to_plugin",

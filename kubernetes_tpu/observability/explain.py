@@ -299,3 +299,96 @@ def explain_pod(
             "events": demotions[-8:],
         }
     return out
+
+
+def explain_whatif(sched, pod: Pod, node_name: str) -> dict:
+    """Preemption what-if: which victims would free ``node_name`` for
+    ``pod`` — the existing preemption dry-run machinery
+    (framework/preemption.Evaluator.select_victims_on_node, the same code
+    PostFilter runs) restricted to one node, served read-only: the dry run
+    works on a working copy and restores the shared view before returning.
+
+    Returns eligibility, the victim list (what PostFilter would evict
+    there, importance-ordered), and the PDB-violation count — "what would
+    it take" without nominating anything or touching the queue."""
+    from kubernetes_tpu.framework.interface import CycleState
+
+    fwk = sched.profiles.get(
+        pod.scheduler_name, next(iter(sched.profiles.values()))
+    )
+    out: dict = {
+        "pod": {"uid": pod.uid, "name": pod.name, "namespace": pod.namespace},
+        "node": node_name,
+    }
+    ev = next(
+        (
+            p.evaluator
+            for p in fwk.post_filter_plugins()
+            if hasattr(p, "evaluator")
+        ),
+        None,
+    )
+    if ev is None:
+        out["error"] = "profile has no preemption evaluator"
+        return out
+    with sched._mu:
+        state = sched.oracle_view()
+        if node_name not in state.nodes:
+            out["error"] = f"unknown node {node_name!r}"
+            return out
+        ok, msg = ev.pod_eligible(pod, state)
+        out["eligible"] = ok
+        if not ok:
+            out["reason"] = msg
+            return out
+        cs = CycleState()
+        failures = fwk.run_pre_filter(cs, [pod]) or {}
+        s = failures.get(pod.uid)
+        if s is not None:
+            out["eligible"] = False
+            out["reason"] = "; ".join(s.reasons) or "PreFilter rejected"
+            return out
+        # the same host-filter / extension context preempt() arms, saved
+        # and restored so a live PostFilter's state never leaks
+        prev = (ev._hf_fwk, ev._hf_state, ev._ext_fwk, ev._ext_state)
+        prev_fast = getattr(ev, "_fast_fit", False)
+        ev._hf_fwk = ev._hf_state = ev._ext_fwk = ev._ext_state = None
+        ev._fast_fit = False  # one node: always run the full fit check
+        if fwk.has_host_filters() and fwk.active_host_filters(cs, [pod]):
+            ev._hf_fwk, ev._hf_state = fwk, cs
+        if fwk.has_pre_filter_extensions():
+            ev._ext_fwk, ev._ext_state = fwk, cs
+        try:
+            victims = ev.select_victims_on_node(
+                pod, state, node_name, sched.pdb_lister()
+            )
+        finally:
+            ev._hf_fwk, ev._hf_state, ev._ext_fwk, ev._ext_state = prev
+            ev._fast_fit = prev_fast
+        lower = sum(
+            1
+            for p in state.nodes[node_name].pods
+            if p.priority < pod.priority
+        )
+        out["lower_priority_pods"] = lower
+        if victims is None:
+            out["feasible_after_preemption"] = False
+            out["reason"] = (
+                "no lower-priority pods on the node"
+                if lower == 0
+                else "pod still does not fit after removing every "
+                "lower-priority pod"
+            )
+            return out
+        out["feasible_after_preemption"] = True
+        out["num_pdb_violations"] = victims.num_pdb_violations
+        out["victims"] = [
+            {
+                "uid": v.uid,
+                "name": v.name,
+                "namespace": v.namespace,
+                "priority": v.priority,
+            }
+            for v in victims.pods
+        ]
+        return out

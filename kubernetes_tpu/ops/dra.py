@@ -1,0 +1,397 @@
+"""DRA claim allocation as a batched device-matching kernel.
+
+The reference's structured allocator (staging DRA structured/allocator.go,
+mirrored serially by framework/dynamicresources.py) walks every node's
+ResourceSlices per pod, evaluating selector requirements against device
+attributes one (claim, node, device) triple at a time — the per-pod host
+path the workloads tier replaces.  Here the whole surface is tensorized:
+
+  * ResourceSlice devices pack into ``[N, DD, DA]`` attribute key/value
+    tensors (one device slot axis per node, one attribute slot axis per
+    device, both bucketed);
+  * claim requests pack into ``[P, DQ]`` slots whose (attribute, op,
+    values) selector triples — DeviceClass selectors concatenated with the
+    request's own — become ``[P, DQ, DS(, DV)]`` requirement tensors, so
+    matching is one vectorized compare + all-reduce producing the full
+    ``[P, DQ, N, DD]`` match tensor (selector semantics identical to
+    dra.DeviceSelector.matches: In / NotIn / Exists / DoesNotExist, NotIn
+    admitting absent attributes);
+  * allocation state is two carried arrays — ``free [N, DD]`` (device not
+    held by any allocated claim) and ``claim_node [CL]`` (node an
+    in-batch-referenced claim is allocated to, -1 unallocated) — that ride
+    the admission scan's state dict like any other usage row, so claims
+    participate in conflict resolution (and gang rollback) exactly like
+    CPU/memory do;
+  * per-node feasibility + the greedy take mask are one fused pass over
+    the static DQ request slots: ExactCount needs ``count`` matching free
+    devices (taken lowest-slot-first — the reference's slice/device
+    enumeration order, which the host packer preserves), All needs EVERY
+    matching device free (allocator.go:530-552).
+
+The kernels here are pure functions invoked from the workloads admission
+root (ops/coscheduling.py); the serial oracle (oracle/workloads.py) and
+the DynamicResources plugin path define the same semantics object-by-object
+— property-tested equal in tests/test_dra.py / tests/test_coscheduling.py.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from kubernetes_tpu.api.dra import ALLOCATION_MODE_ALL
+from kubernetes_tpu.ops.common import I32
+from kubernetes_tpu.snapshot.interner import ABSENT, PAD
+from kubernetes_tpu.snapshot.schema import bucket_cap
+from kubernetes_tpu.snapshot.selectors import (
+    OP_DOES_NOT_EXIST,
+    OP_EXISTS,
+    OP_IN,
+    OP_NOT_IN,
+)
+
+_SEL_OPS = {
+    "In": OP_IN,
+    "NotIn": OP_NOT_IN,
+    "Exists": OP_EXISTS,
+    "DoesNotExist": OP_DOES_NOT_EXIST,
+}
+
+
+# ---------------------------------------------------------------------------
+# Host-side packing
+# ---------------------------------------------------------------------------
+
+
+def dra_tables(
+    pods,
+    name_to_idx,
+    n_cap: int,
+    p_cap: int,
+    slices,
+    device_classes,
+    claims_by_key,
+):
+    """Pack the batch's DRA surface into device-ready tensors.
+
+    ``slices`` is the scheduler's ResourceSlice list in lister order (the
+    enumeration order the greedy take and the plugin's serial allocator
+    share), ``device_classes`` maps name → DeviceClass, ``claims_by_key``
+    maps "ns/name" → the WHOLE claim-cache view (assumed state included)
+    — not just batch-referenced claims: ``free0`` must exclude devices
+    held by ANY allocated claim (the serial plugin's _allocated_devices
+    contract), so a batch-local view would hand out devices earlier
+    drains already granted.  Request slots are still built only for the
+    claims the batch references.
+
+    Returns None when no pod references claims, else a dict of jnp arrays
+    + static caps + host-side bookkeeping:
+
+      dev_key/dev_val  i32 [N, DD, DA]   device attribute pairs (-1 pad)
+      dev_valid        bool [N, DD]
+      free0            bool [N, DD]      not held by any allocated claim
+      sel_key/sel_op   i32 [P, DQ, DS]   packed selector requirements
+      sel_vals         i32 [P, DQ, DS, DV]
+      req_count        i32 [P, DQ]       ExactCount count
+      req_all          bool [P, DQ]      AllocationMode=All
+      req_cl           i32 [P, DQ]       owning claim slot (-1 pad)
+      req_bad          bool [P, DQ]      device class missing → never fits
+      q_valid          bool [P, DQ]
+      ref_cl           i32 [P, CQ]       claim slots the pod references
+      claim_node0      i32 [CL]          pre-batch allocation node (-1 none)
+      claim_keys       [CL] list         slot → "ns/name" (host bookkeeping)
+      has_claims       bool [P] numpy    host-side routing bit
+    """
+    referenced = []  # claim keys in first-reference order
+    ref_idx = {}
+    per_pod_claims = []
+    for pod in pods:
+        keys = []
+        for name in pod.resource_claims:
+            key = f"{pod.namespace}/{name}"
+            if key not in ref_idx:
+                claim = claims_by_key.get(key)
+                if claim is None:
+                    # PreFilter already rejected the pod; don't pack a slot
+                    continue
+                ref_idx[key] = len(referenced)
+                referenced.append(key)
+            keys.append(ref_idx[key])
+        per_pod_claims.append(keys)
+    if not referenced:
+        return None
+
+    # -- attribute vocab over slice devices + selector keys/values ----------
+    key_ids: dict = {}
+    val_ids: dict = {}
+
+    def _k(s):
+        return key_ids.setdefault(s, len(key_ids))
+
+    def _v(s):
+        return val_ids.setdefault(s, len(val_ids))
+
+    # node-grouped slices in lister order; devices flatten per node
+    per_node = [[] for _ in range(n_cap)]
+    for sl in slices:
+        idx = name_to_idx.get(sl.node_name)
+        if idx is None or idx >= n_cap:
+            continue
+        for dev in sl.devices:
+            per_node[idx].append((sl.driver, sl.pool, dev))
+    dd_need = max((len(devs) for devs in per_node), default=1) or 1
+    da_need = 1
+    for devs in per_node:
+        for _, _, dev in devs:
+            da_need = max(da_need, len(dev.attributes))
+
+    # selector tables: class selectors first, then request selectors —
+    # "all must admit" is order-independent, but keep the reference order
+    def _sels(req):
+        cls = device_classes.get(req.device_class_name)
+        if cls is None:
+            return None  # missing class: the slot can never fit
+        return tuple(cls.selectors) + tuple(req.selectors)
+
+    per_pod_slots = []  # [(cl_slot, count, is_all, sels-or-None)]
+    dq_need, ds_need, dv_need, cq_need = 1, 1, 1, 1
+    for pod, cl_slots in zip(pods, per_pod_claims):
+        slots = []
+        for cl in cl_slots:
+            claim = claims_by_key[referenced[cl]]
+            if claim.allocation is not None:
+                continue  # allocated claims consume nothing new
+            for req in claim.requests:
+                sels = _sels(req)
+                slots.append(
+                    (
+                        cl,
+                        int(req.count),
+                        req.allocation_mode == ALLOCATION_MODE_ALL,
+                        sels,
+                    )
+                )
+                if sels is not None:
+                    ds_need = max(ds_need, len(sels))
+                    for s in sels:
+                        dv_need = max(dv_need, len(s.values))
+        per_pod_slots.append(slots)
+        dq_need = max(dq_need, len(slots))
+        cq_need = max(cq_need, len(cl_slots))
+
+    DD = bucket_cap(dd_need, 1)
+    DA = bucket_cap(da_need, 1)
+    DQ = bucket_cap(dq_need, 1)
+    DS = bucket_cap(ds_need, 1)
+    DV = bucket_cap(dv_need, 1)
+    CQ = bucket_cap(cq_need, 1)
+    CL = bucket_cap(len(referenced), 1)
+
+    dev_key = np.full((n_cap, DD, DA), ABSENT, np.int32)
+    dev_val = np.full((n_cap, DD, DA), ABSENT, np.int32)
+    dev_valid = np.zeros((n_cap, DD), bool)
+    dev_ident = {}  # (driver, pool, device-name) → (node, slot)
+    for n, devs in enumerate(per_node):
+        for d, (driver, pool, dev) in enumerate(devs[:DD]):
+            dev_valid[n, d] = True
+            dev_ident[(driver, pool, dev.name)] = (n, d)
+            for a, (k, v) in enumerate(dev.attributes[:DA]):
+                dev_key[n, d, a] = _k(k)
+                dev_val[n, d, a] = _v(v)
+
+    # devices held by ANY allocated claim in the cache view are taken
+    free0 = dev_valid.copy()
+    for claim in claims_by_key.values():
+        if claim.allocation is None:
+            continue
+        for r in claim.allocation.results:
+            pos = dev_ident.get((r.driver, r.pool, r.device))
+            if pos is not None:
+                free0[pos] = False
+
+    sel_key = np.full((p_cap, DQ, DS), PAD, np.int32)
+    sel_op = np.full((p_cap, DQ, DS), PAD, np.int32)
+    sel_vals = np.full((p_cap, DQ, DS, DV), PAD, np.int32)
+    req_count = np.zeros((p_cap, DQ), np.int32)
+    req_all = np.zeros((p_cap, DQ), bool)
+    req_cl = np.full((p_cap, DQ), -1, np.int32)
+    req_bad = np.zeros((p_cap, DQ), bool)
+    q_valid = np.zeros((p_cap, DQ), bool)
+    ref_cl = np.full((p_cap, CQ), -1, np.int32)
+    has_claims = np.zeros((p_cap,), bool)
+    for i, (slots, cl_slots) in enumerate(
+        zip(per_pod_slots, per_pod_claims)
+    ):
+        has_claims[i] = bool(cl_slots)
+        for c, cl in enumerate(cl_slots[:CQ]):
+            ref_cl[i, c] = cl
+        for q, (cl, count, is_all, sels) in enumerate(slots[:DQ]):
+            q_valid[i, q] = True
+            req_cl[i, q] = cl
+            req_count[i, q] = count
+            req_all[i, q] = is_all
+            if sels is None:
+                req_bad[i, q] = True
+                continue
+            for s, sel in enumerate(sels[:DS]):
+                # unseen attribute keys/values still intern: they simply
+                # match no device (Exists on an unknown key is never true)
+                sel_key[i, q, s] = _k(sel.attribute)
+                sel_op[i, q, s] = _SEL_OPS.get(sel.operator, PAD)
+                for v, val in enumerate(sel.values[:DV]):
+                    sel_vals[i, q, s, v] = _v(val)
+
+    claim_node0 = np.full((CL,), -1, np.int32)
+    for cl, key in enumerate(referenced):
+        claim = claims_by_key[key]
+        if claim.allocation is not None and claim.allocation.node_name:
+            claim_node0[cl] = name_to_idx.get(claim.allocation.node_name, n_cap)
+
+    return dict(
+        dev_key=jnp.asarray(dev_key),
+        dev_val=jnp.asarray(dev_val),
+        dev_valid=jnp.asarray(dev_valid),
+        free0=jnp.asarray(free0),
+        sel_key=jnp.asarray(sel_key),
+        sel_op=jnp.asarray(sel_op),
+        sel_vals=jnp.asarray(sel_vals),
+        req_count=jnp.asarray(req_count),
+        req_all=jnp.asarray(req_all),
+        req_cl=jnp.asarray(req_cl),
+        req_bad=jnp.asarray(req_bad),
+        q_valid=jnp.asarray(q_valid),
+        ref_cl=jnp.asarray(ref_cl),
+        claim_node0=jnp.asarray(claim_node0),
+        claim_keys=list(referenced),
+        has_claims=has_claims,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Device kernels (pure functions under the workloads admission jit root)
+# ---------------------------------------------------------------------------
+
+
+def selector_match(dev_key, dev_val, dev_valid, sel_key, sel_op, sel_vals):
+    """The batched device-matching pass: ``[P, DQ, N, DD]`` bool — device
+    slot (n, d) satisfies EVERY selector requirement of request slot
+    (p, q).  Static loops over the DS/DV/DA axes keep the live buffer at
+    one [P, DQ, N, DD] plane per op (the eval_table discipline)."""
+    P, DQ, DS = sel_key.shape
+    DV = sel_vals.shape[3]
+    N, DD, DA = dev_key.shape
+    ok = jnp.ones((P, DQ, N, DD), bool)
+    for s in range(DS):
+        key = sel_key[:, :, s]  # [P, DQ]
+        op = sel_op[:, :, s]
+        present = jnp.zeros((P, DQ, N, DD), bool)
+        val_at = jnp.full((P, DQ, N, DD), ABSENT, I32)
+        for a in range(DA):
+            k_a = dev_key[:, :, a]  # [N, DD]
+            hit = (k_a[None, None] == key[:, :, None, None]) & (
+                k_a >= 0
+            )[None, None]
+            present = present | hit
+            val_at = jnp.where(hit, dev_val[:, :, a][None, None], val_at)
+        in_any = jnp.zeros((P, DQ, N, DD), bool)
+        for v in range(DV):
+            sv = sel_vals[:, :, s, v]  # [P, DQ]
+            in_any = in_any | (
+                present
+                & (val_at == sv[:, :, None, None])
+                & (sv >= 0)[:, :, None, None]
+            )
+        opb = op[:, :, None, None]
+        res = jnp.where(
+            opb == OP_IN,
+            in_any,
+            jnp.where(
+                opb == OP_NOT_IN,
+                ~in_any,  # NotIn admits absent attributes (in_any ⊆ present)
+                jnp.where(opb == OP_EXISTS, present, ~present),
+            ),
+        )
+        res = jnp.where(opb == PAD, True, res)  # padded requirement slot
+        ok = ok & res
+    return ok & dev_valid[None, None]
+
+
+def node_feasible(
+    match_p,
+    free,
+    claim_node,
+    req_count_p,
+    req_all_p,
+    req_cl_p,
+    q_valid_p,
+    req_bad_p,
+    ref_cl_p,
+):
+    """Per-node DRA verdict + greedy take mask for ONE pod against the
+    carried allocation state.
+
+    match_p [DQ, N, DD]; free [N, DD]; claim_node [CL].  Returns
+    (ok [N] bool, take [N, DD] bool): ok requires every referenced
+    ALLOCATED claim to pin to the node and every ACTIVE request slot
+    (claim still unallocated) to be satisfiable from the node's free
+    devices — requests of one pod allocate greedily in slot order, so a
+    device granted to slot q is unavailable to q+1 (the reference's
+    ``taken`` accumulation)."""
+    DQ, N, DD = match_p.shape
+    CL = claim_node.shape[0]
+    CQ = ref_cl_p.shape[0]
+    n_ids = jnp.arange(N, dtype=I32)
+    ok = jnp.ones((N,), bool)
+    for c in range(CQ):
+        cl = ref_cl_p[c]
+        pin = jnp.where(
+            cl >= 0, claim_node[jnp.clip(cl, 0, CL - 1)], -1
+        )
+        ok = ok & ((pin < 0) | (pin == n_ids))
+    free_sim = free
+    take_acc = jnp.zeros((N, DD), bool)
+    for q in range(DQ):
+        cl = req_cl_p[q]
+        unalloc = jnp.where(
+            cl >= 0, claim_node[jnp.clip(cl, 0, CL - 1)] < 0, False
+        )
+        active = q_valid_p[q] & unalloc
+        m = match_p[q] & free_sim  # [N, DD]
+        cnt = jnp.sum(m.astype(I32), axis=1)  # [N]
+        total_m = jnp.sum(match_p[q].astype(I32), axis=1)
+        # AllocationMode=All requires EVERY matching device allocatable
+        # (structured/allocator.go:530-552) — one in use fails the node
+        ok_all = (total_m > 0) & (cnt == total_m)
+        ok_q = jnp.where(req_all_p[q], ok_all, cnt >= req_count_p[q])
+        ok_q = ok_q & ~req_bad_p[q]
+        ok = ok & jnp.where(active, ok_q, True)
+        rank = jnp.cumsum(m.astype(I32), axis=1)
+        take = m & jnp.where(
+            req_all_p[q], True, rank <= req_count_p[q]
+        )
+        take = take & active
+        free_sim = free_sim & ~take
+        take_acc = take_acc | take
+    return ok, take_acc
+
+
+def dra_commit(free, claim_node, choice, take_p, ref_cl_p):
+    """Commit pod p's placement into the allocation carries: the chosen
+    node's take row leaves ``free`` and every referenced still-unallocated
+    claim pins to the chosen node.  Dense one-hot row updates — no
+    scatters.  Returns (new_free, new_claim_node)."""
+    N = free.shape[0]
+    CL = claim_node.shape[0]
+    CQ = ref_cl_p.shape[0]
+    committed = choice >= 0
+    row = (jnp.arange(N, dtype=I32) == choice) & committed  # [N]
+    new_free = free & ~(take_p & row[:, None])
+    newly = jnp.zeros((CL,), bool)
+    for c in range(CQ):
+        cl = ref_cl_p[c]
+        oh = jnp.arange(CL, dtype=I32) == cl  # cl<0 matches no slot
+        newly = newly | (oh & (claim_node < 0))
+    new_claim_node = jnp.where(
+        newly & committed, choice.astype(I32), claim_node
+    )
+    return new_free, new_claim_node

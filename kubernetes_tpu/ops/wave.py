@@ -367,6 +367,140 @@ def _rep_rows(mat, rp, rc):
     )
 
 
+# ---------------------------------------------------------------------------
+# The term-factored delta algebra, factored out of the admission scan so
+# every serial-recurrence replayer shares ONE definition: wave_schedule's
+# conflict-resolution pass below and the workloads tier's gang/DRA
+# admission scan (ops/coscheduling.py) produce pod p's batch-peer count
+# tensors from the SAME [T, N] carries — the paths cannot drift.
+# ---------------------------------------------------------------------------
+
+
+def factored_spread_dyn(g, p, tid_sp, cnt_sp, d_cap: int):
+    """SpreadDyn for pod p from the factored spread carries.
+
+    tid_sp [P, C] maps p's constraint slots onto distinct-term ids;
+    cnt_sp [Tsp, N] carries per-term committed-peer counts."""
+    Tsp = cnt_sp.shape[0]
+    d_ids = jnp.arange(d_cap, dtype=I32)
+    tid = tid_sp[p]  # [C]
+    ohc = (
+        (tid[:, None] == jnp.arange(Tsp, dtype=I32)[None, :])
+        & (tid >= 0)[:, None]
+    ).astype(I32)
+    cnt_rows = jnp.einsum("ct,tn->cn", ohc, cnt_sp)  # [C,N]
+    te = g.sp_te[p].astype(I32)
+    cting = g.sp_counting[p].astype(I32)
+    cdv = g.sp_cdv[p]
+    dom_oh = (
+        (cdv[:, :, None] == d_ids[None, None, :])
+        & (cdv >= 0)[:, :, None]
+    ).astype(I32)  # [C, N, D]
+    g1 = jnp.einsum("cn,cnd->cd", cnt_rows * te, dom_oh)
+    g2 = jnp.einsum("cn,cnd->cd", cnt_rows * cting, dom_oh)
+    dyn_f_dom = jnp.einsum("cd,cnd->cn", g1, dom_oh)
+    dyn_dom = jnp.einsum("cd,cnd->cn", g2, dom_oh)
+    present = (g.sp_dv[p] >= 0).astype(I32)
+    dyn_f = jnp.where(
+        g.sp_is_host[p][:, None], cnt_rows * te * present, dyn_f_dom
+    )
+    return gang.SpreadDyn(dyn_f, cnt_rows, dyn_dom)
+
+
+def factored_interpod_dyn(
+    g,
+    db,
+    p,
+    tid_ip,
+    ip_cdv_tab,
+    d2_cap: int,
+    hostname_key,
+    cnt_ip,
+    rev_cnt,
+    m_ip_all,
+    t_anti,
+    t_w,
+):
+    """InterpodDyn for pod p from the factored inter-pod carries, plus the
+    aux tuple factored_carry_update needs to spread p's own committed terms
+    over their topology domains (ohu, cdv2, dvip, is_host_u, ki)."""
+    Tip = cnt_ip.shape[0]
+    Kd2 = ip_cdv_tab.shape[0]
+    d2_ids = jnp.arange(d2_cap, dtype=I32)
+    tidu = tid_ip[p]  # [AT]
+    ohu = (
+        (tidu[:, None] == jnp.arange(Tip, dtype=I32)[None, :])
+        & (tidu >= 0)[:, None]
+    ).astype(I32)
+    fcnt = jnp.einsum("ut,tn->un", ohu, cnt_ip)  # [AT,N]
+    ki = g.ip_key_idx[p]  # [AT]
+    cdv2 = ip_cdv_tab[jnp.clip(ki, 0, Kd2 - 1)]  # [AT, N]
+    cdv2 = jnp.where((ki >= 0)[:, None], cdv2, -1)
+    dom2 = (
+        (cdv2[:, :, None] == d2_ids[None, None, :])
+        & (cdv2 >= 0)[:, :, None]
+    ).astype(I32)  # [AT, N, D2]
+    gf = jnp.einsum("un,und->ud", fcnt, dom2)
+    ip_dyn_dom = jnp.einsum("ud,und->un", gf, dom2)
+    dvip = g.ip_dv[p]
+    is_host_u = db.aff_topo[p] == hostname_key  # [AT]
+    ip_dyn = jnp.where(
+        is_host_u[:, None], fcnt * (dvip >= 0).astype(I32), ip_dyn_dom
+    )
+    any_dyn = jnp.any(g.ip_is_aff[p] & (jnp.sum(fcnt, axis=1) > 0))
+    m_rev = m_ip_all[:, p]  # [Tip]
+    viol_b = jnp.any(
+        (m_rev & t_anti)[:, None] & (rev_cnt > 0), axis=0
+    )
+    sym_b = jnp.sum(
+        jnp.where(
+            m_rev[:, None],
+            t_w[:, None] * rev_cnt.astype(I64),
+            0,
+        ),
+        axis=0,
+    )
+    idyn = gang.InterpodDyn(ip_dyn, viol_b, sym_b, any_dyn)
+    return idyn, (ohu, cdv2, dvip, is_host_u, ki)
+
+
+def factored_carry_update(
+    cnt_sp, cnt_ip, rev_cnt, p, choice, m_sp_all, m_ip_all, ip_aux
+):
+    """Commit pod p's placement into the factored carries: dense rank-1
+    outer products, no scatters.  ``ip_aux`` is factored_interpod_dyn's aux
+    tuple (None when the batch carries no inter-pod terms)."""
+    N = cnt_sp.shape[1]
+    n_ids = jnp.arange(N, dtype=I32)
+    committed = choice >= 0
+    onehot_n = ((n_ids == choice) & committed).astype(I32)
+    new_cnt_sp = cnt_sp + m_sp_all[:, p, None].astype(I32) * onehot_n[None, :]
+    new_cnt_ip = cnt_ip + m_ip_all[:, p, None].astype(I32) * onehot_n[None, :]
+    if ip_aux is None:
+        return new_cnt_sp, new_cnt_ip, rev_cnt
+    ohu, cdv2, dvip, is_host_u, ki = ip_aux
+    # p's own terms spread over their topology domains (the
+    # reverse/symmetric direction future steps read back)
+    val2_at = jnp.sum(
+        jnp.where(onehot_n[None, :] > 0, cdv2, 0), axis=1
+    )  # [AT] compact id at the chosen node
+    dval_at = jnp.sum(
+        jnp.where(onehot_n[None, :] > 0, dvip, 0), axis=1
+    )  # [AT] label value at the chosen node
+    dom_row = jnp.where(
+        is_host_u[:, None],
+        (onehot_n > 0)[None, :] & (dval_at >= 0)[:, None],
+        (cdv2 == val2_at[:, None])
+        & (cdv2 >= 0)
+        & (val2_at >= 0)[:, None],
+    )
+    dom_row = dom_row & committed & (ki >= 0)[:, None]
+    new_rev_cnt = rev_cnt + jnp.einsum(
+        "ut,un->tn", ohu, dom_row.astype(I32)
+    )
+    return new_cnt_sp, new_cnt_ip, new_rev_cnt
+
+
 # ktpu: axes(dc=DeviceCluster, db=DeviceBatch, g=GangStatics, hostname_key=i32)
 # ktpu: axes(tid_sp=i32[P,C], rep_sp_p=i32[Tsp], rep_sp_c=i32[Tsp])
 # ktpu: axes(tid_ip=i32[P,A], rep_ip_p=i32[Tip], rep_ip_u=i32[Tip], ip_cdv_tab=i32[Kd2,N])
@@ -523,70 +657,28 @@ def wave_schedule(
 
     def step(state, p):
         if C:
-            tid = tid_sp[p]  # [C]
-            ohc = (
-                (tid[:, None] == jnp.arange(Tsp, dtype=I32)[None, :])
-                & (tid >= 0)[:, None]
-            ).astype(I32)
-            cnt_rows = jnp.einsum("ct,tn->cn", ohc, state["cnt_sp"])  # [C,N]
-            te = g.sp_te[p].astype(I32)
-            cting = g.sp_counting[p].astype(I32)
-            cdv = g.sp_cdv[p]
-            dom_oh = (
-                (cdv[:, :, None] == d_ids[None, None, :])
-                & (cdv >= 0)[:, :, None]
-            ).astype(I32)  # [C, N, D]
-            g1 = jnp.einsum("cn,cnd->cd", cnt_rows * te, dom_oh)
-            g2 = jnp.einsum("cn,cnd->cd", cnt_rows * cting, dom_oh)
-            dyn_f_dom = jnp.einsum("cd,cnd->cn", g1, dom_oh)
-            dyn_dom = jnp.einsum("cd,cnd->cn", g2, dom_oh)
-            present = (g.sp_dv[p] >= 0).astype(I32)
-            dyn_f = jnp.where(
-                g.sp_is_host[p][:, None], cnt_rows * te * present, dyn_f_dom
-            )
-            sdyn = gang.SpreadDyn(dyn_f, cnt_rows, dyn_dom)
+            sdyn = factored_spread_dyn(g, p, tid_sp, state["cnt_sp"], d_cap)
         else:
             sdyn = zero_sdyn()
 
         if AT:
-            tidu = tid_ip[p]  # [AT]
-            ohu = (
-                (tidu[:, None] == jnp.arange(Tip, dtype=I32)[None, :])
-                & (tidu >= 0)[:, None]
-            ).astype(I32)
-            fcnt = jnp.einsum("ut,tn->un", ohu, state["cnt_ip"])  # [AT,N]
-            ki = g.ip_key_idx[p]  # [AT]
-            cdv2 = ip_cdv_tab[jnp.clip(ki, 0, Kd2 - 1)]  # [AT, N]
-            cdv2 = jnp.where((ki >= 0)[:, None], cdv2, -1)
-            dom2 = (
-                (cdv2[:, :, None] == d2_ids[None, None, :])
-                & (cdv2 >= 0)[:, :, None]
-            ).astype(I32)  # [AT, N, D2]
-            gf = jnp.einsum("un,und->ud", fcnt, dom2)
-            ip_dyn_dom = jnp.einsum("ud,und->un", gf, dom2)
-            dvip = g.ip_dv[p]
-            is_host_u = db.aff_topo[p] == hostname_key  # [AT]
-            ip_dyn = jnp.where(
-                is_host_u[:, None], fcnt * (dvip >= 0).astype(I32), ip_dyn_dom
+            idyn, ip_aux = factored_interpod_dyn(
+                g,
+                db,
+                p,
+                tid_ip,
+                ip_cdv_tab,
+                d2_cap,
+                hostname_key,
+                state["cnt_ip"],
+                state["rev_cnt"],
+                m_ip_all,
+                t_anti,
+                t_w,
             )
-            any_dyn = jnp.any(
-                g.ip_is_aff[p] & (jnp.sum(fcnt, axis=1) > 0)
-            )
-            m_rev = m_ip_all[:, p]  # [Tip]
-            viol_b = jnp.any(
-                (m_rev & t_anti)[:, None] & (state["rev_cnt"] > 0), axis=0
-            )
-            sym_b = jnp.sum(
-                jnp.where(
-                    m_rev[:, None],
-                    t_w[:, None] * state["rev_cnt"].astype(I64),
-                    0,
-                ),
-                axis=0,
-            )
-            idyn = gang.InterpodDyn(ip_dyn, viol_b, sym_b, any_dyn)
         else:
             idyn = zero_idyn()
+            ip_aux = None
 
         hv, c_ok, anti_viol = build_hv(p, sdyn, idyn)
         new_state, (choice, n_feas, reason_counts) = gang.pod_step(
@@ -594,38 +686,18 @@ def wave_schedule(
         )
 
         # carry updates: dense rank-1 outer products, no scatters
-        committed = choice >= 0
-        onehot_n = ((n_ids == choice) & committed).astype(I32)
-        new_state["cnt_sp"] = (
-            state["cnt_sp"]
-            + m_sp_all[:, p, None].astype(I32) * onehot_n[None, :]
-        )
-        new_state["cnt_ip"] = (
-            state["cnt_ip"]
-            + m_ip_all[:, p, None].astype(I32) * onehot_n[None, :]
-        )
-        if AT:
-            # p's own terms spread over their topology domains (the
-            # reverse/symmetric direction future steps read back)
-            val2_at = jnp.sum(
-                jnp.where(onehot_n[None, :] > 0, cdv2, 0), axis=1
-            )  # [AT] compact id at the chosen node
-            dval_at = jnp.sum(
-                jnp.where(onehot_n[None, :] > 0, dvip, 0), axis=1
-            )  # [AT] label value at the chosen node
-            dom_row = jnp.where(
-                is_host_u[:, None],
-                (onehot_n > 0)[None, :] & (dval_at >= 0)[:, None],
-                (cdv2 == val2_at[:, None])
-                & (cdv2 >= 0)
-                & (val2_at >= 0)[:, None],
+        new_state["cnt_sp"], new_state["cnt_ip"], new_state["rev_cnt"] = (
+            factored_carry_update(
+                state["cnt_sp"],
+                state["cnt_ip"],
+                state["rev_cnt"],
+                p,
+                choice,
+                m_sp_all,
+                m_ip_all,
+                ip_aux,
             )
-            dom_row = dom_row & committed & (ki >= 0)[:, None]
-            new_state["rev_cnt"] = state["rev_cnt"] + jnp.einsum(
-                "ut,un->tn", ohu, dom_row.astype(I32)
-            )
-        else:
-            new_state["rev_cnt"] = state["rev_cnt"]
+        )
 
         # demotion attribution vs the speculative candidate: evaluated at
         # the pod's own step, where the carries are exactly the serial

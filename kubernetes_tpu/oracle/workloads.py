@@ -1,0 +1,281 @@
+"""Serial gang/DRA/volume oracle — the reference-shaped replay the
+workloads kernel (ops/coscheduling.py) must match bit-for-bit.
+
+One pod at a time in the canonical planner order (workloads/gang.py
+plan_batch), each pod's feasible set is the oracle pipeline's verdict
+(oracle/pipeline.py) narrowed by:
+
+  * DRA claim allocation — the structured allocator's greedy walk in
+    slice/device enumeration order (framework/dynamicresources.py
+    _allocate_on_node semantics: DeviceClass + request selectors must all
+    admit, ExactCount takes the first ``count`` free matches, All requires
+    every match free, one pod's earlier requests shadow its later ones);
+  * volume topology — every bound PVC's PV node-affinity must admit the
+    node (the VolumeBinding bound-claims check, binder.go:868).
+
+Placements commit into the oracle state AND the allocation ledger
+(claims pin to their node, granted devices join the taken set) so
+in-batch contention resolves in queue order, and each gang's member run
+executes under an undo log: if the members placed cannot cover the
+gang's remaining minMember need, every placement, claim grant, and taken
+device of the gang is rolled back before the next pod runs — exactly the
+kernel's checkpoint/restore.
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from kubernetes_tpu.api import dra
+from kubernetes_tpu.oracle.pipeline import prioritize, feasible_nodes, select_host
+from kubernetes_tpu.oracle.state import OracleState
+from kubernetes_tpu.workloads.gang import PodGroup, group_key_of, plan_batch
+
+
+def allocate_on_node(
+    claim: dra.ResourceClaim,
+    node_name: str,
+    node_slices: List[dra.ResourceSlice],
+    device_classes: Dict[str, dra.DeviceClass],
+    taken: Set[Tuple[str, str, str]],
+) -> Optional[dra.AllocationResult]:
+    """The structured allocator's per-(claim, node) walk — semantics
+    identical to DynamicResources._allocate_on_node; ``taken`` accumulates
+    grants (earlier claims/requests of the same pod shadow later ones) and
+    is unwound on failure."""
+    results: List[dra.DeviceRequestAllocationResult] = []
+    granted: List[Tuple[str, str, str]] = []
+
+    def fail() -> None:
+        for key in granted:
+            taken.discard(key)
+
+    for req in claim.requests:
+        device_class = device_classes.get(req.device_class_name)
+        if device_class is None:
+            fail()
+            return None
+        found: List[dra.DeviceRequestAllocationResult] = []
+        want = (
+            req.count if req.allocation_mode == dra.ALLOCATION_MODE_EXACT else None
+        )
+        ok = True
+        for sl in node_slices:
+            for dev in sl.devices:
+                key = (sl.driver, sl.pool, dev.name)
+                attrs = dev.attr_map()
+                if not device_class.admits(attrs):
+                    continue
+                if not all(s.matches(attrs) for s in req.selectors):
+                    continue
+                if key in taken:
+                    if want is None:
+                        ok = False
+                        break
+                    continue
+                found.append(
+                    dra.DeviceRequestAllocationResult(
+                        request=req.name,
+                        driver=sl.driver,
+                        pool=sl.pool,
+                        device=dev.name,
+                    )
+                )
+                taken.add(key)
+                granted.append(key)
+                if want is not None and len(found) >= want:
+                    break
+            if not ok or (want is not None and len(found) >= want):
+                break
+        if not ok or (want is not None and len(found) < want) or (
+            want is None and not found
+        ):
+            fail()
+            return None
+        results.extend(found)
+    return dra.AllocationResult(results=tuple(results), node_name=node_name)
+
+
+@dataclass
+class WorkloadResult:
+    placements: Dict[str, Optional[str]] = field(default_factory=dict)
+    rolled_back: Set[str] = field(default_factory=set)  # pod names
+    gang_admitted: Dict[str, bool] = field(default_factory=dict)
+    # claim key → node the oracle allocated it to
+    claim_nodes: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class WorkloadOracle:
+    """Mutable serial replay state over an OracleState + allocation ledger."""
+
+    state: OracleState
+    slices: List[dra.ResourceSlice] = field(default_factory=list)
+    device_classes: Dict[str, dra.DeviceClass] = field(default_factory=dict)
+    claims: Dict[str, dra.ResourceClaim] = field(default_factory=dict)
+    pvs: Dict[str, object] = field(default_factory=dict)
+    pvcs: Dict[str, object] = field(default_factory=dict)
+    groups: Dict[str, PodGroup] = field(default_factory=dict)
+    bound: Dict[str, int] = field(default_factory=dict)
+
+    def __post_init__(self):
+        # working copies: allocation state mutates during the replay
+        self.claims = {k: copy.deepcopy(c) for k, c in self.claims.items()}
+        self.taken: Set[Tuple[str, str, str]] = set()
+        for c in self.claims.values():
+            if c.allocation is not None:
+                for r in c.allocation.results:
+                    self.taken.add((r.driver, r.pool, r.device))
+        self._slices_by_node: Dict[str, List[dra.ResourceSlice]] = {}
+        for sl in self.slices:
+            self._slices_by_node.setdefault(sl.node_name, []).append(sl)
+
+    # -- per-node workload narrowing ----------------------------------------
+
+    def _dra_ok(self, pod, node_name: str) -> bool:
+        """Feasibility probe against a throwaway taken-set copy — the
+        probe's grants are discarded wholesale, no unwind needed."""
+        sim_taken = set(self.taken)
+        for name in pod.resource_claims:
+            claim = self.claims.get(f"{pod.namespace}/{name}")
+            if claim is None:
+                return False
+            if claim.allocation is not None:
+                if (
+                    claim.allocation.node_name
+                    and claim.allocation.node_name != node_name
+                ):
+                    return False
+                continue
+            alloc = allocate_on_node(
+                claim,
+                node_name,
+                self._slices_by_node.get(node_name, []),
+                self.device_classes,
+                sim_taken,
+            )
+            if alloc is None:
+                return False
+        return True
+
+    def _dra_commit(self, pod, node_name: str, undo: List) -> None:
+        for name in pod.resource_claims:
+            claim = self.claims.get(f"{pod.namespace}/{name}")
+            if claim is None or claim.allocation is not None:
+                continue
+            alloc = allocate_on_node(
+                claim,
+                node_name,
+                self._slices_by_node.get(node_name, []),
+                self.device_classes,
+                self.taken,
+            )
+            # feasibility was proven before commit
+            assert alloc is not None, f"oracle DRA commit lost {claim.key}"
+            claim.allocation = alloc
+            keys = [(r.driver, r.pool, r.device) for r in alloc.results]
+            undo.append(("claim", claim, keys))
+
+    def _vol_ok(self, pod, node_name: str) -> bool:
+        from kubernetes_tpu.framework.volumebinding import (
+            pv_node_affinity_matches,
+        )
+
+        names = pod.pvc_names() if hasattr(pod, "pvc_names") else []
+        for name in names:
+            pvc = self.pvcs.get(f"{pod.namespace}/{name}")
+            if pvc is None:
+                return False
+            if pvc.is_fully_bound():
+                pv = self.pvs.get(pvc.volume_name)
+                if pv is None:
+                    return False
+                ns = self.state.nodes.get(node_name)
+                if ns is None or not pv_node_affinity_matches(pv, ns.node):
+                    return False
+            else:
+                return False  # unbound claims never reach the kernel path
+        return True
+
+    # -- the serial replay ---------------------------------------------------
+
+    def _schedule_pod(self, pod) -> Optional[str]:
+        fit = feasible_nodes(pod, self.state)
+        narrowed = [
+            n
+            for n in fit.feasible
+            if (not pod.resource_claims or self._dra_ok(pod, n))
+            and self._vol_ok(pod, n)
+        ]
+        if not narrowed:
+            return None
+        totals = prioritize(pod, self.state, narrowed)
+        return select_host(totals)
+
+    def schedule(self, pods) -> WorkloadResult:
+        """Replay the batch in canonical planner order with gang undo."""
+        out = WorkloadResult()
+
+        def group_of(pod):
+            # pods referencing an UNREGISTERED group schedule as ordinary
+            # pods — same contract as the scheduler's _workloads_group_of
+            key = group_key_of(pod)
+            return key if key is not None and key in self.groups else None
+
+        order, gang_positions = plan_batch(pods, group_of=group_of)
+        gang_at: Dict[int, str] = {}
+        for key, positions in gang_positions.items():
+            gang_at[positions[0]] = key
+        pos_to_key: Dict[int, str] = {}
+        for key, positions in gang_positions.items():
+            for pos in positions:
+                pos_to_key[pos] = key
+
+        undo: List = []
+        landed = 0
+
+        def rollback() -> None:
+            for kind, obj, extra in reversed(undo):
+                if kind == "place":
+                    self.state.unplace(obj)
+                    obj.node_name = ""
+                    out.placements[obj.name] = None
+                    out.rolled_back.add(obj.name)
+                else:  # claim
+                    obj.allocation = None
+                    for k in extra:
+                        self.taken.discard(k)
+
+        for pos, idx in enumerate(order):
+            pod = pods[idx]
+            key = pos_to_key.get(pos)
+            if key is not None and gang_at.get(pos) == key:
+                undo = []
+                landed = 0
+            node = self._schedule_pod(pod)
+            out.placements[pod.name] = node
+            if node is not None:
+                self._dra_commit(pod, node, undo)
+                pod.node_name = node
+                self.state.place(pod)
+                undo.append(("place", pod, None))
+                landed += 1 if key is not None else 0
+            if key is not None and pos == gang_positions[key][-1]:
+                pg = self.groups.get(key)
+                need = max(
+                    0,
+                    (pg.min_member if pg else 0) - self.bound.get(key, 0),
+                )
+                if landed < need:
+                    rollback()
+                    out.gang_admitted[key] = False
+                else:
+                    out.gang_admitted[key] = True
+                    self.bound[key] = self.bound.get(key, 0) + landed
+                undo = []
+        for k, c in self.claims.items():
+            if c.allocation is not None and c.allocation.node_name:
+                out.claim_nodes[k] = c.allocation.node_name
+        return out

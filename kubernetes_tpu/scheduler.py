@@ -47,6 +47,7 @@ from kubernetes_tpu.queue import SchedulingQueue
 from kubernetes_tpu.queue.nominator import Nominator
 from kubernetes_tpu.snapshot.interner import PAD
 from kubernetes_tpu.snapshot.schema import bucket_cap, pack_pod_batch
+from kubernetes_tpu.workloads import gang as wlg
 
 logger = logging.getLogger(__name__)
 
@@ -67,6 +68,10 @@ _KTPU_GUARDED = {
             "_oracle_cache": None,
             "_nonfast_commits": None,
             "metrics": None,
+            # PodGroup registry + gang bookkeeping (workloads/gang.py):
+            # mutated by informer handlers, the workloads dispatch, and
+            # bind-failure unwinds — all under _mu
+            "gangs": "GangDirectory",
         },
         "requires_lock": [
             "_view_pod_added",
@@ -76,6 +81,7 @@ _KTPU_GUARDED = {
             "_repack_mirror",
             "_sync_mirror_external",
             "_wave_tables",
+            "_hostnames_unique",
         ],
     },
     "Nominator": {
@@ -332,6 +338,9 @@ class Scheduler:
         self.capacities: Dict[str, object] = {}
         self.resource_slices: Dict[str, object] = {}
         self.device_classes: Dict[str, object] = {}
+        # gang/coscheduling tier: PodGroup registry + quorum bookkeeping
+        # (workloads/gang.py; fed by the POD_GROUP informer or directly)
+        self.gangs = wlg.GangDirectory(clock=clock)
         self.pv_writer = lambda pv: None
         self.pvc_writer = lambda pvc: None
         self.claim_writer = lambda claim: None
@@ -363,6 +372,20 @@ class Scheduler:
         for fwk in self.profiles.values():
             for name, evs in fwk.events_to_register().items():
                 hints.setdefault(name, []).extend(evs)
+        # gang barrier rejections ("waiting for members" / rollback / quorum
+        # timeout) requeue on PodGroup events — the workloads dispatch fires
+        # a synthetic one when a missing member finally arrives (the
+        # coscheduling plugin's Pod-Add EventsToRegister analogue)
+        from kubernetes_tpu.framework.interface import ClusterEventWithHint
+
+        hints.setdefault("Coscheduling", []).append(
+            ClusterEventWithHint(
+                ClusterEvent(
+                    EventResource.POD_GROUP,
+                    ActionType.ADD | ActionType.UPDATE,
+                )
+            )
+        )
 
         def pre_enqueue(pod: Pod):
             # PreEnqueue runs under the pod's OWN profile
@@ -476,6 +499,12 @@ class Scheduler:
             "resident_batches": 0,
             "resident_pods": 0,
             "resident_rounds": 0,
+            "workload_batches": 0,
+            "workload_spec_admitted": 0,
+            "gang_admitted": 0,
+            "gang_rolled_back": 0,
+            "dra_pods": 0,
+            "dra_claims_allocated": 0,
         }
 
     # ----- event handlers (eventhandlers.go:345-428) ------------------------
@@ -556,6 +585,7 @@ class Scheduler:
     def on_pod_add(self, pod: Pod) -> None:
       with self._mu:
         if pod.node_name:
+            self.gangs.note_placed(pod)
             # Confirmation of OUR assumed pod on the same node changes no
             # capacity state (the assume already counted it) — don't treat
             # it as an external mutation (cache.go:484 reconciliation).
@@ -578,10 +608,24 @@ class Scheduler:
             )
         elif self._responsible_for(pod):
             self.queue.add(pod)
+            # a new member can complete a waiting gang's quorum — kick its
+            # siblings out of the unschedulable pool via the group event
+            key = wlg.group_key_of(pod)
+            if key is not None:
+                pg = self.gangs.get(key)
+                if pg is not None:
+                    self.queue.move_all_on_event(
+                        ClusterEvent(
+                            EventResource.POD_GROUP, ActionType.UPDATE
+                        ),
+                        pg,
+                        pg,
+                    )
 
     def on_pod_update(self, old: Pod, new: Pod) -> None:
       with self._mu:
         if new.node_name:
+            self.gangs.note_placed(new)
             ps = self.cache.pod_states.get(new.uid)
             if (
                 ps is not None
@@ -643,6 +687,7 @@ class Scheduler:
 
     def on_pod_delete(self, pod: Pod) -> None:
       with self._mu:
+        self.gangs.note_removed(pod)
         if pod.node_name:
             self._external_mutations += 1
             ps = self.cache.pod_states.get(pod.uid)
@@ -684,12 +729,16 @@ class Scheduler:
         cache = assume_caches.get(resource)
         lister = lister_maps.get(resource)
 
+        is_pod_group = resource == EventResource.POD_GROUP
+
         def on_add(obj):
             with self._mu:
                 if cache is not None:
                     cache.on_add(obj)
                 if lister is not None:
                     lister[obj.key] = obj
+                if is_pod_group:
+                    self.gangs.upsert(obj)
                 self.queue.move_all_on_event(
                     ClusterEvent(resource, ActionType.ADD), None, obj
                 )
@@ -700,6 +749,8 @@ class Scheduler:
                     cache.on_update(old, new)
                 if lister is not None:
                     lister[new.key] = new
+                if is_pod_group:
+                    self.gangs.upsert(new)
                 self.queue.move_all_on_event(
                     ClusterEvent(resource, ActionType.UPDATE), old, new
                 )
@@ -710,6 +761,8 @@ class Scheduler:
                     cache.on_delete(obj)
                 if lister is not None:
                     lister.pop(obj.key, None)
+                if is_pod_group:
+                    self.gangs.delete(obj.key)
                 self.queue.move_all_on_event(
                     ClusterEvent(resource, ActionType.DELETE), obj, None
                 )
@@ -1081,13 +1134,42 @@ class Scheduler:
         self.refresh_gauges()
         return self.prom.expose()
 
-    def _schedule_batch(self, batch) -> List[ScheduleOutcome]:
+    def _schedule_batch(
+        self, batch, try_workloads: bool = True
+    ) -> List[ScheduleOutcome]:
         fwk = self.profiles.get(
             batch[0].pod.scheduler_name, next(iter(self.profiles.values()))
         )
         outcomes: List[ScheduleOutcome] = []
         # direct-path commits happen outside any device chain
         self._chain = None
+
+        # the workloads tier: gang/coscheduling + DRA + volume topology
+        # batches take ONE fused dispatch with all-or-nothing gang
+        # admission instead of degrading to one-pod host-plugin cycles
+        if try_workloads and self.config.gang_dispatch:
+            wl_out = self._try_dispatch_workloads(fwk, batch)
+            if wl_out is not None:
+                return wl_out
+            # mixed batch: one disqualifying pod (nominated / extender /
+            # host ports / uncovered plugin) must not silently drop the
+            # quorum semantics for gang members sharing its batch — peel
+            # the members out and retry the workloads dispatch on them
+            # alone; only a member that ITSELF disqualifies falls through
+            gang_qps = [
+                qp
+                for qp in batch
+                if self._workloads_group_of(qp.pod) is not None
+            ]
+            if gang_qps and len(gang_qps) < len(batch):
+                rest = [
+                    qp
+                    for qp in batch
+                    if self._workloads_group_of(qp.pod) is None
+                ]
+                wl_out = self._try_dispatch_workloads(fwk, gang_qps)
+                if wl_out is not None:
+                    return wl_out + self._schedule_batch(rest)
 
         if len(batch) > 1:
             # Host-stateful Filter plugins (volumebinding/DRA class) judge
@@ -1537,6 +1619,12 @@ class Scheduler:
         # attempt — the direct path owns that state
         if self._sampling_active(fwk):
             return False
+        # gang members take the direct path's workloads dispatch (all-or-
+        # nothing admission with device-side rollback, ops/coscheduling.py)
+        if self.config.gang_dispatch and any(
+            wlg.group_key_of(qp.pod) is not None for qp in batch
+        ):
+            return False
         # the device append doesn't splice node port-usage rows, so pods
         # with host ports must take the direct path (which resyncs the
         # snapshot from host state every batch)
@@ -1616,6 +1704,12 @@ class Scheduler:
         * placed host-port users never constrain port-FREE pods (and port
           users are already signature-ineligible), so no port gate at all.
         """
+        # gang members need the workloads tier's all-or-nothing admission —
+        # the signature committer has no rollback
+        if self.config.gang_dispatch and any(
+            wlg.group_key_of(qp.pod) is not None for qp in batch
+        ):
+            return False
         if len(self.nominator):
             max_nom = max(p.priority for _, p in self.nominator.entries())
             if any(qp.pod.priority <= max_nom for qp in batch):
@@ -1685,10 +1779,14 @@ class Scheduler:
         # the default registry leaves every gate list empty — guard each
         # any() so the hot steady-state predicate is just the signature
         # memo lookup (pop_batch_while runs this once per extended pod)
+        gang_on = self.config.gang_dispatch
+
         def elig(qp) -> bool:
             p = qp.pod
             if p.scheduler_name != group_name or p.nominated_node_name:
                 return False
+            if gang_on and wlg.group_key_of(p) is not None:
+                return False  # gang members need the workloads dispatch
             if max_nom is not None and p.priority <= max_nom:
                 return False
             # explicit loops, not any(genexpr): this predicate runs once
@@ -2163,6 +2261,661 @@ class Scheduler:
         wt = wave_ops.wave_tables(pb, self.mirror.nodes.label_vals, hk_id)
         self._wave_tables_memo = (key, wt)
         return wt
+
+    # ----- the workloads tier: gang/coscheduling + DRA + volume topology ----
+    #
+    # One fused dispatch (ops/coscheduling.py) schedules batches carrying
+    # PodGroup gangs, DRA resource claims, and bound-volume topology —
+    # workloads the per-pod reference pipeline (and our one-pod fallback)
+    # handles only serially.  Gangs admit all-or-nothing with device-side
+    # rollback; claims allocate inside the admission scan so in-batch
+    # contention resolves in queue order; volume topology rides a kernel
+    # mask.  Behind the gangDispatch kill-switch; bit-identical to the
+    # serial gang/DRA oracle (oracle/workloads.py, paritycheck.py).
+
+    def _workloads_group_of(self, pod):
+        """Gang key of a pod, or None when it has no REGISTERED PodGroup
+        (pods referencing an unknown group schedule as ordinary pods)."""
+        key = wlg.group_key_of(pod)
+        if key is None or self.gangs.get(key) is None:
+            return None
+        return key
+
+    def _vol_kernel_ok(self, pod) -> bool:
+        """True when the pod's volume surface is exactly what the kernel
+        mask covers: every PVC exists, fully bound, its PV present.  Any
+        other shape (WaitForFirstConsumer, immediate unbound, missing PV)
+        keeps the serial VolumeBinding path — including its
+        unresolvable-status semantics."""
+        for name in pod.pvc_names():
+            pvc = self.pvc_cache.get(f"{pod.namespace}/{name}")
+            if pvc is None or not pvc.is_fully_bound():
+                return False
+            if self.pv_cache.get(pvc.volume_name) is None:
+                return False
+        return True
+
+    def _workloads_eligible(self, fwk, batch) -> bool:
+        """Spec-only pre-gate: True when the batch MIGHT take the
+        workloads dispatch — at least one gang/DRA/volume-kernel pod, and
+        none of the spec-level disqualifiers (nominations, extenders, host
+        ports, score-relevant host plugins, sampling compat).  The
+        host-filter COVERAGE check runs post-PreFilter inside the dispatch
+        (_workloads_covered), where the plugins' Skip verdicts are known."""
+        if not self.config.gang_dispatch or self._sampling_active(fwk):
+            return False
+        hf_names = {p.name for p in fwk.host_filter_plugins()}
+        dra_on = "DynamicResources" in hf_names
+        vol_on = "VolumeBinding" in hf_names
+        # cheap O(P) relevance pass FIRST: the common direct-path batch has
+        # no gang/claim/volume pod at all and must not pay the plugin /
+        # extender disqualifier scan below
+        if not any(
+            (dra_on and qp.pod.resource_claims)
+            or (vol_on and qp.pod.pvc_names())
+            or self._workloads_group_of(qp.pod) is not None
+            for qp in batch
+        ):
+            return False
+        ns_plugins = self._normalizing_score_plugins(fwk)
+        host_scores = [
+            p
+            for p in fwk.host_score_plugins()
+            if fwk.score_weights.get(p.name, 0)
+        ]
+        for qp in batch:
+            pod = qp.pod
+            if pod.nominated_node_name or pod.host_ports():
+                return False
+            for e in self.extenders:
+                if e.is_interested(pod):
+                    return False
+            for pl in ns_plugins:
+                if pl.score_relevant(pod):
+                    return False
+            for pl in host_scores:
+                if pl.score_relevant(pod):
+                    return False
+            if (
+                vol_on
+                and pod.pvc_names()
+                and not self._vol_kernel_ok(pod)
+            ):
+                return False
+        return True
+
+    def _workloads_covered(self, fwk, state, pods) -> bool:
+        """Post-PreFilter coverage check: every host Filter plugin still
+        ACTIVE for some pod must be one the kernel replaces —
+        DynamicResources (the batched allocator), VolumeBinding
+        (bound-topology kernel mask; _vol_kernel_ok pre-checked), or
+        NodeVolumeLimits when no CSINode advertises limits (its Filter is
+        then a constant success).  Anything else falls back to the serial
+        split path."""
+        for p in fwk.host_filter_plugins():
+            if p.name == "DynamicResources" or p.name == "VolumeBinding":
+                continue
+            if p.name == "NodeVolumeLimits" and not self.csinodes:
+                continue
+            for pod in pods:
+                if not state.is_filter_skipped(pod.uid, p.name):
+                    return False
+        return True
+
+    def _hostnames_unique(self) -> bool:
+        """The wave/workloads factored algebra treats hostname topology as
+        node identity — duplicate hostname label values disqualify it."""
+        import numpy as np
+
+        vocab = self.mirror.vocab
+        hk = vocab.label_keys.lookup(HOSTNAME_LABEL)
+        lv = np.asarray(self.mirror.nodes.label_vals)
+        if not 0 <= hk < lv.shape[1]:
+            return True
+        col = lv[:, hk]
+        vals = col[col >= 0]
+        return len(vals) == len(np.unique(vals))
+
+    def _vol_tables(self, pods, p_cap: int, vocab):
+        """Pack bound-PV node-affinity DNFs into the volume-topology kernel
+        mask's tables: one PV per PV2 slot, ORed selector terms on the
+        DTable term axis (ops/coscheduling.volume_topology_mask).  Returns
+        None when no pod carries an affinity-constrained bound PV."""
+        import numpy as np
+
+        from kubernetes_tpu.ops.common import DTable
+        from kubernetes_tpu.snapshot.schema import pack_conjunction_table
+        from kubernetes_tpu.snapshot.selectors import compile_node_selector_dnf
+
+        per_pod: List[list] = []
+        bad = np.zeros((p_cap,), bool)
+        any_rows = False
+        for i, pod in enumerate(pods):
+            rows = []
+            for name in pod.pvc_names():
+                pvc = self.pvc_cache.get(f"{pod.namespace}/{name}")
+                if pvc is None or not pvc.is_fully_bound():
+                    bad[i] = True  # gate should have routed this away
+                    continue
+                pv = self.pv_cache.get(pvc.volume_name)
+                if pv is None:
+                    bad[i] = True
+                    continue
+                if pv.node_affinity is None:
+                    continue  # nil affinity matches everywhere
+                rows.append(compile_node_selector_dnf(pv.node_affinity, vocab))
+            per_pod.append(rows)
+            any_rows = any_rows or bool(rows)
+        if not any_rows and not bad.any():
+            return None
+        pv_cap = bucket_cap(max((len(r) for r in per_pod), default=1) or 1, 1)
+        flat: List[list] = []
+        valid = np.zeros((p_cap, pv_cap), bool)
+        for i in range(p_cap):
+            rows = per_pod[i] if i < len(per_pod) else []
+            for j in range(pv_cap):
+                if j < len(rows):
+                    flat.append(rows[j])
+                    valid[i, j] = True
+                else:
+                    flat.append([])
+        ct = pack_conjunction_table(flat)
+        T, R, V = ct.req_key.shape[1], ct.req_key.shape[2], ct.req_vals.shape[3]
+
+        def rs(a, tail):
+            return jnp.asarray(
+                np.asarray(a).reshape((p_cap, pv_cap) + tail)
+            )
+
+        table = DTable(
+            req_key=rs(ct.req_key, (T, R)),
+            req_op=rs(ct.req_op, (T, R)),
+            req_vals=rs(ct.req_vals, (T, R, V)),
+            req_rhs=rs(ct.req_rhs, (T, R)),
+            term_valid=rs(ct.term_valid, (T,)),
+        )
+        return dict(
+            vol_table=table,
+            vol_valid=jnp.asarray(valid),
+            vol_bad=jnp.asarray(bad),
+        )
+
+    def _try_dispatch_workloads(self, fwk, batch):
+        """The workloads dispatch: gang planning + one fused admission
+        kernel + the commit walk.  Returns the outcome list, or None when
+        the batch should fall through to the existing machinery (the
+        caller treats None as "not handled"; nothing is committed or
+        failed before eligibility is certain)."""
+        from kubernetes_tpu.ops import coscheduling as cos_ops
+        from kubernetes_tpu.ops import dra as dra_ops
+
+        if not self._workloads_eligible(fwk, batch):
+            return None
+        outcomes: List[ScheduleOutcome] = []
+        self._chain = None
+        with self._mu:
+            state = CycleState()
+            vocab = self.mirror.vocab
+            for qp in batch:
+                for k, v in qp.pod.labels.items():
+                    vocab.intern_label(k, v)
+            self._sync_mirror_external()
+            if not self._hostnames_unique():
+                return None  # factored hostname-domain trick invalid
+            from kubernetes_tpu.metrics import Trace
+
+            trace = Trace(
+                "Scheduling workloads batch",
+                clock=time.perf_counter,
+                pods=len(batch),
+                profile=fwk.profile_name,
+            )
+
+            # 0. PreFilter (missing/deleted claims and PVCs reject here).
+            # Failures are NOT emitted until the coverage check commits to
+            # this path — a fallback must leave no trace.
+            pf_failures = (
+                fwk.run_pre_filter(state, [qp.pod for qp in batch]) or {}
+            )
+            live_pods = [
+                qp.pod for qp in batch if qp.pod.uid not in pf_failures
+            ]
+            if not self._workloads_covered(fwk, state, live_pods):
+                return None  # an uncovered host filter is active — serial
+            if pf_failures:
+                live = []
+                for qp in batch:
+                    s = pf_failures.get(qp.pod.uid)
+                    if s is None:
+                        live.append(qp)
+                        continue
+                    self.metrics["schedule_attempts"] += 1
+                    outcomes.append(
+                        self._post_filter_or_fail_locked(
+                            fwk, state, qp, s, 0
+                        )
+                    )
+                batch = live
+                if not batch:
+                    return outcomes
+            trace.step("PreFilter done")
+
+            # 1. gang planning: quorum/timeout barriers reject pre-dispatch
+            # (the coscheduling plugin's PreFilter/Permit-timeout verdicts)
+            keys = [self._workloads_group_of(qp.pod) for qp in batch]
+            present: Dict[str, int] = {}
+            for key in keys:
+                if key is not None:
+                    present[key] = present.get(key, 0) + 1
+            needs: Dict[str, int] = {}
+            rejected: Dict[str, Status] = {}
+            for key, n_present in present.items():
+                pg = self.gangs.get(key)
+                bound = self.gangs.bound_count(key)
+                if self.gangs.timed_out(key):
+                    rejected[key] = Status.unresolvable(
+                        f'pod group "{key}" scheduling timed out after '
+                        f"{pg.schedule_timeout_s:.0f}s",
+                        plugin="Coscheduling",
+                    )
+                    self.gangs.close_window(key)
+                elif n_present + bound < pg.min_member:
+                    rejected[key] = Status.unschedulable(
+                        f'pod group "{key}" has {n_present + bound}/'
+                        f"{pg.min_member} members; waiting for the rest",
+                        plugin="Coscheduling",
+                    )
+                    self.gangs.note_attempt(key)
+                else:
+                    needs[key] = max(0, pg.min_member - bound)
+                    self.gangs.note_attempt(key)
+            if rejected:
+                live = []
+                for qp, key in zip(batch, keys):
+                    if key in rejected:
+                        s = rejected[key]
+                        self.metrics["schedule_attempts"] += 1
+                        if self.flight.enabled:
+                            self.flight.record(
+                                qp.pod.uid,
+                                "unschedulable",
+                                {"plugins": ["Coscheduling"], "reasons": list(s.reasons)[:3]},
+                            )
+                        self._handle_failure(qp, s)
+                        outcomes.append(
+                            ScheduleOutcome(qp.pod, None, s, 0)
+                        )
+                    else:
+                        live.append(qp)
+                batch = live
+                if not batch:
+                    return outcomes
+
+            # 2. canonical order: gang members contiguous at first member
+            order, gang_positions = wlg.plan_batch(
+                [qp.pod for qp in batch], group_of=self._workloads_group_of
+            )
+            ordered = [batch[i] for i in order]
+            pods = [qp.pod for qp in ordered]
+            trace.step("Gang plan done")
+
+            # 3. pack (the scan path's prep, workloads tables added)
+            enabled = fwk.device_enabled()
+            weights = tuple(
+                fwk.score_weights.get(n, 0) for n in gang.WEIGHT_ORDER
+            )
+            t_pack = time.perf_counter()
+            self._repack_mirror()
+            self.phases.add("pack", time.perf_counter() - t_pack)
+            self._p_cap_max = max(self._p_cap_max, bucket_cap(len(pods), 1))
+            p_cap = self._p_cap_max
+            pb = pack_pod_batch(
+                pods,
+                vocab,
+                k_cap=self.mirror.nodes.k_cap,
+                p_cap=p_cap,
+                namespace_labels=self.namespace_labels,
+            )
+            t_sync = time.perf_counter()
+            dc = self._dc_cache.sync(self.mirror, vocab)
+            db = DeviceBatch.from_host(pb)
+            self.phases.add("h2d", time.perf_counter() - t_sync)
+            v_cap = bucket_cap(len(vocab.label_vals))
+            hostname_key = self._hostname_dev(vocab)
+            tables = self._gang_tables(pb, vocab)
+            wt = self._wave_tables(pb)
+            if wt is None:
+                # The host-ports and duplicate-hostname pre-checks mirror
+                # wave_tables' refusal conditions, so this is unreachable
+                # today — but PreFilter failures and quorum rejections
+                # were already emitted above, so if the copies ever drift
+                # the only safe move is to finish the REMAINING live pods
+                # on the ordinary machinery (gang semantics degrade for
+                # one batch; nothing double-processes).  Returning None
+                # here instead would hand the caller the ORIGINAL batch,
+                # re-processing pods whose failures already landed.
+                return outcomes + self._schedule_batch(
+                    ordered, try_workloads=False
+                )
+            has_interpod = bool(
+                (pb.aff_kind != PAD).any()
+                or (self.mirror.existing.term_kind != PAD).any()
+            )
+            has_spread = bool((pb.tsc_topo_key != PAD).any())
+            has_images = bool((pb.img_ids >= 0).any())
+
+            # 4. workloads tables: gang arrays + DRA pack + volume DNFs
+            gid, gfirst, glast, gneed, g_cap, slot_keys = wlg.gang_arrays(
+                p_cap, gang_positions, needs
+            )
+            dt = None
+            claim_keys: List[str] = []
+            dra_on = any(
+                p.name == "DynamicResources"
+                for p in fwk.host_filter_plugins()
+            )
+            claims_by_key = {}
+            if dra_on and any(p.resource_claims for p in pods):
+                # the WHOLE cache view, not just batch-referenced claims:
+                # free0 must exclude devices held by ANY allocated claim
+                # (the serial plugin's _allocated_devices walks the full
+                # cache too) — a batch-local view would hand out devices
+                # earlier drains already granted
+                claims_by_key = {
+                    c.key: c for c in self.claim_cache.list()
+                }
+                dt = dra_ops.dra_tables(
+                    pods,
+                    self.mirror.nodes.name_to_idx,
+                    self.mirror.nodes.n_cap,
+                    p_cap,
+                    list(self.resource_slices.values()),
+                    self.device_classes,
+                    claims_by_key,
+                )
+                if dt is not None:
+                    claim_keys = dt.pop("claim_keys")
+                    dt.pop("has_claims")
+            volt = self._vol_tables(pods, p_cap, vocab)
+            nom_node = nom_prio = nom_req = None
+            if len(self.nominator):
+                nom_node, nom_prio, nom_req = self._nominated_arrays(
+                    {qp.pod.uid for qp in ordered}
+                )
+            self.metrics["workload_batches"] += 1
+
+        # 5. one fused dispatch (outside the lock, like every device path)
+        t_gang = time.perf_counter()
+        chosen_dev, n_feas_dev, reason_counts, tallies, wl_dev = (
+            cos_ops.workloads_run(
+                dc,
+                db,
+                hostname_key,
+                v_cap,
+                g_cap,
+                wt["tid_sp"],
+                wt["rep_sp_p"],
+                wt["rep_sp_c"],
+                wt["tid_ip"],
+                wt["rep_ip_p"],
+                wt["rep_ip_u"],
+                wt["ip_cdv_tab"],
+                jnp.asarray(gid),
+                jnp.asarray(gfirst),
+                jnp.asarray(glast),
+                jnp.asarray(gneed),
+                **(dt or {}),
+                **(volt or {}),
+                has_interpod=has_interpod,
+                has_spread=has_spread,
+                has_images=has_images,
+                enabled=enabled,
+                weights=weights,
+                nom_node=nom_node,
+                nom_prio=nom_prio,
+                nom_req=nom_req,
+                d2_cap=wt["d2_cap"],
+                fit_strategy=fwk.fit_strategy(),
+                **tables,
+            )
+        )
+        t_d2h = time.perf_counter()
+        self.phases.add("device", t_d2h - t_gang)
+        fetched = self._d2h(
+            (
+                chosen_dev,
+                n_feas_dev,
+                wl_dev["raw"],
+                wl_dev["spec"],
+                wl_dev["gang_admit"],
+                wl_dev["gang_landed"],
+                wl_dev["claim_node"] if dt is not None else None,
+            )
+        )
+        chosen, n_feas, raw, spec, gang_admit, gang_landed, claim_node = (
+            fetched
+        )
+        self.phases.add("d2h", time.perf_counter() - t_d2h)
+        self.prom.recorder.observe(
+            self.prom.gang_dispatch_duration,
+            time.perf_counter() - t_gang,
+            path="workloads",
+        )
+        self._trace_dispatch("workloads", t_gang, ordered)
+        trace.step("Workloads dispatch done")
+
+        self._process_workloads_results(
+            fwk,
+            state,
+            ordered,
+            chosen,
+            n_feas,
+            raw,
+            spec,
+            reason_counts,
+            gang_admit,
+            gang_landed,
+            gang_positions,
+            slot_keys,
+            needs,
+            claim_keys,
+            claims_by_key,
+            claim_node,
+            outcomes,
+        )
+        trace.step("Commits done")
+        trace.log_if_long()
+        return outcomes
+
+    def _wl_host_replay(self, fwk, state, pod, node_name: str) -> Status:
+        """Re-run PreFilter (fresh claim/volume ledgers) + the chosen
+        node's host Filter walk for a DRA/volume pod, so Reserve/PreBind
+        read per-pod decisions consistent with the live cache — the kernel
+        proved feasibility; this materializes the concrete device/PV picks
+        in cycle state, claim contention resolving in the same batch order
+        the kernel replayed."""
+        with self._mu:
+            pf = fwk.run_pre_filter(state, [pod])
+            if pf:
+                s = pf.get(pod.uid)
+                if s is not None:
+                    return s
+            st = self.oracle_view()
+            ns = st.nodes.get(node_name)
+            if ns is None:
+                return Status.error(f"node {node_name} vanished", plugin="Workloads")
+            return fwk.run_host_filters(state, pod, ns)
+
+    def _process_workloads_results(
+        self,
+        fwk,
+        state,
+        ordered,
+        chosen,
+        n_feas,
+        raw,
+        spec,
+        reason_counts,
+        gang_admit,
+        gang_landed,
+        gang_positions,
+        slot_keys,
+        needs,
+        claim_keys,
+        claims_by_key,
+        claim_node,
+        outcomes,
+    ) -> None:
+        """The workloads result walk: gang admit/rollback accounting +
+        flight events, rolled-back members failed WITHOUT preemption (a
+        dry run for a pod its own gang rolled back just churns victims),
+        genuine failures through the normal diagnosis path (DRA/volume
+        lanes renamed to their plugin reasons), successes through the
+        host-replay commit."""
+        import numpy as np
+
+        t_commit = time.perf_counter()
+        node_names = self.mirror.nodes.names
+        n_nodes = len(self.cache.real_nodes())
+        counts = None
+        fr = self.flight
+        chosen_n = np.asarray(chosen)[: len(ordered)]
+        spec_n = np.asarray(spec)[: len(ordered)]
+        with self._mu:
+            self.metrics["schedule_attempts"] += len(ordered)
+            # speculation stats: pods whose admitted placement survived
+            # the serial admission pass unchanged (the wave's admitted-as-
+            # speculated notion, here over gang/DRA-carried state)
+            self.metrics["workload_spec_admitted"] += int(
+                np.sum((chosen_n == spec_n) & (chosen_n >= 0))
+            )
+            # claim allocations count ONCE per newly-allocated claim (a
+            # shared claim is one allocation however many pods reference
+            # it; pre-allocated claims don't count)
+            if claim_node is not None:
+                new_allocs = sum(
+                    1
+                    for i, ckey in enumerate(claim_keys)
+                    if int(claim_node[i]) >= 0
+                    and claims_by_key[ckey].allocation is None
+                )
+                if new_allocs:
+                    self.metrics["dra_claims_allocated"] += new_allocs
+                    self.prom.dra_allocations.inc(new_allocs)
+        pos_gang: Dict[int, str] = {}
+        for key, positions in gang_positions.items():
+            for pos in positions:
+                pos_gang[pos] = key
+        slot_of = {key: i for i, key in enumerate(slot_keys)}
+
+        # gang verdicts: metrics + flight + scheduling-window bookkeeping
+        for key, positions in gang_positions.items():
+            slot = slot_of[key]
+            admit = int(gang_admit[slot])
+            landed = int(gang_landed[slot])
+            with self._mu:
+                if admit == 1:
+                    self.gangs.close_window(key)
+                    self.metrics["gang_admitted"] += landed
+                    self.prom.gang_admitted.inc(landed)
+                elif admit == 0:
+                    self.metrics["gang_rolled_back"] += 1
+                    self.prom.gang_rollbacks.inc()
+            if fr.enabled:
+                kind = "gang_admit" if admit == 1 else "gang_rollback"
+                for pos in positions:
+                    fr.record(
+                        ordered[pos].pod.uid,
+                        kind,
+                        {
+                            "group": key,
+                            "landed": landed,
+                            "need": needs.get(key, 0),
+                        },
+                    )
+
+        for i, qp in enumerate(ordered):
+            pod = qp.pod
+            idx = int(chosen[i])
+            if idx < 0:
+                key = pos_gang.get(i)
+                if key is not None and int(raw[i]) >= 0:
+                    # placed by the admission pass, rolled back with its
+                    # gang — not a feasibility failure, no preemption
+                    slot = slot_of[key]
+                    pg = self.gangs.get(key)
+                    s = Status.unschedulable(
+                        f'pod group "{key}" admission rolled back: '
+                        f"{int(gang_landed[slot])}/"
+                        f"{pg.min_member if pg else 0} members schedulable",
+                        plugin="Coscheduling",
+                    )
+                    with self._mu:
+                        self._handle_failure(qp, s)
+                    outcomes.append(
+                        ScheduleOutcome(pod, None, s, int(n_feas[i]))
+                    )
+                    continue
+                if counts is None:
+                    counts = self._d2h(reason_counts)
+                diag = {
+                    k: int(c)
+                    for k, c in zip(gang.DIAG_KERNELS, counts[i])
+                    if c > 0
+                }
+                plugins = set(diag)
+                # workloads batches carry no host ports, so the dynamic
+                # hv lane counts exactly the DRA rejections; the extra
+                # mask lane is the volume-topology kernel mask
+                if "NodePorts" in diag and pod.resource_claims:
+                    n = diag.pop("NodePorts")
+                    plugins.discard("NodePorts")
+                    diag["cannot allocate all devices"] = n
+                    plugins.add("DynamicResources")
+                if "HostFilters" in diag:
+                    n = diag.pop("HostFilters")
+                    plugins.discard("HostFilters")
+                    diag["node(s) had volume node affinity conflict"] = n
+                    plugins.add("VolumeBinding")
+                status = Status.unschedulable(
+                    fit_error_message(n_nodes, diag)
+                )
+                outcomes.append(
+                    self._post_filter_or_fail(
+                        fwk, state, qp, status, int(n_feas[i]), diag, plugins
+                    )
+                )
+                continue
+            node_name = node_names[idx]
+            if pod.resource_claims or pod.pvc_names():
+                s = self._wl_host_replay(fwk, state, pod, node_name)
+                if not s.ok:
+                    # a race moved the ground truth between dispatch and
+                    # commit (informer event, concurrent binder) — fail
+                    # the pod; the requeue converges like any lost race
+                    outcomes.append(
+                        self._post_filter_or_fail(
+                            fwk, state, qp, s, int(n_feas[i])
+                        )
+                    )
+                    continue
+            outcome = self._commit(fwk, state, qp, node_name, int(n_feas[i]))
+            if outcome.node is not None:
+                with self._mu:
+                    self.gangs.note_placed(pod)
+                    if pod.resource_claims:
+                        self.metrics["dra_pods"] += 1
+                if fr.enabled and pod.resource_claims:
+                    fr.record(
+                        pod.uid,
+                        "dra_alloc",
+                        {
+                            "node": node_name,
+                            "claims": list(pod.resource_claims)[:4],
+                        },
+                    )
+            outcomes.append(outcome)
+        self.phases.add("commit", time.perf_counter() - t_commit)
 
     def _wave_resolve(self, fwk, batch, chosen, wstats_dev):
         """Harvest one wave's speculation stats: admitted/demoted counters,
@@ -4320,6 +5073,7 @@ class Scheduler:
             if ps is not None:
                 self._view_pod_removed(ps.pod)
             self.cache.forget_pod(pod)
+            self.gangs.note_removed(pod)  # quorum bookkeeping unwinds too
             self._handle_failure(qp, s)
         outcome.node = None
         outcome.status = s

@@ -276,7 +276,7 @@ class SchedulerServer:
                   /debug/cache                       dump + comparer (text)
                   /debug/trace?action=start|stop|export   default: status
                   /debug/flightrecorder?pod=<uid|name>    default: stats
-                  /debug/explain?pod=<uid|name>
+                  /debug/explain?pod=<uid|name>[&whatif_node=<node>]
                   /debug/slo?action=status|trace          default: status
                 """
                 q = parse_qs(parsed.query)
@@ -348,6 +348,7 @@ class SchedulerServer:
                         return
                     from kubernetes_tpu.observability import (
                         explain_pod,
+                        explain_whatif,
                         find_pod,
                     )
 
@@ -356,6 +357,12 @@ class SchedulerServer:
                         self._send_json(
                             {"error": f"pod {ref!r} not found"}, code=404
                         )
+                        return
+                    # ?whatif_node=X: preemption what-if — which victims
+                    # would free node X for this pod (dry run, read-only)
+                    whatif = q.get("whatif_node", [None])[0]
+                    if whatif is not None:
+                        self._send_json(explain_whatif(sched, pod, whatif))
                         return
                     try:
                         max_nodes = int(q.get("max_nodes", ["500"])[0])

@@ -80,6 +80,7 @@ class FakeCluster:
         self.resource_claims = _ObjectStore(self)
         self.resource_slices = _ObjectStore(self)
         self.device_classes = _ObjectStore(self)
+        self.pod_groups = _ObjectStore(self)  # coscheduling PodGroups
         self._pv_controller = pv_controller
         self.provisioned: List[str] = []  # PV names the fake provisioner made
         # coordination.k8s.io Lease objects (leader election, server.py)
@@ -360,6 +361,7 @@ class FakeCluster:
             (self.resource_claims, EventResource.RESOURCE_CLAIM),
             (self.resource_slices, EventResource.RESOURCE_SLICE),
             (self.device_classes, EventResource.DEVICE_CLASS),
+            (self.pod_groups, EventResource.POD_GROUP),
         ):
             store.watch(*scheduler.storage_handlers(res))
         scheduler.pvc_writer = self.update_pvc

@@ -338,6 +338,260 @@ def check_resident_vs_oracle(n_nodes=1000, n_pods=5000) -> dict:
     }
 
 
+def _gang_workload(n_nodes, n_gangs, seed=12):
+    """Plain pods + gangs of mixed feasibility on tight nodes — partial
+    gangs MUST roll back, so the check exercises the rollback algebra."""
+    from kubernetes_tpu.api.resource import Resource
+    from kubernetes_tpu.api.types import Container, Node, Pod
+    from kubernetes_tpu.workloads.gang import PodGroup
+
+    rng = random.Random(seed)
+    nodes = [
+        Node(
+            name=f"node-{i}",
+            labels={
+                "topology.kubernetes.io/zone": f"zone-{i % 4}",
+                "kubernetes.io/hostname": f"node-{i}",
+            },
+            capacity=Resource.from_map(
+                {"cpu": rng.choice(["2", "4"]), "memory": "8Gi", "pods": 110}
+            ),
+        )
+        for i in range(n_nodes)
+    ]
+    pods, groups = [], {}
+    for gi in range(n_gangs):
+        size = rng.randrange(2, 6)
+        name = f"gang-{gi}"
+        groups[f"default/{name}"] = PodGroup(
+            name=name, min_member=rng.randrange(2, size + 1)
+        )
+        for m in range(size):
+            pods.append(
+                Pod(
+                    name=f"{name}-{m}",
+                    pod_group=name,
+                    containers=[
+                        Container(
+                            name="c",
+                            requests={
+                                "cpu": rng.choice(["200m", "800m", "1800m"]),
+                                "memory": "256Mi",
+                            },
+                        )
+                    ],
+                )
+            )
+        if gi % 3 == 0:
+            pods.append(
+                Pod(
+                    name=f"plain-{gi}",
+                    containers=[
+                        Container(name="c", requests={"cpu": "150m"})
+                    ],
+                )
+            )
+    return nodes, pods, groups
+
+
+def check_gang_vs_oracle(n_nodes=60, n_gangs=120) -> dict:
+    """Workloads-tier gang admission (ops/coscheduling.py: all-or-nothing
+    checkpoint/rollback over the factored algebra) vs the serial gang
+    oracle replaying the same canonical order — zero diffs required."""
+    import copy
+
+    from kubernetes_tpu.oracle.state import OracleState
+    from kubernetes_tpu.oracle.workloads import WorkloadOracle
+
+    nodes, pods, groups = _gang_workload(n_nodes, n_gangs)
+    t0 = time.perf_counter()
+    got, sched = _drain_workloads(nodes, pods, groups)
+    wl_batches = sched.metrics["workload_batches"]
+
+    oracle = WorkloadOracle(
+        state=OracleState.build(nodes), groups=copy.deepcopy(groups)
+    )
+    res = oracle.schedule(copy.deepcopy(pods))
+    diffs = _diff(got, res.placements)
+    n_diffs = len(diffs)
+    if wl_batches == 0:
+        n_diffs += 1
+        diffs = [("__workload_batches__", 0, ">=1")] + diffs
+    if sched.metrics["gang_rolled_back"] == 0:
+        # the check certifies ROLLBACK; a workload where no gang ever
+        # rolls back would make the claim vacuous — fail loud
+        n_diffs += 1
+        diffs = [("__gang_rolled_back__", 0, ">=1")] + diffs
+    return {
+        "nodes": n_nodes,
+        "pods": len(pods),
+        "gangs": n_gangs,
+        "workload_batches": wl_batches,
+        "gangs_rolled_back": sched.metrics["gang_rolled_back"],
+        "bound_kernel": sum(1 for v in got.values() if v),
+        "bound_oracle": sum(1 for v in res.placements.values() if v),
+        "diffs": n_diffs,
+        "first_diffs": diffs[:5],
+        "wall_s": round(time.perf_counter() - t0, 1),
+    }
+
+
+def _dra_workload(n_nodes, n_pods, seed=9):
+    from kubernetes_tpu.api import dra
+    from kubernetes_tpu.api.types import Container, Pod
+
+    rng = random.Random(seed)
+    nodes = _basic_nodes(n_nodes)
+    slices = []
+    for i in range(n_nodes):
+        if i % 2:
+            continue
+        slices.append(
+            dra.ResourceSlice(
+                name=f"sl-{i}",
+                node_name=f"node-{i}",
+                driver="drv",
+                pool=f"pool-{i}",
+                devices=tuple(
+                    dra.Device(
+                        name=f"dev-{i}-{j}",
+                        attributes=(
+                            ("vendor", "x" if j % 2 else "y"),
+                            ("mem", rng.choice(["16", "32"])),
+                        ),
+                    )
+                    for j in range(rng.randrange(1, 5))
+                ),
+            )
+        )
+    classes = {
+        "gpu": dra.DeviceClass(
+            name="gpu",
+            selectors=(dra.DeviceSelector("vendor", "In", ("x",)),),
+        ),
+        "any": dra.DeviceClass(name="any"),
+    }
+    claims, pods = {}, []
+    for i in range(n_pods):
+        mode_all = rng.random() < 0.2
+        c = dra.ResourceClaim(
+            name=f"claim-{i}",
+            requests=(
+                dra.DeviceRequest(
+                    name="r",
+                    device_class_name=rng.choice(["gpu", "any"]),
+                    count=rng.randrange(1, 3),
+                    allocation_mode=(
+                        dra.ALLOCATION_MODE_ALL
+                        if mode_all
+                        else dra.ALLOCATION_MODE_EXACT
+                    ),
+                    selectors=(
+                        (dra.DeviceSelector("mem", "In", ("32",)),)
+                        if rng.random() < 0.3
+                        else ()
+                    ),
+                ),
+            ),
+        )
+        claims[c.key] = c
+        pods.append(
+            Pod(
+                name=f"dp-{i}",
+                containers=[Container(name="c", requests={"cpu": "100m"})],
+                resource_claims=(c.name,),
+            )
+        )
+    return nodes, slices, classes, claims, pods
+
+
+def check_dra_vs_oracle(n_nodes=200, n_pods=600) -> dict:
+    """Batched DRA allocation (ops/dra.py device-matching kernel inside
+    the workloads admission scan) vs the serial structured-allocator
+    oracle — placements AND claim→node pinnings, zero diffs required."""
+    import copy
+
+    from kubernetes_tpu.oracle.state import OracleState
+    from kubernetes_tpu.oracle.workloads import WorkloadOracle
+
+    nodes, slices, classes, claims, pods = _dra_workload(n_nodes, n_pods)
+    t0 = time.perf_counter()
+    got, sched = _drain_workloads(
+        nodes, pods, {}, slices=slices, classes=classes, claims=claims
+    )
+    wl_batches = sched.metrics["workload_batches"]
+
+    oracle = WorkloadOracle(
+        state=OracleState.build(nodes),
+        slices=copy.deepcopy(slices),
+        device_classes=copy.deepcopy(classes),
+        claims=copy.deepcopy(claims),
+    )
+    res = oracle.schedule(copy.deepcopy(pods))
+    diffs = _diff(got, res.placements)
+    # claim pinning identity through the live claim cache
+    for key, want_node in res.claim_nodes.items():
+        c = sched.claim_cache.get(key)
+        have = (
+            c.allocation.node_name
+            if c is not None and c.allocation is not None
+            else None
+        )
+        if have != want_node:
+            diffs.append((f"claim:{key}", have, want_node))
+    n_diffs = len(diffs)
+    if wl_batches == 0:
+        n_diffs += 1
+        diffs = [("__workload_batches__", 0, ">=1")] + diffs
+    return {
+        "nodes": n_nodes,
+        "pods": n_pods,
+        "workload_batches": wl_batches,
+        "bound_kernel": sum(1 for v in got.values() if v),
+        "bound_oracle": sum(1 for v in res.placements.values() if v),
+        "claims_allocated": len(res.claim_nodes),
+        "diffs": n_diffs,
+        "first_diffs": diffs[:5],
+        "wall_s": round(time.perf_counter() - t0, 1),
+    }
+
+
+def _drain_workloads(
+    nodes, pods, groups, slices=(), classes=None, claims=None, **cfg_kw
+):
+    """A FakeCluster drain wired for the workloads tier (PodGroups +
+    DRA objects), returning ({pod: node}, scheduler)."""
+    import copy
+
+    from kubernetes_tpu.framework.config import SchedulerConfiguration
+    from kubernetes_tpu.scheduler import Scheduler
+    from kubernetes_tpu.testing import FakeCluster
+
+    api = FakeCluster()
+    cfg = SchedulerConfiguration(batch_size=4096)
+    cfg.feature_gates["DynamicResourceAllocation"] = True
+    for k, v in cfg_kw.items():
+        setattr(cfg, k, v)
+    s = Scheduler(configuration=cfg)
+    api.connect(s)
+    for n in nodes:
+        api.create_node(n)
+    for pg in groups.values():
+        api.pod_groups.create(pg)
+    for cls in (classes or {}).values():
+        api.device_classes.create(cls)
+    for sl in slices:
+        api.resource_slices.create(sl)
+    for c in (claims or {}).values():
+        api.resource_claims.create(c)
+    for p in pods:
+        api.create_pod(copy.deepcopy(p))
+    got = {}
+    for o in s.schedule_pending():
+        got[o.pod.name] = o.node
+    return got, s
+
+
 def run_checks(ns_nodes=10000, ns_pods=50000) -> dict:
     checks = {
         "cross_batch_devfast_vs_hostgreedy": check_cross_batch(
@@ -346,6 +600,8 @@ def run_checks(ns_nodes=10000, ns_pods=50000) -> dict:
         "sampling_compat_vs_serial_oracle": check_compat_vs_oracle(),
         "wave_dispatch_vs_serial_oracle": check_wave_vs_oracle(),
         "resident_drain_vs_serial_oracle": check_resident_vs_oracle(),
+        "gang_admission_vs_serial_oracle": check_gang_vs_oracle(),
+        "dra_allocation_vs_serial_oracle": check_dra_vs_oracle(),
     }
     return {
         "checks": checks,

@@ -1,0 +1,675 @@
+"""The workloads tier: gang/coscheduling admission + batched DRA + volume
+topology masks (ops/coscheduling.py) must be decision-identical to the
+serial gang/DRA oracle (oracle/workloads.py) and — for DRA/volume pods —
+to the gangDispatch:false serial one-pod plugin path.
+
+Randomized property tests run the FULL scheduler under KTPU_SANITIZE=1:
+
+  * gang ≡ serial-oracle with partial-gang rollback (members placed then
+    rolled back when the quorum can't be covered — usage, topology
+    counts, and device grants all restored);
+  * DRA ≡ serial-oracle under in-batch claim contention, shared claims,
+    and AllocationMode=All;
+  * kill-switch identity (gangDispatch:false) for DRA and volume pods;
+  * minMember/timeout barrier semantics (the coscheduling plugin's
+    PreFilter/Permit-timeout verdicts).
+"""
+
+import copy
+import random
+import time
+
+import pytest
+
+from kubernetes_tpu.api import dra
+from kubernetes_tpu.api import storage as st
+from kubernetes_tpu.api.resource import Resource
+from kubernetes_tpu.api.types import Container, Node, Pod
+from kubernetes_tpu.framework.config import SchedulerConfiguration
+from kubernetes_tpu.oracle.state import OracleState
+from kubernetes_tpu.oracle.workloads import WorkloadOracle
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.testing import FakeCluster
+from kubernetes_tpu.workloads.gang import PodGroup, plan_batch
+
+
+@pytest.fixture()
+def sanitize_on(monkeypatch):
+    from kubernetes_tpu.analysis import sanitizer
+
+    monkeypatch.setenv("KTPU_SANITIZE", "1")
+    sanitizer.reset_enabled_memo()
+    yield
+    monkeypatch.delenv("KTPU_SANITIZE", raising=False)
+    sanitizer.reset_enabled_memo()
+
+
+def make_node(name, cpu="4", zone="zone-a"):
+    return Node(
+        name=name,
+        labels={
+            "kubernetes.io/hostname": name,
+            "topology.kubernetes.io/zone": zone,
+        },
+        capacity=Resource.from_map(
+            {"cpu": cpu, "memory": "16Gi", "pods": 110}
+        ),
+    )
+
+
+def mkpod(name, cl=(), group="", cpu="100m", labels=None):
+    return Pod(
+        name=name,
+        labels=dict(labels or {}),
+        containers=[Container(name="c", requests={"cpu": cpu})],
+        resource_claims=tuple(cl),
+        pod_group=group,
+    )
+
+
+def build_env(batch_size=128, **cfg_kw):
+    api = FakeCluster()
+    config = SchedulerConfiguration(
+        batch_size=batch_size,
+        pod_initial_backoff_seconds=0.01,
+        pod_max_backoff_seconds=0.02,
+        **cfg_kw,
+    )
+    config.feature_gates["DynamicResourceAllocation"] = True
+    sched = Scheduler(configuration=config)
+    api.connect(sched)
+    return api, sched
+
+
+def drain(api, sched):
+    outs = sched.schedule_pending()
+    return {o.pod.name: o.node for o in outs}, outs
+
+
+# ---------------------------------------------------------------------------
+# Randomized property: gang ≡ serial oracle (partial-gang rollback included)
+# ---------------------------------------------------------------------------
+
+
+def _random_gang_workload(rng, n_groups=3):
+    """Plain pods + gangs; tight capacity makes some gangs roll back."""
+    nodes = [
+        make_node(f"node-{i}", cpu=rng.choice(["1", "2"]), zone=f"zone-{i % 3}")
+        for i in range(rng.randrange(4, 9))
+    ]
+    pods, groups = [], {}
+    for i in range(rng.randrange(2, 6)):
+        pods.append(mkpod(f"plain-{i}", cpu=f"{rng.choice([100, 300])}m"))
+    for gi in range(n_groups):
+        size = rng.randrange(2, 5)
+        min_member = rng.randrange(2, size + 1)
+        name = f"gang-{gi}"
+        groups[f"default/{name}"] = PodGroup(name=name, min_member=min_member)
+        for m in range(size):
+            # heavy members force partial-gang infeasibility sometimes
+            cpu = rng.choice(["300m", "700m", "1500m"])
+            pods.append(mkpod(f"{name}-{m}", group=name, cpu=cpu))
+    rng.shuffle(pods)
+    return nodes, pods, groups
+
+
+@pytest.mark.parametrize("seed", [3, 17, 41])
+def test_gang_property_vs_oracle(sanitize_on, seed):
+    rng = random.Random(seed)
+    for _ in range(3):
+        nodes, pods, groups = _random_gang_workload(rng)
+
+        api, sched = build_env()
+        for n in nodes:
+            api.create_node(n)
+        for pg in groups.values():
+            api.pod_groups.create(pg)
+        for p in pods:
+            api.create_pod(copy.deepcopy(p))
+        got, _ = drain(api, sched)
+
+        oracle = WorkloadOracle(
+            state=OracleState.build(nodes), groups=copy.deepcopy(groups)
+        )
+        want = oracle.schedule(copy.deepcopy(pods)).placements
+
+        assert got == want, (seed, got, want)
+        assert sched.metrics["workload_batches"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# Randomized property: DRA ≡ serial oracle (contention, sharing, All mode)
+# ---------------------------------------------------------------------------
+
+
+def _random_dra_workload(rng):
+    nodes = [make_node(f"node-{i}", cpu="8") for i in range(rng.randrange(3, 7))]
+    slices = []
+    for i, n in enumerate(nodes):
+        if rng.random() < 0.7:
+            devs = tuple(
+                dra.Device(
+                    name=f"dev-{i}-{j}",
+                    attributes=(
+                        ("vendor", rng.choice(["x", "y"])),
+                        ("mem", rng.choice(["16", "32"])),
+                    ),
+                )
+                for j in range(rng.randrange(1, 4))
+            )
+            slices.append(
+                dra.ResourceSlice(
+                    name=f"sl-{i}",
+                    node_name=n.name,
+                    driver="drv",
+                    pool=f"pool-{i}",
+                    devices=devs,
+                )
+            )
+    classes = {
+        "gpu": dra.DeviceClass(
+            name="gpu",
+            selectors=(dra.DeviceSelector("vendor", "In", ("x",)),),
+        ),
+        "any": dra.DeviceClass(name="any"),
+    }
+    claims, pods = {}, []
+    n_claims = rng.randrange(3, 8)
+    for ci in range(n_claims):
+        mode_all = rng.random() < 0.25
+        sels = ()
+        if rng.random() < 0.4:
+            sels = (
+                dra.DeviceSelector("mem", rng.choice(["In", "NotIn"]), ("32",)),
+            )
+        if rng.random() < 0.15:
+            sels = sels + (dra.DeviceSelector("vendor", "Exists"),)
+        req = dra.DeviceRequest(
+            name="r0",
+            device_class_name=rng.choice(["gpu", "any"]),
+            count=rng.randrange(1, 3),
+            allocation_mode=(
+                dra.ALLOCATION_MODE_ALL if mode_all else dra.ALLOCATION_MODE_EXACT
+            ),
+            selectors=sels,
+        )
+        c = dra.ResourceClaim(name=f"claim-{ci}", requests=(req,))
+        claims[c.key] = c
+    claim_names = [c.split("/", 1)[1] for c in claims]
+    for pi in range(rng.randrange(4, 9)):
+        refs = rng.sample(claim_names, rng.randrange(0, 3))
+        pods.append(mkpod(f"pod-{pi}", cl=refs))
+    return nodes, slices, classes, claims, pods
+
+
+@pytest.mark.parametrize("seed", [5, 23, 67])
+def test_dra_property_vs_oracle(sanitize_on, seed):
+    rng = random.Random(seed)
+    for _ in range(3):
+        nodes, slices, classes, claims, pods = _random_dra_workload(rng)
+
+        api, sched = build_env()
+        for n in nodes:
+            api.create_node(n)
+        for cls in classes.values():
+            api.device_classes.create(cls)
+        for sl in slices:
+            api.resource_slices.create(sl)
+        for c in claims.values():
+            api.resource_claims.create(c)
+        for p in pods:
+            api.create_pod(copy.deepcopy(p))
+        got, _ = drain(api, sched)
+
+        oracle = WorkloadOracle(
+            state=OracleState.build(nodes),
+            slices=copy.deepcopy(slices),
+            device_classes=copy.deepcopy(classes),
+            claims=copy.deepcopy(claims),
+        )
+        res = oracle.schedule(copy.deepcopy(pods))
+        assert got == res.placements, (seed, got, res.placements)
+
+        # claim allocations must pin to the same nodes through the API
+        for key, want_node in res.claim_nodes.items():
+            stored = api.resource_claims.get(key)
+            assert stored.allocation is not None, key
+            assert stored.allocation.node_name == want_node, key
+        # claims the oracle left unallocated stay unallocated
+        for key in claims:
+            if key not in res.claim_nodes:
+                stored = api.resource_claims.get(key)
+                assert stored.allocation is None, key
+
+
+# ---------------------------------------------------------------------------
+# Directed scenarios
+# ---------------------------------------------------------------------------
+
+
+def _gpu_env(n_nodes=3, devices_per_node=2, gpu_nodes=None, **cfg_kw):
+    api, sched = build_env(**cfg_kw)
+    for i in range(n_nodes):
+        api.create_node(make_node(f"node-{i}"))
+    api.device_classes.create(
+        dra.DeviceClass(
+            name="gpu",
+            selectors=(dra.DeviceSelector("vendor", "In", ("x",)),),
+        )
+    )
+    for i in gpu_nodes if gpu_nodes is not None else range(n_nodes):
+        api.resource_slices.create(
+            dra.ResourceSlice(
+                name=f"sl-{i}",
+                node_name=f"node-{i}",
+                driver="drv",
+                pool=f"pool-{i}",
+                devices=tuple(
+                    dra.Device(name=f"g-{i}-{j}", attributes=(("vendor", "x"),))
+                    for j in range(devices_per_node)
+                ),
+            )
+        )
+    return api, sched
+
+
+def _claim(api, name, count=1, mode=dra.ALLOCATION_MODE_EXACT):
+    api.resource_claims.create(
+        dra.ResourceClaim(
+            name=name,
+            requests=(
+                dra.DeviceRequest(
+                    name="r",
+                    device_class_name="gpu",
+                    count=count,
+                    allocation_mode=mode,
+                ),
+            ),
+        )
+    )
+
+
+def test_gang_rollback_releases_devices(sanitize_on):
+    """A gang member's claim allocation must roll back with its gang —
+    the device stays free for later pods in the SAME batch."""
+    api, sched = _gpu_env(n_nodes=2, devices_per_node=1, gpu_nodes=[0])
+    api.pod_groups.create(PodGroup(name="g", min_member=2))
+    _claim(api, "c-member")
+    _claim(api, "c-late")
+    # member 0 wants the only gpu; member 1 cannot fit anywhere (huge cpu)
+    api.create_pod(mkpod("g-0", cl=("c-member",), group="g"))
+    api.create_pod(mkpod("g-1", group="g", cpu="100"))
+    # a later ordinary pod wants the same gpu — it must get it after the
+    # gang rolled back inside the batch
+    api.create_pod(mkpod("late", cl=("c-late",)))
+
+    got, outs = drain(api, sched)
+    assert got["g-0"] is None and got["g-1"] is None
+    assert got["late"] == "node-0"
+    assert api.resource_claims.get("default/c-member").allocation is None
+    stored = api.resource_claims.get("default/c-late").allocation
+    assert stored is not None and stored.node_name == "node-0"
+    assert sched.metrics["gang_rolled_back"] == 1
+
+
+def test_all_mode_claim_vs_contention(sanitize_on):
+    """AllocationMode=All needs EVERY matching device free — one taken
+    device on the node fails it there (in-batch contention included)."""
+    api, sched = _gpu_env(n_nodes=2, devices_per_node=2, gpu_nodes=[0, 1])
+    _claim(api, "c-one")
+    _claim(api, "c-all", mode=dra.ALLOCATION_MODE_ALL)
+    api.create_pod(mkpod("p-one", cl=("c-one",)))
+    api.create_pod(mkpod("p-all", cl=("c-all",)))
+    got, _ = drain(api, sched)
+    # p-one takes one device on node-0; All must land on the untouched node
+    assert got["p-one"] == "node-0"
+    assert got["p-all"] == "node-1"
+    alloc = api.resource_claims.get("default/c-all").allocation
+    assert len(alloc.results) == 2
+
+
+def test_shared_claim_pins_batch_peers(sanitize_on):
+    """Two pods sharing one claim in one batch: the second pins to the
+    first's node and consumes no new device."""
+    api, sched = _gpu_env(n_nodes=3, devices_per_node=1, gpu_nodes=[1])
+    _claim(api, "c-shared")
+    api.create_pod(mkpod("a", cl=("c-shared",)))
+    api.create_pod(mkpod("b", cl=("c-shared",)))
+    got, _ = drain(api, sched)
+    assert got["a"] == "node-1" and got["b"] == "node-1"
+    claim = api.resource_claims.get("default/c-shared")
+    assert len(claim.allocation.results) == 1
+    assert len(claim.reserved_for) == 2
+
+
+def test_kill_switch_identity_dra(sanitize_on):
+    """gangDispatch:false must produce IDENTICAL placements and claim
+    allocations through the serial one-pod plugin path."""
+
+    def run(gang_dispatch):
+        api, sched = _gpu_env(
+            n_nodes=3, devices_per_node=1, gang_dispatch=gang_dispatch
+        )
+        for i in range(4):
+            _claim(api, f"c-{i}")
+            api.create_pod(mkpod(f"p-{i}", cl=(f"c-{i}",)))
+        got, _ = drain(api, sched)
+        allocs = {
+            f"default/c-{i}": (
+                api.resource_claims.get(f"default/c-{i}").allocation.node_name
+                if api.resource_claims.get(f"default/c-{i}").allocation
+                else None
+            )
+            for i in range(4)
+        }
+        return got, allocs, sched
+
+    got_on, allocs_on, s_on = run(True)
+    got_off, allocs_off, s_off = run(False)
+    assert got_on == got_off
+    assert allocs_on == allocs_off
+    assert s_on.metrics["workload_batches"] >= 1
+    assert s_off.metrics["workload_batches"] == 0
+
+
+def _vol_env(**cfg_kw):
+    api, sched = build_env(**cfg_kw)
+    for i in range(4):
+        api.create_node(
+            make_node(f"node-{i}", zone="zone-b" if i >= 2 else "zone-a")
+        )
+    return api, sched
+
+
+def _bound_pvc(api, name, zone):
+    from kubernetes_tpu.api.types import (
+        NodeSelector,
+        NodeSelectorRequirement,
+        NodeSelectorTerm,
+    )
+
+    affinity = None
+    if zone is not None:
+        affinity = NodeSelector(
+            (
+                NodeSelectorTerm(
+                    match_expressions=(
+                        NodeSelectorRequirement(
+                            "topology.kubernetes.io/zone", "In", (zone,)
+                        ),
+                    )
+                ),
+            )
+        )
+    pv = st.PersistentVolume(
+        name=f"pv-{name}",
+        capacity=10,
+        access_modes=("ReadWriteOnce",),
+        storage_class_name="std",
+        node_affinity=affinity,
+        phase=st.PV_BOUND,
+        claim_ref=st.ObjectRef("default", name),
+    )
+    pvc = st.PersistentVolumeClaim(
+        name=name,
+        namespace="default",
+        request=10,
+        access_modes=("ReadWriteOnce",),
+        storage_class_name="std",
+        volume_name=pv.name,
+        phase=st.PVC_BOUND,
+    )
+    api.pvs.create(pv)
+    api.pvcs.create(pvc)
+    return pvc
+
+
+def _vol_pod(name, pvc_name):
+    from kubernetes_tpu.api.types import Volume
+
+    return Pod(
+        name=name,
+        containers=[Container(name="c", requests={"cpu": "100m"})],
+        volumes=(Volume(name="v", pvc_name=pvc_name),),
+    )
+
+
+def test_volume_topology_kernel_mask(sanitize_on):
+    """Bound-PV node affinity rides the kernel mask: the pod lands in the
+    PV's zone through the workloads dispatch."""
+    api, sched = _vol_env()
+    _bound_pvc(api, "data-b", "zone-b")
+    _bound_pvc(api, "data-none", "zone-c")  # no node carries zone-c
+    api.create_pod(_vol_pod("pinned", "data-b"))
+    api.create_pod(_vol_pod("impossible", "data-none"))
+    got, outs = drain(api, sched)
+    assert got["pinned"] in ("node-2", "node-3")
+    assert got["impossible"] is None
+    assert sched.metrics["workload_batches"] >= 1
+    bad = next(o for o in outs if o.pod.name == "impossible")
+    assert "volume node affinity" in " ".join(bad.status.reasons)
+
+
+def test_kill_switch_identity_volumes(sanitize_on):
+    def run(gang_dispatch):
+        api, sched = _vol_env(gang_dispatch=gang_dispatch)
+        _bound_pvc(api, "d0", "zone-b")
+        _bound_pvc(api, "d1", None)  # nil affinity: anywhere
+        _bound_pvc(api, "d2", "zone-c")  # infeasible
+        api.create_pod(_vol_pod("v0", "d0"))
+        api.create_pod(_vol_pod("v1", "d1"))
+        api.create_pod(_vol_pod("v2", "d2"))
+        got, _ = drain(api, sched)
+        return got
+
+    assert run(True) == run(False)
+
+
+def test_gang_switch_off_schedules_individually():
+    """gangDispatch:false = no quorum semantics: the feasible member
+    places even though its sibling cannot."""
+    api, sched = build_env(gang_dispatch=False)
+    api.create_node(make_node("node-0", cpu="1"))
+    api.pod_groups.create(PodGroup(name="g", min_member=2))
+    api.create_pod(mkpod("m-0", group="g", cpu="500m"))
+    api.create_pod(mkpod("m-1", group="g", cpu="100"))
+    got, _ = drain(api, sched)
+    assert got["m-0"] == "node-0"
+    assert got["m-1"] is None
+    assert sched.metrics["workload_batches"] == 0
+
+
+def test_gang_incomplete_waits_then_admits():
+    """minMember barrier: members present < minMember reject with a
+    waiting status; once the quorum exists the gang admits."""
+    api, sched = build_env()
+    for i in range(3):
+        api.create_node(make_node(f"node-{i}"))
+    pg = PodGroup(name="trio", min_member=3)
+    api.pod_groups.create(pg)
+    api.create_pod(mkpod("t-0", group="trio"))
+    api.create_pod(mkpod("t-1", group="trio"))
+    got, outs = drain(api, sched)
+    assert got == {"t-0": None, "t-1": None}
+    assert any(
+        "waiting for the rest" in " ".join(o.status.reasons) for o in outs
+    )
+    api.create_pod(mkpod("t-2", group="trio"))
+    api.pod_groups.update(pg)  # group event requeues the waiters
+    time.sleep(0.05)  # clear the (tiny) backoff window
+    got2, _ = drain(api, sched)
+    assert all(got2.get(f"t-{i}") for i in range(3)), got2
+
+
+def test_gang_timeout_rejects_unresolvable():
+    """After scheduleTimeoutSeconds of failed attempts the gang's members
+    reject UNSCHEDULABLE_AND_UNRESOLVABLE and the window resets."""
+    from kubernetes_tpu.framework.interface import Code
+
+    api, sched = build_env()
+    api.create_node(make_node("node-0", cpu="1"))
+    pg = PodGroup(name="stuck", min_member=2, schedule_timeout_s=0.02)
+    api.pod_groups.create(pg)
+    api.create_pod(mkpod("s-0", group="stuck", cpu="800m"))
+    api.create_pod(mkpod("s-1", group="stuck", cpu="800m"))
+    drain(api, sched)  # opens the scheduling window
+    time.sleep(0.06)
+    api.pod_groups.update(pg)  # group event requeues the members
+    time.sleep(0.05)  # clear the (tiny) backoff window
+    _, outs = drain(api, sched)
+    timed = [
+        o
+        for o in outs
+        if o.status.code == Code.UNSCHEDULABLE_AND_UNRESOLVABLE
+    ]
+    assert timed and "timed out" in " ".join(timed[0].status.reasons)
+
+
+def test_gang_metrics_and_flight_events(sanitize_on):
+    api, sched = build_env()
+    sched.flight.enabled = True
+    for i in range(2):
+        api.create_node(make_node(f"node-{i}"))
+    api.pod_groups.create(PodGroup(name="duo", min_member=2))
+    api.create_pod(mkpod("d-0", group="duo"))
+    api.create_pod(mkpod("d-1", group="duo"))
+    got, outs = drain(api, sched)
+    assert all(got.values())
+    text = sched.expose_metrics()
+    assert "scheduler_tpu_gang_admitted_total 2" in text
+    assert sched.metrics["gang_rolled_back"] == 0
+    # flight ring carries gang_admit breadcrumbs for both members
+    for o in outs:
+        kinds = [e["kind"] for e in sched.flight.events_for(o.pod.uid)]
+        assert "gang_admit" in kinds, (o.pod.name, kinds)
+
+
+def test_dra_flight_event_and_counter(sanitize_on):
+    api, sched = _gpu_env(n_nodes=2, devices_per_node=1, gpu_nodes=[0])
+    sched.flight.enabled = True
+    _claim(api, "c-f")
+    api.create_pod(mkpod("p-f", cl=("c-f",)))
+    got, outs = drain(api, sched)
+    assert got["p-f"] == "node-0"
+    kinds = [e["kind"] for e in sched.flight.events_for(outs[0].pod.uid)]
+    assert "dra_alloc" in kinds
+    assert "scheduler_tpu_dra_allocations_total 1" in sched.expose_metrics()
+
+
+def test_plan_batch_contiguity_and_order():
+    """The planner's canonical order: gang members splice at the first
+    member's position, relative order preserved everywhere."""
+    pods = [
+        mkpod("a"),
+        mkpod("g1-0", group="g1"),
+        mkpod("b"),
+        mkpod("g2-0", group="g2"),
+        mkpod("g1-1", group="g1"),
+        mkpod("c"),
+        mkpod("g2-1", group="g2"),
+    ]
+    order, positions = plan_batch(pods)
+    names = [pods[i].name for i in order]
+    assert names == ["a", "g1-0", "g1-1", "b", "g2-0", "g2-1", "c"]
+    assert positions["default/g1"] == [1, 2]
+    assert positions["default/g2"] == [4, 5]
+
+
+# ---------------------------------------------------------------------------
+# Preemption what-if explain: "which victims would free node X for pod P"
+# ---------------------------------------------------------------------------
+
+
+def test_explain_whatif_preemption_victims():
+    from kubernetes_tpu.observability import explain_whatif
+
+    api, sched = build_env()
+    api.create_node(make_node("node-0", cpu="1"))
+    api.create_node(make_node("node-1", cpu="1"))
+    # node-0 full of low-priority pods; node-1 full of HIGH-priority ones
+    for i in range(2):
+        low = mkpod(f"low-{i}", cpu="500m")
+        low.priority = 0
+        low.node_name = "node-0"
+        api.create_pod(low)
+        high = mkpod(f"high-{i}", cpu="500m")
+        high.priority = 1000
+        high.node_name = "node-1"
+        api.create_pod(high)
+    # the what-if is a PURE dry run: ask BEFORE any scheduling attempt
+    # (a real attempt's PostFilter would nominate and evict for real)
+    wanter = mkpod("wanter", cpu="600m")
+    wanter.priority = 500
+    api.create_pod(wanter)
+    from kubernetes_tpu.observability import find_pod
+
+    pod = find_pod(sched, "wanter")
+    assert pod is not None
+
+    out0 = explain_whatif(sched, pod, "node-0")
+    assert out0["eligible"] is True
+    assert out0["feasible_after_preemption"] is True
+    names = {v["name"] for v in out0["victims"]}
+    assert names and names <= {"low-0", "low-1"}
+    assert out0["num_pdb_violations"] == 0
+
+    out1 = explain_whatif(sched, pod, "node-1")
+    assert out1["feasible_after_preemption"] is False
+    assert out1["lower_priority_pods"] == 0
+
+    out2 = explain_whatif(sched, pod, "node-nope")
+    assert "unknown node" in out2["error"]
+
+
+# ---------------------------------------------------------------------------
+# Review regressions: cross-batch device exclusivity + mixed-batch peeling
+# ---------------------------------------------------------------------------
+
+
+def test_devices_taken_by_unreferenced_claims_stay_taken(sanitize_on):
+    """The kernel's free-device plane must exclude devices held by claims
+    NOT referenced in the current batch (earlier drains' allocations) —
+    the serial plugin's _allocated_devices walks the whole cache."""
+    api, sched = _gpu_env(n_nodes=2, devices_per_node=1, gpu_nodes=[0, 1])
+    # drain 1: c0 takes node-0's only device; a heavy plain pod loads
+    # node-1 so a free-device-blind kernel would PREFER node-0 later
+    _claim(api, "c0")
+    p0 = mkpod("p0", cl=("c0",))
+    p0.node_selector = {"kubernetes.io/hostname": "node-0"}
+    api.create_pod(p0)
+    api.create_pod(mkpod("heavy", cpu="2000m"))
+    got1, _ = drain(api, sched)
+    assert got1["p0"] == "node-0"
+
+    # drain 2: c1 does NOT reference c0; node-0's device is taken, so the
+    # only correct landing spot is node-1 (score-wise less attractive)
+    _claim(api, "c1")
+    api.create_pod(mkpod("p1", cl=("c1",)))
+    got2, _ = drain(api, sched)
+    assert got2["p1"] == "node-1", got2
+    alloc = api.resource_claims.get("default/c1").allocation
+    assert alloc is not None and alloc.node_name == "node-1"
+
+
+def test_gang_semantics_survive_mixed_batch(sanitize_on):
+    """One disqualifying pod (host ports) in the batch must not drop the
+    gang quorum semantics — members peel into their own workloads
+    dispatch and still admit all-or-nothing."""
+    from kubernetes_tpu.api.types import ContainerPort
+
+    api, sched = build_env()
+    api.create_node(make_node("node-0", cpu="1"))
+    api.pod_groups.create(PodGroup(name="duo", min_member=2))
+    port_pod = mkpod("porty")
+    port_pod.containers[0].ports = [
+        ContainerPort(container_port=80, host_port=8080)
+    ]
+    api.create_pod(port_pod)
+    # one member fits, the other can't: the gang must roll back (with the
+    # bug the members scheduled individually and m-0 landed)
+    api.create_pod(mkpod("m-0", group="duo", cpu="500m"))
+    api.create_pod(mkpod("m-1", group="duo", cpu="100"))
+    got, _ = drain(api, sched)
+    assert got["porty"] == "node-0"
+    assert got["m-0"] is None and got["m-1"] is None, got
+    assert sched.metrics["gang_rolled_back"] == 1

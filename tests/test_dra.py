@@ -207,3 +207,114 @@ def test_unreserve_rolls_back_assumed_claim():
     assert cached.reserved_for == ()
     # and the API object was never written
     assert api.resource_claims.get("default/claim-r").allocation is None
+
+
+# ---------------------------------------------------------------------------
+# Workloads-tier satellites (PR 10): the batched DRA kernel path
+# (ops/dra.py + ops/coscheduling.py behind gangDispatch) — contention
+# resolved IN ONE BATCH instead of one-pod cycles; deeper coverage incl.
+# randomized oracle properties lives in tests/test_coscheduling.py.
+# ---------------------------------------------------------------------------
+
+
+def test_in_batch_contention_via_workloads_kernel():
+    """Two claims, one device, ONE batch: the kernel resolves the
+    contention in queue order (the old path needed one-pod cycles)."""
+    api, sched = build_env()
+    api.create_node(make_node("node-1"))
+    api.device_classes.create(GPU_CLASS)
+    api.resource_slices.create(gpu_slice("sl-1", "node-1", 1))
+    for i in range(2):
+        api.resource_claims.create(
+            dra.ResourceClaim(
+                name=f"wl-claim-{i}",
+                requests=(
+                    dra.DeviceRequest(name="gpu", device_class_name="gpu"),
+                ),
+            )
+        )
+        api.create_pod(make_pod(f"wl-pod-{i}", claims=(f"wl-claim-{i}",)))
+    outcomes = sched.schedule_pending()
+    by_name = {o.pod.name: o for o in outcomes}
+    assert by_name["wl-pod-0"].node == "node-1"
+    assert by_name["wl-pod-1"].node is None
+    assert sched.metrics["workload_batches"] >= 1
+    assert sched.metrics["dra_pods"] == 1
+
+
+def test_all_mode_requires_every_match_free():
+    """AllocationMode=All fails a node where ANY matching device is held
+    by an earlier allocation (structured/allocator.go:530-552)."""
+    api, sched = build_env()
+    api.create_node(make_node("node-1"))
+    api.device_classes.create(GPU_CLASS)
+    api.resource_slices.create(gpu_slice("sl-1", "node-1", 2))
+    api.resource_claims.create(
+        dra.ResourceClaim(
+            name="one",
+            requests=(dra.DeviceRequest(name="g", device_class_name="gpu"),),
+        )
+    )
+    api.resource_claims.create(
+        dra.ResourceClaim(
+            name="all",
+            requests=(
+                dra.DeviceRequest(
+                    name="g",
+                    device_class_name="gpu",
+                    allocation_mode=dra.ALLOCATION_MODE_ALL,
+                ),
+            ),
+        )
+    )
+    api.create_pod(make_pod("p-one", claims=("one",)))
+    api.create_pod(make_pod("p-all", claims=("all",)))
+    outcomes = sched.schedule_pending()
+    by_name = {o.pod.name: o for o in outcomes}
+    assert by_name["p-one"].node == "node-1"
+    assert by_name["p-all"].node is None  # gpu-0 taken → All fails
+
+
+def test_kernel_path_matches_serial_path_decisions():
+    """gangDispatch on/off must agree on a mixed claim workload — the
+    batched kernel is a pure optimization (kill-switch identity)."""
+
+    def run(gang_dispatch):
+        api = FakeCluster()
+        config = SchedulerConfiguration(batch_size=8)
+        config.feature_gates["DynamicResourceAllocation"] = True
+        config.gang_dispatch = gang_dispatch
+        sched = Scheduler(configuration=config)
+        api.connect(sched)
+        for i in range(3):
+            api.create_node(make_node(f"node-{i}"))
+        api.device_classes.create(GPU_CLASS)
+        api.resource_slices.create(gpu_slice("sl-0", "node-0", 2))
+        api.resource_slices.create(gpu_slice("sl-2", "node-2", 1))
+        for i in range(4):
+            api.resource_claims.create(
+                dra.ResourceClaim(
+                    name=f"c{i}",
+                    requests=(
+                        dra.DeviceRequest(
+                            name="g",
+                            device_class_name="gpu",
+                            count=1 + i % 2,
+                        ),
+                    ),
+                )
+            )
+            api.create_pod(make_pod(f"p{i}", claims=(f"c{i}",)))
+        outs = sched.schedule_pending()
+        placements = {o.pod.name: o.node for o in outs}
+        allocs = {}
+        for i in range(4):
+            c = api.resource_claims.get(f"default/c{i}")
+            allocs[c.name] = (
+                (c.allocation.node_name, tuple(r.device for r in c.allocation.results))
+                if c.allocation
+                else None
+            )
+        return placements, allocs
+
+    assert run(True) == run(False)
