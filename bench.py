@@ -56,7 +56,7 @@ def _drain(sched):
     return ok, dt
 
 
-def _run_workload(nodes, pods, warm=None, trace=False):
+def _run_workload(nodes, pods, warm=None, trace=False, config=None):
     """Warm the jit caches at FINAL bucket shapes (two full batches cover
     both the direct and chained dispatch paths, with the capacity hint
     pre-sized to the whole workload), then time the rest — the steady-state
@@ -67,6 +67,10 @@ def _run_workload(nodes, pods, warm=None, trace=False):
     (fast_batch_max) so the sig_scan kernel compiles here; scan-path
     workloads pass warm=batch_size+64 (their batches never extend)."""
     sched, _ = _mk_sched()
+    # config overrides (e.g. the compat drain's sampling knobs) — applied
+    # before any scheduling so every drain below sees them
+    for k, v in (config or {}).items():
+        setattr(sched.config, k, v)
     # capacity planning: pre-size the placed-pod axes so the device
     # pipeline compiles once (the e_cap_hint mechanism schedule_pending
     # uses; here the full workload size is known up front).  Must DOMINATE
@@ -278,6 +282,61 @@ def bench_spread(n_nodes, n_pods):
         )
     # scan-path workload (spread constraints): batches never extend
     return _run_workload(_basic_nodes(n_nodes, zones=8), pods, warm=576)
+
+
+def bench_ports(n_nodes=1000, n_pods=10000):
+    """Config 13: port-contended drain — most pods race two (port, proto)
+    pairs (some wildcard-IP, some IP-scoped) alongside spread terms.
+    Before the factored [Tpt, N] port-occupancy carry these batches fell
+    back to the gang scan's [C,N,J] peer contractions; now they ride the
+    wave, so this line records the de-fallback win as an artifact."""
+    from kubernetes_tpu.tools.paritycheck import _port_heavy_pods
+
+    pods = _port_heavy_pods(n_pods)
+    # scan-shaped batches (cross-pod constraints): never extend
+    return _run_workload(_basic_nodes(n_nodes, zones=8), pods, warm=576)
+
+
+def bench_compat(n_nodes=1000, n_pods=10000):
+    """Config 13's compat twin: a reference_sampling_compat + seeded-tie
+    drain over a spread workload — the adaptive window + nodeTree rotation
+    now replay inside the wave's factored admission pass instead of the
+    gang scan."""
+    from kubernetes_tpu.api.types import (
+        Container,
+        LabelSelector,
+        Pod,
+        TopologySpreadConstraint,
+    )
+
+    pods = []
+    for i in range(n_pods):
+        app = f"a{i % 20}"
+        pods.append(
+            Pod(
+                name=f"pod-{i}",
+                labels={"app": app},
+                topology_spread_constraints=(
+                    TopologySpreadConstraint(
+                        max_skew=5,
+                        topology_key="topology.kubernetes.io/zone",
+                        when_unsatisfiable="DoNotSchedule",
+                        label_selector=LabelSelector(match_labels={"app": app}),
+                    ),
+                ),
+                containers=[
+                    Container(
+                        name="c", requests={"cpu": "100m", "memory": "64Mi"}
+                    )
+                ],
+            )
+        )
+    return _run_workload(
+        _basic_nodes(n_nodes, zones=8),
+        pods,
+        warm=576,
+        config=dict(reference_sampling_compat=True, tie_break_seed=1234),
+    )
 
 
 def bench_gang(n_nodes=1000, n_pods=20000, gang_size=8):
@@ -1093,6 +1152,42 @@ def main():
             f"# config11 dra: {ok11} pods in {dt11:.2f}s "
             f"(workload_batches={s11.metrics['workload_batches']} "
             f"dra_pods={s11.metrics['dra_pods']})",
+            file=sys.stderr,
+        )
+        # config13: the de-fallback pair (ISSUE 11) — port-contended and
+        # sampling-compat drains now ride the wave's factored engine; both
+        # keys are floor-less on this CPU-only box (BENCH_FLOORS
+        # discipline) and assert the retired fallback rungs stayed unused
+        # (a fallback here silently re-measures the gang scan).
+        n13 = int(os.environ.get("BENCH_PORTS_PODS", "10000"))
+        ok13, dt13, s13 = bench_ports(1000, n13)
+        # a regression can fall off the wave two ways: a counted fallback
+        # (any reason — a future rung could reuse one) or a routing change
+        # that stops wave-shaping these batches at all, which only
+        # wave_batches==0 detects.  Either zeroes the artifact so the
+        # floors gate catches a silently re-measured gang scan.
+        pf13 = s13.prom.wave_fallback.value(reason="ports") + (
+            1.0 if s13.metrics["wave_batches"] == 0 else 0.0
+        )
+        configs["config13_ports_1000n_pods_per_s"] = (
+            0.0 if pf13 else round(ok13 / dt13, 1)
+        )
+        print(
+            f"# config13 ports: {ok13} pods in {dt13:.2f}s ({_mix(s13)} "
+            f"admit={_admit_rate(s13):.2%} fallback_ports={pf13:g})",
+            file=sys.stderr,
+        )
+        n13c = int(os.environ.get("BENCH_COMPAT_PODS", "10000"))
+        ok13c, dt13c, s13c = bench_compat(1000, n13c)
+        cf13 = s13c.prom.wave_fallback.value(reason="sampling_compat") + (
+            1.0 if s13c.metrics["wave_batches"] == 0 else 0.0
+        )
+        configs["config13_compat_1000n_pods_per_s"] = (
+            0.0 if cf13 else round(ok13c / dt13c, 1)
+        )
+        print(
+            f"# config13 compat: {ok13c} pods in {dt13c:.2f}s ({_mix(s13c)} "
+            f"fallback_sampling_compat={cf13:g})",
             file=sys.stderr,
         )
 

@@ -90,6 +90,10 @@ DEFAULT_SIZES = {
     "G2": 39,
     "PV2": 40,
     "VT": 41,
+    # wave port-term carry (ops/wave.py) and preemption batch-peer rows
+    # (ops/preemption.py)
+    "Tpt": 42,
+    "B2": 43,
     "B": 64,
 }
 assert len(set(DEFAULT_SIZES.values())) == len(DEFAULT_SIZES)

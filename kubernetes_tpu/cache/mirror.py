@@ -186,6 +186,34 @@ class SnapshotMirror:
         self._m_cap_max = max(self._m_cap_max, bucket_cap(max(est, 1), 1))
         return self._m_cap_max
 
+    @property
+    def hostnames_unique(self) -> bool:
+        """True when no two nodes share a hostname label value — the
+        precondition of the wave/workloads factored algebra's
+        hostname-topology ≡ node-identity trick.  Computed once per
+        SNAPSHOT (memoized on the static lineage: full packs, static
+        generation, node population) instead of re-derived per batch;
+        node usage churn never invalidates it because hostname labels are
+        static row content."""
+        nt = self.nodes
+        if nt is None:
+            return True
+        key = (self._full_packs, self.static_generation, len(nt.name_to_idx))
+        memo = getattr(self, "_hostnames_unique_memo", None)
+        if memo is not None and memo[0] == key:
+            return memo[1]
+        from kubernetes_tpu.oracle.scores import HOSTNAME_LABEL
+
+        hk = self.vocab.label_keys.lookup(HOSTNAME_LABEL)
+        unique = True
+        lv = nt.label_vals
+        if 0 <= hk < lv.shape[1]:
+            col = lv[:, hk]
+            vals = col[col >= 0]
+            unique = len(vals) == len(np.unique(vals))
+        self._hostnames_unique_memo = (key, unique)
+        return unique
+
     def apply_fast_usage(self, fc, cache: Cache) -> bool:
         """Vectorized usage refresh from a live FastCommitter: one numpy
         assignment per tensor instead of update()'s per-dirty-node Python

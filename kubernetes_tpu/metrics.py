@@ -604,8 +604,22 @@ class SchedulerMetrics:
                 "scheduler_tpu_wave_conflicts_total",
                 "Pods demoted by the wave's conflict-resolution pass, by "
                 "conflicting constraint kind "
-                "(spread / affinity / fit / score).",
+                "(spread / affinity / ports / fit / score).",
                 ("kind",),
+            )
+        )
+        self.wave_fallback = r.register(
+            Counter(
+                "scheduler_tpu_wave_fallback_total",
+                "Wave-shaped work (pods/batches carrying cross-pod "
+                "constraint terms or in-batch host ports) that fell off "
+                "the factored wave engine, by reason (dup_hostname / "
+                "kill_switch / nominated / extender / host_filters / "
+                "host_scores / ...).  reason=ports and "
+                "reason=sampling_compat are RETIRED rungs — the factored "
+                "engine carries both — and must stay zero; a bump is a "
+                "fallback-ladder regression.",
+                ("reason",),
             )
         )
         self.gang_admitted = r.register(

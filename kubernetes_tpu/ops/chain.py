@@ -78,6 +78,7 @@ def caps_compatible(dc_shapes, pb) -> bool:
 # ktpu: axes(sp_keys=i32[Kd], sp_cdv_tab=i32[Kd,N], ip_keys=i32[Kd2])
 # ktpu: axes(tid_sp=i32[P,C], rep_sp_p=i32[Tsp], rep_sp_c=i32[Tsp])
 # ktpu: axes(tid_ip=i32[P,A], rep_ip_p=i32[Tip], rep_ip_u=i32[Tip], ip_cdv_tab=i32[Kd2,N])
+# ktpu: axes(tid_pt=i32[P,UP], port_conf=bool[Tpt,Tpt])
 # ktpu: accum(i64, i32, bool)
 # ktpu: static(v_cap=16)
 # ktpu: noinstantiate — donates and splices the cluster at host-checked
@@ -100,6 +101,7 @@ def caps_compatible(dc_shapes, pb) -> bool:
         "append_terms",
         "fit_strategy",
         "wave",
+        "wave_ports",
     ),
 )
 def chain_dispatch(
@@ -134,6 +136,9 @@ def chain_dispatch(
     rep_ip_u=None,
     ip_cdv_tab=None,
     d2_cap: int = 8,
+    wave_ports: bool = False,
+    tid_pt=None,
+    port_conf=None,
 ):
     """One fused dispatch: gang schedule the batch, then append its
     committed pods into the (donated) cluster at the given cursors.
@@ -146,6 +151,15 @@ def chain_dispatch(
     parallel speculation pass + the term-factored admission pass) instead
     of the gang scan — same decisions, a fraction of the per-step cost —
     and appends a fourth output: the [3, P] wave stats block.
+    ``wave_ports`` compiles in the wave's [Tpt, N] port-occupancy carry
+    for batches with in-batch host ports (tid_pt/port_conf from
+    wave_tables).  NOT YET REACHABLE from the scheduler: the chained
+    router refuses port batches outright because the device append below
+    does not splice committed pods' port rows into used_ppk, so a LATER
+    chained batch would miss their conflicts (scheduler._chain_quickcheck)
+    — port batches take the direct wave instead.  The plumbing keeps the
+    wave call signature uniform and is the landing slot for a future
+    port-row splice.
 
     Returns (next_dc, stacked [2, P] (chosen, n_feas), reason_counts
     [, wave_stats])."""
@@ -157,7 +171,9 @@ def chain_dispatch(
         hard_pod_affinity_weight,
         has_interpod=has_interpod,
         has_spread=has_spread,
-        has_ports=has_ports,
+        # the wave never reads the scan's pod×pod port matrix — in-batch
+        # ports ride its factored [Tpt, N] occupancy carry instead
+        has_ports=has_ports and not wave,
         has_images=has_images,
         enabled=enabled,
         sp_keys=sp_keys,
@@ -190,6 +206,9 @@ def chain_dispatch(
                 d_cap=d_cap,
                 d2_cap=d2_cap,
                 fit_strategy=fit_strategy,
+                has_ports=wave_ports,
+                tid_pt=tid_pt,
+                port_conf=port_conf,
             )
         )
     else:

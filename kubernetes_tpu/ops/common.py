@@ -522,3 +522,52 @@ def gather_at(cols_t, key):
     known = (key >= 0) & (key < K)
     safe = jnp.clip(key, 0, K - 1)
     return jnp.where(known[..., None], cols_t[safe], ABSENT)
+
+
+# ---------------------------------------------------------------------------
+# The shared usage carry update — ONE serial-recurrence commit
+# ---------------------------------------------------------------------------
+
+
+def usage_carry_update(rows, deltas, nodes, live):
+    """THE per-commit node-usage update shared by every serial-recurrence
+    replayer: the gang scan / wave admission / workloads admission (via
+    gang.pod_step), the sig_scan serial tail (fastpath.make_sig_step), and
+    the resident fixed point's round commit (ops/resident.py).
+
+    rows:   dict name → [N, ...] carried usage tensor
+    deltas: dict name → per-commit row delta (broadcastable against the
+            trailing dims of rows[name]; scalar for counters)
+    nodes:  committed node index — a scalar i32 choice, or an [W] window of
+            per-slot choices (the resident loop commits a whole agreement
+            prefix at once)
+    live:   bool commit gate, same leading shape as ``nodes``
+
+    Scalar commits are scatter-free rank-1 one-hot updates — scan bodies
+    must never scatter (the TPU op-latency discipline of ops/gang.py).
+    Windowed commits scatter-add: within a resident round each walk
+    position commits at most once, so the adds are disjoint and the result
+    equals replaying the scalar form per slot.
+    """
+    if nodes.ndim == 0:
+        N = next(iter(rows.values())).shape[0]
+        onehot = (jnp.arange(N, dtype=I32) == nodes) & live
+        out = {}
+        # ktpu: allow(jit-boundary) — rows' KEYS are static python
+        # structure fixed per call site; only the values are traced
+        for k, row in rows.items():
+            d = jnp.asarray(deltas[k], row.dtype)
+            oh = onehot.reshape((N,) + (1,) * (row.ndim - 1)).astype(row.dtype)
+            out[k] = row + oh * d
+        return out
+    out = {}
+    # ktpu: allow(jit-boundary) — rows' KEYS are static python structure
+    # fixed per call site; only the values are traced
+    for k, row in rows.items():
+        d = jnp.asarray(deltas[k], row.dtype)
+        gate = live.reshape(live.shape + (1,) * (row.ndim - 1))
+        d = jnp.broadcast_to(d, nodes.shape + row.shape[1:]) * gate.astype(
+            row.dtype
+        )
+        out[k] = row.at[nodes].add(d)
+    return out

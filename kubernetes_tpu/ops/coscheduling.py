@@ -195,18 +195,9 @@ def workloads_schedule(
     true_n = jnp.ones((N,), bool)
 
     # batch-peer match tensors from the statics (the wave's gathers)
-    if C:
-        m_sp_all = wave._rep_rows(g.sp_bmatch, rep_sp_p, rep_sp_c)  # [Tsp,P]
-    else:
-        m_sp_all = jnp.zeros((Tsp, P), bool)
-    if AT:
-        m_ip_all = wave._rep_rows(g.ip_bmatch, rep_ip_p, rep_ip_u)  # [Tip,P]
-        t_anti = wave._rep_rows(g.ip_is_anti, rep_ip_p, rep_ip_u)  # [Tip]
-        t_w = wave._rep_rows(g.ip_sym_w, rep_ip_p, rep_ip_u)  # [Tip] i64
-    else:
-        m_ip_all = jnp.zeros((Tip, P), bool)
-        t_anti = jnp.zeros((Tip,), bool)
-        t_w = jnp.zeros((Tip,), I64)
+    m_sp_all, m_ip_all, t_anti, t_w = wave.term_match_rows(
+        g, rep_sp_p, rep_sp_c, rep_ip_p, rep_ip_u
+    )
 
     # the batched device-matching pass: selectors are static per batch, so
     # the full [P, DQ, N, DD] match tensor is built ONCE outside the scan
@@ -296,9 +287,7 @@ def workloads_schedule(
     # ---- pass 2: gang/DRA admission over the factored deltas ---------------
     init = dict(
         base,
-        cnt_sp=jnp.zeros((Tsp, N), I32),
-        cnt_ip=jnp.zeros((Tip, N), I32),
-        rev_cnt=jnp.zeros((Tip, N), I32),
+        **wave.factored_carry_init(Tsp, Tip, N),
         gang_landed=jnp.asarray(0, I32),
         gang_admit=jnp.full((g_cap,), -1, I32),
         gang_landed_out=jnp.zeros((g_cap,), I32),
@@ -357,11 +346,9 @@ def workloads_schedule(
             dc, db, g, p, state, hv, jnp.asarray(True), **step_kw
         )
 
-        new_state["cnt_sp"], new_state["cnt_ip"], new_state["rev_cnt"] = (
+        new_state.update(
             wave.factored_carry_update(
-                state["cnt_sp"],
-                state["cnt_ip"],
-                state["rev_cnt"],
+                {k: state[k] for k in ("cnt_sp", "cnt_ip", "rev_cnt")},
                 p,
                 choice,
                 m_sp_all,

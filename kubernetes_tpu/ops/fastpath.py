@@ -25,6 +25,7 @@ import jax.numpy as jnp
 
 from kubernetes_tpu.ops import filters as F
 from kubernetes_tpu.ops import scores as S
+from kubernetes_tpu.ops.common import usage_carry_update
 from kubernetes_tpu.snapshot.schema import LANE_CPU, LANE_MEM, N_FIXED_LANES
 
 MAX = 100  # MaxNodeScore
@@ -137,7 +138,6 @@ def make_sig_step(
     fit_w = h0.astype(I64) + h1.astype(I64)
     den_bal = jnp.maximum(a0 * a1, 1)
     ext_lane = jnp.arange(R) >= N_FIXED_LANES  # bool [R]
-    iota_n = jnp.arange(N, dtype=I32)
 
     def step(carry, s):
         used, nz0, nz1, num_pods = carry
@@ -192,13 +192,13 @@ def make_sig_step(
         choice = jnp.argmax(ranked).astype(I32)
         any_feas = ranked[choice] >= 0
         choice = jnp.where(active & any_feas, choice, -1)
-        onehot = iota_n == choice  # all-false when choice == -1
-        carry = (
-            used + onehot[:, None].astype(I64) * req[None, :],
-            nz0 + onehot.astype(I64) * snz0,
-            nz1 + onehot.astype(I64) * snz1,
-            num_pods + onehot.astype(I32),
+        rows = usage_carry_update(
+            {"used": used, "nz0": nz0, "nz1": nz1, "num_pods": num_pods},
+            {"used": req, "nz0": snz0, "nz1": snz1, "num_pods": 1},
+            choice,
+            choice >= 0,
         )
+        carry = (rows["used"], rows["nz0"], rows["nz1"], rows["num_pods"])
         return carry, choice
 
     return step

@@ -46,6 +46,7 @@ from kubernetes_tpu.ops.common import (
     gather_at,
     ns_member,
     per_node_counts,
+    usage_carry_update,
 )
 from kubernetes_tpu.snapshot.interner import ABSENT, PAD
 from kubernetes_tpu.snapshot.schema import (
@@ -911,14 +912,18 @@ def pod_step(
 
     # ---------------- commit ----------------
     committed = choice >= 0
-    onehot_n = (jnp.arange(N, dtype=I32) == choice) & committed
     new_state = dict(
         state,
-        requested=state["requested"]
-        + onehot_n[:, None].astype(I32) * db.requests[p][None, :Rn],
-        nonzero=state["nonzero"]
-        + onehot_n[:, None].astype(I32) * db.nonzero_req[p][None, :],
-        num_pods=state["num_pods"] + onehot_n.astype(I32),
+        **usage_carry_update(
+            {k: state[k] for k in ("requested", "nonzero", "num_pods")},
+            {
+                "requested": db.requests[p][:Rn],
+                "nonzero": db.nonzero_req[p],
+                "num_pods": 1,
+            },
+            choice,
+            committed,
+        ),
         # inactive (pad) slots must not clobber row p's assignment.
         # p is the scan/vmap index over the batch axis — in range by
         # construction; mode="drop" (the default, spelled out) documents

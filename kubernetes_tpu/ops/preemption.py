@@ -20,6 +20,21 @@ Victim removal totals are factored by DISTINCT preemptor priority (usually
 a handful of PriorityClasses): per group, a segment-sum over placed pods
 yields the per-node requests that remain — O(G·E) scatter work instead of
 a P×E×N contraction.
+
+The batch's OWN committed placements (the admission scan's carried state,
+handed over as the dispatch's ``chosen`` output) join the victim plane as
+``batch_*`` rows instead of being re-derived from the cache — at narrowing
+time they are not yet assumed, so the placed-pod walk cannot see them.
+Charging is deliberately asymmetric to stay a SUPERSET of the host
+reprieve walk each failed pod later runs (queue order is priority-ordered,
+so peers of strictly higher priority committed BEFORE every failed pod and
+the walk sees them assumed; equal-priority peers may commit after the
+failed pod's walk and must not be charged; strictly-lower peers commit
+after it and can only be future victims):
+
+  * strictly higher priority  → charged as kept usage (exact);
+  * equal priority            → ignored (loose, sound);
+  * strictly lower priority   → counts as a removable victim (loose).
 """
 
 from __future__ import annotations
@@ -34,12 +49,13 @@ from kubernetes_tpu.ops.common import DeviceBatch, DeviceCluster, I32
 # pods INTO per-node rows — a scatter across a sharded N axis
 _KTPU_N_COLLECTIVES = {
     "narrow_candidates.per_group": "per-priority-group segment-sum of "
-    "victim requests/counts into [N] rows",
+    "victim AND committed-batch-peer requests/counts into [N] rows",
 }
 
 
 # ktpu: axes(dc=DeviceCluster, db=DeviceBatch, victim_node=i32[E], victim_prio=i32[E])
 # ktpu: axes(victim_req=i32[E,Rn], prio_groups=i32[G], pod_group=i32[P])
+# ktpu: axes(batch_node=i32[B2], batch_prio=i32[B2], batch_req=i32[B2,Rn])
 @jax.jit
 def narrow_candidates(
     dc: DeviceCluster,
@@ -49,6 +65,9 @@ def narrow_candidates(
     victim_req,   # i32 [E,R] placed-pod request rows
     prio_groups,  # i32 [G]   distinct preemptor priorities (pad: INT32_MIN)
     pod_group,    # i32 [P]   index into prio_groups per batch pod
+    batch_node=None,  # i32 [B2]   this batch's committed placements
+    batch_prio=None,  # i32 [B2]   (<0 node pads; see module docstring)
+    batch_req=None,   # i32 [B2,R]
 ):
     """bool [P, N]: nodes worth dry-running per failed pod."""
     N = dc.node_valid.shape[0]
@@ -65,6 +84,9 @@ def narrow_candidates(
 
     valid = victim_node >= 0
     seg = jnp.where(valid, victim_node, N)  # dump row N
+    if batch_node is not None:
+        bvalid = batch_node >= 0
+        bseg = jnp.where(bvalid, batch_node, N)
 
     def per_group(threshold):
         lower = (victim_prio < threshold) & valid  # victims that go
@@ -77,6 +99,25 @@ def narrow_candidates(
             jax.ops.segment_sum(lower.astype(I32), seg, num_segments=N + 1)[:N]
             > 0
         )
+        if batch_node is not None:
+            # committed batch peers: the asymmetric charging of the module
+            # docstring — strictly-higher kept, equal ignored, lower victim
+            bkeep = (bvalid & (batch_prio > threshold)).astype(I32)
+            blower = bvalid & (batch_prio < threshold)
+            kept_req = kept_req + jax.vmap(
+                lambda col: jax.ops.segment_sum(
+                    col * bkeep, bseg, num_segments=N + 1
+                )
+            )(batch_req.T).T[:N]
+            kept_cnt = kept_cnt + jax.ops.segment_sum(
+                bkeep, bseg, num_segments=N + 1
+            )[:N]
+            victim_here = victim_here | (
+                jax.ops.segment_sum(
+                    blower.astype(I32), bseg, num_segments=N + 1
+                )[:N]
+                > 0
+            )
         return kept_req, kept_cnt, victim_here
 
     kept_req_g, kept_cnt_g, victim_g = jax.vmap(per_group)(prio_groups)

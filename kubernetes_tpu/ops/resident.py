@@ -54,6 +54,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from kubernetes_tpu.ops.common import usage_carry_update
 from kubernetes_tpu.ops.fastpath import make_sig_step
 from kubernetes_tpu.snapshot.schema import LANE_CPU, LANE_MEM, N_FIXED_LANES
 
@@ -345,11 +346,23 @@ def resident_run(
         A = jnp.where(any_dis, first, W)  # admitted prefix length (>= 1)
         adm = iota_w < A
         commit = adm & ok_sched
-        ai = commit.astype(I64)
-        used = used.at[cnode].add(sig_req[sig_w] * ai[:, None])
-        nz0 = nz0.at[cnode].add(sig_nz[sig_w, 0] * ai)
-        nz1 = nz1.at[cnode].add(sig_nz[sig_w, 1] * ai)
-        num_pods = num_pods.at[cnode].add(commit.astype(I32))
+        # windowed form of THE shared usage commit (ops/common.py): each
+        # walk position commits at most once per round, so the scatter-add
+        # equals replaying the scalar rank-1 form per admitted slot
+        rows = usage_carry_update(
+            {"used": used, "nz0": nz0, "nz1": nz1, "num_pods": num_pods},
+            {
+                "used": sig_req[sig_w],
+                "nz0": sig_nz[sig_w, 0],
+                "nz1": sig_nz[sig_w, 1],
+                "num_pods": 1,
+            },
+            cnode,
+            commit,
+        )
+        used, nz0, nz1, num_pods = (
+            rows["used"], rows["nz0"], rows["nz1"], rows["num_pods"]
+        )
         cvals = jnp.where(commit, cnode, -1)  # admitted dead pods: -1
         # choices is padded by W so this window write NEVER reaches the
         # array end — XLA CLAMPS out-of-range dynamic_update_slice starts,

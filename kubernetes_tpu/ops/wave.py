@@ -41,13 +41,21 @@ its speculative placements; fully shared footprints degenerate to the
 serial recurrence — exactly the gang scan's semantics at a fraction of its
 per-step cost.
 
-**Fallback ladder.**  Batches the factored algebra cannot express keep the
-older machinery: in-batch host-port users and sampling-compat / seeded-tie
-drains take the gang scan; host-filter-relevant, extender, and nominated
-pods take the one-pod paths; resource-only batches never get here (the
-signature fast path owns them).  Duplicate hostname label values (two
-nodes claiming one hostname) also disqualify the wave — the factored
-hostname-topology counts assume node identity ≡ hostname domain.
+**Fallback ladder.**  The factored algebra expresses the whole hot path:
+in-batch host-port users ride a dedicated ``[Tpt, N]`` port-occupancy
+carry (distinct (proto, port, hostIP-class) tuples dedup into ``Tpt ≪ P``
+port terms whose pairwise conflicts are a static host-built matrix), and
+sampling-compat / seeded-tie drains replay ``numFeasibleNodesToFind``'s
+adaptive window and nodeTree rotation per step (the sampling cut lives in
+gang.pod_step and is carry-state, not peer-state, so the factored pass
+reproduces it bit-exactly).  What remains off the wave: host-filter-
+relevant, extender, and nominated pods take the one-pod paths;
+resource-only batches never get here (the signature fast path owns them);
+duplicate hostname label values (two nodes claiming one hostname)
+disqualify the wave — the factored hostname-topology counts assume node
+identity ≡ hostname domain (the uniqueness bit is computed once per
+snapshot by the mirror, not per batch).  Every fallback bumps
+``scheduler_tpu_wave_fallback_total{reason=}``.
 
 The verdict itself — filters, scores, normalization, tie-break — is the
 SAME code as the scan path (gang.pod_step + gang.spread_constraints +
@@ -79,11 +87,13 @@ DEMOTE_FIT = 4
 # (a batch peer's commit satisfied a required affinity) — the wave upgraded
 # the pod; reported separately, never as a conflict
 DEMOTE_UPGRADE = 5
+DEMOTE_PORTS = 6
 DEMOTE_KINDS = {
     DEMOTE_SPREAD: "spread",
     DEMOTE_AFFINITY: "affinity",
     DEMOTE_SCORE: "score",
     DEMOTE_FIT: "fit",
+    DEMOTE_PORTS: "ports",
 }
 
 # shard-rule roster: the admission scan's per-step work contracts the
@@ -93,7 +103,10 @@ DEMOTE_KINDS = {
 # the roster is the inventory of exactly where those collectives go.
 _KTPU_N_COLLECTIVES = {
     "wave_schedule.step": "term-factored domain compare+reduce over N + "
-    "speculative-node row gathers (demotion attribution)",
+    "port-occupancy [Tpt, N] conflict reduce + speculative-node row "
+    "gathers (demotion attribution)",
+    "factored_port_mask": "port-term occupancy conflict reduce over the "
+    "carried [Tpt, N] rows",
 }
 
 
@@ -130,7 +143,7 @@ def _slot_content(n_slots, parts):
     return np.concatenate(cols, axis=1)
 
 
-def wave_tables(pb, node_label_vals, hostname_id: int):
+def wave_tables(pb, node_label_vals, hostname_id: int, hostnames_unique=None):
     """Dedup the batch's constraint terms into distinct-term tables — the
     host half of the interaction partitioner.
 
@@ -138,11 +151,17 @@ def wave_tables(pb, node_label_vals, hostname_id: int):
     selector) coincide — then their batch-peer counts are the same counter;
     inter-pod terms additionally key on (kind, weight, namespace scope), so
     a term's symmetric weight and violation polarity are term constants.
+    In-batch host ports dedup the same way: distinct (proto-port key,
+    hostIP, wildcard) tuples become ``Tpt`` port terms with a static
+    pairwise conflict matrix, so the admission pass carries per-term
+    occupancy instead of the gang scan's pod×pod conflict matrix.
 
-    Returns None when the batch is not wave-eligible (in-batch host ports,
-    or duplicate hostname label values among nodes — the factored
-    hostname-domain counts assume hostname ≡ node identity).  Otherwise a
-    dict of device-ready arrays + static caps:
+    Returns None only when the batch is not wave-eligible: duplicate
+    hostname label values among nodes (the factored hostname-domain counts
+    assume hostname ≡ node identity).  ``hostnames_unique`` is the
+    once-per-snapshot bit from SnapshotMirror.hostnames_unique; None
+    re-derives it here (standalone/test callers).  Otherwise a dict of
+    device-ready arrays + static caps:
 
       tid_sp  i32 [P, C]   distinct spread-term id per slot (-1 empty)
       rep_sp_p/rep_sp_c  i32 [Tsp]  a representative slot per term
@@ -151,19 +170,21 @@ def wave_tables(pb, node_label_vals, hostname_id: int):
       ip_cdv_tab i32 [Kd2, N]  compact domain ids per inter-pod topology
                  key (row of -1 for the hostname key: identity domains)
       d2_cap  int  static bucket over inter-pod distinct-domain counts
-      n_terms int  total distinct terms (spread + inter-pod)
+      tid_pt  i32 [P, W]   distinct port-term id per want slot (-1 empty)
+      port_conf bool [Tpt, Tpt]  static term-pair conflict matrix
+      has_ports bool       batch carries in-batch host ports
+      n_terms int  total distinct terms (spread + inter-pod + port)
     """
     import numpy as np
 
-    if (np.asarray(pb.want_ppk) != PAD).any():
-        return None  # in-batch port conflicts are peer-node-resolved
     lv = np.asarray(node_label_vals)
     n_cap, K = lv.shape
-    if 0 <= hostname_id < K:
+    if hostnames_unique is None and 0 <= hostname_id < K:
         col = lv[:, hostname_id]
         vals = col[col >= 0]
-        if len(vals) != len(np.unique(vals)):
-            return None  # duplicate hostname labels: identity trick invalid
+        hostnames_unique = len(vals) == len(np.unique(vals))
+    if hostnames_unique is False:
+        return None  # duplicate hostname labels: identity trick invalid
 
     P, C = np.asarray(pb.tsc_topo_key).shape
     AT = np.asarray(pb.aff_kind).shape[1]
@@ -230,6 +251,35 @@ def wave_tables(pb, node_label_vals, hostname_id: int):
     rep_ip_u[: len(rep_flat)] = rep_flat % AT if AT else 0
     n_ip = len(rep_flat)
 
+    # distinct port terms: (proto-port key, hostIP, wildcard) — the same
+    # content identity node_ports.go compares; the pairwise conflict rule
+    # (same proto-port ∧ (same IP ∨ either wildcard)) is evaluated ONCE
+    # over the Tpt ≪ P·W distinct tuples instead of per pod pair
+    want_ppk = np.asarray(pb.want_ppk)
+    W = want_ppk.shape[1]
+    n_pt = 0
+    if W and (want_ppk != PAD).any():
+        pt_content = _slot_content(
+            P * W, [want_ppk, pb.want_ip, pb.want_wild]
+        )
+        pt_live = (want_ppk != PAD).reshape(-1) & np.repeat(valid, W)
+        tid_flat, rep_flat = _dedup_slots(pt_content, pt_live)
+        tid_pt = tid_flat.reshape(P, W).astype(np.int32)
+        n_pt = len(rep_flat)
+        t_pt = bucket_cap(max(n_pt, 1), 1)
+        r_ppk = want_ppk.reshape(-1)[rep_flat]
+        r_ip = np.asarray(pb.want_ip).reshape(-1)[rep_flat]
+        r_wild = np.asarray(pb.want_wild).reshape(-1)[rep_flat]
+        port_conf = np.zeros((t_pt, t_pt), bool)
+        port_conf[:n_pt, :n_pt] = (r_ppk[:, None] == r_ppk[None, :]) & (
+            (r_ip[:, None] == r_ip[None, :])
+            | r_wild[:, None]
+            | r_wild[None, :]
+        )
+    else:
+        tid_pt = np.full((P, W), -1, np.int32)
+        port_conf = np.zeros((1, 1), bool)
+
     # Compact per-key domain ids for the inter-pod keys, batch_tables-style
     # (same distinct-key ordering as gang.batch_tables so g.ip_key_idx rows
     # index both tables).  The hostname key keeps a -1 row: its domains are
@@ -258,7 +308,10 @@ def wave_tables(pb, node_label_vals, hostname_id: int):
         rep_ip_u=jnp.asarray(rep_ip_u),
         ip_cdv_tab=jnp.asarray(ip_cdv_tab),
         d2_cap=bucket_cap(d2_max, 8),
-        n_terms=n_sp + n_ip,
+        tid_pt=jnp.asarray(tid_pt),
+        port_conf=jnp.asarray(port_conf),
+        has_ports=n_pt > 0,
+        n_terms=n_sp + n_ip + n_pt,
     )
 
 
@@ -372,8 +425,77 @@ def _rep_rows(mat, rp, rc):
 # every serial-recurrence replayer shares ONE definition: wave_schedule's
 # conflict-resolution pass below and the workloads tier's gang/DRA
 # admission scan (ops/coscheduling.py) produce pod p's batch-peer count
-# tensors from the SAME [T, N] carries — the paths cannot drift.
+# tensors from the SAME [T, N] carries and commit them through the SAME
+# factored_carry_update entry point (whose usage-row twin is
+# common.usage_carry_update, called from gang.pod_step) — the paths
+# cannot drift.
 # ---------------------------------------------------------------------------
+
+
+def term_match_rows(g, rep_sp_p, rep_sp_c, rep_ip_p, rep_ip_u):
+    """Per-dispatch gathers from the statics: which batch pods each
+    distinct term matches (the forward AND reverse match matrix —
+    ip_bmatch[p,u,j] reads "pod j matches p's term u", so one gather
+    serves both sides).  Shared by the wave and workloads admission
+    scans.  Returns (m_sp_all [Tsp,P], m_ip_all [Tip,P], t_anti [Tip],
+    t_w [Tip] i64)."""
+    P = g.static_mask.shape[0]
+    C = g.sp_dv.shape[1]
+    AT = g.ip_dv.shape[1]
+    Tsp = rep_sp_p.shape[0]
+    Tip = rep_ip_p.shape[0]
+    if C:
+        m_sp_all = _rep_rows(g.sp_bmatch, rep_sp_p, rep_sp_c)
+    else:
+        m_sp_all = jnp.zeros((Tsp, P), bool)
+    if AT:
+        m_ip_all = _rep_rows(g.ip_bmatch, rep_ip_p, rep_ip_u)
+        t_anti = _rep_rows(g.ip_is_anti, rep_ip_p, rep_ip_u)
+        t_w = _rep_rows(g.ip_sym_w, rep_ip_p, rep_ip_u)
+    else:
+        m_ip_all = jnp.zeros((Tip, P), bool)
+        t_anti = jnp.zeros((Tip,), bool)
+        t_w = jnp.zeros((Tip,), I64)
+    return m_sp_all, m_ip_all, t_anti, t_w
+
+
+def factored_carry_init(Tsp, Tip, N, Tpt=0):
+    """Zero factored carries for one admission scan.  Keys present in the
+    returned dict are exactly the keys factored_carry_update advances —
+    callers thread them through their scan state wholesale."""
+    out = dict(
+        cnt_sp=jnp.zeros((Tsp, N), I32),
+        cnt_ip=jnp.zeros((Tip, N), I32),
+        rev_cnt=jnp.zeros((Tip, N), I32),
+    )
+    if Tpt:
+        out["occ_pt"] = jnp.zeros((Tpt, N), I32)
+    return out
+
+
+FACTORED_CARRY_KEYS = ("cnt_sp", "cnt_ip", "rev_cnt", "occ_pt")
+
+
+def factored_port_mask(tid_pt, port_conf, occ_pt, p):
+    """NodePorts verdict for pod p from the factored port-occupancy carry.
+
+    tid_pt [P, W] maps p's want slots onto distinct port-term ids;
+    port_conf [Tpt, Tpt] is the static term-pair conflict matrix;
+    occ_pt [Tpt, N] carries committed-peer port occupancy.  Returns
+    (m_portb [N], pt_cnt [Tpt] — p's own per-term slot counts, the aux
+    factored_carry_update commits)."""
+    Tpt = occ_pt.shape[0]
+    tidw = tid_pt[p]  # [W]
+    ohw = (
+        (tidw[:, None] == jnp.arange(Tpt, dtype=I32)[None, :])
+        & (tidw >= 0)[:, None]
+    )  # [W, Tpt]
+    mine = jnp.any(ohw, axis=0)  # [Tpt] terms p requests
+    conf_p = jnp.any(mine[:, None] & port_conf, axis=0)  # [Tpt]
+    blocked = jnp.any(conf_p[:, None] & (occ_pt > 0), axis=0)  # [N]
+    # dtype pinned: an i32 sum promotes to i64 under x64, which would
+    # drift the occ_pt carry's dtype across scan steps
+    return ~blocked, jnp.sum(ohw.astype(I32), axis=0).astype(I32)
 
 
 def factored_spread_dyn(g, p, tid_sp, cnt_sp, d_cap: int):
@@ -465,19 +587,32 @@ def factored_interpod_dyn(
 
 
 def factored_carry_update(
-    cnt_sp, cnt_ip, rev_cnt, p, choice, m_sp_all, m_ip_all, ip_aux
+    carries, p, choice, m_sp_all, m_ip_all, ip_aux, pt_cnt=None
 ):
-    """Commit pod p's placement into the factored carries: dense rank-1
-    outer products, no scatters.  ``ip_aux`` is factored_interpod_dyn's aux
-    tuple (None when the batch carries no inter-pod terms)."""
+    """Commit pod p's placement into the factored carries — THE shared
+    carry-update entry point of every factored admission scan (the wave's
+    conflict-resolution pass and the workloads gang/DRA scan): dense
+    rank-1 outer products, no scatters.  ``carries`` holds the keys
+    factored_carry_init produced; ``ip_aux`` is factored_interpod_dyn's
+    aux tuple (None when the batch carries no inter-pod terms) and
+    ``pt_cnt`` factored_port_mask's per-term slot counts (None when the
+    batch carries no in-batch host ports)."""
+    cnt_sp = carries["cnt_sp"]
+    cnt_ip = carries["cnt_ip"]
+    rev_cnt = carries["rev_cnt"]
     N = cnt_sp.shape[1]
     n_ids = jnp.arange(N, dtype=I32)
     committed = choice >= 0
     onehot_n = ((n_ids == choice) & committed).astype(I32)
-    new_cnt_sp = cnt_sp + m_sp_all[:, p, None].astype(I32) * onehot_n[None, :]
-    new_cnt_ip = cnt_ip + m_ip_all[:, p, None].astype(I32) * onehot_n[None, :]
+    out = dict(
+        cnt_sp=cnt_sp + m_sp_all[:, p, None].astype(I32) * onehot_n[None, :],
+        cnt_ip=cnt_ip + m_ip_all[:, p, None].astype(I32) * onehot_n[None, :],
+        rev_cnt=rev_cnt,
+    )
+    if pt_cnt is not None:
+        out["occ_pt"] = carries["occ_pt"] + pt_cnt[:, None] * onehot_n[None, :]
     if ip_aux is None:
-        return new_cnt_sp, new_cnt_ip, rev_cnt
+        return out
     ohu, cdv2, dvip, is_host_u, ki = ip_aux
     # p's own terms spread over their topology domains (the
     # reverse/symmetric direction future steps read back)
@@ -495,16 +630,18 @@ def factored_carry_update(
         & (val2_at >= 0)[:, None],
     )
     dom_row = dom_row & committed & (ki >= 0)[:, None]
-    new_rev_cnt = rev_cnt + jnp.einsum(
+    out["rev_cnt"] = rev_cnt + jnp.einsum(
         "ut,un->tn", ohu, dom_row.astype(I32)
     )
-    return new_cnt_sp, new_cnt_ip, new_rev_cnt
+    return out
 
 
 # ktpu: axes(dc=DeviceCluster, db=DeviceBatch, g=GangStatics, hostname_key=i32)
 # ktpu: axes(tid_sp=i32[P,C], rep_sp_p=i32[Tsp], rep_sp_c=i32[Tsp])
 # ktpu: axes(tid_ip=i32[P,A], rep_ip_p=i32[Tip], rep_ip_u=i32[Tip], ip_cdv_tab=i32[Kd2,N])
+# ktpu: axes(tid_pt=i32[P,UP], port_conf=bool[Tpt,Tpt])
 # ktpu: axes(nom_node=i32[G], nom_prio=i32[G], nom_req=i32[G,Rn], extra_score=i64[P,N])
+# ktpu: axes(sample_k=i32, sample_start=i32, tie_key=key, attempt_base=i32)
 # ktpu: accum(i64, i32, bool)
 # ktpu: static(v_cap=16)
 @functools.partial(
@@ -516,6 +653,7 @@ def factored_carry_update(
         "d_cap",
         "d2_cap",
         "fit_strategy",
+        "has_ports",
     ),
 )
 def wave_schedule(
@@ -540,8 +678,23 @@ def wave_schedule(
     d2_cap: int = 8,
     extra_score=None,
     fit_strategy: tuple = gang.DEFAULT_FIT_STRATEGY,
+    has_ports: bool = False,
+    tid_pt=None,
+    port_conf=None,
+    sample_k=None,
+    sample_start=None,
+    tie_key=None,
+    attempt_base=None,
 ):
     """One fused wave dispatch: speculation + factored admission pass.
+
+    ``has_ports`` (static) compiles in the [Tpt, N] port-occupancy carry
+    for in-batch host-port users; ``sample_k``/``sample_start``/
+    ``tie_key``/``attempt_base`` opt into the bit-compat sampling and
+    seeded-tie modes exactly as gang_schedule does — the sampling window,
+    nodeTree rotation cursor, and tie-break live in gang.pod_step and read
+    only carried state, so the factored pass replays them bit-exactly
+    (``tallies["sample_start"]`` returns the advanced cursor).
 
     Returns (chosen [P], n_feas [P], reason_counts [P, ND], tallies,
     stats [3, P]) where stats rows are (speculative choice, demote kind,
@@ -553,6 +706,7 @@ def wave_schedule(
     Tsp = rep_sp_p.shape[0]
     Tip = rep_ip_p.shape[0]
     Kd2 = ip_cdv_tab.shape[0]
+    Tpt = port_conf.shape[0] if has_ports else 0
 
     if nom_node is not None:
         nom_oh = (
@@ -566,21 +720,9 @@ def wave_schedule(
     d2_ids = jnp.arange(d2_cap, dtype=I32)
     n_ids = jnp.arange(N, dtype=I32)
 
-    # per-dispatch gathers from the statics: which batch pods each distinct
-    # term matches (the forward AND reverse match matrix — ip_bmatch[p,u,j]
-    # reads "pod j matches p's term u", so one gather serves both sides)
-    if C:
-        m_sp_all = _rep_rows(g.sp_bmatch, rep_sp_p, rep_sp_c)  # [Tsp, P]
-    else:
-        m_sp_all = jnp.zeros((Tsp, P), bool)
-    if AT:
-        m_ip_all = _rep_rows(g.ip_bmatch, rep_ip_p, rep_ip_u)  # [Tip, P]
-        t_anti = _rep_rows(g.ip_is_anti, rep_ip_p, rep_ip_u)  # [Tip]
-        t_w = _rep_rows(g.ip_sym_w, rep_ip_p, rep_ip_u)  # [Tip] i64
-    else:
-        m_ip_all = jnp.zeros((Tip, P), bool)
-        t_anti = jnp.zeros((Tip,), bool)
-        t_w = jnp.zeros((Tip,), I64)
+    m_sp_all, m_ip_all, t_anti, t_w = term_match_rows(
+        g, rep_sp_p, rep_sp_c, rep_ip_p, rep_ip_u
+    )
 
     def zero_sdyn():
         z = jnp.zeros((C, N), I32)
@@ -594,7 +736,7 @@ def wave_schedule(
             jnp.asarray(False),
         )
 
-    def build_hv(p, sdyn, idyn):
+    def build_hv(p, sdyn, idyn, m_portb):
         """hv dict for pod_step + attribution tensors (c_ok, anti_viol)."""
         if C:
             m_spread, sp_cnt, c_ok = gang.spread_constraints(db, g, p, sdyn)
@@ -611,7 +753,7 @@ def wave_schedule(
             ip_raw = g.ip_sym[p]
             anti_viol = jnp.zeros((AT, N), bool)
         hv = dict(
-            m_portb=true_n,
+            m_portb=m_portb,
             m_spread=m_spread,
             sp_cnt=sp_cnt,
             m_interpod=m_interpod,
@@ -628,6 +770,9 @@ def wave_schedule(
         nom_oh=nom_oh,
         nom_prio=nom_prio,
         nom_req=nom_req,
+        sample_k=sample_k,
+        tie_key=tie_key,
+        attempt_base=attempt_base,
     )
 
     base = dict(
@@ -636,10 +781,15 @@ def wave_schedule(
         num_pods=dc.num_pods,
         assigned=jnp.full((P,), ABSENT, I32),
     )
+    if sample_k is not None:
+        base["sample_start"] = jnp.asarray(sample_start, I32)
 
     # ---- pass 1: speculation — the whole wave against the frozen snapshot
+    # (in sampling mode every pod speculates from the INITIAL rotation
+    # cursor — the admission pass alone carries the advancing cursor, and
+    # speculation feeds only the stats/attribution outputs)
     def spec_one(p):
-        hv, _, _ = build_hv(p, zero_sdyn(), zero_idyn())
+        hv, _, _ = build_hv(p, zero_sdyn(), zero_idyn(), true_n)
         _, (choice, _, _) = gang.pod_step(
             dc, db, g, p, base, hv, jnp.asarray(True), commit=False, **step_kw
         )
@@ -648,12 +798,8 @@ def wave_schedule(
     c0 = jax.vmap(spec_one)(jnp.arange(P, dtype=I32))
 
     # ---- pass 2: conflict resolution / admission over factored deltas
-    init = dict(
-        base,
-        cnt_sp=jnp.zeros((Tsp, N), I32),
-        cnt_ip=jnp.zeros((Tip, N), I32),
-        rev_cnt=jnp.zeros((Tip, N), I32),
-    )
+    init = dict(base, **factored_carry_init(Tsp, Tip, N, Tpt))
+    carry_keys = FACTORED_CARRY_KEYS[:3] + (("occ_pt",) if Tpt else ())
 
     def step(state, p):
         if C:
@@ -680,22 +826,28 @@ def wave_schedule(
             idyn = zero_idyn()
             ip_aux = None
 
-        hv, c_ok, anti_viol = build_hv(p, sdyn, idyn)
+        if has_ports:
+            m_portb, pt_cnt = factored_port_mask(
+                tid_pt, port_conf, state["occ_pt"], p
+            )
+        else:
+            m_portb, pt_cnt = true_n, None
+
+        hv, c_ok, anti_viol = build_hv(p, sdyn, idyn, m_portb)
         new_state, (choice, n_feas, reason_counts) = gang.pod_step(
             dc, db, g, p, state, hv, jnp.asarray(True), **step_kw
         )
 
         # carry updates: dense rank-1 outer products, no scatters
-        new_state["cnt_sp"], new_state["cnt_ip"], new_state["rev_cnt"] = (
+        new_state.update(
             factored_carry_update(
-                state["cnt_sp"],
-                state["cnt_ip"],
-                state["rev_cnt"],
+                {k: state[k] for k in carry_keys},
                 p,
                 choice,
                 m_sp_all,
                 m_ip_all,
                 ip_aux,
+                pt_cnt=pt_cnt,
             )
         )
 
@@ -705,6 +857,7 @@ def wave_schedule(
         spec = c0[p]
         spec_live = spec >= 0
         at = jnp.clip(spec, 0, N - 1)
+        pt_bad = spec_live & ~m_portb[at]
         sp_bad = spec_live & ~hv["m_spread"][at]
         ip_bad = spec_live & ~hv["m_interpod"][at]
         # resource-contention demotion: earlier wave commits consumed the
@@ -736,12 +889,16 @@ def wave_schedule(
                 ~spec_live,
                 DEMOTE_UPGRADE,
                 jnp.where(
-                    sp_bad,
-                    DEMOTE_SPREAD,
+                    pt_bad,
+                    DEMOTE_PORTS,
                     jnp.where(
-                        ip_bad,
-                        DEMOTE_AFFINITY,
-                        jnp.where(fit_bad, DEMOTE_FIT, DEMOTE_SCORE),
+                        sp_bad,
+                        DEMOTE_SPREAD,
+                        jnp.where(
+                            ip_bad,
+                            DEMOTE_AFFINITY,
+                            jnp.where(fit_bad, DEMOTE_FIT, DEMOTE_SCORE),
+                        ),
                     ),
                 ),
             ),
@@ -773,6 +930,8 @@ def wave_schedule(
         "nonzero": state["nonzero"],
         "num_pods": state["num_pods"],
     }
+    if sample_k is not None:
+        tallies["sample_start"] = state["sample_start"]
     stats = jnp.stack([c0, kinds, cterms])  # [3, P]
     return chosen, n_feas, reason_counts, tallies, stats
 
@@ -780,8 +939,10 @@ def wave_schedule(
 # ktpu: axes(dc=DeviceCluster, db=DeviceBatch, hostname_key=i32, extra_mask=bool[P,N])
 # ktpu: axes(tid_sp=i32[P,C], rep_sp_p=i32[Tsp], rep_sp_c=i32[Tsp])
 # ktpu: axes(tid_ip=i32[P,A], rep_ip_p=i32[Tip], rep_ip_u=i32[Tip], ip_cdv_tab=i32[Kd2,N])
+# ktpu: axes(tid_pt=i32[P,UP], port_conf=bool[Tpt,Tpt])
 # ktpu: axes(nom_node=i32[G], nom_prio=i32[G], nom_req=i32[G,Rn], extra_score=i64[P,N])
 # ktpu: axes(sp_keys=i32[Kd], sp_cdv_tab=i32[Kd,N], ip_keys=i32[Kd2])
+# ktpu: axes(sample_k=i32, sample_start=i32, tie_key=key, attempt_base=i32)
 # ktpu: accum(i64, i32, bool)
 # ktpu: static(v_cap=16)
 @functools.partial(
@@ -797,6 +958,7 @@ def wave_schedule(
         "d_cap",
         "d2_cap",
         "fit_strategy",
+        "has_ports",
     ),
 )
 def wave_run(
@@ -828,10 +990,19 @@ def wave_run(
     d2_cap: int = 8,
     extra_score=None,
     fit_strategy: tuple = gang.DEFAULT_FIT_STRATEGY,
+    has_ports: bool = False,
+    tid_pt=None,
+    port_conf=None,
+    sample_k=None,
+    sample_start=None,
+    tie_key=None,
+    attempt_base=None,
 ):
     """Fused precompute + wave: ONE device dispatch per batch (the wave
-    counterpart of gang.gang_run — wave-eligible batches carry no in-batch
-    host ports, so the port axis is compiled out via has_ports=False)."""
+    counterpart of gang.gang_run).  The gang scan's pod×pod port matrix
+    stays compiled out (precompute has_ports=False): in-batch host ports
+    ride the factored [Tpt, N] occupancy carry instead (``has_ports`` here
+    gates THAT carry)."""
     g = gang.precompute(
         dc,
         db,
@@ -870,4 +1041,11 @@ def wave_run(
         d2_cap=d2_cap,
         extra_score=extra_score,
         fit_strategy=fit_strategy,
+        has_ports=has_ports,
+        tid_pt=tid_pt,
+        port_conf=port_conf,
+        sample_k=sample_k,
+        sample_start=sample_start,
+        tie_key=tie_key,
+        attempt_base=attempt_base,
     )

@@ -1350,22 +1350,29 @@ class Scheduler:
             )
 
             # 1a'. WAVE eligibility: batches carrying their own cross-pod
-            # constraint terms ride the speculative wave dispatch
-            # (ops/wave.py) — speculation + term-factored conflict
-            # resolution, bit-identical to the scan at a fraction of its
-            # per-step cost.  Sampling-compat / seeded-tie drains and
-            # in-batch host-port users keep the gang scan (_wave_tables
-            # also refuses batches the factored algebra cannot express).
+            # constraints — spread/inter-pod terms OR in-batch host ports
+            # — ride the speculative wave dispatch (ops/wave.py):
+            # speculation + term-factored conflict resolution,
+            # bit-identical to the scan at a fraction of its per-step
+            # cost.  Port users ride the [Tpt, N] occupancy carry and
+            # sampling-compat / seeded-tie drains replay their window +
+            # rotation per step, so neither falls back any more; the only
+            # remaining disqualifier is duplicate hostname labels
+            # (_wave_tables → mirror.hostnames_unique).  Every fallback
+            # bumps scheduler_tpu_wave_fallback_total{reason=}.
+            wave_shaped = bool(
+                (pb.aff_kind != PAD).any()
+                or (pb.tsc_topo_key != PAD).any()
+                or (pb.want_ppk != PAD).any()
+            )
             wt = None
-            if (
-                self.config.wave_dispatch
-                and bool(
-                    (pb.aff_kind != PAD).any()
-                    or (pb.tsc_topo_key != PAD).any()
-                )
-                and not self._sampling_active(fwk)
-            ):
-                wt = self._wave_tables(pb)
+            if wave_shaped:
+                if self.config.wave_dispatch:
+                    wt = self._wave_tables(pb)
+                    if wt is None:
+                        self.prom.wave_fallback.inc(reason="dup_hostname")
+                else:
+                    self.prom.wave_fallback.inc(reason="kill_switch")
             self.metrics[
                 "wave_batches" if wt is not None else "scan_batches"
             ] += 1
@@ -1437,6 +1444,13 @@ class Scheduler:
                     wt["rep_ip_u"],
                     wt["ip_cdv_tab"],
                     d2_cap=wt["d2_cap"],
+                    has_ports=wt["has_ports"],
+                    tid_pt=wt["tid_pt"],
+                    port_conf=wt["port_conf"],
+                    sample_k=sample_k,
+                    sample_start=sample_start,
+                    tie_key=tie_key,
+                    attempt_base=attempt_base,
                     **shared_kw,
                 )
             )
@@ -1526,7 +1540,20 @@ class Scheduler:
                 qp for i, qp in enumerate(batch) if int(chosen[i]) < 0
             ]
             if failed:
-                self._batched_preemption_narrow(fwk, state, failed)
+                # the dispatch's own committed placements ride into the
+                # narrowing dry run (the admission scan's carried state,
+                # not yet visible through the cache at this point).
+                # Peers travel as node NAMES: the narrow repacks the
+                # mirror first, which may compact node slots, so raw
+                # dispatch-time indices could charge the wrong rows.
+                self._batched_preemption_narrow(
+                    fwk,
+                    state,
+                    failed,
+                    batch=batch,
+                    chosen=chosen,
+                    node_names=node_names,
+                )
         # one locked bump for the whole batch: `metrics` is a registered
         # lock-guarded field (binding workers write other keys of it under
         # _mu); uniform write discipline costs one acquisition per batch
@@ -2050,14 +2077,27 @@ class Scheduler:
                 fwk.score_weights.get(n, 0) for n in gang.WEIGHT_ORDER
             )
             fit_strategy = fwk.fit_strategy()
-            # cross-pod-constraint batches ride the speculative wave inside
-            # the chained dispatch (same self-append, wave scheduling) —
-            # computed from the FINAL pb (post-PreFilter repack)
+            # cross-pod-constraint batches ride the speculative wave
+            # inside the chained dispatch (same self-append, wave
+            # scheduling) — computed from the FINAL pb (post-PreFilter
+            # repack).  Port batches never reach here (_chain_quickcheck
+            # refuses them: the device append doesn't splice port rows),
+            # so the want_ppk arm and the wave_ports pass-through below
+            # are inert today — kept so the wave surface stays uniform
+            # with the direct path.
+            wave_shaped = bool(
+                (pb.aff_kind != PAD).any()
+                or (pb.tsc_topo_key != PAD).any()
+                or (pb.want_ppk != PAD).any()
+            )
             wt = None
-            if self.config.wave_dispatch and bool(
-                (pb.aff_kind != PAD).any() or (pb.tsc_topo_key != PAD).any()
-            ):
-                wt = self._wave_tables(pb)
+            if wave_shaped:
+                if self.config.wave_dispatch:
+                    wt = self._wave_tables(pb)
+                    if wt is None:
+                        self.prom.wave_fallback.inc(reason="dup_hostname")
+                else:
+                    self.prom.wave_fallback.inc(reason="kill_switch")
             wave_kw = {}
             if wt is not None:
                 wave_kw = dict(
@@ -2070,6 +2110,9 @@ class Scheduler:
                     rep_ip_u=wt["rep_ip_u"],
                     ip_cdv_tab=wt["ip_cdv_tab"],
                     d2_cap=wt["d2_cap"],
+                    wave_ports=wt["has_ports"],
+                    tid_pt=wt["tid_pt"],
+                    port_conf=wt["port_conf"],
                 )
             t0 = time.perf_counter()
             out = chain_ops.chain_dispatch(
@@ -2211,9 +2254,9 @@ class Scheduler:
 
     def _wave_tables(self, pb):
         """Host half of the wave's interaction partitioner: distinct-term
-        tables for the factored admission pass (ops/wave.py).  None when
-        the batch is wave-ineligible (in-batch host ports, duplicate
-        hostname labels) — the caller falls back to the gang scan.
+        tables (spread + inter-pod + port) for the factored admission pass
+        (ops/wave.py).  None only when duplicate hostname labels disqualify
+        the factored algebra — the caller falls back to the gang scan.
 
         Memoized like _gang_tables: template-stamped drains repeat the
         same term content batch after batch, so the np.unique row-dedup
@@ -2230,6 +2273,8 @@ class Scheduler:
             pb.valid,
             pb.ns_id,
             pb.want_ppk,
+            pb.want_ip,
+            pb.want_wild,
             pb.tsc_topo_key,
             pb.tsc_table.req_key,
             pb.tsc_table.req_op,
@@ -2258,7 +2303,12 @@ class Scheduler:
         cached = getattr(self, "_wave_tables_memo", None)
         if cached is not None and cached[0] == key:
             return cached[1]
-        wt = wave_ops.wave_tables(pb, self.mirror.nodes.label_vals, hk_id)
+        wt = wave_ops.wave_tables(
+            pb,
+            self.mirror.nodes.label_vals,
+            hk_id,
+            hostnames_unique=self.mirror.hostnames_unique,
+        )
         self._wave_tables_memo = (key, wt)
         return wt
 
@@ -2364,17 +2414,10 @@ class Scheduler:
 
     def _hostnames_unique(self) -> bool:
         """The wave/workloads factored algebra treats hostname topology as
-        node identity — duplicate hostname label values disqualify it."""
-        import numpy as np
-
-        vocab = self.mirror.vocab
-        hk = vocab.label_keys.lookup(HOSTNAME_LABEL)
-        lv = np.asarray(self.mirror.nodes.label_vals)
-        if not 0 <= hk < lv.shape[1]:
-            return True
-        col = lv[:, hk]
-        vals = col[col >= 0]
-        return len(vals) == len(np.unique(vals))
+        node identity — duplicate hostname label values disqualify it.
+        The bit is computed once per SNAPSHOT by the mirror (memoized on
+        the static lineage), not re-derived per batch."""
+        return self.mirror.hostnames_unique
 
     def _vol_tables(self, pods, p_cap: int, vocab):
         """Pack bound-PV node-affinity DNFs into the volume-topology kernel
@@ -2585,8 +2628,9 @@ class Scheduler:
             tables = self._gang_tables(pb, vocab)
             wt = self._wave_tables(pb)
             if wt is None:
-                # The host-ports and duplicate-hostname pre-checks mirror
-                # wave_tables' refusal conditions, so this is unreachable
+                # The duplicate-hostname pre-check mirrors wave_tables'
+                # only remaining refusal condition (in-batch ports ride
+                # the factored port carry now), so this is unreachable
                 # today — but PreFilter failures and quorum rejections
                 # were already emitted above, so if the copies ever drift
                 # the only safe move is to finish the REMAINING live pods
@@ -3846,6 +3890,19 @@ class Scheduler:
             "nz_np": nz,
         }
 
+    @staticmethod
+    def _wave_shaped_pod(pod) -> bool:
+        """Pod carries a cross-pod constraint the wave engine owns (spread
+        or inter-pod terms, in-batch host ports) — routing it onto a
+        one-pod host path is a fallback-ladder event worth counting in
+        scheduler_tpu_wave_fallback_total."""
+        if pod.host_ports() or pod.topology_spread_constraints:
+            return True
+        aff = pod.affinity
+        return aff is not None and bool(
+            aff.pod_affinity or aff.pod_anti_affinity
+        )
+
     def _schedule_one_nominated(self, fwk, qp) -> List[ScheduleOutcome]:
         """The nominated-node fast path (schedule_one.go:490-499): a pod
         whose preemption already nominated a node evaluates feasibility of
@@ -3859,6 +3916,8 @@ class Scheduler:
 
         pod = qp.pod
         nom = pod.nominated_node_name
+        if self._wave_shaped_pod(pod):
+            self.prom.wave_fallback.inc(reason="nominated")
         with self._mu:
             state = CycleState()
             self.metrics["schedule_attempts"] += 1
@@ -3960,6 +4019,15 @@ class Scheduler:
         cycles are the rare path, so stalling binds behind an extender
         round-trip is acceptable (the reference's extender calls sit on the
         scheduling goroutine too)."""
+        pod = qp.pod
+        if not pod.nominated_node_name and self._wave_shaped_pod(pod):
+            # nominated fall-through already counted its own reason
+            reason = (
+                "extender"
+                if any(e.is_interested(pod) for e in self.extenders)
+                else "host_scores"
+            )
+            self.prom.wave_fallback.inc(reason=reason)
         with self._mu:
             return self._schedule_one_extender_locked(fwk, qp)
 
@@ -4386,13 +4454,26 @@ class Scheduler:
             out.append(fit)
         return out
 
-    def _batched_preemption_narrow(self, fwk, state, failed) -> None:
+    def _batched_preemption_narrow(
+        self, fwk, state, failed, batch=None, chosen=None, node_names=None
+    ) -> None:
         """ONE device dispatch shortlisting preemption candidates for every
         failed pod of a batch (ops/preemption.narrow_candidates — the
         batched front of DryRunPreemption, preemption.go:548).  Shortlists
         land in the CycleState under ("preemption_potential", uid);
         DefaultPreemption passes them into the evaluator.  Best-effort: on
-        any precondition failure the evaluator's host walk runs unassisted."""
+        any precondition failure the evaluator's host walk runs unassisted.
+
+        ``batch``/``chosen``/``node_names`` hand over the dispatch's OWN
+        committed placements — the admission scan's carried state, which
+        the cache cannot show yet (commits happen in the result walk after
+        this) — so victim evaluation reuses them instead of re-deriving
+        peer state: strictly-higher-priority peers charge the kept plane,
+        lower ones count as removable victims (ops/preemption.py
+        docstring).  ``node_names`` is the DISPATCH-TIME packing's name
+        list: the mirror.update() below may full-repack and compact node
+        slots, so peers resolve name→current-index like the victim rows
+        do, never by raw dispatch index."""
         import numpy as np
 
         from kubernetes_tpu.ops import preemption as ops_preemption
@@ -4455,6 +4536,36 @@ class Scheduler:
                     "groups": groups,
                     "pg": pod_group,
                 }
+                if (
+                    batch is not None
+                    and chosen is not None
+                    and node_names is not None
+                ):
+                    # this dispatch's committed peers (sticky bucket like
+                    # the victim plane — retry rounds must not recompile)
+                    self._bpeer_cap_max = max(
+                        getattr(self, "_bpeer_cap_max", 1),
+                        bucket_cap(max(len(batch), 1), 1),
+                    )
+                    B2 = self._bpeer_cap_max
+                    bnode = np.full(B2, -1, np.int32)
+                    bprio = np.zeros(B2, np.int32)
+                    breq = np.zeros((B2, R), np.int32)
+                    for i, qp in enumerate(batch):
+                        c = int(chosen[i])
+                        if c < 0 or c >= len(node_names):
+                            continue
+                        # dispatch index → name → CURRENT slot (the
+                        # repack above may have moved it)
+                        idx = nt.name_to_idx.get(node_names[c])
+                        if idx is None:
+                            continue
+                        bnode[i] = idx
+                        bprio[i] = qp.pod.priority
+                        breq[i] = lanes.request_row(
+                            qp.pod.compute_requests(), R
+                        )
+                    tree.update(bnode=bnode, bprio=bprio, breq=breq)
                 from kubernetes_tpu.ops import wire
 
                 t = wire.device_put_packed(tree)
@@ -4468,6 +4579,9 @@ class Scheduler:
                             t["vreq"],
                             t["groups"],
                             t["pg"],
+                            batch_node=t.get("bnode"),
+                            batch_prio=t.get("bprio"),
+                            batch_req=t.get("breq"),
                         )
                     )
                 )

@@ -294,6 +294,183 @@ def check_wave_vs_oracle(n_nodes=500, n_pods=2000) -> dict:
     }
 
 
+def _port_heavy_pods(n, seed=13, apps=8, prefix="pp"):
+    """Port-contended mix: most pods race a couple of (port, proto) pairs
+    (some wildcard-IP, some IP-scoped) alongside spread terms — the wave's
+    factored [Tpt, N] port-occupancy carry is the only thing standing
+    between this workload and the gang scan.  THE workload definition for
+    the de-fallback coverage: bench config13 and tests/test_wave.py both
+    import it, so the artifacts exercise one mix, not drifting copies."""
+    from kubernetes_tpu.api.types import (
+        Container,
+        ContainerPort,
+        LabelSelector,
+        Pod,
+        TopologySpreadConstraint,
+    )
+
+    rng = random.Random(seed)
+    pods = []
+    for i in range(n):
+        kw = {"labels": {"app": f"srv-{i % apps}"}}
+        containers = [
+            Container(
+                name="c",
+                requests={
+                    "cpu": f"{rng.choice([100, 250])}m",
+                    "memory": "128Mi",
+                },
+            )
+        ]
+        if i % 3 != 2:
+            containers.append(
+                Container(
+                    name="srv",
+                    ports=(
+                        ContainerPort(
+                            container_port=8080,
+                            host_port=rng.choice([8080, 9090]),
+                            protocol=rng.choice(["TCP", "UDP"]),
+                            host_ip=rng.choice(["", "", "10.0.0.1"]),
+                        ),
+                    ),
+                )
+            )
+        if i % 2 == 0:
+            app = kw["labels"]["app"]
+            kw["topology_spread_constraints"] = (
+                TopologySpreadConstraint(
+                    max_skew=2,
+                    topology_key="topology.kubernetes.io/zone",
+                    when_unsatisfiable="DoNotSchedule",
+                    label_selector=LabelSelector(match_labels={"app": app}),
+                ),
+            )
+        pods.append(Pod(name=f"{prefix}-{i}", containers=containers, **kw))
+    return pods
+
+
+def check_port_carry_vs_oracle(n_nodes=400, n_pods=1600) -> dict:
+    """Port-contended wave drain (the factored [Tpt, N] port-occupancy
+    carry) vs the serial oracle — the de-fallback's bit-identity evidence.
+    Fails loud if the wave never engaged or the retired `ports` fallback
+    rung was used."""
+    import copy
+
+    from kubernetes_tpu.oracle.pipeline import schedule_one
+    from kubernetes_tpu.oracle.state import OracleState
+
+    nodes = _basic_nodes(n_nodes, zones=5)
+    pods = _port_heavy_pods(n_pods)
+    t0 = time.perf_counter()
+    got, sched = _drain(nodes, copy.deepcopy(pods), return_sched=True)
+    wave_batches = sched.metrics["wave_batches"]
+    port_fallbacks = sched.prom.wave_fallback.value(reason="ports")
+
+    state = OracleState.build(nodes)
+    want: Dict[str, Optional[str]] = {}
+    for pod in copy.deepcopy(pods):
+        r = schedule_one(pod, state)
+        want[pod.name] = r.node
+        if r.node is not None:
+            pod.node_name = r.node
+            state.place(pod)
+    diffs = _diff(got, want)
+    n_diffs = len(diffs)
+    if wave_batches == 0:
+        n_diffs += 1
+        diffs = [("__wave_batches__", 0, ">=1")] + diffs
+    if port_fallbacks:
+        n_diffs += 1
+        diffs = [("__fallback_ports__", port_fallbacks, 0)] + diffs
+    return {
+        "nodes": n_nodes,
+        "pods": n_pods,
+        "wave_batches": wave_batches,
+        "bound_wave": sum(1 for v in got.values() if v),
+        "bound_oracle": sum(1 for v in want.values() if v),
+        "diffs": n_diffs,
+        "first_diffs": diffs[:5],
+        "wall_s": round(time.perf_counter() - t0, 1),
+    }
+
+
+def check_compat_wave_vs_oracle(n_nodes=800, n_pods=1600, seed=47) -> dict:
+    """Sampling-compat + seeded-tie drain over a CROSS-POD-constraint
+    workload vs the serial oracle: the wave engine replays the adaptive
+    window, nodeTree rotation, and seeded tie-break per step, so compat
+    drains no longer pay the [C,N,J] gang scan.  Fails loud if the wave
+    never engaged or the retired `sampling_compat` rung was used."""
+    import copy
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kubernetes_tpu.oracle.pipeline import feasible_nodes, prioritize
+    from kubernetes_tpu.oracle.state import OracleState
+
+    nodes = _basic_nodes(n_nodes, zones=3)
+    pods = _cross_pod_pods(n_pods, seed=seed)
+    t0 = time.perf_counter()
+    got, sched = _drain(
+        nodes,
+        copy.deepcopy(pods),
+        return_sched=True,
+        reference_sampling_compat=True,
+        tie_break_seed=seed,
+    )
+    wave_batches = sched.metrics["wave_batches"]
+    compat_fallbacks = sched.prom.wave_fallback.value(
+        reason="sampling_compat"
+    )
+
+    state = OracleState.build(nodes)
+    key = jax.random.PRNGKey(seed)
+    h_all = np.asarray(
+        jax.vmap(
+            lambda a: jax.random.bits(
+                jax.random.fold_in(key, a), (n_nodes,), dtype=jnp.uint32
+            )
+        )(jnp.arange(n_pods))
+    )
+    idx_of = {name: i for i, name in enumerate(state.nodes)}
+    start = 0
+    attempt = 0
+    want: Dict[str, Optional[str]] = {}
+    for pod in copy.deepcopy(pods):
+        fit = feasible_nodes(pod, state, sample_pct=0, start_index=start)
+        start = (start + fit.processed) % n_nodes
+        totals = prioritize(pod, state, fit.feasible)
+        h = h_all[attempt]
+        attempt += 1
+        if not totals:
+            want[pod.name] = None
+            continue
+        node = max(totals, key=lambda m: (totals[m], int(h[idx_of[m]])))
+        want[pod.name] = node
+        pod.node_name = node
+        state.place(pod)
+    diffs = _diff(got, want)
+    n_diffs = len(diffs)
+    if wave_batches == 0:
+        n_diffs += 1
+        diffs = [("__wave_batches__", 0, ">=1")] + diffs
+    if compat_fallbacks:
+        n_diffs += 1
+        diffs = [("__fallback_sampling_compat__", compat_fallbacks, 0)] + diffs
+    return {
+        "nodes": n_nodes,
+        "pods": n_pods,
+        "wave_batches": wave_batches,
+        "bound_device": sum(1 for v in got.values() if v),
+        "bound_oracle": sum(1 for v in want.values() if v),
+        "diffs": n_diffs,
+        "first_diffs": diffs[:5],
+        "wall_s": round(time.perf_counter() - t0, 1),
+    }
+
+
 def check_resident_vs_oracle(n_nodes=1000, n_pods=5000) -> dict:
     """Resident drain loop (ops/resident.py speculation/admission fixed
     point + tail engine) vs the serial oracle AND vs the residentDrain:false
@@ -599,6 +776,8 @@ def run_checks(ns_nodes=10000, ns_pods=50000) -> dict:
         ),
         "sampling_compat_vs_serial_oracle": check_compat_vs_oracle(),
         "wave_dispatch_vs_serial_oracle": check_wave_vs_oracle(),
+        "port_carry_vs_serial_oracle": check_port_carry_vs_oracle(),
+        "compat_wave_vs_serial_oracle": check_compat_wave_vs_oracle(),
         "resident_drain_vs_serial_oracle": check_resident_vs_oracle(),
         "gang_admission_vs_serial_oracle": check_gang_vs_oracle(),
         "dra_allocation_vs_serial_oracle": check_dra_vs_oracle(),

@@ -246,3 +246,113 @@ def test_no_preemption_when_not_helpful():
     assert out[0].node is None
     assert not cluster.evictions
     assert cluster.pods[hp.uid].nominated_node_name == ""
+
+
+def test_narrow_candidates_charges_committed_batch_peers():
+    """The narrowing kernel's batch-peer plane (ops/preemption.py): the
+    dispatch's own committed placements join the dry run — strictly
+    higher priority charges the kept plane (exact: the host walk sees
+    them assumed), equal priority is ignored (superset-sound either way),
+    strictly lower counts as a removable victim."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kubernetes_tpu.ops import preemption as ops_preemption
+    from kubernetes_tpu.ops.common import DeviceBatch, DeviceCluster
+    from kubernetes_tpu.oracle.state import OracleState
+    from kubernetes_tpu.snapshot.cluster import pack_cluster
+    from kubernetes_tpu.snapshot.interner import Vocab
+    from kubernetes_tpu.snapshot.schema import pack_pod_batch
+
+    # two 4-cpu nodes, empty; the failed pod needs 4 cpu at priority 50
+    nodes = [_node("n0", cpu="4"), _node("n1", cpu="4")]
+    failed = _pod("f", cpu="4", priority=50)
+    state = OracleState.build(nodes)
+    vocab = Vocab()
+    pc = pack_cluster(state, vocab, pending_pods=[failed])
+    pb = pack_pod_batch([failed], vocab, k_cap=pc.nodes.k_cap)
+    dc = DeviceCluster.from_host(pc.nodes, pc.existing, vocab)
+    db = DeviceBatch.from_host(pb)
+
+    # no placed victims at all
+    E = 4
+    vnode = jnp.full((E,), -1, jnp.int32)
+    vprio = jnp.zeros((E,), jnp.int32)
+    vreq = jnp.zeros((E, dc.allocatable.shape[1]), jnp.int32)
+    groups = jnp.asarray([50], jnp.int32)
+    pg = jnp.zeros((pb.valid.shape[0],), jnp.int32)
+
+    def masks(batch_rows):
+        kw = {}
+        if batch_rows is not None:
+            bn, bp, br = batch_rows
+            kw = dict(
+                batch_node=jnp.asarray(bn, jnp.int32),
+                batch_prio=jnp.asarray(bp, jnp.int32),
+                batch_req=jnp.asarray(br, jnp.int32),
+            )
+        return np.asarray(
+            ops_preemption.narrow_candidates(
+                dc, db, vnode, vprio, vreq, groups, pg, **kw
+            )
+        )
+
+    R = dc.allocatable.shape[1]
+    req4 = np.zeros((1, R), np.int32)
+    req4[0, 0] = 4000  # 4 cpu in milli (LANE_CPU is lane 0)
+
+    # baseline: no batch peers, no victims anywhere → no candidates
+    assert not masks(None)[0].any()
+
+    # a strictly LOWER-priority peer committed to n0 → n0 becomes a
+    # dry-run candidate (the peer is a future victim) and its usage is
+    # removable, so the failed pod fits post-removal
+    m = masks(([0], [10], req4))
+    assert m[0, 0] and not m[0, 1]
+
+    # a strictly HIGHER-priority peer on n0 → charged, not removable:
+    # no victim there, still no candidates
+    m = masks(([0], [100], req4))
+    assert not m[0].any()
+
+    # an EQUAL-priority peer is ignored entirely (it may commit after the
+    # failed pod's walk): neither a victim nor a charge
+    m = masks(([0], [50], req4))
+    assert not m[0].any()
+
+
+def test_batch_peer_narrowing_keeps_oracle_decisions():
+    """End-to-end: a batch whose higher-priority pods fill the cluster and
+    whose tail pod must preempt — the narrowed dry run (batch peers
+    charged) still finds the preemption the serial walk finds."""
+    cluster = FakeCluster()
+    sched = Scheduler()
+    cluster.connect(sched)
+    for i in range(2):
+        cluster.create_node(_node(f"n{i}", cpu="4"))
+    # pre-placed low-priority victims filling BOTH nodes
+    for i in range(2):
+        cluster.create_pod(
+            Pod(
+                name=f"v{i}",
+                node_name=f"n{i}",
+                priority=0,
+                start_time=float(i),
+                containers=[
+                    Container(name="c", requests={"cpu": "3", "memory": "64Mi"})
+                ],
+            )
+        )
+    # one batch: two high-priority pods that consume the remaining cpu,
+    # then a mid-priority pod that can only land by evicting a victim
+    cluster.create_pod(_pod("hp0", cpu="1", priority=100))
+    cluster.create_pod(_pod("hp1", cpu="1", priority=100))
+    cluster.create_pod(_pod("mid", cpu="3", priority=50))
+    out1 = {o.pod.name: o.node for o in sched.schedule_pending()}
+    assert out1["hp0"] and out1["hp1"]
+    assert out1["mid"] is None
+    # preemption found a node despite the batch peers charging the plane
+    assert cluster.pods[
+        next(p.uid for p in cluster.pods.values() if p.name == "mid")
+    ].nominated_node_name != ""
+    assert len(cluster.evictions) == 1

@@ -59,7 +59,7 @@ def _pack(state, pending):
     return vocab, pc, pb, dc, db, v_cap, hk_id, hostname_key, tables
 
 
-def run_wave(state, pending, with_stats=False):
+def run_wave(state, pending, with_stats=False, sample_kw=None):
     """wave_schedule end to end — the wave analogue of run_gang."""
     vocab, pc, pb, dc, db, v_cap, hk_id, hostname_key, tables = _pack(
         state, pending
@@ -85,6 +85,10 @@ def run_wave(state, pending, with_stats=False):
         wt["ip_cdv_tab"],
         d_cap=d_cap,
         d2_cap=d2_cap,
+        has_ports=wt["has_ports"],
+        tid_pt=wt["tid_pt"],
+        port_conf=wt["port_conf"],
+        **(sample_kw or {}),
     )
     names = list(state.nodes)
     out = [
@@ -121,21 +125,17 @@ def run_serial(state, pending):
     return out
 
 
-def _no_ports(pod):
-    return not pod.host_ports()
-
-
 @pytest.mark.parametrize(
     "seed,n_nodes,n_placed,n_pending",
     [(41, 10, 20, 20), (42, 10, 20, 20), (43, 12, 24, 24),
      (111, 40, 80, 120), (222, 40, 80, 120), (333, 40, 80, 120)],
 )
 def test_wave_matches_gang_and_serial(seed, n_nodes, n_placed, n_pending):
+    # in-batch host-port users ride the factored [Tpt, N] occupancy carry
+    # now — the generator's port pods stay IN the batch
     rng = random.Random(seed)
     nodes, placed = make_cluster(rng, n_nodes, n_placed)
-    pending = [make_pod(rng, f"pend-{i}") for i in range(n_pending * 2)]
-    # wave eligibility excludes in-batch host ports; filter, keep the count
-    pending = [p for p in pending if _no_ports(p)][:n_pending]
+    pending = [make_pod(rng, f"pend-{i}") for i in range(n_pending)]
 
     state_w = OracleState.build(nodes, placed, namespace_labels=NS_LABELS)
     got = run_wave(state_w, pending)
@@ -428,16 +428,216 @@ def test_wave_bulk_commit_never_skips_relevant_reserve():
 
 def test_wave_off_matches_wave_on():
     """The config kill-switch routes back to the gang scan — decisions
-    must not depend on the switch."""
+    must not depend on the switch (port users included: on the wave they
+    ride the occupancy carry, off it the scan's pod×pod matrix)."""
     import random as _r
 
     rng = _r.Random(9)
     nodes, placed = make_cluster(rng, 14, 10)
     pods = [make_pod(rng, f"w-{i}") for i in range(60)]
-    pods = [p for p in pods if _no_ports(p)]
     for p in pods:
         p.node_name = None
     g_on, s_on = _drain_sched(nodes, pods, wave=True)
     g_off, s_off = _drain_sched(nodes, pods, wave=False)
     assert g_on == g_off
     assert s_off.metrics["wave_batches"] == 0
+    # the kill switch is a COUNTED fallback-ladder rung now
+    assert s_off.prom.wave_fallback.value(reason="kill_switch") >= 1
+    assert s_on.prom.wave_fallback.value(reason="kill_switch") == 0
+
+
+# ---------------------------------------------------------------------------
+# De-fallback coverage: port-heavy and sampling-compat batches ride the
+# factored wave engine (ISSUE 11) — randomized property tests under
+# KTPU_SANITIZE=1 plus kill-switch identity, with the fallback counter
+# asserting the retired rungs (ports / sampling_compat) stay unused.
+# ---------------------------------------------------------------------------
+
+
+def _port_heavy_pods(n, seed=5):
+    """THE port-contended mix — imported from paritycheck so the property
+    tests, the parity artifact, and bench config13 all exercise one
+    workload definition instead of drifting copies."""
+    from kubernetes_tpu.tools.paritycheck import (
+        _port_heavy_pods as _gen,
+    )
+
+    return _gen(n, seed=seed, apps=6, prefix="pt")
+
+
+@pytest.mark.parametrize("seed", [1, 7, 23])
+def test_port_heavy_wave_matches_serial(sanitize_on, seed):
+    """Randomized port-heavy drains: the wave engine (port-occupancy carry
+    engaged) is bit-identical to the serial oracle and to the kill-switch
+    (gang scan) drain, and the retired `ports` fallback rung stays at
+    zero."""
+    import copy
+
+    from kubernetes_tpu.oracle.state import OracleState as OS
+
+    nodes = _zone_nodes(10)
+    pods = _port_heavy_pods(48, seed=seed)
+
+    state = OS.build(nodes)
+    want = run_serial(state, copy.deepcopy(pods))
+
+    got, s_on = _drain_sched(nodes, pods, wave=True)
+    assert [got.get(p.name) for p in pods] == want
+    assert s_on.metrics["wave_batches"] >= 1
+    assert s_on.prom.wave_fallback.value(reason="ports") == 0
+    assert s_on.prom.wave_fallback.value(reason="sampling_compat") == 0
+
+    g_off, _ = _drain_sched(nodes, pods, wave=False)
+    assert got == g_off
+
+
+def test_port_conflict_demotes_with_ports_kind(sanitize_on):
+    """Two pods racing ONE host port on a shared best node: the loser is
+    demoted with kind=ports (attribution, flight event, counter)."""
+    from kubernetes_tpu.api.types import Container, ContainerPort, Pod
+
+    nodes = _zone_nodes(1)  # one node: identical speculative placements
+    pods = [
+        Pod(
+            name=f"racer-{i}",
+            labels={"app": "race"},
+            containers=[
+                Container(
+                    name="c",
+                    requests={"cpu": "100m", "memory": "64Mi"},
+                    ports=(
+                        ContainerPort(
+                            container_port=8080, host_port=7777, protocol="TCP"
+                        ),
+                    ),
+                )
+            ],
+        )
+        for i in range(2)
+    ]
+    got, s = _drain_sched(nodes, pods, wave=True)
+    assert got.get("racer-0") == "node-0"
+    assert got.get("racer-1") is None
+    assert s.metrics["wave_batches"] >= 1
+    demoted = [
+        e for e in s.flight.tail(1000) if e["kind"] == "wave_demoted"
+    ]
+    assert demoted and demoted[-1]["detail"]["kind"] == "ports"
+    assert s.prom.wave_conflicts.value(kind="ports") >= 1
+
+
+def _compat_drain(nodes, pods, wave: bool, seed=17):
+    import copy
+
+    from kubernetes_tpu.framework.config import SchedulerConfiguration
+    from kubernetes_tpu.scheduler import Scheduler
+
+    conf = SchedulerConfiguration()
+    conf.wave_dispatch = wave
+    conf.batch_size = 64
+    conf.reference_sampling_compat = True
+    conf.tie_break_seed = seed
+    s = Scheduler(configuration=conf)
+    got = {}
+    s.binding_sink = lambda pod, node: got.__setitem__(pod.name, node)
+    for n in nodes:
+        s.on_node_add(n)
+    for p in copy.deepcopy(pods):
+        s.on_pod_add(p)
+    for o in s.schedule_pending():
+        got.setdefault(o.pod.name, o.node)
+    return got, s
+
+
+@pytest.mark.parametrize("seed", [3, 19])
+def test_sampling_compat_rides_wave(sanitize_on, seed):
+    """reference_sampling_compat + seeded-tie drains with cross-pod terms
+    ride the wave engine now — identical to the kill-switch (gang scan)
+    drain, which the sampling modes are already oracle-parity-tested on,
+    and the retired `sampling_compat` rung stays at zero."""
+    rng = random.Random(seed)
+    nodes = _zone_nodes(12)
+    pods = [make_pod(rng, f"sc-{i}") for i in range(72)]
+    for p in pods:
+        p.node_name = None
+
+    got_on, s_on = _compat_drain(nodes, pods, wave=True, seed=seed)
+    got_off, s_off = _compat_drain(nodes, pods, wave=False, seed=seed)
+    assert got_on == got_off
+    # the compat drain actually exercised the wave (the generator mixes in
+    # spread/affinity/port pods, so at least one batch is wave-shaped)
+    assert s_on.metrics["wave_batches"] >= 1
+    assert s_off.metrics["wave_batches"] == 0
+    assert s_on.prom.wave_fallback.value(reason="sampling_compat") == 0
+    assert s_on.prom.wave_fallback.value(reason="ports") == 0
+
+
+def test_duplicate_hostname_falls_back_counted(sanitize_on):
+    """Two nodes claiming ONE hostname label value: the mirror's
+    once-per-snapshot uniqueness bit disqualifies the wave (the factored
+    hostname-domain counts assume hostname ≡ node identity), the batch
+    takes the gang scan with reason=dup_hostname counted, and decisions
+    still match the serial oracle."""
+    import copy
+
+    from kubernetes_tpu.api.resource import Resource
+    from kubernetes_tpu.api.types import Node
+    from kubernetes_tpu.oracle.state import OracleState as OS
+
+    nodes = _zone_nodes(6)
+    nodes.append(
+        Node(
+            name="impostor",
+            labels={
+                "topology.kubernetes.io/zone": "zone-0",
+                # duplicates node-0's hostname label value
+                "kubernetes.io/hostname": "node-0",
+            },
+            capacity=Resource.from_map(
+                {"cpu": "8", "memory": "32Gi", "pods": 110}
+            ),
+        )
+    )
+    pods = _one_term_pods(16)
+
+    state = OS.build(nodes)
+    want = run_serial(state, copy.deepcopy(pods))
+
+    got, s = _drain_sched(nodes, pods, wave=True)
+    assert [got.get(p.name) for p in pods] == want
+    assert s.metrics["wave_batches"] == 0
+    assert s.prom.wave_fallback.value(reason="dup_hostname") >= 1
+    assert not s.mirror.hostnames_unique
+
+
+def test_mirror_hostnames_unique_memoizes():
+    """The uniqueness bit is computed once per snapshot lineage: repeated
+    reads hit the memo; adding a duplicate-hostname node invalidates it."""
+    from kubernetes_tpu.framework.config import SchedulerConfiguration
+    from kubernetes_tpu.scheduler import Scheduler
+
+    s = Scheduler(configuration=SchedulerConfiguration())
+    for n in _zone_nodes(4):
+        s.on_node_add(n)
+    with s._mu:
+        s.mirror.update(s.cache, s.namespace_labels)
+        assert s.mirror.hostnames_unique
+        memo = s.mirror._hostnames_unique_memo
+        assert s.mirror.hostnames_unique  # second read: memo hit
+        assert s.mirror._hostnames_unique_memo is memo
+
+    from kubernetes_tpu.api.resource import Resource
+    from kubernetes_tpu.api.types import Node
+
+    s.on_node_add(
+        Node(
+            name="dup",
+            labels={"kubernetes.io/hostname": "node-0"},
+            capacity=Resource.from_map(
+                {"cpu": "8", "memory": "32Gi", "pods": 110}
+            ),
+        )
+    )
+    with s._mu:
+        s.mirror.update(s.cache, s.namespace_labels)
+        assert not s.mirror.hostnames_unique
