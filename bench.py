@@ -463,6 +463,87 @@ def bench_dra(n_nodes=500, n_pods=2000, devices_per_node=4):
     return ok, max(dt, 1e-9), sched
 
 
+def bench_plan(n_nodes=300, n_fill=1500, n_backlog=96, k=64):
+    """Config 14: the counterfactual planner tier (PLANNER.md) — K forked
+    snapshots (clone-adds, cordons, evictions, capacity scales) × an
+    unschedulable backlog, once through the batched [K, P, N] kernel (ONE
+    dispatch + ONE d2h) and once as K sequential K=1 what-ifs (the serial
+    formulation every satellite-project simulator is stuck with).
+    Returns (k, batched_s, seq_s, batched_roundtrips, seq_roundtrips)."""
+    from kubernetes_tpu.api.types import Container, Pod
+    from kubernetes_tpu.planner import Fork, simulate_forks
+
+    sched, _ = _mk_sched()
+    sched.mirror.e_cap_hint = n_fill + sched.config.batch_size + 128
+    nodes = _basic_nodes(n_nodes, zones=4)
+    for n in nodes:
+        sched.on_node_add(n)
+    for i in range(n_fill):
+        sched.on_pod_add(
+            Pod(
+                name=f"fill-{i}",
+                priority=2,
+                labels={"app": f"a{i % 16}"},
+                containers=[
+                    Container(
+                        name="c",
+                        requests={"cpu": "900m", "memory": "512Mi"},
+                    )
+                ],
+            )
+        )
+    _drain(sched)
+    backlog = [
+        Pod(
+            name=f"want-{i}",
+            labels={"app": "want"},
+            containers=[
+                Container(name="c", requests={"cpu": "1200m", "memory": "1Gi"})
+            ],
+        )
+        for i in range(n_backlog)
+    ]
+    placed = sched.cache.placed_pods()
+    names = [n.name for n in nodes]
+    forks = [Fork(label="baseline")]
+    rng = random.Random(14)
+    while len(forks) < k:
+        i = len(forks)
+        kind = i % 4
+        if kind == 0:
+            t = names[i % len(names)]
+            forks.append(
+                Fork(label=f"add{i}", add=tuple(
+                    (t, f"{t}~cf{i}-{j}") for j in range(1 + i % 3)
+                ))
+            )
+        elif kind == 1:
+            forks.append(Fork(label=f"cordon{i}", cordon=(names[i % len(names)],)))
+        elif kind == 2 and placed:
+            forks.append(Fork(label=f"evict{i}", evict=tuple(
+                p.uid for p in rng.sample(placed, min(4, len(placed)))
+            )))
+        else:
+            forks.append(Fork(label=f"scale{i}", scale=((names[i % len(names)], 3, 2),)))
+    # warm the kernel shape once so compile time doesn't smear the measure
+    simulate_forks(sched, forks, backlog, planner="bench_warm")
+    rt0 = sched.prom.host_roundtrips.value()
+    t0 = time.perf_counter()
+    batched = simulate_forks(sched, forks, backlog, planner="bench")
+    batched_s = time.perf_counter() - t0
+    batched_rt = sched.prom.host_roundtrips.value() - rt0
+    assert batched.engine == "kernel", "planner kernel not engaged"
+    # K sequential what-ifs: one K=1 simulate per fork (compile shared)
+    simulate_forks(sched, [forks[0]], backlog, planner="bench_warm")
+    rt1 = sched.prom.host_roundtrips.value()
+    t1 = time.perf_counter()
+    for f in forks:
+        simulate_forks(sched, [f], backlog, planner="bench_seq")
+    seq_s = time.perf_counter() - t1
+    seq_rt = sched.prom.host_roundtrips.value() - rt1
+    return len(forks), batched_s, seq_s, batched_rt, seq_rt
+
+
 def bench_density_churn(n_nodes=5000, n_pods=10000, waves=10):
     """Config 5: density replay with CHURN during scheduling
     (SchedulingWithMixedChurn, performance-config.yaml:769, floor 265
@@ -1188,6 +1269,28 @@ def main():
         print(
             f"# config13 compat: {ok13c} pods in {dt13c:.2f}s ({_mix(s13c)} "
             f"fallback_sampling_compat={cf13:g})",
+            file=sys.stderr,
+        )
+        # config14: the counterfactual planner tier (ISSUE 12; PLANNER.md)
+        # — K what-if snapshot forks through ONE fused [K, P, N] dispatch
+        # vs K sequential K=1 what-ifs.  Floor-less on this CPU-only box
+        # per the BENCH_FLOORS discipline; the dispatch ratio is the
+        # acceptance artifact (≥ K-fold fewer host round trips).
+        k14 = int(os.environ.get("BENCH_PLAN_FORKS", "64"))
+        kk, b_s, q_s, b_rt, q_rt = bench_plan(k=k14)
+        configs["config14_plan_forks"] = kk
+        configs["config14_plan_batched_s"] = round(b_s, 3)
+        configs["config14_plan_sequential_s"] = round(q_s, 3)
+        configs["config14_plan_dispatch_ratio"] = round(
+            q_rt / max(b_rt, 1), 1
+        )
+        configs["config14_plan_speedup"] = round(q_s / max(b_s, 1e-9), 2)
+        print(
+            f"# config14 plan: {kk} forks batched {b_s:.2f}s "
+            f"({b_rt:g} roundtrips) vs sequential {q_s:.2f}s "
+            f"({q_rt:g} roundtrips) — dispatch ratio "
+            f"{q_rt / max(b_rt, 1):.0f}x, wall speedup "
+            f"{q_s / max(b_s, 1e-9):.1f}x",
             file=sys.stderr,
         )
 

@@ -106,6 +106,7 @@ JIT_MODULES = (
     os.path.join("ops", "chain.py"),
     os.path.join("ops", "common.py"),
     os.path.join("ops", "coscheduling.py"),
+    os.path.join("ops", "counterfactual.py"),
     os.path.join("ops", "dra.py"),
     os.path.join("ops", "explain.py"),
     os.path.join("ops", "fastpath.py"),

@@ -94,6 +94,10 @@ DEFAULT_SIZES = {
     # (ops/preemption.py)
     "Tpt": 42,
     "B2": 43,
+    # counterfactual planner tier (ops/counterfactual.py): the leading
+    # fork axis of the batched [KF, P, N] what-if kernel ("K" is taken by
+    # label keys)
+    "KF": 45,
     "B": 64,
 }
 assert len(set(DEFAULT_SIZES.values())) == len(DEFAULT_SIZES)

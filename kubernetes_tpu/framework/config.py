@@ -187,6 +187,12 @@ class SchedulerConfiguration:
     # back to the serial one-pod host-plugin path — decision-identical for
     # DRA/volume (kill-switch identity, tests/test_coscheduling.py).
     gang_dispatch: bool = True
+    # TPU extension: the counterfactual planner tier (ops/counterfactual.py,
+    # kubernetes_tpu/planner/) — /debug/plan what-ifs ride one batched
+    # [K, P, N] kernel dispatch.  Off = the same fork specs replay through
+    # the serial forked-snapshot oracle (oracle/planner.py) — decision-
+    # identical (kill-switch identity, tests/test_planner.py).
+    planner_kernel: bool = True
     # Bit-compat knobs (SURVEY §7 "decision-identical tie-breaking"):
     # full-width evaluation is the TPU-native default; these opt into the
     # reference's sampling + randomized-tie semantics.
@@ -481,6 +487,7 @@ def load_config(source) -> SchedulerConfiguration:
         resident_window=d.get("residentWindow", 2048),
         resident_serial_tail=d.get("residentSerialTail", False),
         gang_dispatch=d.get("gangDispatch", True),
+        planner_kernel=d.get("plannerKernel", True),
         reference_sampling_compat=d.get("referenceSamplingCompat", False),
         tie_break_seed=d.get("tieBreakSeed"),
     )
@@ -540,6 +547,7 @@ def dump_config(cfg: SchedulerConfiguration) -> dict:
         "residentWindow": cfg.resident_window,
         "residentSerialTail": cfg.resident_serial_tail,
         "gangDispatch": cfg.gang_dispatch,
+        "plannerKernel": cfg.planner_kernel,
         "referenceSamplingCompat": cfg.reference_sampling_compat,
         "tieBreakSeed": cfg.tie_break_seed,
         "featureGates": dict(cfg.feature_gates),

@@ -644,6 +644,22 @@ class SchedulerMetrics:
                 "device-matching kernel (ops/dra.py).",
             )
         )
+        self.plan_forks = r.register(
+            Counter(
+                "scheduler_tpu_plan_forks_total",
+                "Counterfactual snapshot forks simulated by the planner "
+                "tier (ops/counterfactual.py) — K forks per fused "
+                "[K, P, N] dispatch.",
+            )
+        )
+        self.plan_duration = r.register(
+            Histogram(
+                "scheduler_tpu_plan_duration_seconds",
+                "End-to-end planner runs (fork packing + one fused "
+                "dispatch + readback) by planner.",
+                ("planner",),
+            )
+        )
         self.resident_rounds = r.register(
             Counter(
                 "scheduler_tpu_resident_rounds_total",

@@ -365,30 +365,50 @@ def explain_whatif(sched, pod: Pod, node_name: str) -> dict:
         finally:
             ev._hf_fwk, ev._hf_state, ev._ext_fwk, ev._ext_state = prev
             ev._fast_fit = prev_fast
-        lower = sum(
-            1
+        lower_uids = [
+            p.uid
             for p in state.nodes[node_name].pods
             if p.priority < pod.priority
-        )
-        out["lower_priority_pods"] = lower
+        ]
+        out["lower_priority_pods"] = len(lower_uids)
         if victims is None:
             out["feasible_after_preemption"] = False
             out["reason"] = (
                 "no lower-priority pods on the node"
-                if lower == 0
+                if not lower_uids
                 else "pod still does not fit after removing every "
                 "lower-priority pod"
             )
-            return out
-        out["feasible_after_preemption"] = True
-        out["num_pdb_violations"] = victims.num_pdb_violations
-        out["victims"] = [
-            {
-                "uid": v.uid,
-                "name": v.name,
-                "namespace": v.namespace,
-                "priority": v.priority,
-            }
-            for v in victims.pods
-        ]
-        return out
+            evict_uids = lower_uids
+        else:
+            out["feasible_after_preemption"] = True
+            out["num_pdb_violations"] = victims.num_pdb_violations
+            out["victims"] = [
+                {
+                    "uid": v.uid,
+                    "name": v.name,
+                    "namespace": v.namespace,
+                    "priority": v.priority,
+                }
+                for v in victims.pods
+            ]
+            evict_uids = [v.uid for v in victims.pods]
+
+    # K=1 planner-kernel reroute (outside the lock — device dispatch +
+    # compile must not stall the scheduling loop): the single
+    # counterfactual and the batched /debug/plan tier share ONE
+    # implementation (ops/counterfactual.py), so they cannot drift; the
+    # host dry run above stays as the parity reference.
+    from kubernetes_tpu.planner.plan import whatif_after_evictions
+
+    try:
+        k = whatif_after_evictions(sched, pod, node_name, evict_uids)
+    except Exception as e:  # noqa: BLE001 — debug surface must not 500
+        k = {"error": str(e)}
+    out["kernel"] = k
+    if "feasible" in k:
+        host_verdict = out["feasible_after_preemption"]
+        out["feasible_after_preemption"] = k["feasible"]
+        out["host_feasible_after_preemption"] = host_verdict
+        out["parity"] = k["feasible"] == host_verdict
+    return out

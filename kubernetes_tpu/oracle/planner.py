@@ -1,0 +1,191 @@
+"""Serial forked-snapshot oracle — the reference-shaped replay every
+planner fork must match bit-for-bit (tools/paritycheck.py
+``plan_vs_serial_oracle``; PLANNER.md).
+
+For each fork the host snapshot is forked the way a real cluster mutation
+would land: removed nodes (and their pods) vanish, cordons flip
+``unschedulable``, capacities scale in LANE space (planner/forks.
+``scale_node_lanes`` — the same integer arithmetic the kernel plane
+applies), clones materialize via ``clone_node``, and evicted pods are
+simply not placed.  The fork's live batch pods then replay through a
+``WorkloadOracle`` in the shared canonical order (workloads/gang.
+plan_batch) — gang undo logs included — which is exactly the engine the
+workloads kernel is already proven against, so planner parity reduces to
+fork-application parity.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Dict, List, Optional, Sequence
+
+from kubernetes_tpu.api.resource import Resource
+from kubernetes_tpu.oracle.state import OracleState
+from kubernetes_tpu.oracle.workloads import WorkloadOracle
+from kubernetes_tpu.planner.forks import Fork, clone_node, scale_node_lanes
+from kubernetes_tpu.snapshot.schema import MEM_UNIT
+
+# density fixed-point scale — must match ops/counterfactual.DENSITY_SCALE
+DENSITY_SCALE = 1_000_000
+
+
+def fork_cluster_host(nodes, placed, fork: Fork):
+    """Apply one fork to host objects: returns (nodes', placed') — new
+    Node objects where mutated, original pods filtered (never mutated)."""
+    by_name = {n.name: n for n in nodes}
+    removed = set(fork.remove)
+    cordoned = set(fork.cordon)
+    scaled = {name: (num, den) for name, num, den in fork.scale}
+    out_nodes = []
+    for n in nodes:
+        if n.name in removed:
+            continue
+        if n.name in scaled:
+            num, den = scaled[n.name]
+            n = scale_node_lanes(n, num, den)
+        if n.name in cordoned:
+            n = copy.copy(n)
+            n.labels = dict(n.labels)
+            n.unschedulable = True
+        out_nodes.append(n)
+    for template, clone_name in fork.add:
+        tmpl = by_name.get(template)
+        if tmpl is None:
+            raise ValueError(f"fork {fork.label!r}: unknown template {template!r}")
+        if not any(n.name == clone_name for n in out_nodes):
+            out_nodes.append(clone_node(tmpl, clone_name))
+    evicted = set(fork.evict)
+    out_placed = [
+        p
+        for p in placed
+        if p.uid not in evicted and p.node_name not in removed
+    ]
+    return out_nodes, out_placed
+
+
+def host_density_ppm(state: OracleState) -> int:
+    """The kernel's fork_density in host space: mean cpu+mem utilization
+    over schedulable-capacity nodes, computed in the same pack-lane units
+    (milli-cpu; ceil-MiB requested vs floor-MiB allocatable)."""
+    total = 0
+    n = 0
+    for ns in state.nodes.values():
+        a_cpu = ns.node.allocatable.milli_cpu
+        a_mem = ns.node.allocatable.memory // MEM_UNIT
+        if a_cpu <= 0 or a_mem <= 0:
+            continue
+        req = Resource()
+        for p in ns.pods:
+            req.add(p.compute_requests())
+        u_cpu = req.milli_cpu
+        u_mem = -(-req.memory // MEM_UNIT)
+        total += (
+            u_cpu * DENSITY_SCALE // max(a_cpu, 1)
+            + u_mem * DENSITY_SCALE // max(a_mem, 1)
+        ) // 2
+        n += 1
+    return total // max(n, 1)
+
+
+def serial_plan(
+    nodes,
+    placed,
+    pods: Sequence,
+    forks: Sequence[Fork],
+    groups: Optional[Dict] = None,
+    needs: Optional[Dict[str, int]] = None,
+    pvs=None,
+    pvcs=None,
+    namespace_labels=None,
+    target_node: Optional[str] = None,
+) -> List[dict]:
+    """Replay every fork through a fresh WorkloadOracle.  Returns one dict
+    per fork: placements (live pods only), admitted/unschedulable counts,
+    density_ppm, gang_admitted, and (with ``target_node``) per-pod
+    feasibility at the target."""
+    groups = groups or {}
+    out: List[dict] = []
+    for fork in forks:
+        f_nodes, f_placed = fork_cluster_host(nodes, placed, fork)
+        state = OracleState.build(
+            f_nodes, f_placed, namespace_labels=namespace_labels
+        )
+        # bound counts pre-credited: the kernel's gang_need arrays carry
+        # the remaining need, so the oracle's window starts from the same
+        # quorum arithmetic
+        bound = {}
+        for key, pg in groups.items():
+            if needs is not None and pg is not None:
+                bound[key] = max(0, pg.min_member - needs.get(key, pg.min_member))
+        oracle = WorkloadOracle(
+            state=state,
+            pvs=dict(_items(pvs)) if pvs is not None else {},
+            pvcs=dict(_items(pvcs)) if pvcs is not None else {},
+            groups=dict(groups),
+            bound=bound,
+        )
+        live = (
+            {uid for uid in fork.live}
+            if fork.live is not None
+            else {p.uid for p in pods}
+        )
+        # Non-live pods are inert in the kernel scan (they commit nothing
+        # and influence nothing), so replaying only the live subset in its
+        # preserved relative order is exactly equivalent.
+        batch = [copy.deepcopy(p) for p in pods if p.uid in live]
+        live_names = {p.name for p in batch}
+        res = oracle.schedule(batch)
+        placements = {
+            name: node
+            for name, node in res.placements.items()
+            if name in live_names
+        }
+        admitted = sum(1 for v in placements.values() if v)
+        fork_out = {
+            "label": fork.label,
+            "placements": placements,
+            "admitted": admitted,
+            "unschedulable": len(placements) - admitted,
+            "density_ppm": host_density_ppm(state),
+            "gang_admitted": {
+                k: (1 if v else 0) for k, v in res.gang_admitted.items()
+            },
+        }
+        if target_node is not None:
+            # feasibility-at-target is judged against the FORKED initial
+            # state (the K=1 what-if contract: single-pod batches)
+            t_ok = {}
+            f2_nodes, f2_placed = fork_cluster_host(nodes, placed, fork)
+            st2 = OracleState.build(
+                f2_nodes, f2_placed, namespace_labels=namespace_labels
+            )
+            probe = WorkloadOracle(
+                state=st2,
+                pvs=dict(_items(pvs)) if pvs is not None else {},
+                pvcs=dict(_items(pvcs)) if pvcs is not None else {},
+                groups=dict(groups),
+            )
+            from kubernetes_tpu.oracle.pipeline import feasible_nodes
+
+            for p in pods:
+                if p.uid not in live:
+                    continue
+                fit = feasible_nodes(p, st2)
+                ok = target_node in fit.feasible and probe._vol_ok(
+                    p, target_node
+                )
+                t_ok[p.name] = bool(ok)
+            fork_out["target_ok"] = t_ok
+        out.append(fork_out)
+    return out
+
+
+def _items(cache):
+    """dict(...) over either a mapping or an AssumeCache-style object."""
+    if cache is None:
+        return ()
+    if hasattr(cache, "items"):
+        return cache.items()
+    if hasattr(cache, "list"):
+        return ((getattr(o, "key", getattr(o, "name", None)), o) for o in cache.list())
+    return ()

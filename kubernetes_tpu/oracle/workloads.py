@@ -179,6 +179,8 @@ class WorkloadOracle:
             undo.append(("claim", claim, keys))
 
     def _vol_ok(self, pod, node_name: str) -> bool:
+        from kubernetes_tpu.api import storage as st
+        from kubernetes_tpu.framework.volume_plugins import _zone_value_set
         from kubernetes_tpu.framework.volumebinding import (
             pv_node_affinity_matches,
         )
@@ -195,6 +197,16 @@ class WorkloadOracle:
                 ns = self.state.nodes.get(node_name)
                 if ns is None or not pv_node_affinity_matches(pv, ns.node):
                     return False
+                # zone/region-LABELED PVs (volume_zone.go:109): every
+                # topology label must match the node's — the kernel packs
+                # these as per-label In-conjunctions in _vol_tables
+                for key in st.VOLUME_TOPOLOGY_LABELS:
+                    if key in pv.labels:
+                        node_val = ns.node.labels.get(key)
+                        if node_val is None or node_val not in _zone_value_set(
+                            pv.labels[key]
+                        ):
+                            return False
             else:
                 return False  # unbound claims never reach the kernel path
         return True

@@ -1,0 +1,26 @@
+"""Counterfactual fleet planners over the batched [K, P, N] what-if kernel.
+
+See PLANNER.md.  ``Fork`` + ``pack_forks`` build forked-snapshot planes
+off the mirror; ``simulate_forks`` runs K what-ifs in one fused dispatch;
+``plan_autoscale`` / ``plan_deschedule`` / ``plan_preempt_cost`` are the
+planner catalogue behind ``/debug/plan``.
+"""
+
+from kubernetes_tpu.planner.forks import (  # noqa: F401
+    Fork,
+    PackedForks,
+    clone_node,
+    pack_forks,
+    scale_node_lanes,
+)
+from kubernetes_tpu.planner.plan import (  # noqa: F401
+    PLANNERS,
+    SimResult,
+    backlog_pods,
+    plan_autoscale,
+    plan_deschedule,
+    plan_preempt_cost,
+    run_planner,
+    simulate_forks,
+    whatif_after_evictions,
+)

@@ -1,0 +1,333 @@
+"""Fork specs + the snapshot fork packer off the mirror.
+
+A ``Fork`` names one counterfactual mutation set over the live snapshot —
+nodes added (cloned from an existing shape), removed, or cordoned,
+capacities scaled, placed pods evicted, and which batch pods the fork
+simulates.  ``pack_forks`` turns a list of forks into the [K, …] fork
+planes ``ops.counterfactual.counterfactual_run`` consumes, built off the
+SnapshotMirror's packed tensors so every untouched plane is byte-shared
+with the production engine's view.
+
+Exactness contract (what makes kernel-vs-oracle parity a theorem rather
+than a hope): every per-fork plane must equal what packing the MUTATED
+cluster from scratch would produce at the same slots.
+
+  * evictions recompute the touched node's usage rows from the remaining
+    pods' Resources in the mirror's own pack arithmetic (request_row /
+    ceil-MiB nonzero totals) — subtracting a quantized per-pod row would
+    drift on the ceil;
+  * capacity scaling is defined in LANE space (``row * num // den``) and
+    ``scale_node_lanes`` builds the host-side Node the same way, so the
+    oracle's byte-space view re-packs to exactly the scaled lanes;
+  * clones are written with the same ``write_node_row`` the mirror uses,
+    from a cloned Node object the oracle forks share (``clone_node``);
+  * removed (and not-added) slots are neutralized in-kernel
+    (ops/counterfactual.fork_cluster_view), which the oracle mirrors by
+    simply not materializing the node.
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from kubernetes_tpu.api.resource import Resource
+from kubernetes_tpu.api.types import Node
+from kubernetes_tpu.oracle.scores import HOSTNAME_LABEL
+from kubernetes_tpu.snapshot.interner import ABSENT, PAD
+from kubernetes_tpu.snapshot.schema import (
+    MEM_UNIT,
+    ResourceLanes,
+    bucket_cap,
+    write_node_row,
+)
+
+
+@dataclass(frozen=True)
+class Fork:
+    """One counterfactual: mutations + the batch pods it simulates.
+
+    ``live`` is the uid set of batch pods this fork schedules (None = all);
+    ``add`` entries are (template node name, clone name) — clone slots are
+    shared across forks by clone NAME, so fork "add 3×shape-A" reuses the
+    slots fork "add 2×shape-A" allocated plus one more.
+    """
+
+    label: str = ""
+    evict: Tuple[str, ...] = ()  # placed-pod uids
+    cordon: Tuple[str, ...] = ()  # node names
+    remove: Tuple[str, ...] = ()  # node names
+    add: Tuple[Tuple[str, str], ...] = ()  # (template name, clone name)
+    scale: Tuple[Tuple[str, int, int], ...] = ()  # (node name, num, den)
+    live: Optional[Tuple[str, ...]] = None  # batch pod uids (None = all)
+    meta: Tuple[Tuple[str, object], ...] = ()  # planner-private annotations
+
+
+def clone_node(template: Node, name: str) -> Node:
+    """A schedulable copy of ``template`` under a fresh identity: new name,
+    new (unique) hostname label, zero usage.  Shared by the fork packer and
+    the serial oracle fork so both sides pack the identical row."""
+    n = copy.deepcopy(template)
+    n.name = name
+    n.labels = dict(n.labels)
+    if HOSTNAME_LABEL in n.labels:
+        n.labels[HOSTNAME_LABEL] = name
+    return n
+
+
+def scale_node_lanes(node: Node, num: int, den: int) -> Node:
+    """Capacity scaling defined in pack-lane space: milli-cpu, MiB memory /
+    ephemeral lanes, and extended scalars each become ``v * num // den``.
+    The returned Node re-packs to exactly ``allocatable_row * num // den``,
+    which is what the kernel plane applies — byte-space and lane-space
+    views cannot drift."""
+    r = node.allocatable
+    scaled = Resource(
+        milli_cpu=r.milli_cpu * num // den,
+        memory=((r.memory // MEM_UNIT) * num // den) * MEM_UNIT,
+        ephemeral_storage=((r.ephemeral_storage // MEM_UNIT) * num // den)
+        * MEM_UNIT,
+        allowed_pod_number=r.allowed_pod_number,
+        scalars={k: v * num // den for k, v in r.scalars.items()},
+    )
+    n = copy.copy(node)
+    n.labels = dict(node.labels)
+    n.allocatable = scaled
+    return n
+
+
+@dataclass
+class PackedForks:
+    """The kernel's fork planes + the bookkeeping to read results back."""
+
+    planes: Dict[str, np.ndarray]  # fk_* arrays, [K, ...]
+    nt: object  # the EXTENDED NodeTensors (clone slots appended)
+    clone_slots: Dict[str, int]  # clone name → node slot
+    k_used: int  # real forks (the rest is identity padding)
+    names: List[str]  # slot → node name (clones included)
+
+
+def _extend_node_tensors(nt, clones: Dict[str, Node], vocab):
+    """Copy of ``nt`` with clone rows appended (base-invalid; forks flip
+    their own alive bits).  Grows the node bucket only when the clones
+    outrun the padding."""
+    n_used = len(nt.name_to_idx)
+    need = n_used + len(clones)
+    if need <= nt.n_cap:
+        ext = copy.copy(nt)
+        for f in (
+            "allocatable",
+            "requested",
+            "nonzero_req",
+            "num_pods",
+            "allowed_pods",
+            "label_vals",
+            "val_ints",
+            "taint_key",
+            "taint_val",
+            "taint_effect",
+            "unschedulable",
+            "valid",
+            "used_ppk",
+            "used_ip",
+            "used_wild",
+            "img_sizes",
+            "visit_rank",
+        ):
+            setattr(ext, f, np.array(getattr(nt, f)))
+        ext.names = list(nt.names)
+        ext.name_to_idx = dict(nt.name_to_idx)
+    else:
+        n_cap = bucket_cap(need)
+        ext = copy.copy(nt)
+
+        def grow(a, fill):
+            out = np.full((n_cap,) + a.shape[1:], fill, a.dtype)
+            out[: a.shape[0]] = a
+            return out
+
+        ext.allocatable = grow(nt.allocatable, 0)
+        ext.requested = grow(nt.requested, 0)
+        ext.nonzero_req = grow(nt.nonzero_req, 0)
+        ext.num_pods = grow(nt.num_pods, 0)
+        ext.allowed_pods = grow(nt.allowed_pods, 0)
+        ext.label_vals = grow(nt.label_vals, ABSENT)
+        ext.val_ints = np.array(nt.val_ints)
+        ext.taint_key = grow(nt.taint_key, PAD)
+        ext.taint_val = grow(nt.taint_val, PAD)
+        ext.taint_effect = grow(nt.taint_effect, PAD)
+        ext.unschedulable = grow(nt.unschedulable, False)
+        ext.valid = grow(nt.valid, False)
+        ext.used_ppk = grow(nt.used_ppk, PAD)
+        ext.used_ip = grow(nt.used_ip, PAD)
+        ext.used_wild = grow(nt.used_wild, False)
+        ext.img_sizes = grow(nt.img_sizes, 0)
+        ext.visit_rank = grow(nt.visit_rank, -1)
+        ext.names = list(nt.names)
+        ext.name_to_idx = dict(nt.name_to_idx)
+
+    slots: Dict[str, int] = {}
+    cursor = n_used
+    for name, node in clones.items():
+        write_node_row(ext, cursor, node, vocab)
+        # base-invalid + zero visit rank state: alive only per fork; the
+        # planner path never samples, so the rank is inert anyway
+        ext.valid[cursor] = False
+        ext.visit_rank[cursor] = -1
+        slots[name] = cursor
+        cursor += 1
+    return ext, slots
+
+
+def collect_clones(forks: Sequence[Fork], node_by_name) -> Dict[str, Node]:
+    """Clone name → cloned Node object, deduped across forks.  Raises on an
+    unknown template or a clone name colliding with a real node."""
+    out: Dict[str, Node] = {}
+    for f in forks:
+        for template, clone_name in f.add:
+            if clone_name in out:
+                continue
+            tmpl = node_by_name.get(template)
+            if tmpl is None:
+                raise ValueError(f"fork {f.label!r}: unknown template node {template!r}")
+            if clone_name in node_by_name:
+                raise ValueError(
+                    f"fork {f.label!r}: clone name {clone_name!r} collides with a real node"
+                )
+            out[clone_name] = clone_node(tmpl, clone_name)
+    return out
+
+
+def pack_forks(
+    mirror,
+    cache,
+    forks: Sequence[Fork],
+    batch_uids: Sequence[str],
+    p_cap: int,
+    k_cap: Optional[int] = None,
+    clones: Optional[Dict[str, Node]] = None,
+) -> PackedForks:
+    """Build the [K, …] fork planes off the mirror's packed snapshot.
+
+    Caller holds the scheduler lock and has already synced/repacked the
+    mirror (and interned every clone's labels — ``collect_clones`` runs
+    before the repack so a val-bucket overflow forces the full pack the
+    mirror already knows how to do).
+    """
+    vocab = mirror.vocab
+    node_by_name = {cn.node.name: cn for cn in cache.real_nodes()}
+    if clones is None:
+        clones = collect_clones(
+            forks, {n: cn.node for n, cn in node_by_name.items()}
+        )
+    nt, clone_slots = _extend_node_tensors(mirror.nodes, clones, vocab)
+    existing = mirror.existing
+    epod_slot = {
+        uid: slot for uid, (slot, _pod) in (mirror._epod_slots or {}).items()
+    }
+    epod_node = np.asarray(existing.node_idx)
+    lanes = ResourceLanes(vocab)
+    R = nt.allocatable.shape[1]
+
+    K = len(forks)
+    k_pad = k_cap or bucket_cap(max(K, 1), 1)
+    N = nt.n_cap
+    E = existing.valid.shape[0]
+    base_valid = np.asarray(nt.valid, bool)
+    base_epod_valid = np.asarray(existing.valid, bool)
+
+    fk_alive = np.broadcast_to(base_valid, (k_pad, N)).copy()
+    fk_unsched = np.broadcast_to(np.asarray(nt.unschedulable, bool), (k_pad, N)).copy()
+    fk_alloc = np.broadcast_to(nt.allocatable, (k_pad, N, R)).copy()
+    fk_req = np.broadcast_to(nt.requested, (k_pad, N, R)).copy()
+    fk_nz = np.broadcast_to(nt.nonzero_req, (k_pad, N, 2)).copy()
+    fk_npods = np.broadcast_to(nt.num_pods, (k_pad, N)).copy()
+    fk_epod_valid = np.broadcast_to(base_epod_valid, (k_pad, E)).copy()
+    fk_pod_live = np.zeros((k_pad, p_cap), bool)
+    fk_pod_live[:K, : len(batch_uids)] = True  # padding forks: no live pods
+    fk_pod_live[K:, :] = False
+    uid_pos = {uid: i for i, uid in enumerate(batch_uids)}
+
+    for k, f in enumerate(forks):
+        for _template, clone_name in f.add:
+            fk_alive[k, clone_slots[clone_name]] = True
+        for name in f.remove:
+            slot = nt.name_to_idx.get(name)
+            if slot is None:
+                raise ValueError(f"fork {f.label!r}: unknown node {name!r}")
+            fk_alive[k, slot] = False
+            fk_epod_valid[k] &= epod_node != slot
+        for name in f.cordon:
+            slot = nt.name_to_idx.get(name)
+            if slot is None:
+                raise ValueError(f"fork {f.label!r}: unknown node {name!r}")
+            fk_unsched[k, slot] = True
+        for name, num, den in f.scale:
+            slot = nt.name_to_idx.get(name)
+            if slot is None:
+                raise ValueError(f"fork {f.label!r}: unknown node {name!r}")
+            fk_alloc[k, slot] = fk_alloc[k, slot].astype(np.int64) * num // den
+        if f.evict:
+            evicted = set(f.evict)
+            touched: Dict[str, None] = {}
+            for uid in f.evict:
+                slot = epod_slot.get(uid)
+                if slot is None:
+                    raise ValueError(
+                        f"fork {f.label!r}: evicted pod {uid!r} is not placed"
+                    )
+                fk_epod_valid[k, slot] = False
+                node_name = (
+                    nt.names[epod_node[slot]]
+                    if 0 <= epod_node[slot] < len(nt.names)
+                    else None
+                )
+                if node_name is not None:
+                    touched[node_name] = None
+            # exact pack arithmetic: recompute each touched node's usage
+            # rows from the REMAINING pods' Resources (the mirror's own
+            # formulas) — subtracting quantized rows would drift on ceils
+            for node_name in touched:
+                cn = node_by_name.get(node_name)
+                slot = nt.name_to_idx[node_name]
+                remaining = [
+                    p for p in cn.pods.values() if p.uid not in evicted
+                ]
+                req = Resource()
+                nz = Resource()
+                for p in remaining:
+                    pr = p.compute_requests()
+                    req.add(pr)
+                    nz.add(pr.non_zero_defaulted())
+                fk_req[k, slot] = lanes.request_row(req, R)
+                fk_nz[k, slot, 0] = nz.milli_cpu
+                fk_nz[k, slot, 1] = -(-nz.memory // MEM_UNIT)
+                fk_npods[k, slot] = len(remaining)
+        if f.live is not None:
+            fk_pod_live[k, :] = False
+            for uid in f.live:
+                pos = uid_pos.get(uid)
+                if pos is not None:
+                    fk_pod_live[k, pos] = True
+
+    planes = dict(
+        fk_alive=fk_alive,
+        fk_unsched=fk_unsched,
+        fk_alloc=fk_alloc.astype(np.int32),
+        fk_req=fk_req.astype(np.int32),
+        fk_nz=fk_nz.astype(np.int32),
+        fk_npods=fk_npods.astype(np.int32),
+        fk_epod_valid=fk_epod_valid,
+        fk_nvalid=fk_alive.sum(axis=1).astype(np.int32),
+        fk_pod_live=fk_pod_live,
+    )
+    return PackedForks(
+        planes=planes,
+        nt=nt,
+        clone_slots=clone_slots,
+        k_used=K,
+        names=list(nt.names),
+    )

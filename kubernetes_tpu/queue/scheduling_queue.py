@@ -380,6 +380,39 @@ class SchedulingQueue:
             )
         return out
 
+    def pop_siblings(self, match) -> List[QueuedPodInfo]:
+        """Pop every ACTIVE pod matching ``match`` regardless of heap
+        position — the gang sibling-pull feed: popping one member pulls
+        its READY siblings into the same batch, so a gang split across pop
+        batches converges in one dispatch instead of by retry.  Pods in
+        backoff / unschedulable / gated stay put (their gates still
+        apply).  Matched entries are removed in QueueSort order; everyone
+        else keeps their positions exactly (stale heap entries
+        lazy-delete, the discipline pop_batch already relies on)."""
+        picked = [
+            entry
+            for entry in self._active
+            if self._entry_live(entry[2], entry[1], "active")
+            and match(entry[2])
+        ]
+        picked.sort(key=lambda e: (e[0], e[1]))
+        out: List[QueuedPodInfo] = []
+        for _key, eid, qp in picked:
+            if not self._entry_live(qp, eid, "active"):
+                continue
+            del self._in_queue[qp.uid]
+            self._live.pop(qp.uid, None)
+            self._items.pop(qp.uid, None)
+            qp.attempts += 1
+            self._in_flight[qp.uid] = []
+            out.append(qp)
+        fr = self.flight
+        if fr is not None and fr.enabled:
+            fr.record_many(
+                (qp.uid, "pop", {"attempt": qp.attempts}) for qp in out
+            )
+        return out
+
     def pop(self) -> Optional[QueuedPodInfo]:
         batch = self.pop_batch(1)
         return batch[0] if batch else None

@@ -82,6 +82,7 @@ _KTPU_GUARDED = {
             "_sync_mirror_external",
             "_wave_tables",
             "_hostnames_unique",
+            "_pull_gang_siblings",
         ],
     },
     "Nominator": {
@@ -870,6 +871,12 @@ class Scheduler:
             t_pop = time.perf_counter()
             with self._mu:
                 batch = self.queue.pop_batch(self.config.batch_size)
+                if batch and self.config.gang_dispatch:
+                    # gang sibling-pull: a gang split across pop batches
+                    # previously converged by waiting-retry; pull its
+                    # ready members into THIS batch so quorum is judged
+                    # once (PR 10 remainder; cheap for gang-free batches)
+                    batch.extend(self._pull_gang_siblings(batch))
             self.phases.add("queue_pop", time.perf_counter() - t_pop)
             if not batch:
                 break
@@ -2323,6 +2330,31 @@ class Scheduler:
     # mask.  Behind the gangDispatch kill-switch; bit-identical to the
     # serial gang/DRA oracle (oracle/workloads.py, paritycheck.py).
 
+    def _pull_gang_siblings(self, batch):
+        """Queue-level gang sibling-pull: when a popped batch carries gang
+        members whose quorum the batch itself cannot cover, pop the gangs'
+        remaining ACTIVE members into the same batch (QueueSort order
+        preserved among them).  Backoff/unschedulable members stay parked —
+        their gates still apply — so an uncoverable gang still takes the
+        waiting/timeout barrier, just without burning an attempt per pop
+        split.  Caller holds _mu.  Gang-free batches pay one dict probe
+        per pod and never scan the queue."""
+        present: Dict[str, int] = {}
+        for qp in batch:
+            key = self._workloads_group_of(qp.pod)
+            if key is not None:
+                present[key] = present.get(key, 0) + 1
+        wanted = set()
+        for key, n in present.items():
+            pg = self.gangs.get(key)
+            if pg is not None and n + self.gangs.bound_count(key) < pg.min_member:
+                wanted.add(key)
+        if not wanted:
+            return []
+        return self.queue.pop_siblings(
+            lambda qp: self._workloads_group_of(qp.pod) in wanted
+        )
+
     def _workloads_group_of(self, pod):
         """Gang key of a pod, or None when it has no REGISTERED PodGroup
         (pods referencing an unknown group schedule as ordinary pods)."""
@@ -2398,12 +2430,13 @@ class Scheduler:
         """Post-PreFilter coverage check: every host Filter plugin still
         ACTIVE for some pod must be one the kernel replaces —
         DynamicResources (the batched allocator), VolumeBinding
-        (bound-topology kernel mask; _vol_kernel_ok pre-checked), or
-        NodeVolumeLimits when no CSINode advertises limits (its Filter is
-        then a constant success).  Anything else falls back to the serial
-        split path."""
+        (bound-topology kernel mask; _vol_kernel_ok pre-checked),
+        VolumeZone (zone-labeled PV constraints fold into the same mask as
+        per-label In-conjunctions — _vol_tables), or NodeVolumeLimits when
+        no CSINode advertises limits (its Filter is then a constant
+        success).  Anything else falls back to the serial split path."""
         for p in fwk.host_filter_plugins():
-            if p.name == "DynamicResources" or p.name == "VolumeBinding":
+            if p.name in ("DynamicResources", "VolumeBinding", "VolumeZone"):
                 continue
             if p.name == "NodeVolumeLimits" and not self.csinodes:
                 continue
@@ -2422,13 +2455,25 @@ class Scheduler:
     def _vol_tables(self, pods, p_cap: int, vocab):
         """Pack bound-PV node-affinity DNFs into the volume-topology kernel
         mask's tables: one PV per PV2 slot, ORed selector terms on the
-        DTable term axis (ops/coscheduling.volume_topology_mask).  Returns
-        None when no pod carries an affinity-constrained bound PV."""
+        DTable term axis (ops/coscheduling.volume_topology_mask).  A PV
+        carrying zone/region LABELS (the pre-CSI topology convention the
+        VolumeZone plugin judges) contributes one extra slot whose single
+        conjunction requires ``key In zone-set`` per topology label — the
+        AND across slots reproduces volume_zone.go's every-label-must-
+        match semantics, so zone-labeled shapes ride the kernel instead of
+        falling back to the serial path.  Returns None when no pod
+        carries an affinity- or zone-constrained bound PV."""
         import numpy as np
 
+        from kubernetes_tpu.api import labels as k8slabels
+        from kubernetes_tpu.api import storage as storage_api
+        from kubernetes_tpu.framework.volume_plugins import _zone_value_set
         from kubernetes_tpu.ops.common import DTable
         from kubernetes_tpu.snapshot.schema import pack_conjunction_table
-        from kubernetes_tpu.snapshot.selectors import compile_node_selector_dnf
+        from kubernetes_tpu.snapshot.selectors import (
+            CompiledRequirements,
+            compile_node_selector_dnf,
+        )
 
         per_pod: List[list] = []
         bad = np.zeros((p_cap,), bool)
@@ -2444,6 +2489,17 @@ class Scheduler:
                 if pv is None:
                     bad[i] = True
                     continue
+                zone_c = CompiledRequirements()
+                for key in storage_api.VOLUME_TOPOLOGY_LABELS:
+                    if key in pv.labels:
+                        zone_c.add(
+                            key,
+                            k8slabels.IN,
+                            sorted(_zone_value_set(pv.labels[key])),
+                            vocab,
+                        )
+                if zone_c.n_reqs:
+                    rows.append([zone_c])
                 if pv.node_affinity is None:
                     continue  # nil affinity matches everywhere
                 rows.append(compile_node_selector_dnf(pv.node_affinity, vocab))

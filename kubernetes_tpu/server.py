@@ -278,6 +278,9 @@ class SchedulerServer:
                   /debug/flightrecorder?pod=<uid|name>    default: stats
                   /debug/explain?pod=<uid|name>[&whatif_node=<node>]
                   /debug/slo?action=status|trace          default: status
+                  /debug/plan?planner=autoscale|deschedule|preempt_cost
+                      [&shapes=a,b][&max_count=N][&max_candidates=N]
+                      default: the planner catalogue
                 """
                 q = parse_qs(parsed.query)
                 path = parsed.path
@@ -375,6 +378,18 @@ class SchedulerServer:
                     self._send_json(
                         explain_pod(sched, pod, max_nodes=max_nodes)
                     )
+                elif path == "/debug/plan":
+                    # the counterfactual planner tier (PLANNER.md): K
+                    # what-if snapshot forks per fused device dispatch —
+                    # autoscale / deschedule / preemption-cost planning
+                    # the reference delegates to satellite projects
+                    from kubernetes_tpu.planner import PLANNERS, run_planner
+
+                    name = q.get("planner", ["list"])[0]
+                    params = {k: v[0] for k, v in q.items()}
+                    out = run_planner(sched, name, params)
+                    bad = name != "list" and name not in PLANNERS
+                    self._send_json(out, code=400 if bad else 200)
                 elif path == "/debug/slo":
                     # the steady-state SLO tier (observability/slo.py):
                     # live SLI snapshot + per-stage breakdown + last-breach

@@ -769,6 +769,155 @@ def _drain_workloads(
     return got, s
 
 
+def check_plan_vs_oracle(
+    n_nodes=60, n_fill=1500, n_backlog=32, k=24, seed=991
+) -> dict:
+    """Counterfactual planner tier vs the serial forked-snapshot oracle
+    (PLANNER.md): K mixed forks — clone-adds, cordons, evictions,
+    capacity scales, removals — over a spread-constrained backlog with a
+    gang, per-fork placements / gang verdicts / admission counts /
+    density bit-identical.  Fails loud when the K-vmap kernel path is not
+    engaged (kernel must cost exactly ONE dispatch for all K forks)."""
+    from kubernetes_tpu.api.types import (
+        Container,
+        LabelSelector,
+        Pod,
+        TopologySpreadConstraint,
+    )
+    from kubernetes_tpu.framework.config import SchedulerConfiguration
+    from kubernetes_tpu.planner import Fork, simulate_forks
+    from kubernetes_tpu.scheduler import Scheduler
+    from kubernetes_tpu.testing import FakeCluster
+    from kubernetes_tpu.workloads.gang import PodGroup
+
+    rng = random.Random(seed)
+    t0 = time.perf_counter()
+    api = FakeCluster()
+    sched = Scheduler(configuration=SchedulerConfiguration(batch_size=4096))
+    api.connect(sched)
+    for n in _basic_nodes(n_nodes, zones=3):
+        api.create_node(n)
+    for p in _basic_pods(n_fill, seed=seed):
+        p.priority = 2
+        api.create_pod(p)
+    sched.schedule_pending()
+    backlog = []
+    for i in range(n_backlog):
+        tsc = ()
+        if i % 3 == 0:
+            tsc = (
+                TopologySpreadConstraint(
+                    max_skew=1,
+                    topology_key="topology.kubernetes.io/zone",
+                    when_unsatisfiable="DoNotSchedule",
+                    label_selector=LabelSelector(
+                        match_labels={"app": "plan"}
+                    ),
+                ),
+            )
+        backlog.append(
+            Pod(
+                name=f"plan-{i}",
+                labels={"app": "plan"},
+                topology_spread_constraints=tsc,
+                containers=[
+                    Container(
+                        name="c",
+                        requests={
+                            "cpu": f"{rng.choice([500, 900, 1500])}m",
+                            "memory": "256Mi",
+                        },
+                    )
+                ],
+            )
+        )
+    with sched._mu:
+        sched.gangs.upsert(PodGroup(name="pg", min_member=3))
+    backlog += [
+        Pod(
+            name=f"pg-{m}",
+            pod_group="pg",
+            containers=[
+                Container(name="c", requests={"cpu": "700m", "memory": "128Mi"})
+            ],
+        )
+        for m in range(3)
+    ]
+    placed = sched.cache.placed_pods()
+    names = [f"node-{i}" for i in range(n_nodes)]
+    forks = [Fork(label="baseline")]
+    while len(forks) < k:
+        i = len(forks)
+        kind = i % 5
+        if kind == 0:
+            t = rng.choice(names)
+            forks.append(
+                Fork(
+                    label=f"add{i}",
+                    add=tuple(
+                        (t, f"{t}~cf{i}-{j}") for j in range(1 + i % 3)
+                    ),
+                )
+            )
+        elif kind == 1:
+            forks.append(
+                Fork(label=f"cordon{i}", cordon=(rng.choice(names),))
+            )
+        elif kind == 2:
+            forks.append(
+                Fork(
+                    label=f"evict{i}",
+                    evict=tuple(
+                        p.uid
+                        for p in rng.sample(placed, min(6, len(placed)))
+                    ),
+                )
+            )
+        elif kind == 3:
+            forks.append(
+                Fork(
+                    label=f"scale{i}",
+                    scale=((rng.choice(names), rng.choice([1, 3]), 2),),
+                )
+            )
+        else:
+            forks.append(
+                Fork(label=f"remove{i}", remove=(rng.choice(names),))
+            )
+    kern = simulate_forks(sched, forks, backlog, planner="paritycheck")
+    serial = simulate_forks(
+        sched, forks, backlog, planner="paritycheck", use_kernel=False
+    )
+    diffs: List = []
+    if kern.engine != "kernel" or kern.dispatches != 1:
+        diffs.append(
+            ("__kernel_engaged__", (kern.engine, kern.dispatches), ("kernel", 1))
+        )
+    for fk, fs in zip(kern.forks, serial.forks):
+        for key in (
+            "placements",
+            "admitted",
+            "unschedulable",
+            "density_ppm",
+            "gang_admitted",
+        ):
+            if fk[key] != fs[key]:
+                diffs.append((f"{fk['label']}:{key}", fk[key], fs[key]))
+    return {
+        "nodes": n_nodes,
+        "fill": n_fill,
+        "backlog": len(backlog),
+        "forks": len(forks),
+        "kernel_dispatches": kern.dispatches,
+        "admitted_baseline": kern.forks[0]["admitted"],
+        "diffs": len(diffs),
+        "first_diffs": [
+            (lbl, str(a)[:120], str(b)[:120]) for lbl, a, b in diffs[:5]
+        ],
+        "wall_s": round(time.perf_counter() - t0, 1),
+    }
+
+
 def run_checks(ns_nodes=10000, ns_pods=50000) -> dict:
     checks = {
         "cross_batch_devfast_vs_hostgreedy": check_cross_batch(
@@ -781,6 +930,7 @@ def run_checks(ns_nodes=10000, ns_pods=50000) -> dict:
         "resident_drain_vs_serial_oracle": check_resident_vs_oracle(),
         "gang_admission_vs_serial_oracle": check_gang_vs_oracle(),
         "dra_allocation_vs_serial_oracle": check_dra_vs_oracle(),
+        "plan_vs_serial_oracle": check_plan_vs_oracle(),
     }
     return {
         "checks": checks,

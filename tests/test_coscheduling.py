@@ -673,3 +673,107 @@ def test_gang_semantics_survive_mixed_batch(sanitize_on):
     assert got["porty"] == "node-0"
     assert got["m-0"] is None and got["m-1"] is None, got
     assert sched.metrics["gang_rolled_back"] == 1
+
+
+def _zone_labeled_pv(api, name, zone):
+    """Pre-CSI convention: zone constraint carried as PV LABELS (what the
+    VolumeZone plugin judges), no nodeAffinity."""
+    pv = st.PersistentVolume(
+        name=f"pv-{name}",
+        capacity=10,
+        access_modes=("ReadWriteOnce",),
+        storage_class_name="std",
+        labels={"topology.kubernetes.io/zone": zone},
+        phase=st.PV_BOUND,
+        claim_ref=st.ObjectRef("default", name),
+    )
+    pvc = st.PersistentVolumeClaim(
+        name=name,
+        namespace="default",
+        request=10,
+        access_modes=("ReadWriteOnce",),
+        storage_class_name="std",
+        volume_name=pv.name,
+        phase=st.PVC_BOUND,
+    )
+    api.pvs.create(pv)
+    api.pvcs.create(pvc)
+    return pvc
+
+
+def test_pv_zone_labels_ride_workloads_kernel(sanitize_on):
+    """PR 10 remainder closed: zone-LABELED PVs fold into the volume
+    kernel mask as per-label In-conjunctions instead of falling back to
+    the serial VolumeZone path — the pod lands in the PV's zone THROUGH
+    the workloads dispatch."""
+    api, sched = _vol_env()
+    _zone_labeled_pv(api, "zl-b", "zone-b")
+    _zone_labeled_pv(api, "zl-none", "zone-z")  # no node carries zone-z
+    api.create_pod(_vol_pod("zoned", "zl-b"))
+    api.create_pod(_vol_pod("nowhere", "zl-none"))
+    got, outs = drain(api, sched)
+    assert got["zoned"] in ("node-2", "node-3")
+    assert got["nowhere"] is None
+    assert sched.metrics["workload_batches"] >= 1, (
+        "zone-labeled volume shape fell back to the serial path"
+    )
+
+
+def test_pv_zone_labels_kill_switch_identity(sanitize_on):
+    """Kernel-vs-serial identity for zone-labeled PVs, multi-zone ("__"
+    separated) label sets included."""
+    def run(gang_dispatch):
+        api, sched = _vol_env(gang_dispatch=gang_dispatch)
+        _zone_labeled_pv(api, "z0", "zone-b")
+        _zone_labeled_pv(api, "z1", "zone-a__zone-b")  # multi-zone set
+        _zone_labeled_pv(api, "z2", "zone-z")  # infeasible
+        for i, claim in enumerate(("z0", "z1", "z2")):
+            api.create_pod(_vol_pod(f"zp{i}", claim))
+        got, _ = drain(api, sched)
+        return got
+
+    kernel = run(True)
+    serial = run(False)
+    assert kernel == serial, (kernel, serial)
+    assert kernel["zp0"] in ("node-2", "node-3")
+    assert kernel["zp1"] is not None
+    assert kernel["zp2"] is None
+
+
+def test_gang_sibling_pull_single_dispatch(sanitize_on):
+    """PR 10 remainder closed: a gang split across pop batches converges
+    in ONE workloads dispatch — popping one member pulls its ready
+    siblings into the batch instead of burning a waiting-retry attempt
+    per split."""
+    api, sched = build_env(batch_size=3)
+    for i in range(4):
+        api.create_node(make_node(f"node-{i}", cpu="4"))
+    api.pod_groups.create(PodGroup(name="big", min_member=6))
+    for m in range(6):
+        api.create_pod(mkpod(f"big-{m}", group="big", cpu="100m"))
+    got, outs = drain(api, sched)
+    assert all(got[f"big-{m}"] for m in range(6)), got
+    assert sched.metrics["workload_batches"] == 1, (
+        "gang split across pop batches needed more than one dispatch"
+    )
+    # exactly one attempt per member: no waiting-retry churn
+    for o in outs:
+        assert o.pod_attempts == 1, (o.pod.name, o.pod_attempts)
+
+
+def test_gang_sibling_pull_mixed_batch(sanitize_on):
+    """Sibling-pull in a MIXED batch: plain pods around the gang keep
+    their queue order and outcomes; backoff-parked members stay parked
+    (the pull only reaches ACTIVE entries)."""
+    api, sched = build_env(batch_size=4)
+    for i in range(4):
+        api.create_node(make_node(f"node-{i}", cpu="4"))
+    api.pod_groups.create(PodGroup(name="duo", min_member=5))
+    # interleave: 2 plain, then gang members beyond the batch boundary
+    for i in range(2):
+        api.create_pod(mkpod(f"plain-{i}", cpu="200m"))
+    for m in range(5):
+        api.create_pod(mkpod(f"duo-{m}", group="duo", cpu="100m"))
+    got, _ = drain(api, sched)
+    assert all(v is not None for v in got.values()), got
+    assert sched.metrics["workload_batches"] == 1

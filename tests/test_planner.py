@@ -1,0 +1,540 @@
+"""The counterfactual planner tier (ops/counterfactual.py,
+kubernetes_tpu/planner/, oracle/planner.py; PLANNER.md).
+
+Every fork of the batched [K, P, N] kernel must be bit-identical to the
+serial forked-snapshot oracle (the ``plan_vs_serial_oracle`` contract),
+forks must be perfectly isolated (one fork's evictions never leak into
+another), the ``plannerKernel: false`` kill switch must be
+decision-identical, and the /debug/plan + whatif surfaces must round-trip.
+Property tests run under KTPU_SANITIZE=1.
+"""
+
+import json
+import random
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.api.resource import Resource
+from kubernetes_tpu.api.types import (
+    Container,
+    LabelSelector,
+    Node,
+    Pod,
+    TopologySpreadConstraint,
+)
+from kubernetes_tpu.framework.config import SchedulerConfiguration
+from kubernetes_tpu.planner import (
+    Fork,
+    backlog_pods,
+    plan_autoscale,
+    plan_deschedule,
+    plan_preempt_cost,
+    simulate_forks,
+)
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.testing import FakeCluster
+from kubernetes_tpu.workloads.gang import PodGroup
+
+
+@pytest.fixture()
+def sanitize_on(monkeypatch):
+    from kubernetes_tpu.analysis import sanitizer
+
+    monkeypatch.setenv("KTPU_SANITIZE", "1")
+    sanitizer.reset_enabled_memo()
+    yield
+    monkeypatch.delenv("KTPU_SANITIZE", raising=False)
+    sanitizer.reset_enabled_memo()
+
+
+def make_node(name, cpu="2", zone="zone-a", mem="8Gi"):
+    return Node(
+        name=name,
+        labels={
+            "kubernetes.io/hostname": name,
+            "topology.kubernetes.io/zone": zone,
+        },
+        capacity=Resource.from_map(
+            {"cpu": cpu, "memory": mem, "pods": 110}
+        ),
+    )
+
+
+def mkpod(name, cpu="500m", prio=0, group="", spread=False, labels=None):
+    tsc = ()
+    if spread:
+        tsc = (
+            TopologySpreadConstraint(
+                max_skew=1,
+                topology_key="topology.kubernetes.io/zone",
+                when_unsatisfiable="DoNotSchedule",
+                label_selector=LabelSelector(
+                    match_labels={"app": "spread"}
+                ),
+            ),
+        )
+    return Pod(
+        name=name,
+        priority=prio,
+        labels=dict(labels or {"app": "spread" if spread else "x"}),
+        pod_group=group,
+        topology_spread_constraints=tsc,
+        containers=[
+            Container(name="c", requests={"cpu": cpu, "memory": "256Mi"})
+        ],
+    )
+
+
+def build_env(**cfg_kw):
+    api = FakeCluster()
+    cfg = SchedulerConfiguration(
+        batch_size=128,
+        pod_initial_backoff_seconds=0.01,
+        pod_max_backoff_seconds=0.02,
+        **cfg_kw,
+    )
+    sched = Scheduler(configuration=cfg)
+    api.connect(sched)
+    return api, sched
+
+
+def _fork_key(f):
+    return (
+        f["label"],
+        tuple(sorted(f["placements"].items())),
+        f["admitted"],
+        f["unschedulable"],
+        f["density_ppm"],
+        tuple(sorted(f["gang_admitted"].items())),
+    )
+
+
+def _assert_forks_identical(a, b):
+    assert len(a.forks) == len(b.forks)
+    for fa, fb in zip(a.forks, b.forks):
+        assert _fork_key(fa) == _fork_key(fb), (
+            f"fork {fa['label']!r} diverged:\n{fa}\n!=\n{fb}"
+        )
+
+
+# ---------------------------------------------------------------------------
+# Randomized property: K-fork kernel ≡ serial forked-snapshot oracle
+# ---------------------------------------------------------------------------
+
+
+def _random_env(rng):
+    api, sched = build_env()
+    n_nodes = rng.randrange(4, 8)
+    for i in range(n_nodes):
+        api.create_node(
+            make_node(
+                f"node-{i}",
+                cpu=rng.choice(["1", "2", "4"]),
+                zone=f"zone-{i % 3}",
+            )
+        )
+    # fill: placed pods the forks can evict
+    for i in range(rng.randrange(5, 12)):
+        api.create_pod(
+            mkpod(f"fill-{i}", cpu=f"{rng.choice([200, 400, 700])}m", prio=2)
+        )
+    sched.schedule_pending()
+    # backlog: plain + spread + one gang
+    pods = [
+        mkpod(f"want-{i}", cpu=f"{rng.choice([300, 800, 1200])}m",
+              spread=rng.random() < 0.4)
+        for i in range(rng.randrange(3, 7))
+    ]
+    api.pod_groups.create(PodGroup(name="pg", min_member=2))
+    pods += [mkpod(f"pg-{m}", cpu="600m", group="pg") for m in range(2)]
+    rng.shuffle(pods)
+    return api, sched, pods
+
+
+def _random_forks(rng, sched, max_k=6):
+    placed = sched.cache.placed_pods()
+    names = [cn.node.name for cn in sched.cache.real_nodes()]
+    forks = [Fork(label="baseline")]
+    for k in range(rng.randrange(2, max_k)):
+        kind = rng.choice(["evict", "cordon", "add", "scale", "remove", "mix"])
+        evict = cordon = remove = add = scale = ()
+        if kind in ("evict", "mix") and placed:
+            evict = tuple(
+                p.uid
+                for p in rng.sample(placed, min(len(placed), rng.randrange(1, 4)))
+            )
+        if kind in ("cordon", "mix"):
+            cordon = (rng.choice(names),)
+        if kind == "remove":
+            remove = (rng.choice(names),)
+        if kind in ("add", "mix"):
+            t = rng.choice(names)
+            add = tuple((t, f"{t}~cf{i}") for i in range(rng.randrange(1, 3)))
+        if kind == "scale":
+            scale = ((rng.choice(names), rng.choice([1, 3, 2]), 2),)
+        forks.append(
+            Fork(
+                label=f"f{k}:{kind}",
+                evict=evict,
+                cordon=cordon,
+                remove=remove,
+                add=add,
+                scale=scale,
+            )
+        )
+    return forks
+
+
+@pytest.mark.parametrize("seed", [7, 23, 61])
+def test_plan_property_vs_oracle(sanitize_on, seed):
+    rng = random.Random(seed)
+    for _ in range(2):
+        api, sched, pods = _random_env(rng)
+        forks = _random_forks(rng, sched)
+        kern = simulate_forks(sched, forks, pods, planner="test")
+        serial = simulate_forks(
+            sched, forks, pods, planner="test", use_kernel=False
+        )
+        assert kern.engine == "kernel", "K-vmap path not engaged"
+        assert serial.engine == "serial"
+        _assert_forks_identical(kern, serial)
+
+
+def test_fork_isolation(sanitize_on):
+    """One fork's evictions/mutations never leak into another: each fork
+    of a batched run equals the same fork simulated alone (K=1)."""
+    rng = random.Random(5)
+    api, sched, pods = _random_env(rng)
+    placed = sched.cache.placed_pods()
+    forks = [
+        Fork(label="baseline"),
+        Fork(label="evict-all", evict=tuple(p.uid for p in placed)),
+        Fork(label="cordon-0", cordon=("node-0",)),
+        Fork(label="clone", add=(("node-1", "node-1~cf0"),)),
+    ]
+    batched = simulate_forks(sched, forks, pods, planner="test")
+    assert batched.engine == "kernel"
+    for i, f in enumerate(forks):
+        alone = simulate_forks(sched, [f], pods, planner="test")
+        assert _fork_key(batched.forks[i]) == _fork_key(alone.forks[0]), (
+            f"fork {f.label!r} differs batched vs alone"
+        )
+
+
+def test_kill_switch_identity(sanitize_on):
+    """plannerKernel:false replays the same forks through the serial
+    oracle — decision-identical, no device dispatch."""
+    rng = random.Random(11)
+    api, sched, pods = _random_env(rng)
+    forks = _random_forks(rng, sched)
+    kern = simulate_forks(sched, forks, pods, planner="test")
+    sched.config.planner_kernel = False
+    off = simulate_forks(sched, forks, pods, planner="test")
+    assert kern.engine == "kernel" and off.engine == "serial"
+    assert kern.dispatches == 1 and off.dispatches == 0
+    _assert_forks_identical(kern, off)
+
+
+def test_pod_live_masking(sanitize_on):
+    """A fork simulating a subset of the batch sees ONLY its live pods:
+    non-live pods place nothing and consume nothing."""
+    api, sched = build_env()
+    for i in range(2):
+        api.create_node(make_node(f"node-{i}", cpu="1"))
+    pods = [mkpod("a", cpu="800m"), mkpod("b", cpu="800m"),
+            mkpod("c", cpu="800m")]
+    forks = [
+        Fork(label="only-a", live=(pods[0].uid,)),
+        Fork(label="all"),
+    ]
+    sim = simulate_forks(sched, forks, pods, planner="test")
+    only_a, all_f = sim.forks
+    assert set(only_a["placements"]) == {"a"}
+    assert only_a["admitted"] == 1
+    # with only a live, both nodes are free for it; with all three, one
+    # pod strands (2 nodes × 1 cpu, 800m each)
+    assert all_f["admitted"] == 2 and all_f["unschedulable"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Planner catalogue
+# ---------------------------------------------------------------------------
+
+
+def _stranded_env():
+    """4 full nodes + a backlog that fits only after scale-up."""
+    api, sched = build_env()
+    for i in range(4):
+        api.create_node(make_node(f"node-{i}", zone=f"zone-{i % 2}"))
+    for i in range(12):
+        api.create_pod(mkpod(f"fill-{i}", cpu="600m", prio=2))
+    sched.schedule_pending()
+    for i in range(6):
+        api.create_pod(mkpod(f"want-{i}", cpu="900m"))
+    sched.schedule_pending()
+    return api, sched
+
+
+def test_autoscale_recommends_cheapest_admitting_shape(sanitize_on):
+    api, sched = _stranded_env()
+    out = plan_autoscale(sched, max_count=2)
+    assert out["result"]["engine"] == "kernel"
+    rec = out["recommendation"]
+    assert rec["action"] == "scale_up"
+    assert rec["newly_schedulable"] > 0
+    labels = [f["label"] for f in out["result"]["forks"]]
+    assert "baseline" in labels
+    # bigger fork sets admit more: monotone in clone count for one shape
+    by_label = {f["label"]: f for f in out["result"]["forks"]}
+    s = rec["shape"]
+    assert (
+        by_label[f"add:{s}x2"]["admitted"]
+        >= by_label[f"add:{s}x1"]["admitted"]
+    )
+
+
+def test_autoscale_scale_down_flags_empty_nodes(sanitize_on):
+    api, sched = build_env()
+    for i in range(3):
+        api.create_node(make_node(f"node-{i}"))
+    # fill two nodes; node-2 stays empty
+    for i in range(4):
+        api.create_pod(
+            mkpod(f"fill-{i}", cpu="900m", labels={"app": "x"})
+        )
+    sched.schedule_pending()
+    # strand one backlog pod so the planner has something to simulate
+    api.create_pod(mkpod("want-0", cpu="1900m"))
+    sched.schedule_pending()
+    out = plan_autoscale(sched, max_count=1)
+    if "error" in out:
+        pytest.skip(f"no backlog: {out}")
+    empties = {
+        cn.node.name for cn in sched.cache.real_nodes() if not cn.pods
+    }
+    if empties:
+        # removing an empty node must not hurt backlog admission when the
+        # backlog didn't need it
+        assert set(out.get("scale_down", ())) <= empties
+
+
+def test_deschedule_finds_drainable_node(sanitize_on):
+    api, sched = build_env()
+    for i in range(3):
+        api.create_node(make_node(f"node-{i}", cpu="4"))
+    # two pods on purpose-built load: schedule 6 small pods, they spread;
+    # any single node's pods re-place elsewhere easily
+    for i in range(6):
+        api.create_pod(mkpod(f"p-{i}", cpu="300m"))
+    sched.schedule_pending()
+    out = plan_deschedule(sched, max_candidates=3)
+    assert "drains" in out, out
+    assert out["result"]["engine"] == "kernel"
+    assert any(d["fully_drainable"] for d in out["drains"])
+    rec = out["recommendation"]
+    assert rec["action"] == "drain"
+
+
+def test_preempt_cost_forecasts_cascade(sanitize_on):
+    api, sched = build_env()
+    for i in range(2):
+        api.create_node(make_node(f"node-{i}", cpu="2"))
+    for i in range(4):
+        api.create_pod(mkpod(f"low-{i}", cpu="900m", prio=0))
+    sched.schedule_pending()
+    # high-priority backlog that fits only if the low-prio pods go; use
+    # preemption-disabled sizes?  No: pods strand because the default
+    # PostFilter nominates — avoid by matching priority for one class and
+    # exceeding for another
+    api.create_pod(mkpod("same-prio", cpu="1500m", prio=0))
+    sched.schedule_pending()
+    out = plan_preempt_cost(sched)
+    assert out["result"]["engine"] == "kernel"
+    classes = {c["priority"]: c for c in out["classes"]}
+    assert 0 in classes
+    c0 = classes[0]
+    # same-priority pods cannot preempt (victims must be strictly lower)
+    assert c0["victims_considered"] == 0
+    assert c0["cascade_upper_bound"] == 0
+
+
+def test_preempt_cost_counts_lower_priority_victims(sanitize_on):
+    api, sched = build_env(planner_kernel=True)
+    for i in range(2):
+        api.create_node(make_node(f"node-{i}", cpu="2"))
+    for i in range(4):
+        api.create_pod(mkpod(f"low-{i}", cpu="900m", prio=0))
+    sched.schedule_pending()
+    # keep the high-prio pod OUT of the real scheduler (its nomination
+    # machinery would mark it ineligible) — ask the planner directly
+    hi = mkpod("hi", cpu="1500m", prio=10)
+    forks = [
+        Fork(label="base", live=(hi.uid,)),
+        Fork(
+            label="preempt",
+            evict=tuple(p.uid for p in sched.cache.placed_pods()),
+            live=(hi.uid,),
+        ),
+    ]
+    sim = simulate_forks(sched, forks, [hi], planner="test")
+    base, pre = sim.forks
+    assert base["admitted"] == 0
+    assert pre["admitted"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Gang forks
+# ---------------------------------------------------------------------------
+
+
+def test_gang_rides_forks(sanitize_on):
+    """A gang in the planner batch admits all-or-nothing PER FORK: it
+    rolls back in the baseline but admits once a clone adds room."""
+    api, sched = build_env()
+    api.create_node(make_node("node-0", cpu="1"))
+    api.pod_groups.create(PodGroup(name="g", min_member=3))
+    pods = [mkpod(f"g-{m}", cpu="700m", group="g") for m in range(3)]
+    forks = [
+        Fork(label="baseline"),
+        Fork(
+            label="grow",
+            add=(("node-0", "node-0~cf0"), ("node-0", "node-0~cf1")),
+        ),
+    ]
+    sim = simulate_forks(sched, forks, pods, planner="test")
+    serial = simulate_forks(
+        sched, forks, pods, planner="test", use_kernel=False
+    )
+    _assert_forks_identical(sim, serial)
+    base, grow = sim.forks
+    assert base["gang_admitted"].get("default/g") == 0
+    assert base["admitted"] == 0  # rolled back wholesale
+    assert grow["gang_admitted"].get("default/g") == 1
+    assert grow["admitted"] == 3
+
+
+# ---------------------------------------------------------------------------
+# /debug/plan + whatif surfaces
+# ---------------------------------------------------------------------------
+
+
+def _get_json(port, path):
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=30
+        ) as r:
+            return r.status, json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+
+
+def test_debug_plan_endpoint_roundtrip(sanitize_on):
+    from kubernetes_tpu.server import SchedulerServer
+
+    api, sched = _stranded_env()
+    srv = SchedulerServer(sched, port=0)
+    srv._http_thread.start()
+    try:
+        code, out = _get_json(srv.port, "/debug/plan")
+        assert code == 200
+        assert set(out["planners"]) == {
+            "autoscale",
+            "deschedule",
+            "preempt_cost",
+        }
+        code, out = _get_json(
+            srv.port, "/debug/plan?planner=autoscale&max_count=1"
+        )
+        assert code == 200
+        assert out["planner"] == "autoscale"
+        assert out["result"]["engine"] == "kernel"
+        assert out["result"]["k"] >= 2
+        json.dumps(out)  # fully serializable
+        code, out = _get_json(srv.port, "/debug/plan?planner=bogus")
+        assert code == 400
+        assert "unknown planner" in out["error"]
+    finally:
+        srv.http.shutdown()
+
+
+def test_whatif_rides_k1_planner_kernel(sanitize_on):
+    """/debug/explain?whatif_node= answers through the K=1 planner kernel
+    with the host dry run as the parity reference."""
+    from kubernetes_tpu.observability import explain_whatif, find_pod
+
+    api, sched = build_env()
+    for i in range(2):
+        api.create_node(make_node(f"n{i}", cpu="2"))
+    for i in range(4):
+        api.create_pod(mkpod(f"low-{i}", cpu="900m", prio=0))
+    sched.schedule_pending()
+    api.create_pod(mkpod("hi", cpu="1500m", prio=10))
+    pod = find_pod(sched, "hi")
+    out = explain_whatif(sched, pod, "n0")
+    assert out["kernel"]["engine"] == "kernel"
+    assert out["kernel"]["dispatches"] == 1
+    assert out["feasible_after_preemption"] is True
+    assert out["parity"] is True
+    # infeasible even with every victim gone: pod larger than the node
+    api.create_pod(mkpod("huge", cpu="2500m", prio=10))
+    pod2 = find_pod(sched, "huge")
+    out2 = explain_whatif(sched, pod2, "n0")
+    assert out2["feasible_after_preemption"] is False
+    assert out2["parity"] is True
+
+
+def test_whatif_kill_switch_agrees(sanitize_on):
+    from kubernetes_tpu.observability import explain_whatif, find_pod
+
+    api, sched = build_env(planner_kernel=False)
+    for i in range(2):
+        api.create_node(make_node(f"n{i}", cpu="2"))
+    for i in range(4):
+        api.create_pod(mkpod(f"low-{i}", cpu="900m", prio=0))
+    sched.schedule_pending()
+    api.create_pod(mkpod("hi", cpu="1500m", prio=10))
+    pod = find_pod(sched, "hi")
+    out = explain_whatif(sched, pod, "n0")
+    assert out["kernel"]["engine"] == "serial"
+    assert out["parity"] is True
+
+
+# ---------------------------------------------------------------------------
+# Metrics
+# ---------------------------------------------------------------------------
+
+
+def test_plan_metrics(sanitize_on):
+    api, sched = _stranded_env()
+    before = sched.prom.plan_forks.value()
+    out = plan_autoscale(sched, max_count=1)
+    assert sched.prom.plan_forks.value() - before == out["result"]["k"]
+    text = sched.expose_metrics()
+    assert "scheduler_tpu_plan_forks_total" in text
+    assert "scheduler_tpu_plan_duration_seconds" in text
+
+
+def test_run_planner_never_raises_on_bad_input(sanitize_on):
+    """Debug surface discipline: malformed params and unknown shape
+    templates come back as an error field, not an exception/500."""
+    from kubernetes_tpu.planner import run_planner
+
+    api, sched = _stranded_env()
+    out = run_planner(sched, "autoscale", {"max_count": "abc"})
+    assert "bad parameter" in out["error"]
+    out = run_planner(sched, "autoscale", {"shapes": "no-such-node"})
+    assert "error" in out and "no-such-node" in out["error"]
+
+
+def test_target_node_requires_single_pod(sanitize_on):
+    """The target-bonus trick is only well-defined for single-pod batches
+    (kernel judges sequentially, serial against the initial state) — a
+    multi-pod target must fail loud, not silently diverge."""
+    api, sched = build_env()
+    api.create_node(make_node("n0"))
+    pods = [mkpod("a"), mkpod("b")]
+    with pytest.raises(ValueError, match="single-pod"):
+        simulate_forks(
+            sched, [Fork(label="x")], pods, target_node="n0", planner="test"
+        )
