@@ -1142,6 +1142,28 @@ def main():
         configs["config0_resident_rounds"] = s0.metrics.get(
             "resident_rounds", 0
         )
+        # per-kernel attribution from the device telemetry ledger
+        # (observability/kernels.py): the top kernels by device time plus
+        # their d2h bytes — floor-less per the CPU-box discipline (no
+        # ratchets from this box), like the serving-curve keys
+        ktbl = [
+            r
+            for r in s0.kernels.table(cost=False)
+            if r["dispatches"] or r["d2h_bytes"]
+        ]
+        configs["config0_kernel_top5"] = [
+            {
+                "kernel": r["kernel"],
+                "dispatches": r["dispatches"],
+                "execute_s": r["execute_s"],
+                "compile_s": r["compile_s"],
+                "d2h_mb": round(r["d2h_bytes"] / 1e6, 3),
+            }
+            for r in ktbl[:5]
+        ]
+        configs["config0_kernel_dispatches"] = sum(
+            r["dispatches"] for r in ktbl
+        )
         print(
             f"# config0 north-star: {ok0} pods / {n0_nodes} nodes drained in "
             f"{dt0:.2f}s (target <1s; {_mix(s0)} "
@@ -1149,6 +1171,18 @@ def main():
             f"resident_rounds={s0.metrics.get('resident_rounds', 0)}; phases="
             + ",".join(f"{k}={v:.2f}" for k, v in sorted(phases.items()))
             + ")",
+            file=sys.stderr,
+        )
+        print(
+            "# config0 kernels (ledger top-5 by device time): "
+            + (
+                " ".join(
+                    f"{r['kernel']}={r['execute_s']:.2f}s"
+                    f"/n={r['dispatches']}/d2h={r['d2h_mb']:.1f}MB"
+                    for r in configs["config0_kernel_top5"]
+                )
+                or "none"
+            ),
             file=sys.stderr,
         )
         km = run_scale_sim(n_nodes=5000, n_pods=5000, churn_waves=4)

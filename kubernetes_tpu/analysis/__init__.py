@@ -92,6 +92,9 @@ LOCK_MODULES = (
     # SLO tier: ingest runs on every flight-recorder producer thread,
     # snapshot/evaluate on HTTP handlers and the bench harness
     os.path.join("observability", "slo.py"),
+    # device telemetry ledger: the scheduling loop records dispatches,
+    # the planner thread records d2h, HTTP handlers read tables/costs
+    os.path.join("observability", "kernels.py"),
     # workloads tier: the GangDirectory registry/bookkeeping is mutated by
     # informer handlers, the workloads dispatch, and bind-failure unwinds
     os.path.join("workloads", "gang.py"),

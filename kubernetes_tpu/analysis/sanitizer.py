@@ -104,6 +104,11 @@ _jit_roots: dict = {}
 _warm_sizes: Optional[dict] = None
 _recompile_counts: dict = {}
 _recompile_counters: "weakref.WeakSet" = weakref.WeakSet()
+# jit-root listeners (the dispatch ledger's coverage feed,
+# observability/kernels.py): called with (name, fn) for every root that
+# arrives through register_jit_root, so runtime-created roots join the
+# per-kernel accounting roster without a second discovery pass
+_root_listeners: list = []
 _retrace_hook_installed = False
 _retrace_lock = threading.Lock()
 _COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
@@ -141,6 +146,22 @@ def register_recompile_counter(counter) -> None:
         _recompile_counters.add(counter)
 
 
+def add_jit_root_listener(cb) -> None:
+    """Subscribe to runtime jit-root registrations (idempotent per
+    callback identity); already-registered runtime roots replay so a
+    late subscriber misses nothing."""
+    with _retrace_lock:
+        if cb in _root_listeners:
+            return
+        _root_listeners.append(cb)
+        existing = list(_jit_roots.items())
+    for name, fn in existing:
+        try:
+            cb(name, fn)
+        except Exception:  # noqa: BLE001 — accounting only
+            pass
+
+
 def register_jit_root(name: str, fn) -> None:
     """Track an extra jit root (one created at runtime rather than at
     module scope).  If a warm watermark is already set, the root joins it
@@ -151,6 +172,12 @@ def register_jit_root(name: str, fn) -> None:
         _jit_roots[name] = fn
         if _warm_sizes is not None:
             _warm_sizes.setdefault(name, fn._cache_size())
+        listeners = list(_root_listeners)
+    for cb in listeners:
+        try:
+            cb(name, fn)
+        except Exception:  # noqa: BLE001 — accounting only
+            pass
 
 
 def install_retrace_hook() -> None:

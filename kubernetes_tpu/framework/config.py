@@ -193,6 +193,14 @@ class SchedulerConfiguration:
     # the serial forked-snapshot oracle (oracle/planner.py) — decision-
     # identical (kill-switch identity, tests/test_planner.py).
     planner_kernel: bool = True
+    # TPU extension: the device telemetry ledger (observability/
+    # kernels.py) — per-kernel dispatch/compile/d2h accounting over every
+    # registered jit root, served at /debug/kernels and /metrics, with
+    # the execute-time regression sentinel wired into the SLO tier's
+    # black-box dump.  Off = the root wrappers reduce to one global read
+    # + branch per dispatch and nothing records (decision-identical
+    # either way: the ledger only observes).
+    kernel_ledger: bool = True
     # Bit-compat knobs (SURVEY §7 "decision-identical tie-breaking"):
     # full-width evaluation is the TPU-native default; these opt into the
     # reference's sampling + randomized-tie semantics.
@@ -488,6 +496,7 @@ def load_config(source) -> SchedulerConfiguration:
         resident_serial_tail=d.get("residentSerialTail", False),
         gang_dispatch=d.get("gangDispatch", True),
         planner_kernel=d.get("plannerKernel", True),
+        kernel_ledger=d.get("kernelLedger", True),
         reference_sampling_compat=d.get("referenceSamplingCompat", False),
         tie_break_seed=d.get("tieBreakSeed"),
     )
@@ -548,6 +557,7 @@ def dump_config(cfg: SchedulerConfiguration) -> dict:
         "residentSerialTail": cfg.resident_serial_tail,
         "gangDispatch": cfg.gang_dispatch,
         "plannerKernel": cfg.planner_kernel,
+        "kernelLedger": cfg.kernel_ledger,
         "referenceSamplingCompat": cfg.reference_sampling_compat,
         "tieBreakSeed": cfg.tie_break_seed,
         "featureGates": dict(cfg.feature_gates),

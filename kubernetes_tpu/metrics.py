@@ -131,6 +131,14 @@ def wide_duration_buckets() -> List[float]:
     return [0.001 * (2**i) for i in range(21)]
 
 
+# per-kernel execute buckets (the dispatch ledger, observability/
+# kernels.py): submits range from tens of µs (a warm static_eval) to
+# tens of seconds (a first-trace compile on a cold cache), so the span
+# is wider at both ends than the scheduler duration buckets
+def kernel_duration_buckets() -> List[float]:
+    return [0.00001 * (2**i) for i in range(24)]
+
+
 # coarse batch-size label values for the per-pod attempt-latency series:
 # one batched dispatch smears its latency uniformly over the batch, so the
 # serving analysis needs to know HOW MUCH smear a sample carries (batch=1
@@ -815,6 +823,80 @@ class SchedulerMetrics:
                 "scheduler_tpu_trace_evicted_events",
                 "Trace events evicted from the black-box ring since it was "
                 "armed (monotonic, sampled on scrape).",
+            )
+        )
+        # --- device telemetry ledger (observability/kernels.py): the
+        # per-kernel split of the device path the aggregate
+        # host_roundtrips/d2h_bytes counters can't attribute ---
+        self.kernel_dispatches = r.register(
+            Counter(
+                "scheduler_tpu_kernel_dispatches_total",
+                "Dispatches per jit root (kernel: module.function, the "
+                "sanitizer's jit-root roster).",
+                ("kernel",),
+            )
+        )
+        self.kernel_execute = r.register(
+            Histogram(
+                "scheduler_tpu_kernel_execute_seconds",
+                "Per-dispatch execute wall time by kernel — the dispatch "
+                "call's wall clock (host submit on async backends; the "
+                "device latency the host failed to hide shows in the "
+                "kernel d2h series).  First-trace compiles are excluded "
+                "(they count into the compile series).",
+                ("kernel",),
+                buckets=kernel_duration_buckets(),
+            )
+        )
+        self.kernel_compiles = r.register(
+            Counter(
+                "scheduler_tpu_kernel_compiles_total",
+                "Dispatches that grew a kernel's jit compilation cache "
+                "(first trace of a new shape/static bucket).",
+                ("kernel",),
+            )
+        )
+        self.kernel_compile_seconds = r.register(
+            Counter(
+                "scheduler_tpu_kernel_compile_seconds_total",
+                "Wall seconds spent in compiling dispatches, by kernel.",
+                ("kernel",),
+            )
+        )
+        self.kernel_d2h_bytes = r.register(
+            Counter(
+                "scheduler_tpu_kernel_d2h_bytes_total",
+                "Blocking device→host readback bytes attributed per "
+                "kernel through the Scheduler._d2h choke point "
+                "(kernel=_untagged: fetches with no kernel context, so "
+                "the rows sum to scheduler_tpu_d2h_bytes_total).",
+                ("kernel",),
+            )
+        )
+        self.kernel_d2h_seconds = r.register(
+            Counter(
+                "scheduler_tpu_kernel_d2h_seconds_total",
+                "Seconds blocked in device→host readbacks per kernel.",
+                ("kernel",),
+            )
+        )
+        self.kernel_regressions = r.register(
+            Counter(
+                "scheduler_tpu_kernel_regressions_total",
+                "Sustained per-kernel execute-time regressions detected "
+                "by the dispatch ledger's sentinel (each one files a "
+                "kernel_regression breach through the SLO tier's "
+                "black-box freeze→dump machinery when installed).",
+                ("kernel",),
+            )
+        )
+        self.device_hbm_bytes = r.register(
+            Gauge(
+                "scheduler_tpu_device_hbm_bytes",
+                "Live device memory from device.memory_stats() where the "
+                "backend supports it (absent on CPU), sampled on scrape "
+                "(kind: bytes_in_use / peak_bytes_in_use / bytes_limit).",
+                ("device", "kind"),
             )
         )
         self.recorder = MetricAsyncRecorder()

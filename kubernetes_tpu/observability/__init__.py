@@ -18,16 +18,23 @@ endpoints, rebuilt for the batched TPU hot loop (see OBSERVABILITY.md):
     per-stage latency attribution joined from the flight recorder's
     breadcrumbs, objective/burn-rate evaluation over rolling windows,
     and breach-triggered freeze+dump of the tracer's black-box ring.
+  * ``DispatchLedger`` — the device telemetry ledger (kernels.py):
+    per-kernel dispatch/compile/d2h accounting over every registered
+    jit root, lazy XLA cost estimates, and the execute-time regression
+    sentinel wired into the SLO tier's black-box dump.
 
-Served over HTTP by ``server.SchedulerServer``:
+Served over HTTP by ``server.SchedulerServer`` (the full catalogue is
+the JSON index at ``/debug/``):
 
     /debug/trace?action=start|stop|export   (default: status)
     /debug/flightrecorder?pod=<uid|name>    (default: stats + tail)
     /debug/explain?pod=<uid|name>
     /debug/slo?action=status|trace          (default: status)
+    /debug/kernels?cost=0|1                 (the per-kernel table)
 """
 
 from kubernetes_tpu.observability.flightrecorder import FlightRecorder
+from kubernetes_tpu.observability.kernels import DispatchLedger
 from kubernetes_tpu.observability.tracer import Tracer
 from kubernetes_tpu.observability.explain import (
     DIAG_PLUGINS,
@@ -46,6 +53,7 @@ from kubernetes_tpu.observability.slo import (
 __all__ = [
     "Tracer",
     "FlightRecorder",
+    "DispatchLedger",
     "SLOConfig",
     "SLOEvaluator",
     "SLOObjective",

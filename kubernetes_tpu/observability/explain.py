@@ -238,7 +238,7 @@ def explain_pod(
     # one accounted fetch for both artifacts: explain IS a host round
     # trip, and it must show up in host_roundtrips_total/d2h_bytes_total
     # like every other blocking fetch (Scheduler._d2h choke point)
-    fetched = sched._d2h((stack, feasible))
+    fetched = sched._d2h((stack, feasible), kernel="explain.explain_masks")
     stack = np.asarray(fetched[0])[:, 0, :]  # [N_DIAG, N]
     feasible = np.asarray(fetched[1])[0]  # [N]
 

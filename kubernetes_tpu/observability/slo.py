@@ -928,6 +928,29 @@ class SLOEvaluator:
                     trace if record.get("trace") is None else None
                 )
 
+    def external_breach(self, record: dict) -> bool:
+        """File a breach raised by ANOTHER observability tier (the
+        dispatch ledger's kernel-regression sentinel): same cooldown
+        gate, sequence numbering, and freeze→dump→re-arm path as an
+        objective breach, so one machinery serves both.  The record must
+        carry ``objective`` (the dump filename stem; the sentinel uses
+        ``kernel_regression`` plus a ``kernel`` field naming the root).
+        Returns False when the cooldown swallowed it."""
+        now = self._mono()
+        with self._mu:
+            if now - self._slo_last_dump < self.config.breach_cooldown_s:
+                return False
+            self._slo_last_dump = now
+            self._slo_dump_seq += 1
+            record = dict(
+                record,
+                seq=self._slo_dump_seq,
+                mono=now,
+                wall_time=self._wall(),
+            )
+        self._handle_breach(record)
+        return True
+
     # -- introspection (/debug/slo) ------------------------------------------
 
     def evaluate(self) -> Optional[dict]:

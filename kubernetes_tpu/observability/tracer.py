@@ -49,6 +49,7 @@ _KTPU_GUARDED = {
             "_ring_mode": None,
             "_ring_cap": None,
             "_tid_names": None,
+            "_track_tids": None,
             "_overhead_s": None,
         },
     },
@@ -86,6 +87,10 @@ class Tracer:
         self._ring_mode = False
         self._ring_cap = DEFAULT_BLACKBOX_EVENTS
         self._tid_names: Dict[int, str] = {}
+        # synthetic tracks (device-side spans from the dispatch ledger):
+        # track name → synthetic tid, far above any OS thread ident so
+        # Perfetto renders them as their own named rows
+        self._track_tids: Dict[str, int] = {}
         self._overhead_s = 0.0
         self._t0 = clock()
         # optional journal logical-time source (JournalRecorder.attach sets
@@ -103,6 +108,7 @@ class Tracer:
             self._trace_evicted = 0
             self._ring_mode = False
             self._tid_names = {}
+            self._track_tids = {}
             self._overhead_s = 0.0
             self._t0 = self._clock()
         self.enabled = True
@@ -120,6 +126,7 @@ class Tracer:
             self._ring_mode = True
             self._ring_cap = max(int(capacity), 1)
             self._tid_names = {}
+            self._track_tids = {}
             self._overhead_s = 0.0
             self._t0 = self._clock()
         self.enabled = True
@@ -154,18 +161,30 @@ class Tracer:
 
     # -- recording -----------------------------------------------------------
 
-    def _append(self, name, cat, ph, t0, t1, args) -> None:
+    def _append(self, name, cat, ph, t0, t1, args, track=None) -> None:
         """Finalize and buffer one event.  The origin read, the clamp, and
         the buffer append all happen under ONE lock hold: start() swaps
         the buffer and the origin atomically, so a concurrent recorder can
         never stamp a stale origin into the fresh buffer.  A span whose
         work STARTED before the capture renders only its in-capture part —
         an unclamped t0 would paint pre-trace time as a fat span at the
-        origin."""
+        origin.  ``track`` routes the event onto a named SYNTHETIC track
+        (a tid above any OS thread ident) instead of the calling thread's
+        — the device-side spans' own row in Perfetto."""
         t_in = self._clock()
-        tid = threading.get_ident()
-        tname = threading.current_thread().name
+        if track is None:
+            tid = threading.get_ident()
+            tname = threading.current_thread().name
+        else:
+            tid = None
+            tname = track
         with self._mu:
+            if tid is None:
+                tid = self._track_tids.get(track)
+                if tid is None:
+                    tid = self._track_tids[track] = (1 << 40) + len(
+                        self._track_tids
+                    )
             if tid not in self._tid_names:
                 self._tid_names[tid] = tname
             origin = self._t0
@@ -225,6 +244,24 @@ class Tracer:
             except Exception:  # noqa: BLE001 — journal detached mid-trace
                 pass
         self._append(name, cat, "X", t0, t1, args)
+
+    def complete_track(
+        self, track: str, name: str, t0: float, t1: float,
+        cat: str = "device", **args,
+    ) -> None:
+        """Record a complete event spanning [t0, t1) on the named
+        synthetic track (the dispatch ledger's device-side kernel spans,
+        rendered alongside the host thread tracks).  Carries the journal
+        logical time like every other span when one is attached."""
+        if not self.enabled:
+            return
+        lt = self.logical_time
+        if lt is not None:
+            try:
+                args = dict(args, lt=lt())
+            except Exception:  # noqa: BLE001 — journal detached mid-trace
+                pass
+        self._append(name, cat, "X", t0, t1, args, track=track)
 
     def instant(self, name: str, cat: str = "sched", **args) -> None:
         if not self.enabled:

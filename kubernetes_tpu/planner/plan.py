@@ -304,6 +304,7 @@ def simulate_forks(
         nodes_snap, placed_snap, groups_snap, pvs_snap, pvcs_snap = (
             serial_snapshot
         )
+        t_ser = time.perf_counter()
         sim = _simulate_serial(
             sched,
             forks,
@@ -318,11 +319,19 @@ def simulate_forks(
         )
         sim.skipped.update(skipped)
         sim.wall_s = time.perf_counter() - t0
+        tr = sched.tracer
+        if tr.enabled:
+            tr.complete(
+                "plan.serial", t_ser, cat="plan", planner=planner,
+                forks=len(forks),
+            )
         _observe(sched, planner, sim)
         return sim
 
     # the fused dispatch + its d2h run OUTSIDE the lock (device-path rule:
     # a first-shape XLA compile must not stall the scheduling loop)
+    tr = sched.tracer
+    t_disp = time.perf_counter()
     out_dev = cf_ops.counterfactual_run(
         dc,
         db,
@@ -353,7 +362,26 @@ def simulate_forks(
         fit_strategy=fwk.fit_strategy(),
         **tables,
     )
-    fetched = {k: np.asarray(v) for k, v in sched._d2h(out_dev).items()}
+    # planner dispatches are host-tracer-visible like every scheduling
+    # path: dispatch/harvest halves as spans, alongside the
+    # scheduler_tpu_plan_* metrics and the `plan` flight event (_observe)
+    if tr.enabled:
+        tr.complete(
+            "dispatch.plan", t_disp, cat="plan", planner=planner,
+            forks=len(forks), pods=len(ordered),
+        )
+    t_harvest = time.perf_counter()
+    fetched = {
+        k: np.asarray(v)
+        for k, v in sched._d2h(
+            out_dev, kernel="counterfactual.counterfactual_run"
+        ).items()
+    }
+    if tr.enabled:
+        tr.complete(
+            "harvest.plan", t_harvest, cat="plan", planner=planner,
+            forks=len(forks),
+        )
 
     sim = SimResult(
         engine="kernel",
@@ -408,6 +436,22 @@ def _observe(sched, planner: str, sim: SimResult) -> None:
     prom = sched.prom
     prom.plan_forks.inc(sim.k)
     prom.recorder.observe(prom.plan_duration, sim.wall_s, planner=planner)
+    # the flight-recorder `plan` breadcrumb (queryable at
+    # /debug/flightrecorder?pod=planner): one per planner run, both
+    # engines, so what-if traffic is visible next to pod lifecycles
+    fl = sched.flight
+    if fl.enabled:
+        fl.record(
+            "planner",
+            "plan",
+            {
+                "planner": planner,
+                "engine": sim.engine,
+                "forks": sim.k,
+                "dispatches": sim.dispatches,
+                "wall_s": round(sim.wall_s, 6),
+            },
+        )
 
 
 def _serial_snapshot(sched, gang_positions):

@@ -18,6 +18,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
+import weakref
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -472,6 +473,28 @@ class Scheduler:
         # steady-state SLO tier (observability/slo.py) — None until
         # install_slo wires it; /debug/slo serves {"enabled": false} then
         self.slo = None
+        # device telemetry ledger (observability/kernels.py): per-kernel
+        # dispatch/compile/d2h accounting over every registered jit root,
+        # plus the execute-time regression sentinel (breaches reuse the
+        # SLO tier's black-box freeze→dump).  The root wrappers are
+        # process-global; dispatches route to the ACTIVE ledger, d2h
+        # attribution records into THIS scheduler's ledger exactly.
+        from kubernetes_tpu.observability import kernels as kernels_mod
+
+        self_ref = weakref.ref(self)
+
+        def _slo_of():
+            s = self_ref()
+            return s.slo if s is not None else None
+
+        self.kernels = kernels_mod.DispatchLedger(
+            prom=self.prom, tracer=self.tracer, slo_getter=_slo_of
+        )
+        if getattr(self.config, "kernel_ledger", True):
+            kernels_mod.install()
+            kernels_mod.activate(self.kernels)
+        else:
+            self.kernels.enabled = False
         self._batch_seq = 0  # trace batch ids (scheduling-loop thread only)
         # jax.profiler trace hook (SURVEY §5; the --profiling/pprof analog,
         # apis/config/types.go:60): when set, schedule_pending wraps each
@@ -1027,11 +1050,17 @@ class Scheduler:
             )
         return bid
 
-    def _d2h(self, value):
+    def _d2h(self, value, kernel: Optional[str] = None):
         """Blocking device→host fetch with round-trip accounting: every
         harvest-side ``jax.device_get`` goes through here so
         scheduler_tpu_host_roundtrips_total / d2h_bytes_total measure the
-        quantity the resident drain exists to minimize."""
+        quantity the resident drain exists to minimize.  ``kernel`` tags
+        the fetch with the jit root whose results it harvests — the
+        dispatch ledger splits the aggregate bytes per kernel (untagged
+        fetches land under ``_untagged`` so the split always sums to the
+        total)."""
+        led = self.kernels
+        t0 = time.perf_counter() if led.enabled else 0.0
         out = jax.device_get(value)
         prom = self.prom
         prom.host_roundtrips.inc()
@@ -1041,6 +1070,8 @@ class Scheduler:
             if hasattr(a, "nbytes")
         )
         prom.d2h_bytes.inc(nb)
+        if led.enabled:
+            led.record_d2h(kernel, nb, time.perf_counter() - t0)
         return out
 
     def _record_batch_metrics(self, profile, group, outs, dt: float) -> None:
@@ -1113,6 +1144,17 @@ class Scheduler:
         if slo is not None:
             for objective, burn in slo.gauge_rows():
                 self.prom.slo_burn_rate.set(burn, objective=objective)
+        # live device memory where the backend reports it (None on CPU)
+        if self.kernels.enabled:
+            for row in self.kernels.hbm_rows():
+                for kind in (
+                    "bytes_in_use",
+                    "peak_bytes_in_use",
+                    "bytes_limit",
+                ):
+                    self.prom.device_hbm_bytes.set(
+                        row[kind], device=row["device"], kind=kind
+                    )
 
     def install_slo(self, slo_config=None):
         """Install the steady-state SLO tier (observability/slo.py): wires
@@ -1475,14 +1517,15 @@ class Scheduler:
                 **shared_kw,
             )
         path = "wave" if wt is not None else "scan"
+        kroot = "wave.wave_run" if wt is not None else "gang.gang_run"
         t_d2h = time.perf_counter()
         self.phases.add("device", t_d2h - t_gang)
-        both = self._d2h(jnp.stack([chosen, n_feas]))
+        both = self._d2h(jnp.stack([chosen, n_feas]), kernel=kroot)
         self.phases.add("d2h", time.perf_counter() - t_d2h)
         chosen, n_feas = both[0], both[1]
         if sample_k is not None:
             self._next_start_node_index = int(
-                self._d2h(tallies["sample_start"])
+                self._d2h(tallies["sample_start"], kernel=kroot)
             )
         if tie_key is not None or sample_k is not None:
             self._attempt_counter = (
@@ -1502,7 +1545,9 @@ class Scheduler:
         # split by interaction group.
         wave_groups = None
         if wstats_dev is not None:
-            wave_groups = self._wave_resolve(fwk, batch, chosen, wstats_dev)
+            wave_groups = self._wave_resolve(
+                fwk, batch, chosen, wstats_dev, kernel=kroot
+            )
         self._process_results(
             fwk,
             state,
@@ -1514,6 +1559,7 @@ class Scheduler:
             host_diags,
             host_plugin_sets,
             wave_groups=wave_groups,
+            kernel=kroot,
         )
         trace.step("Commits done")
         trace.log_if_long()
@@ -1531,6 +1577,7 @@ class Scheduler:
         host_diags=None,
         host_plugin_sets=None,
         wave_groups=None,
+        kernel=None,
     ) -> None:
         """The per-pod result walk shared by the direct and chained paths:
         failures → diagnosis + PostFilter, successes → _commit (which hands
@@ -1573,7 +1620,7 @@ class Scheduler:
             idx = int(chosen[i])
             if idx < 0:
                 if counts is None:
-                    counts = self._d2h(reason_counts)
+                    counts = self._d2h(reason_counts, kernel=kernel)
                 diag = {
                     k: int(c)
                     for k, c in zip(gang.DIAG_KERNELS, counts[i])
@@ -2188,7 +2235,7 @@ class Scheduler:
         tr = self.tracer
         t_h = tr.now() if tr.enabled else None
         t_d2h = time.perf_counter()
-        both = self._d2h(rec["results"])
+        both = self._d2h(rec["results"], kernel="chain.chain_dispatch")
         self.phases.add("d2h", time.perf_counter() - t_d2h)
         wstats = rec.get("wave_stats")
         self.prom.recorder.observe(
@@ -2199,7 +2246,11 @@ class Scheduler:
         wave_groups = None
         if wstats is not None:
             wave_groups = self._wave_resolve(
-                rec["fwk"], rec["batch"], both[0], wstats
+                rec["fwk"],
+                rec["batch"],
+                both[0],
+                wstats,
+                kernel="chain.chain_dispatch",
             )
         self._process_results(
             rec["fwk"],
@@ -2210,6 +2261,7 @@ class Scheduler:
             rec["reasons"],
             outcomes,
             wave_groups=wave_groups,
+            kernel="chain.chain_dispatch",
         )
         self._record_batch_metrics(
             rec["fwk"].profile_name,
@@ -2790,7 +2842,8 @@ class Scheduler:
                 wl_dev["gang_admit"],
                 wl_dev["gang_landed"],
                 wl_dev["claim_node"] if dt is not None else None,
-            )
+            ),
+            kernel="coscheduling.workloads_run",
         )
         chosen, n_feas, raw, spec, gang_admit, gang_landed, claim_node = (
             fetched
@@ -2957,7 +3010,9 @@ class Scheduler:
                     )
                     continue
                 if counts is None:
-                    counts = self._d2h(reason_counts)
+                    counts = self._d2h(
+                        reason_counts, kernel="coscheduling.workloads_run"
+                    )
                 diag = {
                     k: int(c)
                     for k, c in zip(gang.DIAG_KERNELS, counts[i])
@@ -3017,7 +3072,7 @@ class Scheduler:
             outcomes.append(outcome)
         self.phases.add("commit", time.perf_counter() - t_commit)
 
-    def _wave_resolve(self, fwk, batch, chosen, wstats_dev):
+    def _wave_resolve(self, fwk, batch, chosen, wstats_dev, kernel=None):
         """Harvest one wave's speculation stats: admitted/demoted counters,
         a ``wave_demoted`` flight-recorder event (with the conflicting
         term) per corrected pod, and — when the framework permits lean
@@ -3029,7 +3084,7 @@ class Scheduler:
         from kubernetes_tpu.ops import wave as wave_ops
 
         t0 = time.perf_counter()
-        stats = np.asarray(self._d2h(wstats_dev))
+        stats = np.asarray(self._d2h(wstats_dev, kernel=kernel))
         n = len(batch)
         spec, kinds, cterms = stats[0][:n], stats[1][:n], stats[2][:n]
         chosen_n = np.asarray(chosen)[:n]
@@ -3200,7 +3255,12 @@ class Scheduler:
             res = ops_fp.static_eval(
                 dc, db, enabled=enabled, has_images=has_images
             )
-            res = {k: np.asarray(v) for k, v in self._d2h(res).items()}
+            res = {
+                k: np.asarray(v)
+                for k, v in self._d2h(
+                    res, kernel="fastpath.static_eval"
+                ).items()
+            }
             for k, s in order.items():
                 row = {name: res[name][s] for name in res}
                 # Normalized static scores are argmax-neutral ONLY when
@@ -3517,13 +3577,18 @@ class Scheduler:
             rstats_dev = rec.get("rstats_dev")
             t_d2h = time.perf_counter()
             if rstats_dev is not None:
-                fetched = self._d2h((rec["choices_dev"], rstats_dev))
+                fetched = self._d2h(
+                    (rec["choices_dev"], rstats_dev),
+                    kernel="resident.resident_run",
+                )
                 choices_np = np.asarray(fetched[0])[: len(batch)]
                 rstats = np.asarray(fetched[1])
             else:
-                choices_np = np.asarray(self._d2h(rec["choices_dev"]))[
-                    : len(batch)
-                ]
+                choices_np = np.asarray(
+                    self._d2h(
+                        rec["choices_dev"], kernel="fastpath.sig_scan"
+                    )
+                )[: len(batch)]
                 rstats = None
             choices = choices_np.tolist()
             self.phases.add("d2h", time.perf_counter() - t_d2h)
@@ -4382,7 +4447,9 @@ class Scheduler:
                     else fwk.device_enabled(),
                     has_images=False,
                 )
-                candidates = np.asarray(self._d2h(res["mask"]))
+                candidates = np.asarray(
+                    self._d2h(res["mask"], kernel="fastpath.static_eval")
+                )
             except Exception:  # noqa: BLE001 — narrowing is best-effort
                 candidates = None
         diags: List[Dict[str, int]] = [dict() for _ in pods]
@@ -4625,20 +4692,21 @@ class Scheduler:
                 from kubernetes_tpu.ops import wire
 
                 t = wire.device_put_packed(tree)
+                masks_dev = ops_preemption.narrow_candidates(
+                    dc,
+                    DeviceBatch.from_host(pb),
+                    t["vnode"],
+                    t["vprio"],
+                    t["vreq"],
+                    t["groups"],
+                    t["pg"],
+                    batch_node=t.get("bnode"),
+                    batch_prio=t.get("bprio"),
+                    batch_req=t.get("breq"),
+                )
                 masks = np.asarray(
                     self._d2h(
-                        ops_preemption.narrow_candidates(
-                            dc,
-                            DeviceBatch.from_host(pb),
-                            t["vnode"],
-                            t["vprio"],
-                            t["vreq"],
-                            t["groups"],
-                            t["pg"],
-                            batch_node=t.get("bnode"),
-                            batch_prio=t.get("bprio"),
-                            batch_req=t.get("breq"),
-                        )
+                        masks_dev, kernel="preemption.narrow_candidates"
                     )
                 )
                 names = nt.names

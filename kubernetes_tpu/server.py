@@ -6,12 +6,15 @@ around the embeddable Scheduler:
   * ``SchedulerServer`` — owns the scheduling loop thread, an HTTP mux
     serving /healthz, /readyz (handler-sync gated, server.go:202-211),
     /metrics (Prometheus text exposition), /configz, and the
-    observability debug endpoints (OBSERVABILITY.md):
+    observability debug endpoints (OBSERVABILITY.md; the catalogue lives
+    in ``DEBUG_ENDPOINTS`` and is served as a JSON index at /debug/):
     /debug/trace (start/stop/export span tracing),
     /debug/flightrecorder?pod= (per-pod lifecycle events),
-    /debug/explain?pod= (per-node, per-plugin rejection reasons), and
+    /debug/explain?pod= (per-node, per-plugin rejection reasons),
     /debug/slo (live SLI snapshot, per-stage latency breakdown,
-    last-breach record + black-box trace);
+    last-breach record + black-box trace),
+    /debug/plan (counterfactual planners), and
+    /debug/kernels (the device telemetry ledger's per-kernel table);
   * ``LeaseElector`` — Lease-based leader election
     (client-go/tools/leaderelection/leaderelection.go:116 semantics:
     LeaseDuration/RenewDeadline/RetryPeriod over a CAS'd lease record);
@@ -32,6 +35,77 @@ from typing import Dict, List, Optional
 from urllib.parse import parse_qs, urlparse
 
 from kubernetes_tpu.scheduler import Scheduler
+
+# ---------------------------------------------------------------------------
+# Debug-endpoint catalogue: the ONE table both surfaces render from —
+# GET /debug/ serves it as a JSON index, and the handler's plain-text
+# help block is generated from it below (debug_help_text), so the two
+# can never drift.
+# ---------------------------------------------------------------------------
+
+DEBUG_ENDPOINTS = (
+    (
+        "/debug/",
+        "",
+        "this JSON index of the debug endpoints",
+    ),
+    (
+        "/debug/cache",
+        "",
+        "cache + queue dump with the informer ground-truth comparer (text)",
+    ),
+    (
+        "/debug/trace",
+        "?action=start|stop|export|status",
+        "span tracer control + Perfetto-loadable export (default: status)",
+    ),
+    (
+        "/debug/flightrecorder",
+        "?pod=<uid|name>",
+        "per-pod lifecycle breadcrumbs (default: ring stats + tail)",
+    ),
+    (
+        "/debug/explain",
+        "?pod=<uid|name>[&whatif_node=<node>][&max_nodes=N]",
+        "per-node per-plugin rejection reasons; preemption what-if",
+    ),
+    (
+        "/debug/slo",
+        "?action=status|trace",
+        "live SLI snapshot + burn rates; last breach's black-box trace",
+    ),
+    (
+        "/debug/plan",
+        "?planner=autoscale|deschedule|preempt_cost[&...]",
+        "counterfactual planners over batched [K,P,N] snapshot forks "
+        "(default: the planner catalogue)",
+    ),
+    (
+        "/debug/kernels",
+        "?cost=0|1",
+        "device telemetry ledger: per-kernel dispatches, p50/p99 execute, "
+        "compiles, est. FLOPs, d2h bytes, HBM, sentinel state",
+    ),
+)
+
+
+def debug_endpoint_index() -> dict:
+    """The /debug/ response body."""
+    return {
+        "endpoints": [
+            {"path": p, "params": params, "description": desc}
+            for p, params, desc in DEBUG_ENDPOINTS
+        ]
+    }
+
+
+def debug_help_text() -> str:
+    """The plain-text help block, rendered from DEBUG_ENDPOINTS."""
+    width = max(len(p + params) for p, params, _ in DEBUG_ENDPOINTS)
+    return "\n".join(
+        f"  {(p + params).ljust(width)}   {desc}"
+        for p, params, desc in DEBUG_ENDPOINTS
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -271,21 +345,22 @@ class SchedulerServer:
                     self._send(404, "not found")
 
             def _debug_get(self, parsed):
-                """The observability debug mux (OBSERVABILITY.md):
-
-                  /debug/cache                       dump + comparer (text)
-                  /debug/trace?action=start|stop|export   default: status
-                  /debug/flightrecorder?pod=<uid|name>    default: stats
-                  /debug/explain?pod=<uid|name>[&whatif_node=<node>]
-                  /debug/slo?action=status|trace          default: status
-                  /debug/plan?planner=autoscale|deschedule|preempt_cost
-                      [&shapes=a,b][&max_count=N][&max_candidates=N]
-                      default: the planner catalogue
-                """
+                # docstring generated from DEBUG_ENDPOINTS after the
+                # class body — one table, both surfaces
                 q = parse_qs(parsed.query)
                 path = parsed.path
                 sched = srv.sched
-                if path == "/debug/cache":
+                if path == "/debug/":
+                    # the bare prefix: a JSON index of everything below,
+                    # with ?format=text for the generated help block
+                    if q.get("format", ["json"])[0] == "text":
+                        self._send(
+                            200,
+                            "debug endpoints:\n" + debug_help_text() + "\n",
+                        )
+                    else:
+                        self._send_json(debug_endpoint_index())
+                elif path == "/debug/cache":
                     self._send(
                         200,
                         srv.debugger.dump()
@@ -390,6 +465,18 @@ class SchedulerServer:
                     out = run_planner(sched, name, params)
                     bad = name != "list" and name not in PLANNERS
                     self._send_json(out, code=400 if bad else 200)
+                elif path == "/debug/kernels":
+                    # the device telemetry ledger (observability/
+                    # kernels.py): per-kernel dispatch/compile/d2h
+                    # accounting + live HBM + sentinel state.  ?cost=0
+                    # skips the lazy FLOPs estimate (its first request
+                    # per shape pays a lowering re-trace; memoized after)
+                    led = sched.kernels
+                    if not led.enabled:
+                        self._send_json({"enabled": False})
+                        return
+                    want_cost = q.get("cost", ["1"])[0] not in ("0", "false")
+                    self._send_json(led.snapshot(cost=want_cost))
                 elif path == "/debug/slo":
                     # the steady-state SLO tier (observability/slo.py):
                     # live SLI snapshot + per-stage breakdown + last-breach
@@ -416,11 +503,20 @@ class SchedulerServer:
                             {"error": f"unknown action {action!r}"}, code=400
                         )
                 else:
-                    self._send_json({"error": "not found"}, code=404)
+                    self._send_json(
+                        {"error": "not found", **debug_endpoint_index()},
+                        code=404,
+                    )
 
             def log_message(self, *a):  # quiet
                 pass
 
+        # the mux help IS the endpoint table (satellite contract: the
+        # JSON index and this text block cannot drift apart)
+        Handler._debug_get.__doc__ = (
+            "The observability debug mux (OBSERVABILITY.md):\n\n"
+            + debug_help_text()
+        )
         self.http = ThreadingHTTPServer(("127.0.0.1", port), Handler)
         self.port = self.http.server_port
         self._http_thread = threading.Thread(
