@@ -30,10 +30,10 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 BASELINE_PODS_PER_S = 270.0  # performance-config.yaml:51 floor
 
 
-def _mk_sched():
+def _mk_sched(configuration=None):
     from kubernetes_tpu.scheduler import Scheduler
 
-    sched = Scheduler()
+    sched = Scheduler(configuration=configuration)
     bindings = {}
     sched.binding_sink = lambda pod, node: bindings.__setitem__(pod.uid, node)
 
@@ -56,7 +56,9 @@ def _drain(sched):
     return ok, dt
 
 
-def _run_workload(nodes, pods, warm=None, trace=False, config=None):
+def _run_workload(
+    nodes, pods, warm=None, trace=False, config=None, configuration=None
+):
     """Warm the jit caches at FINAL bucket shapes (two full batches cover
     both the direct and chained dispatch paths, with the capacity hint
     pre-sized to the whole workload), then time the rest — the steady-state
@@ -66,7 +68,10 @@ def _run_workload(nodes, pods, warm=None, trace=False, config=None):
     Default warm covers the fast path's EXTENDED device-batch shape
     (fast_batch_max) so the sig_scan kernel compiles here; scan-path
     workloads pass warm=batch_size+64 (their batches never extend)."""
-    sched, _ = _mk_sched()
+    # `configuration` builds the Scheduler with it (init-time knobs like
+    # meshDispatch resolve in __init__); `config` setattrs post-init
+    # (dispatch-time knobs like the compat drain's sampling flags)
+    sched, _ = _mk_sched(configuration)
     # config overrides (e.g. the compat drain's sampling knobs) — applied
     # before any scheduling so every drain below sees them
     for k, v in (config or {}).items():
@@ -141,6 +146,69 @@ def bench_basic(n_nodes, n_pods):
         for i in range(n_pods)
     ]
     return _run_workload(_basic_nodes(n_nodes), pods)
+
+
+def bench_multichip(n_nodes=1000, n_pods=10000, pods_axis=None):
+    """Config 8: the mesh-partitioned admission engine (MULTICHIP.md) —
+    the config1 basic mix plus a spread slice (so the wave engages too),
+    drained with meshDispatch forced ON over the requested mesh layout.
+    Returns (ok, dt, sched, collective_ratio): collective_ratio is the
+    fraction of ledger-recorded dispatches whose arguments were actually
+    partitioned across >1 device — 0 on a single-device box, and a loud
+    tell when a 'multichip' bench silently ran replicated."""
+    from kubernetes_tpu.api.types import (
+        Container,
+        LabelSelector,
+        Pod,
+        TopologySpreadConstraint,
+    )
+    from kubernetes_tpu.framework.config import SchedulerConfiguration
+
+    rng = random.Random(88)
+    pods = [
+        Pod(
+            name=f"pod-{i}",
+            labels={"app": f"app-{i % 10}"},
+            containers=[
+                Container(
+                    name="c",
+                    requests={
+                        "cpu": f"{rng.choice([100, 250, 500])}m",
+                        "memory": f"{rng.choice([128, 256, 512])}Mi",
+                    },
+                )
+            ],
+        )
+        for i in range(n_pods - n_pods // 10)
+    ] + [
+        Pod(
+            name=f"spread-{i}",
+            labels={"app": "mesh-spread"},
+            topology_spread_constraints=(
+                TopologySpreadConstraint(
+                    max_skew=2,
+                    topology_key="topology.kubernetes.io/zone",
+                    when_unsatisfiable="DoNotSchedule",
+                    label_selector=LabelSelector(
+                        match_labels={"app": "mesh-spread"}
+                    ),
+                ),
+            ),
+            containers=[
+                Container(name="c", requests={"cpu": "100m", "memory": "128Mi"})
+            ],
+        )
+        for i in range(n_pods // 10)
+    ]
+    cfg = SchedulerConfiguration(
+        mesh_dispatch=True, mesh_pods_axis=pods_axis
+    )
+    ok, dt, sched = _run_workload(
+        _basic_nodes(n_nodes), pods, configuration=cfg
+    )
+    st = sched.kernels.stats()
+    ratio = st["multi_device_dispatches"] / max(st["dispatches"], 1)
+    return ok, dt, sched, round(ratio, 4)
 
 
 def bench_affinity_taints(n_nodes, n_pods):
@@ -1016,6 +1084,20 @@ def main():
     n_pods = int(os.environ.get("BENCH_PODS", "10000"))
     full = os.environ.get("BENCH_FULL", "1") != "0"
 
+    # --mesh PAxNA (or --mesh=PAxNA / BENCH_MESH): the config8 multichip
+    # line's mesh layout, wired through make_mesh(pods_axis=)
+    mesh_spec = os.environ.get("BENCH_MESH")
+    argv = sys.argv[1:]
+    for i, a in enumerate(argv):
+        if a == "--mesh" and i + 1 < len(argv):
+            mesh_spec = argv[i + 1]
+        elif a.startswith("--mesh="):
+            mesh_spec = a.split("=", 1)[1]
+    if mesh_spec and not full:
+        # config8 rides the full-bench section; silently dropping an
+        # explicit layout request would fake a missing multichip line
+        raise SystemExit("--mesh/BENCH_MESH requires BENCH_FULL=1")
+
     # --analyze: refuse to emit any bench artifact from a dirty tree
     if "--analyze" in sys.argv[1:]:
         if not analyze_preflight():
@@ -1327,6 +1409,51 @@ def main():
             f"{q_s / max(b_s, 1e-9):.1f}x",
             file=sys.stderr,
         )
+        # config8: mesh-partitioned dispatch (ISSUE 14; MULTICHIP.md).
+        # Runs when the backend has >1 device or a --mesh layout was
+        # requested.  Floor-less everywhere a virtual-device emulation is
+        # in play: config8_multichip_virtual_devices marks such runs and
+        # tests/test_bench_floors REFUSES a ratcheted config8 floor for
+        # them (forced-host devices share one CPU — their throughput is
+        # an emulation artifact, not a hardware fact).
+        import jax as _jax
+
+        if mesh_spec or len(_jax.devices()) > 1:
+            from kubernetes_tpu.parallel.mesh import parse_mesh_shape
+
+            pods_axis = None
+            if mesh_spec:
+                pa8, na8 = parse_mesh_shape(mesh_spec)
+                if pa8 * na8 != len(_jax.devices()):
+                    raise SystemExit(
+                        f"--mesh {mesh_spec}: {pa8 * na8} devices requested, "
+                        f"backend has {len(_jax.devices())}"
+                    )
+                pods_axis = pa8
+            n8 = int(os.environ.get("BENCH_MESH_PODS", "10000"))
+            ok8, dt8, s8, ratio8 = bench_multichip(
+                1000, n8, pods_axis=pods_axis
+            )
+            virtual8 = "xla_force_host_platform_device_count" in os.environ.get(
+                "XLA_FLAGS", ""
+            )
+            configs["config8_multichip_devices"] = s8.mesh.size
+            configs["config8_multichip_mesh"] = (
+                f"{s8.mesh.shape['pods']}x{s8.mesh.shape['nodes']}"
+            )
+            configs["config8_multichip_pods_per_s"] = (
+                0.0 if ratio8 == 0 and s8.mesh.size > 1 else round(ok8 / dt8, 1)
+            )
+            configs["config8_multichip_collective_ratio"] = ratio8
+            configs["config8_multichip_virtual_devices"] = virtual8
+            print(
+                f"# config8 multichip: {ok8} pods in {dt8:.2f}s on "
+                f"{s8.mesh.size} devices (mesh "
+                f"{configs['config8_multichip_mesh']}, collective ratio "
+                f"{ratio8:.2%}, virtual={virtual8}; "
+                f"{_mix(s8)})",
+                file=sys.stderr,
+            )
 
     if full and os.environ.get("BENCH_PARITY", "1") != "0":
         # north-star-scale decision-parity evidence (device fast pipeline

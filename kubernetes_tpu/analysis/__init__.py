@@ -32,8 +32,10 @@ toolchain):
     ``accum(...)`` carry contracts);
   * ``shard`` — classifies every op against the ('pods','nodes') mesh:
     N-axis reductions/gathers must sit under a helper declared in the
-    module's ``_KTPU_N_COLLECTIVES`` roster (the multichip refactor's
-    collective inventory).
+    module's ``_KTPU_N_COLLECTIVES`` roster (the multichip collective
+    inventory, MULTICHIP.md), and every roster entry must carry a
+    ``resolved(collective|local|replicated): <how>`` sharding story —
+    the worklist is a burn-down, not a parking lot.
 
 Plus a runtime sanitizer (``KTPU_SANITIZE=1``, see ``sanitizer.py``),
 including the jit recompile hook (``scheduler_tpu_jit_recompiles_total``)
@@ -66,6 +68,7 @@ from kubernetes_tpu.analysis.shape import (
     DtypeChecker,
     ShapeChecker,
     ShardChecker,
+    collective_roster,
 )
 
 _PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -251,6 +254,7 @@ __all__ = [
     "Finding",
     "run_analysis",
     "default_targets",
+    "collective_roster",
     "render_text",
     "render_json",
 ]

@@ -76,6 +76,19 @@ from kubernetes_tpu.analysis.jit import _jit_decoration
 # with node-major snapshot tensors partitioned over 'nodes', i.e. dim N)
 NODE_AXIS = "N"
 
+# A roster entry is RESOLVED once its reason leads with an explicit
+# sharding story: ``resolved(<mechanism>): <how>`` where mechanism is
+#   collective — GSPMD inserts the cross-shard psum/all-gather/all-to-all
+#   local      — the op addresses only the owning shard's rows (rank-1
+#                commits, fork-axis parallelism)
+#   replicated — the crossed operand replicates on the mesh, so the
+#                "crossing" is shard-local by layout
+# Unresolved entries are findings: the multichip worklist is a BURN-DOWN
+# (MULTICHIP.md inventory), not a parking lot.
+RESOLVED_ROSTER_RE = re.compile(
+    r"^resolved\((collective|local|replicated)\):\s+\S"
+)
+
 _ANNOT_RE = re.compile(
     r"#\s*ktpu:\s*(axes|static|accum|noinstantiate)\b\s*(.*)$"
 )
@@ -649,6 +662,7 @@ class _ModIndex:
         self.imports: Dict[str, Tuple[str, Optional[str]]] = {}
         # local name -> ('jnp'|'np'|'jax'|'lax', None) or (module_base, sym)
         self.roster: Dict[str, str] = {}
+        self.roster_lines: Dict[str, int] = {}  # qual -> dict-key lineno
         self.axes_table: Dict[str, Dict[str, str]] = {}
 
 
@@ -674,6 +688,19 @@ class ShapeEngine:
     def run(self, mods: Sequence[SourceModule]) -> "ShapeEngine":
         for mod in mods:
             self._index(mod)
+        for mi in self.mods.values():
+            for qual, reason in sorted(mi.roster.items()):
+                if not RESOLVED_ROSTER_RE.match(reason):
+                    self.emit(
+                        RULE_SHARD,
+                        mi.mod,
+                        mi.roster_lines.get(qual, 1),
+                        f"_KTPU_N_COLLECTIVES entry {qual!r} has no "
+                        "resolved sharding story — prefix the reason with "
+                        "'resolved(collective|local|replicated): <how>' "
+                        "once the site has an explicit cross-shard "
+                        "treatment (MULTICHIP.md inventory)",
+                    )
         for mi in self.mods.values():
             self.class_tables.update(mi.axes_table)
         for mi in self.mods.values():
@@ -716,6 +743,19 @@ class ShapeEngine:
         roster = module_literal(mod.tree, "_KTPU_N_COLLECTIVES")
         if isinstance(roster, dict):
             mi.roster = {str(k): str(v) for k, v in roster.items()}
+            # per-entry line numbers: the burn-down findings (and their
+            # suppressions) anchor to the entry's own dict-key line
+            for node in mod.tree.body:
+                if (
+                    isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and node.targets[0].id == "_KTPU_N_COLLECTIVES"
+                    and isinstance(node.value, ast.Dict)
+                ):
+                    for k in node.value.keys:
+                        if isinstance(k, ast.Constant):
+                            mi.roster_lines[str(k.value)] = k.lineno
         axes = module_literal(mod.tree, "_KTPU_AXES")
         if isinstance(axes, dict):
             mi.axes_table = {
@@ -2186,6 +2226,9 @@ class ShapeEngine:
             if args and isinstance(args[0], Arr):
                 return Arr(None, dt)
             return UNKNOWN
+        if name in ("with_sharding_constraint", "stop_gradient"):
+            # layout/AD annotations: identity on shape and dtype
+            return args[0] if args else UNKNOWN
         if name == "top_k":
             return UNKNOWN
         if name == "slice":
@@ -3007,6 +3050,31 @@ class DtypeChecker(_EngineChecker):
 
 class ShardChecker(_EngineChecker):
     rule = RULE_SHARD
+
+
+def collective_roster(mods: Sequence[SourceModule]) -> Dict[str, Dict]:
+    """The parsed ``_KTPU_N_COLLECTIVES`` inventory across ``mods``:
+    ``{module path: {qual: {reason, resolved, mechanism, line}}}`` — the
+    machine-readable multichip burn-down (MULTICHIP.md inventory table,
+    tests/test_static_analysis roster gate)."""
+    engine = ShapeEngine()
+    for m in mods:
+        engine._index(m)
+    out: Dict[str, Dict] = {}
+    for mi in engine.mods.values():
+        if not mi.roster:
+            continue
+        entries = {}
+        for qual, reason in sorted(mi.roster.items()):
+            m2 = RESOLVED_ROSTER_RE.match(reason)
+            entries[qual] = {
+                "reason": reason,
+                "resolved": bool(m2),
+                "mechanism": m2.group(1) if m2 else None,
+                "line": mi.roster_lines.get(qual, 1),
+            }
+        out[mi.mod.path] = entries
+    return out
 
 
 # ---------------------------------------------------------------------------

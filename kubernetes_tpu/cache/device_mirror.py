@@ -46,7 +46,12 @@ def _dus(full, delta, start):
 def _delta_applier(spec, treedef, with_rows: bool):
     """One jitted splice per delta signature: unpacks the single wire
     buffer (usage rows + appended pod/term rows + cursors) and merges it
-    into the donated DeviceCluster — one transfer, one dispatch."""
+    into the donated DeviceCluster — one transfer, one dispatch.
+
+    Mesh note: under meshDispatch the incoming ``dc`` is mesh-committed
+    and ``buf`` is replicated on the same mesh; GSPMD propagates the
+    input shardings through the splice, so the output stays partitioned
+    (sync() re-asserts the placement — a no-op when propagation held)."""
     from kubernetes_tpu.ops import wire
 
     # ktpu: axes(dc=DeviceCluster, buf=u8[B])
@@ -97,13 +102,19 @@ _TERM_FIELDS = {
 
 class DeviceClusterCache:
     """Keeps one DeviceCluster in HBM, synced incrementally from the host
-    mirror.  `sync()` returns the up-to-date device snapshot."""
+    mirror.  `sync()` returns the up-to-date device snapshot.
 
-    def __init__(self) -> None:
+    With a ``mesh``, the snapshot is PLACED on it (parallel/mesh.py
+    cluster_shardings: node-major tensors partitioned over the 'nodes'
+    axis, everything else replicated) so every consumer kernel runs
+    SPMD-partitioned; delta uploads ride a replicated wire buffer."""
+
+    def __init__(self, mesh=None) -> None:
         self._dc = None
         self._key = None
         self._e_done = 0
         self._m_done = 0
+        self._mesh = mesh
 
     def invalidate(self) -> None:
         self._dc = None
@@ -127,7 +138,12 @@ class DeviceClusterCache:
             len(vocab.label_keys),
         )
         if self._dc is None or key != self._key:
-            self._dc = DeviceCluster.from_host(nt, ep, vocab)
+            dc = DeviceCluster.from_host(nt, ep, vocab)
+            if self._mesh is not None:
+                from kubernetes_tpu.parallel.mesh import place_cluster
+
+                dc = place_cluster(self._mesh, dc)
+            self._dc = dc
             self._key = key
             self._e_done = mirror.e_used
             self._m_done = mirror.m_used
@@ -172,8 +188,21 @@ class DeviceClusterCache:
             tree["e0"] = np.asarray(e0, np.int32)
             tree["m0"] = np.asarray(m0, np.int32)
         buf, spec, treedef = wire.pack_tree(tree)
-        self._dc = _delta_applier(spec, treedef, with_rows)(
-            self._dc, jax.device_put(buf)
-        )
+        if self._mesh is not None:
+            from kubernetes_tpu.parallel.mesh import place_cluster, replicated
+
+            # the wire buffer must commit to the SAME mesh as the resident
+            # snapshot (mixed device sets are a jit error); re-asserting
+            # the cluster placement after the splice is a no-op when GSPMD
+            # propagation kept it, and repairs it when it didn't
+            buf_dev = jax.device_put(buf, replicated(self._mesh))
+            applied = _delta_applier(spec, treedef, with_rows)(
+                self._dc, buf_dev
+            )
+            self._dc = place_cluster(self._mesh, applied)
+        else:
+            self._dc = _delta_applier(spec, treedef, with_rows)(
+                self._dc, jax.device_put(buf)
+            )
         self._e_done, self._m_done = e1, m1
         return self._dc

@@ -71,6 +71,10 @@ class SnapshotMirror:
         # expected total placed pods (queue pressure) — pre-sizes the E/M
         # axes so the gang pipeline compiles ONCE instead of per doubling
         self.e_cap_hint = 0
+        # node-bucket divisibility for mesh-partitioned dispatch: the
+        # scheduler sets this to the mesh's nodes-axis size so every pack
+        # pads N to a shardable multiple (parallel/mesh.py asserts it)
+        self.node_pad_multiple = 1
 
     @property
     def e_used(self) -> int:
@@ -369,7 +373,11 @@ class SnapshotMirror:
             for k, v in p.labels.items():
                 self.vocab.intern_label(k, v)
             self.vocab.namespaces.intern(p.namespace)
-        self.nodes = pack_nodes([cn.node for cn in real], self.vocab)
+        self.nodes = pack_nodes(
+            [cn.node for cn in real],
+            self.vocab,
+            n_multiple=self.node_pad_multiple,
+        )
         accumulate_node_usage(self.nodes, placed, self.vocab)
         self._existing = pack_existing_pods(
             placed,

@@ -201,6 +201,23 @@ class SchedulerConfiguration:
     # + branch per dispatch and nothing records (decision-identical
     # either way: the ledger only observes).
     kernel_ledger: bool = True
+    # TPU extension: mesh-partitioned dispatch (parallel/mesh.py,
+    # MULTICHIP.md) — the unified admission engine's inputs are placed on
+    # the ('pods', 'nodes') device mesh, so every hot kernel (wave /
+    # workloads / resident / counterfactual) runs SPMD-partitioned: pod
+    # batches shard the pods axis (zero-collective speculation), node-major
+    # snapshot tensors shard the nodes axis (per-term carries reduce
+    # across shards; GSPMD inserts the psum/all-gather at the conflict
+    # compare and final argmax).  None = AUTO: on whenever the backend
+    # exposes more than one device.  Decisions are bit-identical in every
+    # mode (multichip_vs_singlechip paritycheck, tests/test_multichip.py).
+    mesh_dispatch: Optional[bool] = None
+    # pods axis of the mesh (devices / pods_axis = nodes axis).  None =
+    # make_mesh default: all devices on the pods axis — the layout with
+    # zero collectives in the hot path (right for small clusters / big
+    # batches); 1 puts every device on the nodes axis (right for huge
+    # clusters).
+    mesh_pods_axis: Optional[int] = None
     # Bit-compat knobs (SURVEY §7 "decision-identical tie-breaking"):
     # full-width evaluation is the TPU-native default; these opt into the
     # reference's sampling + randomized-tie semantics.
@@ -236,6 +253,8 @@ class SchedulerConfiguration:
             raise ValueError("percentageOfNodesToScore must be in [0, 100]")
         if self.batch_size <= 0:
             raise ValueError("batchSize must be positive")
+        if self.mesh_pods_axis is not None and self.mesh_pods_axis <= 0:
+            raise ValueError("meshPodsAxis must be positive")
         for p in self.profiles:
             if not p.scheduler_name:
                 raise ValueError("profile schedulerName must be non-empty")
@@ -497,6 +516,8 @@ def load_config(source) -> SchedulerConfiguration:
         gang_dispatch=d.get("gangDispatch", True),
         planner_kernel=d.get("plannerKernel", True),
         kernel_ledger=d.get("kernelLedger", True),
+        mesh_dispatch=d.get("meshDispatch"),
+        mesh_pods_axis=d.get("meshPodsAxis"),
         reference_sampling_compat=d.get("referenceSamplingCompat", False),
         tie_break_seed=d.get("tieBreakSeed"),
     )
@@ -558,6 +579,8 @@ def dump_config(cfg: SchedulerConfiguration) -> dict:
         "gangDispatch": cfg.gang_dispatch,
         "plannerKernel": cfg.planner_kernel,
         "kernelLedger": cfg.kernel_ledger,
+        "meshDispatch": cfg.mesh_dispatch,
+        "meshPodsAxis": cfg.mesh_pods_axis,
         "referenceSamplingCompat": cfg.reference_sampling_compat,
         "tieBreakSeed": cfg.tie_break_seed,
         "featureGates": dict(cfg.feature_gates),

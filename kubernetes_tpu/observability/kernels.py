@@ -19,8 +19,11 @@ that accounts each dispatch:
     counts into ``compiles``/``compile_s`` instead of the execute series,
     so a compile storm can't masquerade as a kernel regression.
   * **batch-shape buckets** — dispatches are keyed by the (shapes,
-    dtypes, statics) of their arguments; each kernel reports its bucket
-    population, and the bucket's abstract args are retained (as
+    dtypes, statics) of their arguments PLUS their device placement
+    (device count + mesh axis shape off the most-sharded argument), so a
+    mesh-partitioned dispatch never shares an execute-time series — or a
+    sentinel baseline — with its single-chip twin; each kernel reports
+    its bucket population, and the bucket's abstract args are retained (as
     ``ShapeDtypeStruct`` leaves — never the arrays, which may be donated)
     for cost analysis.
   * **XLA cost estimates** — ``fn.lower(*abstract).cost_analysis()``
@@ -162,13 +165,45 @@ def _leaf_key(leaf):
 
 
 def _bucket_key(args, kwargs) -> tuple:
-    """The dispatch's batch-shape bucket: flat leaf tokens in pytree
-    order (dict keys sort deterministically under tree_flatten), so two
-    calls share a bucket exactly when jit would share an executable
-    (modulo weak types)."""
-    return tuple(
-        _leaf_key(leaf) for leaf in jax.tree_util.tree_leaves((args, kwargs))
-    )
+    """The dispatch's batch-shape bucket + its device placement: flat
+    leaf tokens in pytree order (dict keys sort deterministically under
+    tree_flatten), so two calls share a bucket exactly when jit would
+    share an executable (modulo weak types) — PLUS the dispatch's device
+    count and mesh axis shape, read off the most-sharded array argument.
+    Single-chip and mesh-partitioned dispatches of the same shapes are
+    different executables with different cost profiles; keying them apart
+    keeps the execute-time series (and the regression sentinel's EWMA
+    baseline) from smearing into one meaningless average.
+
+    Returns ``(key, n_devices, mesh_shape)`` where mesh_shape is a tuple
+    of (axis_name, size) pairs (empty off-mesh)."""
+    ndev, mesh_shape = 1, ()
+    toks = []
+    for leaf in jax.tree_util.tree_leaves((args, kwargs)):
+        toks.append(_leaf_key(leaf))
+        sh = getattr(leaf, "sharding", None)
+        if sh is None:
+            continue
+        try:
+            # a replicated placement spans the mesh's device set without
+            # PARTITIONING anything — counting it would let a silently
+            # replicated run satisfy every engagement guard (bench
+            # collective_ratio, the paritycheck __engaged__ check)
+            if sh.is_fully_replicated:
+                continue
+            n = len(sh.device_set)
+        except Exception:  # noqa: BLE001 — placement probing is best-effort
+            continue
+        if n > ndev:
+            ndev = n
+            m = getattr(sh, "mesh", None)
+            try:
+                mesh_shape = tuple(
+                    (str(k), int(v)) for k, v in m.shape.items()
+                )
+            except Exception:  # noqa: BLE001
+                mesh_shape = ()
+    return tuple(toks) + (("devices", ndev, mesh_shape),), ndev, mesh_shape
 
 
 def _abstract_spec(args, kwargs):
@@ -237,8 +272,9 @@ class DispatchLedger:
         if not jax.core.trace_state_clean():
             return fn(*args, **kwargs)
         # the bucket key is built BEFORE the call: args may be donated,
-        # and their metadata must be read while they're live
-        key = _bucket_key(args, kwargs)
+        # and their metadata (shapes AND shardings) must be read while
+        # they're live
+        key, ndev, mesh_shape = _bucket_key(args, kwargs)
         size_before = fn._cache_size()
         with self._mu:
             ks = self._kstats.get(name)
@@ -270,7 +306,12 @@ class DispatchLedger:
             ks.dispatches += 1
             b = ks.buckets.get(key)
             if b is None:
-                b = ks.buckets[key] = {"count": 0, "spec": spec}
+                b = ks.buckets[key] = {
+                    "count": 0,
+                    "spec": spec,
+                    "devices": ndev,
+                    "mesh": mesh_shape,
+                }
             elif b["spec"] is None and spec is not None:
                 b["spec"] = spec
             b["count"] += 1
@@ -421,6 +462,24 @@ class DispatchLedger:
                 ks = self._kstats.get(name)
                 if ks is None:
                     ks = _KernelStats()
+                # device placement summary: which device counts / mesh
+                # shapes this kernel's dispatches ran on (bucket-keyed, so
+                # single-chip vs multichip series never smear — ISSUE 14)
+                dev_counts = sorted(
+                    {b.get("devices", 1) for b in ks.buckets.values()}
+                ) or [1]
+                mesh_shapes = sorted(
+                    {
+                        "x".join(str(s) for _a, s in b["mesh"])
+                        for b in ks.buckets.values()
+                        if b.get("mesh")
+                    }
+                )
+                multi_dev = sum(
+                    b["count"]
+                    for b in ks.buckets.values()
+                    if b.get("devices", 1) > 1
+                )
                 row = {
                     "kernel": name,
                     "dispatches": ks.dispatches,
@@ -429,6 +488,9 @@ class DispatchLedger:
                     "compiles": ks.compiles,
                     "compile_s": round(ks.compile_s, 6),
                     "shape_buckets": len(ks.buckets),
+                    "devices": dev_counts,
+                    "mesh_shapes": mesh_shapes,
+                    "multi_device_dispatches": multi_dev,
                     "d2h_fetches": ks.d2h_fetches,
                     "d2h_bytes": ks.d2h_bytes,
                     "d2h_s": round(ks.d2h_s, 6),
@@ -468,6 +530,14 @@ class DispatchLedger:
                 "kernels": len(self._kstats),
                 "dispatches": sum(
                     ks.dispatches for ks in self._kstats.values()
+                ),
+                # dispatches whose arguments were partitioned across >1
+                # device — the bench tier's collective_ratio numerator
+                "multi_device_dispatches": sum(
+                    b["count"]
+                    for ks in self._kstats.values()
+                    for b in ks.buckets.values()
+                    if b.get("devices", 1) > 1
                 ),
                 "cost_memo_hits": self._cost_hits,
                 "cost_memo_misses": self._cost_misses,

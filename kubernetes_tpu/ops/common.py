@@ -353,9 +353,13 @@ _KTPU_AXES = {
 # collective; the multichip refactor (ROADMAP item 2) routes exactly
 # this roster through jax collectives.
 _KTPU_N_COLLECTIVES = {
-    "per_node_counts": "segment-scatter of per-pod values into [N] rows",
-    "domain_stats": "segment-reduce of [N] rows into topology domains and "
-    "gather back per node",
+    "per_node_counts": "resolved(collective): segment-scatter of per-pod "
+    "values into [N] rows — contributions route to the owning node shard "
+    "(all-to-all + local scatter-add; integer counts, order-free)",
+    "domain_stats": "resolved(collective): segment-reduce of [N] rows "
+    "into topology domains and gather back per node — per-shard partial "
+    "domain sums psum into the small replicated [D] domain table, then "
+    "the per-node gather reads it shard-locally",
 }
 
 

@@ -50,6 +50,7 @@ import jax.numpy as jnp
 from kubernetes_tpu.ops import dra as dra_ops
 from kubernetes_tpu.ops import filters as F
 from kubernetes_tpu.ops import gang
+from kubernetes_tpu.ops.gang import N_DIAG
 from kubernetes_tpu.ops import wave
 from kubernetes_tpu.ops.common import (
     DeviceBatch,
@@ -83,11 +84,20 @@ def volume_topology_mask(dc: DeviceCluster, vol_table, vol_valid, vol_bad):
 # ranks) and gathers the chosen node's take row.  Under a sharded N mesh
 # each is a cross-shard collective (ROADMAP item 2 worklist).
 _KTPU_N_COLLECTIVES = {
-    "workloads_schedule.step": "term-factored domain compare+reduce over N "
-    "+ per-node DRA match/take reductions + chosen-node row gathers "
-    "(allocation commit, gang checkpoint restore)",
-    "workloads_schedule.spec_one": "frozen-snapshot speculation: per-node "
-    "DRA match counts reduced over the device axis per node",
+    "workloads_schedule.step": "resolved(collective): term-factored "
+    "domain compare+reduce over N + per-node DRA match/take reductions + "
+    "chosen-node row gathers (allocation commit, gang checkpoint "
+    "restore) — same algebra as wave_schedule.step: per-term counts "
+    "psum across node shards at the conflict compare, the chosen-node "
+    "row gather is an owning-shard broadcast, rank-1 usage/DRA commits "
+    "stay shard-local, and the gang checkpoint save/restore is "
+    "elementwise over the carried state (no crossing)",
+    "workloads_schedule.spec_one": "resolved(local): frozen-snapshot "
+    "speculation — the vmap shards the POD axis (pods-major mesh: each "
+    "device speculates its own pods against the replicated/node-sharded "
+    "snapshot); the per-node DRA match counts reduce the device axis "
+    "(DD), not N, so the reduction is shard-local until the final "
+    "rostered argmax",
 }
 
 # carried state snapshotted at a gang's first member and restored wholesale
@@ -291,6 +301,16 @@ def workloads_schedule(
         gang_landed=jnp.asarray(0, I32),
         gang_admit=jnp.full((g_cap,), -1, I32),
         gang_landed_out=jnp.zeros((g_cap,), I32),
+        # Per-pod outputs ride CARRY buffers (not scan-stacked ys):
+        # jaxlib 0.4.37's SPMD partitioner mis-clamps the ys-stacking
+        # dynamic_update_slice (s64 scan counter vs its s32 shard
+        # arithmetic) when propagation shards the stacking axis; carry
+        # scatter writes at an i32 index partition correctly.  NOT in
+        # ck_keys: a rolled-back gang keeps its RAW choices recorded,
+        # exactly like the ys did.
+        out_raw=jnp.full((P,), ABSENT, I32),
+        out_nfeas=jnp.zeros((P,), I64),
+        out_rc=jnp.zeros((P, N_DIAG), I64),
     )
     ck_keys = _CK_KEYS + (_CK_DRA_KEYS if has_dra else ())
     if has_dra:
@@ -383,11 +403,20 @@ def workloads_schedule(
             gid_oh, landed, state["gang_landed_out"]
         )
         new_state["gang_landed"] = landed
-        return new_state, (choice, n_feas, reason_counts)
+        # p in range by construction; mode="drop" for the clamp rule
+        new_state["out_raw"] = state["out_raw"].at[p].set(choice, mode="drop")
+        new_state["out_nfeas"] = (
+            state["out_nfeas"].at[p].set(n_feas, mode="drop")
+        )
+        new_state["out_rc"] = (
+            state["out_rc"].at[p].set(reason_counts, mode="drop")
+        )
+        return new_state, None
 
-    state, (raw, n_feas, reason_counts) = jax.lax.scan(
-        step, init, jnp.arange(P, dtype=I32)
-    )
+    state, _ = jax.lax.scan(step, init, jnp.arange(P, dtype=I32))
+    raw = state["out_raw"]
+    n_feas = state["out_nfeas"]
+    reason_counts = state["out_rc"]
     tallies = {
         "requested": state["requested"],
         "nonzero": state["nonzero"],

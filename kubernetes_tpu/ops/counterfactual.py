@@ -65,9 +65,13 @@ DENSITY_SCALE = 1_000_000
 # sharded N mesh each is a cross-shard collective; the K axis itself is
 # embarrassingly parallel and would shard cleanly (ROADMAP item 1).
 _KTPU_N_COLLECTIVES = {
-    "counterfactual_run.one_fork": "per-fork snapshot-view substitution + "
-    "density/utilization reductions over the alive N axis (the admission "
-    "engine inside is workloads_schedule — its own roster entries apply)",
+    "counterfactual_run.one_fork": "resolved(local): per-fork "
+    "snapshot-view substitution + density/utilization reductions over "
+    "the alive N axis — the FORK axis is the sharded one (planner/plan.py "
+    "places the fk_* planes P('pods'): each device simulates its own "
+    "forks against the replicated snapshot, zero cross-fork collectives); "
+    "the admission engine inside is workloads_schedule, whose own roster "
+    "entries govern any in-fork N crossings",
 }
 
 

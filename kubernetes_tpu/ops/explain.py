@@ -41,8 +41,12 @@ from kubernetes_tpu.snapshot.schema import N_FIXED_LANES
 # shard-rule roster: diagnosis recomputes minMatch over the tracked
 # node set per constraint — inherently a full-N reduction
 _KTPU_N_COLLECTIVES = {
-    "explain_masks._spread_one": "per-constraint min-match over the "
-    "tracked N axis (filtering.go:313 semantics)",
+    "explain_masks._spread_one": "resolved(replicated): per-constraint "
+    "min-match over the tracked N axis (filtering.go:313 semantics) — "
+    "the explain/debug tier builds its own single-device snapshot view "
+    "(one diagnosed pod per d2h, latency-bound not throughput-bound), "
+    "so the crossed operand is whole-array by construction; were it "
+    "mesh-placed, the min-match would ride a cross-shard min-reduce",
 }
 
 

@@ -36,8 +36,11 @@ I64 = jnp.int64
 # serial core — per-step first-max argmax over all N nodes plus the
 # chosen node's gather; inherently a full-width collective per pod
 _KTPU_N_COLLECTIVES = {
-    "make_sig_step.step": "per-pod argmax/gather over the full node axis "
-    "(selectHost first-max semantics)",
+    "make_sig_step.step": "resolved(collective): per-pod argmax/gather "
+    "over the full node axis (selectHost first-max semantics) — the "
+    "packed (score, first-max-index) key all-reduces across node shards "
+    "(index tiebreak keeps first-max exact); the committed node's rank-1 "
+    "usage update stays local to the owning shard",
 }
 
 

@@ -112,22 +112,37 @@ _KTPU_AXES = {
 }
 
 # shard-rule roster: the serial verdict core and its per-pod helpers are
-# full-node-width by design — every entry is a cross-shard collective on
-# a sharded N mesh (the gang scan itself stays single-chip; the wave's
-# [T, N] algebra is the shardable path, ROADMAP item 2)
+# full-node-width by design.  Every entry carries its resolved sharding
+# story (MULTICHIP.md inventory): under meshDispatch the DeviceCluster's
+# node-major tensors are partitioned over the mesh's 'nodes' axis and
+# GSPMD lowers each rostered op to per-shard work + the named collective;
+# integer-exact arithmetic makes every reduction order-free, so the
+# partitioned result is bit-identical to the single-chip kernel.
 _KTPU_N_COLLECTIVES = {
-    "pod_step": "per-pod argmax/select over all N nodes + sampling-window "
-    "rotation gathers (selectHost / nodeTree order semantics)",
-    "spread_constraints": "min-match over the tracked N axis "
-    "(filtering.go:313 minMatch)",
-    "interpod_constraints": "per-term verdicts collapse over N-wide rows",
-    "_spread_raw": "counted-node totals + per-domain [C,N,d_cap] "
-    "compare+reduce over N",
-    "_norm_default": "score normalization max over the feasible N axis",
-    "_norm_minmax": "score normalization min+max over the feasible N axis",
-    "_norm_spread": "spread normalization min+max over the valid N axis",
-    "gang_schedule.heavy_parts": "peer-count einsum contractions over N "
-    "(the [C,N,J]/[AT,N,J] dense compare+reduce)",
+    "pod_step": "resolved(collective): per-pod argmax/select over all N "
+    "nodes + sampling-window rotation gathers (selectHost / nodeTree "
+    "order semantics) — GSPMD all-reduces the packed (key, first-index) "
+    "max across node shards; the index tiebreak in the packed key keeps "
+    "first-max semantics exact, and the chosen row gather is an "
+    "owning-shard broadcast",
+    "spread_constraints": "resolved(collective): min-match over the "
+    "tracked N axis (filtering.go:313 minMatch) — per-shard partial min "
+    "+ cross-shard min-reduce",
+    "interpod_constraints": "resolved(collective): per-term verdicts "
+    "collapse over N-wide rows — per-shard partial any/all + cross-shard "
+    "reduce",
+    "_spread_raw": "resolved(collective): counted-node totals + "
+    "per-domain [C,N,d_cap] compare+reduce over N — per-shard partial "
+    "sums psum across node shards (integer counts, order-free)",
+    "_norm_default": "resolved(collective): score normalization max over "
+    "the feasible N axis — cross-shard max-reduce",
+    "_norm_minmax": "resolved(collective): score normalization min+max "
+    "over the feasible N axis — cross-shard min/max-reduce",
+    "_norm_spread": "resolved(collective): spread normalization min+max "
+    "over the valid N axis — cross-shard min/max-reduce",
+    "gang_schedule.heavy_parts": "resolved(collective): peer-count einsum "
+    "contractions over N (the [C,N,J]/[AT,N,J] dense compare+reduce) — "
+    "per-shard partial contractions + psum of the [C,J] partials",
 }
 
 
@@ -541,6 +556,9 @@ DIAG_KERNELS = (
     "PodTopologySpread",
     "InterPodAffinity",
 )
+# literal so the shape interpreter resolves [P, N_DIAG] buffers concretely
+N_DIAG = 9
+assert N_DIAG == len(DIAG_KERNELS)
 
 # Positional weight order for the gang scan's static `weights` tuple — the
 # single source of truth is scores.DEFAULT_SCORE_WEIGHTS.
@@ -1015,6 +1033,15 @@ def gang_schedule(
         nonzero=dc.nonzero_req,
         num_pods=dc.num_pods,
         assigned=jnp.full((P,), ABSENT, I32),
+        # Per-pod outputs ride CARRY buffers written at the pod's own
+        # slot instead of scan-stacked ys: jaxlib 0.4.37's SPMD
+        # partitioner mis-clamps the ys-stacking dynamic_update_slice
+        # (s64 scan counter vs its own s32 shard arithmetic) whenever
+        # propagation shards the stacking axis — carry scatter writes at
+        # an i32 index partition correctly (`assigned` always has).
+        out_choice=jnp.full((P,), ABSENT, I32),
+        out_nfeas=jnp.zeros((P,), I64),
+        out_rc=jnp.zeros((P, N_DIAG), I64),
     )
     if sample_k is not None:
         init["sample_start"] = jnp.asarray(sample_start, I32)
@@ -1143,7 +1170,20 @@ def gang_schedule(
     def step(state, p):
         assigned_valid, eqJ = peer_view(state["assigned"])
         hv = heavy_parts(p, assigned_valid, eqJ)
-        return cheap_body(state, p, hv, jnp.asarray(True))
+        new_state, (choice, n_feas, reason_counts) = cheap_body(
+            state, p, hv, jnp.asarray(True)
+        )
+        # p in range by construction; mode="drop" for the clamp rule
+        new_state["out_choice"] = (
+            state["out_choice"].at[p].set(choice, mode="drop")
+        )
+        new_state["out_nfeas"] = (
+            state["out_nfeas"].at[p].set(n_feas, mode="drop")
+        )
+        new_state["out_rc"] = (
+            state["out_rc"].at[p].set(reason_counts, mode="drop")
+        )
+        return new_state, None
 
     def cheap_body(state, p, hv, active):
         return pod_step(
@@ -1167,9 +1207,10 @@ def gang_schedule(
             attempt_base=attempt_base,
         )
 
-    state, (chosen, n_feas, reason_counts) = jax.lax.scan(
-        step, init, jnp.arange(P, dtype=I32)
-    )
+    state, _ = jax.lax.scan(step, init, jnp.arange(P, dtype=I32))
+    chosen = state["out_choice"]
+    n_feas = state["out_nfeas"]
+    reason_counts = state["out_rc"]
     # Final node tallies let the caller chain batches without a host round
     # trip: feed them back as the next DeviceCluster's requested/nonzero/
     # num_pods (the across-batch analogue of the assume cache).

@@ -30,7 +30,9 @@ from kubernetes_tpu.snapshot.schema import PodBatch, bucket_cap
 # shard-rule roster: the one-shot pipeline ends in selectHost — a
 # full-width argmax over N (single-chip path; the batched paths shard)
 _KTPU_N_COLLECTIVES = {
-    "_pipeline": "final per-pod argmax/any/sum over the full node axis",
+    "_pipeline": "resolved(collective): final per-pod argmax/any/sum over "
+    "the full node axis — per-shard partial (key, first-index) max / "
+    "partial sums + one cross-shard all-reduce at the readback",
 }
 
 

@@ -48,8 +48,11 @@ from kubernetes_tpu.ops.common import DeviceBatch, DeviceCluster, I32
 # shard-rule roster: victim-removal totals are segment-sums of placed
 # pods INTO per-node rows — a scatter across a sharded N axis
 _KTPU_N_COLLECTIVES = {
-    "narrow_candidates.per_group": "per-priority-group segment-sum of "
-    "victim AND committed-batch-peer requests/counts into [N] rows",
+    "narrow_candidates.per_group": "resolved(collective): "
+    "per-priority-group segment-sum of victim AND committed-batch-peer "
+    "requests/counts into [N] rows — victim contributions route to the "
+    "owning node shard (GSPMD lowers the segment scatter to "
+    "all-to-all + local scatter-add; integer sums, order-free)",
 }
 
 

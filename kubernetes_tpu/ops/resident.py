@@ -67,10 +67,17 @@ I64 = jnp.int64
 # with scatters into the N-resident usage rows.  Single-chip by design;
 # sharding N means replacing exactly these with collectives.
 _KTPU_N_COLLECTIVES = {
-    "_upd_keys": "gathers committed nodes' usage/alloc rows ([W]-indexed "
-    "reads of N-leading state)",
-    "resident_run.round_body": "walk-order argsort/gather over N + "
-    "scatter-add commits into the N-resident usage rows",
+    "_upd_keys": "resolved(replicated): gathers committed nodes' "
+    "usage/alloc rows ([W]-indexed reads of N-leading state) — the "
+    "resident lineage's usage state is materialized whole-array per "
+    "dispatch from the host committer (not node-sharded), so the reads "
+    "are shard-local by layout; node-sharded residency across batches is "
+    "ROADMAP item 1's open remainder",
+    "resident_run.round_body": "resolved(replicated): walk-order "
+    "argsort/gather over N + scatter-add commits into the N-resident "
+    "usage rows — same whole-array lineage as _upd_keys: every replica "
+    "applies identical rank-1 commits, so the round needs no collective "
+    "(the [S,N] speculation keys partition over the pods axis instead)",
 }
 NEG = jnp.iinfo(jnp.int64).min // 4  # "no committed node yet" threshold
 UNRESOLVED = -2  # choice sentinel: pod not reached before the round cap

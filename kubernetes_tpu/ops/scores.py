@@ -44,12 +44,18 @@ _FX = 32  # fixed-point fractional bits for the spread log weights
 # reference semantics (normalize_score.go) and becomes a cross-shard
 # reduce on a sharded mesh; image spread counts nodes holding each image
 _KTPU_N_COLLECTIVES = {
-    "default_normalize": "max over the feasible N axis (DefaultNormalizeScore)",
-    "normalize_interpod": "min+max over the feasible N axis (scoring.go:265)",
-    "normalize_spread": "min+max over the valid N axis (scoring.go:227)",
-    "score_image_locality": "image spread counts nodes per image ([N] sum)",
-    "score_spread": "counted-node totals over the feasible N axis "
-    "(topologyNormalizingWeight)",
+    "default_normalize": "resolved(collective): max over the feasible N "
+    "axis (DefaultNormalizeScore) — cross-shard max-reduce of per-shard "
+    "partial maxima (integer scores, order-free)",
+    "normalize_interpod": "resolved(collective): min+max over the "
+    "feasible N axis (scoring.go:265) — cross-shard min/max-reduce",
+    "normalize_spread": "resolved(collective): min+max over the valid N "
+    "axis (scoring.go:227) — cross-shard min/max-reduce",
+    "score_image_locality": "resolved(collective): image spread counts "
+    "nodes per image ([N] sum) — per-shard partial counts + psum",
+    "score_spread": "resolved(collective): counted-node totals over the "
+    "feasible N axis (topologyNormalizingWeight) — per-shard partial "
+    "totals + psum",
 }
 
 

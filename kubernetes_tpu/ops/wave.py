@@ -73,6 +73,7 @@ import jax.numpy as jnp
 
 from kubernetes_tpu.ops import filters as F
 from kubernetes_tpu.ops import gang
+from kubernetes_tpu.ops.gang import N_DIAG
 from kubernetes_tpu.ops.common import DeviceBatch, DeviceCluster, I32, I64
 from kubernetes_tpu.snapshot.interner import ABSENT, PAD
 from kubernetes_tpu.snapshot.schema import N_FIXED_LANES, bucket_cap
@@ -102,11 +103,17 @@ DEMOTE_KINDS = {
 # are the per-term reductions ROADMAP item 2 reduces ACROSS shards —
 # the roster is the inventory of exactly where those collectives go.
 _KTPU_N_COLLECTIVES = {
-    "wave_schedule.step": "term-factored domain compare+reduce over N + "
-    "port-occupancy [Tpt, N] conflict reduce + speculative-node row "
-    "gathers (demotion attribution)",
-    "factored_port_mask": "port-term occupancy conflict reduce over the "
-    "carried [Tpt, N] rows",
+    "wave_schedule.step": "resolved(collective): term-factored domain "
+    "compare+reduce over N + port-occupancy [Tpt, N] conflict reduce + "
+    "speculative-node row gathers (demotion attribution) — the per-term "
+    "[T,N]/[Tpt,N] carry counts are per-node integers that reduce "
+    "cleanly across a sharded N axis: per-shard partial compare+psum at "
+    "the conflict check, owning-shard gather for the speculative row, "
+    "and rank-1 carry commits stay local to the shard that owns the "
+    "committed node",
+    "factored_port_mask": "resolved(collective): port-term occupancy "
+    "conflict reduce over the carried [Tpt, N] rows — per-shard partial "
+    "conflict bits + cross-shard or-reduce",
 }
 
 
@@ -799,6 +806,22 @@ def wave_schedule(
 
     # ---- pass 2: conflict resolution / admission over factored deltas
     init = dict(base, **factored_carry_init(Tsp, Tip, N, Tpt))
+    # Per-pod outputs ride CARRY buffers written at the pod's own slot
+    # instead of scan-stacked ys: jaxlib 0.4.37's SPMD partitioner
+    # mis-clamps the ys-stacking dynamic_update_slice (the scan's s64
+    # loop counter meets the partitioner's own s32 shard arithmetic in
+    # one compare — hlo-verifier rejection after spmd-partitioning)
+    # whenever sharding propagation partitions the stacking axis, and
+    # replicated constraints on the scan outputs do not reach the
+    # in-loop buffers.  Scatter-style carry writes at an i32 index
+    # partition correctly — `assigned` has always used this pattern.
+    init.update(
+        out_choice=jnp.full((P,), ABSENT, I32),
+        out_nfeas=jnp.zeros((P,), I64),
+        out_rc=jnp.zeros((P, N_DIAG), I64),
+        out_kind=jnp.zeros((P,), I32),
+        out_cterm=jnp.full((P,), -1, I32),
+    )
     carry_keys = FACTORED_CARRY_KEYS[:3] + (("occ_pt",) if Tpt else ())
 
     def step(state, p):
@@ -920,11 +943,29 @@ def wave_schedule(
             sp_term,
             jnp.where(kind == DEMOTE_AFFINITY, ip_term, -1),
         )
-        return new_state, (choice, n_feas, reason_counts, kind, cterm)
+        # p is the scan index over the batch axis — in range by
+        # construction; mode="drop" spells it for the slice-clamp rule
+        new_state["out_choice"] = (
+            state["out_choice"].at[p].set(choice, mode="drop")
+        )
+        new_state["out_nfeas"] = (
+            state["out_nfeas"].at[p].set(n_feas, mode="drop")
+        )
+        new_state["out_rc"] = (
+            state["out_rc"].at[p].set(reason_counts, mode="drop")
+        )
+        new_state["out_kind"] = state["out_kind"].at[p].set(kind, mode="drop")
+        new_state["out_cterm"] = (
+            state["out_cterm"].at[p].set(cterm, mode="drop")
+        )
+        return new_state, None
 
-    state, (chosen, n_feas, reason_counts, kinds, cterms) = jax.lax.scan(
-        step, init, jnp.arange(P, dtype=I32)
-    )
+    state, _ = jax.lax.scan(step, init, jnp.arange(P, dtype=I32))
+    chosen = state["out_choice"]
+    n_feas = state["out_nfeas"]
+    reason_counts = state["out_rc"]
+    kinds = state["out_kind"]
+    cterms = state["out_cterm"]
     tallies = {
         "requested": state["requested"],
         "nonzero": state["nonzero"],

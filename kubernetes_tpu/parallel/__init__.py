@@ -15,7 +15,13 @@ normalize/argmax) — there is no hand-written NCCL/MPI equivalent, by design.
 """
 
 from kubernetes_tpu.parallel.mesh import (  # noqa: F401
+    auto_enabled,
     batch_shardings,
     cluster_shardings,
     make_mesh,
+    pad_to_multiple,
+    parse_mesh_shape,
+    place_batch,
+    place_cluster,
+    replicated,
 )

@@ -26,6 +26,13 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from kubernetes_tpu.ops.common import DeviceBatch, DeviceCluster, DTable
 
 
+def auto_enabled() -> bool:
+    """The meshDispatch auto rule: partition the admission engine whenever
+    the backend exposes more than one device (real multichip, or the
+    forced-host virtual-device emulation used by the parity/test tier)."""
+    return len(jax.devices()) > 1
+
+
 def make_mesh(
     n_devices: Optional[int] = None, pods_axis: Optional[int] = None
 ) -> Mesh:
@@ -40,9 +47,35 @@ def make_mesh(
     # Default pods axis: the largest power of two dividing n, so bucketed
     # (power-of-two) batch dims always shard evenly.
     pa = pods_axis or (n & -n)
+    if n % pa:
+        raise ValueError(f"pods_axis {pa} does not divide {n} devices")
     na = n // pa
     arr = np.array(devs).reshape(pa, na)
     return Mesh(arr, ("pods", "nodes"))
+
+
+def parse_mesh_shape(spec: str) -> tuple:
+    """'PAxNA' (e.g. '1x8', '8x1', '4x2') → (pods_axis, nodes_axis)."""
+    try:
+        pa, na = (int(x) for x in spec.lower().split("x"))
+    except ValueError:
+        raise ValueError(f"mesh spec {spec!r} is not PAxNA (e.g. '4x2')")
+    if pa <= 0 or na <= 0:
+        raise ValueError(f"mesh spec {spec!r} axes must be positive")
+    return pa, na
+
+
+def pad_to_multiple(n: int, multiple: int) -> int:
+    """Round ``n`` up to a multiple of ``multiple`` (≥1)."""
+    m = max(int(multiple), 1)
+    return -(-int(n) // m) * m
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    """Fully-replicated placement on ``mesh`` — for wire buffers and side
+    tables that every shard reads in full (mixing mesh-committed kernel
+    operands with single-device-committed ones is a jit error)."""
+    return NamedSharding(mesh, P())
 
 
 def _shard(mesh: Mesh, spec: P) -> NamedSharding:
@@ -50,9 +83,22 @@ def _shard(mesh: Mesh, spec: P) -> NamedSharding:
 
 
 def batch_shardings(mesh: Mesh, db: DeviceBatch) -> DeviceBatch:
-    """Sharding pytree for a DeviceBatch: dim 0 (pods) sharded."""
+    """Sharding pytree for a DeviceBatch: dim 0 (pods) sharded.
+
+    Every DeviceBatch leaf is pod-major, so the invariant is global:
+    P % pods_axis == 0.  The scheduler guarantees it by seeding its sticky
+    batch bucket with the mesh's pods axis (p_cap buckets are powers of
+    two ≥ 8); standalone packers must pass a compatible ``p_cap``.
+    """
+    pa = mesh.shape["pods"]
 
     def spec_for(x):
+        if pa > 1:
+            assert x.shape[0] % pa == 0, (
+                f"pod-major tensor {x.shape} not divisible by the mesh's "
+                f"pods axis {pa} — pad p_cap to the mesh multiple "
+                "(pad_to_multiple) instead of silently replicating"
+            )
         return _shard(mesh, P("pods", *([None] * (x.ndim - 1))))
 
     return jax.tree_util.tree_map(spec_for, db)
@@ -89,7 +135,14 @@ def cluster_shardings(mesh: Mesh, dc: DeviceCluster) -> DeviceCluster:
     partitioned over the mesh's 'nodes' axis (dim 0); everything else
     (placed pods, terms, vocab side-tables, scalars) replicates.  XLA's
     partitioner inserts the all-gathers/reductions where full-width
-    normalize/argmax passes need them (SURVEY §2.4)."""
+    normalize/argmax passes need them (SURVEY §2.4).
+
+    N-divisibility is an INVARIANT, not a fallback: the packer pads the
+    node bucket to the mesh multiple (pack_nodes ``n_multiple`` /
+    SnapshotMirror.node_pad_multiple), so a non-divisible node-major
+    tensor here means the padding discipline broke — assert instead of
+    silently replicating (a replicated snapshot "works" but quietly
+    abandons the node-axis scale-out this layout exists for)."""
     n_nodes_axis = mesh.shape["nodes"]
     from dataclasses import fields, replace
 
@@ -100,8 +153,13 @@ def cluster_shardings(mesh: Mesh, dc: DeviceCluster) -> DeviceCluster:
             n_nodes_axis > 1
             and f.name in _NODE_MAJOR_FIELDS
             and getattr(x, "ndim", 0) >= 1
-            and x.shape[0] % n_nodes_axis == 0
         ):
+            assert x.shape[0] % n_nodes_axis == 0, (
+                f"node-major tensor {f.name}{x.shape} not divisible by the "
+                f"mesh's nodes axis {n_nodes_axis} — the packer must pad N "
+                "to the mesh multiple (pack_nodes n_multiple / "
+                "mirror.node_pad_multiple), not replicate"
+            )
             spec = _shard(mesh, P("nodes", *([None] * (x.ndim - 1))))
         elif f.name == "term_table":
             spec = jax.tree_util.tree_map(lambda _: _shard(mesh, P()), x)

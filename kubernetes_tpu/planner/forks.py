@@ -110,10 +110,11 @@ class PackedForks:
     names: List[str]  # slot → node name (clones included)
 
 
-def _extend_node_tensors(nt, clones: Dict[str, Node], vocab):
+def _extend_node_tensors(nt, clones: Dict[str, Node], vocab, n_multiple=1):
     """Copy of ``nt`` with clone rows appended (base-invalid; forks flip
     their own alive bits).  Grows the node bucket only when the clones
-    outrun the padding."""
+    outrun the padding (to the mesh's nodes-axis multiple, like the
+    mirror's own packs — cluster_shardings asserts divisibility)."""
     n_used = len(nt.name_to_idx)
     need = n_used + len(clones)
     if need <= nt.n_cap:
@@ -141,7 +142,9 @@ def _extend_node_tensors(nt, clones: Dict[str, Node], vocab):
         ext.names = list(nt.names)
         ext.name_to_idx = dict(nt.name_to_idx)
     else:
-        n_cap = bucket_cap(need)
+        from kubernetes_tpu.parallel.mesh import pad_to_multiple
+
+        n_cap = pad_to_multiple(bucket_cap(need), n_multiple)
         ext = copy.copy(nt)
 
         def grow(a, fill):
@@ -223,7 +226,12 @@ def pack_forks(
         clones = collect_clones(
             forks, {n: cn.node for n, cn in node_by_name.items()}
         )
-    nt, clone_slots = _extend_node_tensors(mirror.nodes, clones, vocab)
+    nt, clone_slots = _extend_node_tensors(
+        mirror.nodes,
+        clones,
+        vocab,
+        n_multiple=getattr(mirror, "node_pad_multiple", 1),
+    )
     existing = mirror.existing
     epod_slot = {
         uid: slot for uid, (slot, _pod) in (mirror._epod_slots or {}).items()

@@ -293,6 +293,33 @@ def simulate_forks(
             planes = wire.device_put_packed(
                 {k: np.asarray(v) for k, v in pf.planes.items()}
             )
+            if sched.mesh is not None:
+                # mesh-partitioned what-ifs (MULTICHIP.md): the fork axis
+                # is embarrassingly parallel — shard KF over the mesh's
+                # pods axis (each device simulates its own forks; the
+                # shared snapshot/batch replicate, so the vmap body needs
+                # ZERO collectives on a pods-major mesh).  Indivisible
+                # KF (e.g. the K=1 whatif reroute) replicates instead.
+                import jax as _jax
+                from jax.sharding import (
+                    NamedSharding as _NS,
+                    PartitionSpec as _P,
+                )
+
+                from kubernetes_tpu.parallel.mesh import place_cluster
+
+                pa = sched.mesh.shape["pods"]
+
+                def _place_fork(x):
+                    spec = (
+                        _P("pods", *([None] * (x.ndim - 1)))
+                        if pa > 1 and x.shape[0] % pa == 0
+                        else _P()
+                    )
+                    return _jax.device_put(x, _NS(sched.mesh, spec))
+
+                planes = {k: _place_fork(v) for k, v in planes.items()}
+                dc = place_cluster(sched.mesh, dc)
             d_cap = tables.pop("d_cap")
 
     if serial_snapshot is not None:

@@ -300,8 +300,38 @@ class Scheduler:
         self.mirror = SnapshotMirror()
         from kubernetes_tpu.cache.device_mirror import DeviceClusterCache
 
-        self._dc_cache = DeviceClusterCache()
+        # Mesh-partitioned dispatch (MULTICHIP.md): resolve the
+        # ('pods','nodes') mesh once per scheduler.  meshDispatch None =
+        # AUTO — partition whenever the backend exposes >1 device; the
+        # admission engine's decisions are bit-identical either way
+        # (multichip_vs_singlechip paritycheck), the mesh only changes
+        # where the flops run.
+        from kubernetes_tpu.parallel import mesh as pmesh
+
+        mesh_on = self.config.mesh_dispatch
+        if mesh_on is None:
+            mesh_on = pmesh.auto_enabled()
+        self.mesh = (
+            pmesh.make_mesh(pods_axis=self.config.mesh_pods_axis)
+            if mesh_on
+            else None
+        )
+        if self.mesh is not None:
+            # every node pack must split evenly over the nodes axis
+            # (cluster_shardings asserts; pack_nodes pads)
+            self.mirror.node_pad_multiple = self.mesh.shape["nodes"]
+
+        self._dc_cache = DeviceClusterCache(mesh=self.mesh)
         self._p_cap_max = 1  # sticky batch bucket: avoids per-size recompiles
+        if self.mesh is not None:
+            # pod buckets must split evenly over the pods axis — seed the
+            # sticky bucket so bucket_cap(n, 1) growth stays a multiple
+            # (power-of-two buckets ≥ a power-of-two axis always divide;
+            # non-power-of-two axes ride pad_to_multiple)
+            self._p_cap_max = pmesh.pad_to_multiple(
+                bucket_cap(self.mesh.shape["pods"], 1),
+                self.mesh.shape["pods"],
+            )
         self.nominator = Nominator()
         # Async binding pipeline (schedule_one.go:117-129): the scheduling
         # loop stops at assume+reserve+permit; wait/prebind/bind/postbind run
@@ -1366,7 +1396,7 @@ class Scheduler:
             self.phases.add("pack", time.perf_counter() - t_pack)
             trace.step("Snapshot mirror updated")
 
-            self._p_cap_max = max(self._p_cap_max, bucket_cap(len(pods), 1))
+            self._p_cap_max = max(self._p_cap_max, self._p_bucket(len(pods)))
             p_cap = self._p_cap_max
             pb = pack_pod_batch(
                 pods,
@@ -1377,7 +1407,7 @@ class Scheduler:
             )
             t_sync = time.perf_counter()
             dc = self._dc_cache.sync(self.mirror, vocab)
-            db = DeviceBatch.from_host(pb)
+            db = self._place_db(DeviceBatch.from_host(pb))
             self.prom.recorder.observe(
                 self.prom.snapshot_pack_duration,
                 time.perf_counter() - t_sync,
@@ -2002,7 +2032,7 @@ class Scheduler:
             t_pack = time.perf_counter()
             self._repack_mirror()
             pods = [qp.pod for qp in batch]
-            self._p_cap_max = max(self._p_cap_max, bucket_cap(len(pods), 1))
+            self._p_cap_max = max(self._p_cap_max, self._p_bucket(len(pods)))
             pb = pack_pod_batch(
                 pods,
                 vocab,
@@ -2109,7 +2139,7 @@ class Scheduler:
                 append_terms = bool((pb.aff_kind != PAD).any())
                 AT = pb.aff_kind.shape[1] if append_terms else 0
 
-            db = DeviceBatch.from_host(pb)
+            db = self._place_db(DeviceBatch.from_host(pb))
             v_cap = bucket_cap(len(vocab.label_vals))
             tables = self._gang_tables(pb, vocab)
             nom_node = nom_prio = nom_req = None
@@ -2286,6 +2316,27 @@ class Scheduler:
             self._hostname_key_dev = jnp.asarray(hk_id, I32)
             self._hk_cached = hk_id
         return self._hostname_key_dev
+
+    def _place_db(self, db):
+        """Mesh placement for a DeviceBatch: pod-major tensors sharded
+        over the mesh's pods axis (no-op without meshDispatch).  The
+        snapshot half rides DeviceClusterCache(mesh=...)."""
+        if self.mesh is None:
+            return db
+        from kubernetes_tpu.parallel.mesh import place_batch
+
+        return place_batch(self.mesh, db)
+
+    def _p_bucket(self, n: int) -> int:
+        """Pod-batch bucket: bucket_cap padded to the mesh's pods-axis
+        multiple so sharded batches always split evenly (power-of-two
+        buckets already divide power-of-two axes; this covers the rest)."""
+        cap = bucket_cap(n, 1)
+        if self.mesh is not None:
+            from kubernetes_tpu.parallel.mesh import pad_to_multiple
+
+            cap = pad_to_multiple(cap, self.mesh.shape["pods"])
+        return cap
 
     def _gang_tables(self, pb, vocab):
         """batch_tables' device arrays, reused across batches with the same
@@ -2718,7 +2769,7 @@ class Scheduler:
             t_pack = time.perf_counter()
             self._repack_mirror()
             self.phases.add("pack", time.perf_counter() - t_pack)
-            self._p_cap_max = max(self._p_cap_max, bucket_cap(len(pods), 1))
+            self._p_cap_max = max(self._p_cap_max, self._p_bucket(len(pods)))
             p_cap = self._p_cap_max
             pb = pack_pod_batch(
                 pods,
@@ -2729,7 +2780,7 @@ class Scheduler:
             )
             t_sync = time.perf_counter()
             dc = self._dc_cache.sync(self.mirror, vocab)
-            db = DeviceBatch.from_host(pb)
+            db = self._place_db(DeviceBatch.from_host(pb))
             self.phases.add("h2d", time.perf_counter() - t_sync)
             v_cap = bucket_cap(len(vocab.label_vals))
             hostname_key = self._hostname_dev(vocab)
@@ -3179,9 +3230,14 @@ class Scheduler:
                 self.mirror.vocab,
                 k_cap=self.mirror.nodes.k_cap,
             )
-            self._static_dc = DeviceCluster.from_host(
+            sdc = DeviceCluster.from_host(
                 self.mirror.nodes, empty, self.mirror.vocab
             )
+            if self.mesh is not None:
+                from kubernetes_tpu.parallel.mesh import place_cluster
+
+                sdc = place_cluster(self.mesh, sdc)
+            self._static_dc = sdc
             self._static_dc_key = key
         return self._static_dc
 
@@ -3248,9 +3304,9 @@ class Scheduler:
                 # floor 16: the count of NEW signatures per batch is noisy
                 # (1 here, 2 there) and every distinct count would be a
                 # fresh static_eval compile — one [16, N] shape covers them
-                p_cap=bucket_cap(len(reps), 16),
+                p_cap=self._p_bucket(max(len(reps), 16)),
             )
-            db = DeviceBatch.from_host(pb)
+            db = self._place_db(DeviceBatch.from_host(pb))
             dc = self._static_device_cluster()
             res = ops_fp.static_eval(
                 dc, db, enabled=enabled, has_images=has_images
@@ -4614,7 +4670,7 @@ class Scheduler:
                 # sticky bucket: retry rounds with shrinking failure sets
                 # must not each compile a new narrow shape
                 self._p_cap_max = max(
-                    self._p_cap_max, bucket_cap(len(pods), 1)
+                    self._p_cap_max, self._p_bucket(len(pods))
                 )
                 pb = pack_pod_batch(
                     pods,
@@ -4694,7 +4750,7 @@ class Scheduler:
                 t = wire.device_put_packed(tree)
                 masks_dev = ops_preemption.narrow_candidates(
                     dc,
-                    DeviceBatch.from_host(pb),
+                    self._place_db(DeviceBatch.from_host(pb)),
                     t["vnode"],
                     t["vprio"],
                     t["vreq"],

@@ -252,6 +252,7 @@ def pack_nodes(
     n_cap: Optional[int] = None,
     k_cap: Optional[int] = None,
     t_cap: Optional[int] = None,
+    n_multiple: int = 1,
 ) -> NodeTensors:
     # Intern everything first so capacities cover the content.
     for node in nodes:
@@ -266,7 +267,14 @@ def pack_nodes(
         for img in node.images:
             vocab.images.intern(img)
 
-    N = n_cap or bucket_cap(len(nodes))
+    # n_multiple: device-mesh nodes-axis divisibility — the node bucket
+    # must split evenly across shards (parallel/mesh.py cluster_shardings
+    # ASSERTS it rather than silently replicating).  Power-of-two buckets
+    # already satisfy power-of-two meshes; this covers the rest (e.g. a
+    # 3-wide nodes axis on 6 devices).
+    N = n_cap or -(-bucket_cap(len(nodes)) // max(n_multiple, 1)) * max(
+        n_multiple, 1
+    )
     K = k_cap or bucket_cap(len(vocab.label_keys))
     T = t_cap or bucket_cap(max((len(n.taints) for n in nodes), default=1), 1)
     lanes = ResourceLanes(vocab)

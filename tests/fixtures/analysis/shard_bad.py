@@ -7,6 +7,18 @@ import jax.numpy as jnp
 
 I32 = jnp.int32
 
+# a rostered site WITHOUT a resolved(<mechanism>) sharding story is a
+# finding too: the inventory is a burn-down, not a parking lot
+_KTPU_N_COLLECTIVES = {
+    "unresolved_site": "still thinking about this one",  # VIOLATION
+}
+
+
+# ktpu: axes(term_counts=i64[T,N], spec=i64[P,N])
+@jax.jit
+def unresolved_site(term_counts, spec):
+    return jnp.einsum("tn,pn->tp", term_counts, spec)
+
 
 # ktpu: axes(term_counts=i64[T,N], choice=i32, spec=i64[P,N])
 @jax.jit

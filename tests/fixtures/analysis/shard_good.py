@@ -8,10 +8,12 @@ import jax.numpy as jnp
 I32 = jnp.int32
 
 # the declared collective inventory for this module — the analyzer
-# sanctions N-crossings under these functions only
+# sanctions N-crossings under these functions only, and each entry must
+# lead with its resolved(<mechanism>) sharding story
 _KTPU_N_COLLECTIVES = {
-    "reduce_nodes": "term totals + chosen-node gather are cross-shard by "
-    "design (admission readback)",
+    "reduce_nodes": "resolved(collective): term totals + chosen-node "
+    "gather are cross-shard by design (admission readback) — per-shard "
+    "partials + psum/all-gather",
 }
 
 

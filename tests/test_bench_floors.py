@@ -133,6 +133,32 @@ def test_latest_recorded_bench_clears_floors():
         )
 
 
+def test_no_multichip_floors_from_virtual_device_runs():
+    """ISSUE 14 ratchet guard: config8_multichip_* throughput comes from
+    forced-host VIRTUAL devices on this CPU box (8 'devices' sharing one
+    socket) — an emulation artifact, not a hardware fact.  If the latest
+    recorded bench marks its multichip line virtual, a ratcheted
+    config8 floor/ceiling is itself the regression: refuse it."""
+    bench = _latest_bench()
+    if bench is None:
+        pytest.skip("no BENCH_r*.json recorded yet")
+    results = _bench_configs(bench)
+    if not results.get("config8_multichip_virtual_devices"):
+        pytest.skip("latest bench has no virtual-device multichip line")
+    floors_doc = _load(os.path.join(ROOT, "BENCH_FLOORS.json"))
+    offending = [
+        k
+        for store in ("floors", "ceilings")
+        for k in floors_doc.get(store, {})
+        if k.startswith("config8_multichip")
+    ]
+    assert offending == [], (
+        "config8_multichip floors/ceilings ratcheted from a VIRTUAL-device "
+        f"bench run: {offending} (BENCH_FLOORS _comment_environment "
+        "discipline — calibrate on a real multi-device box)"
+    )
+
+
 def test_new_keys_without_floors_are_tolerated():
     """A bench result key with no recorded floor (or a non-scalar value)
     must never fail the gate — new config lines land a round before their

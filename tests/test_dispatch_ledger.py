@@ -191,6 +191,46 @@ def test_drain_reports_per_kernel_dispatches_and_metrics():
     assert "scheduler_tpu_kernel_execute_seconds" in exposition
 
 
+def test_bucket_key_carries_device_count_and_mesh_shape():
+    """ISSUE 14: single-chip and mesh-partitioned dispatches of the SAME
+    shapes land in different shape buckets (device count + mesh shape
+    ride the key), and /debug/kernels surfaces the placement — the
+    regression sentinel's per-bucket series can't smear across layouts."""
+    import jax
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the 8-virtual-device backend")
+    on_sched, _ = _drained_sched(
+        SchedulerConfiguration(mesh_dispatch=True)
+    )
+    on = {
+        r["kernel"]: r
+        for r in on_sched.kernels.table(cost=False)
+        if r["dispatches"]
+    }
+    ndev = len(jax.devices())
+    wave = on["wave.wave_run"]
+    assert max(wave["devices"]) == ndev, wave
+    assert wave["multi_device_dispatches"] >= 1
+    assert wave["mesh_shapes"], wave  # e.g. ['8x1']
+    assert on_sched.kernels.stats()["multi_device_dispatches"] >= 1
+    off_sched, _ = _drained_sched(
+        SchedulerConfiguration(mesh_dispatch=False)
+    )
+    off = {
+        r["kernel"]: r
+        for r in off_sched.kernels.table(cost=False)
+        if r["dispatches"]
+    }
+    assert off["wave.wave_run"]["devices"] == [1]
+    assert off["wave.wave_run"]["multi_device_dispatches"] == 0
+    assert off["wave.wave_run"]["mesh_shapes"] == []
+    # same drain, same shapes — different buckets by placement alone
+    on_keys = set(on_sched.kernels._kstats["wave.wave_run"].buckets)
+    off_keys = set(off_sched.kernels._kstats["wave.wave_run"].buckets)
+    assert on_keys.isdisjoint(off_keys)
+
+
 def test_d2h_attribution_sums_to_total():
     sched, _ = _drained_sched()
     # force an untagged fetch too (seeded tiebreak path is untagged, but

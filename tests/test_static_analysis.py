@@ -756,6 +756,68 @@ def test_shape_rule_rosters_document_reasons():
     assert total >= 10, total
 
 
+def test_shard_rosters_are_a_burn_down():
+    """ISSUE 14 acceptance: every sharded-path roster entry carries an
+    explicit ``resolved(<mechanism>): ...`` sharding story, parsed by
+    collective_roster().  A new N-crossing can only land (a) unrostered —
+    the shard rule flags it, tree-is-clean fails — or (b) rostered but
+    unresolved — the engine flags the entry itself AND this test names
+    it.  The worklist cannot silently regress."""
+    from kubernetes_tpu.analysis import (
+        SHAPE_MODULES,
+        _PKG_ROOT,
+        collective_roster,
+    )
+    from kubernetes_tpu.analysis.core import load_source
+
+    mods = [load_source(os.path.join(_PKG_ROOT, p)) for p in SHAPE_MODULES]
+    roster = collective_roster(mods)
+    unresolved = [
+        (path, qual)
+        for path, entries in roster.items()
+        for qual, e in entries.items()
+        if not e["resolved"]
+    ]
+    assert unresolved == [], unresolved
+    mechanisms = {
+        e["mechanism"] for entries in roster.values() for e in entries.values()
+    }
+    assert mechanisms <= {"collective", "local", "replicated"}, mechanisms
+    total = sum(len(entries) for entries in roster.values())
+    assert total >= 20, total  # the inventoried worklist, fully resolved
+
+
+def test_unresolved_roster_entry_is_flagged(tmp_path):
+    """A rostered-but-unresolved entry is itself a shard finding anchored
+    to the entry's line, and a reasoned suppression can park it."""
+    src = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        '_KTPU_N_COLLECTIVES = {\n'
+        '    "f": "reduces over N, story TBD",\n'
+        "}\n"
+        "# ktpu: axes(x=i64[T,N])\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return jnp.sum(x, axis=1)\n"
+    )
+    p = tmp_path / "unresolved_mod.py"
+    p.write_text(src)
+    findings = run_analysis({k: [str(p)] for k in CHECKER_KEYS})
+    shard = [f for f in findings if f.rule == RULE_SHARD]
+    assert len(shard) == 1, [f.format() for f in findings]
+    assert shard[0].line == 4
+    assert "resolved(collective|local|replicated)" in shard[0].message
+    # the same entry with a story is clean
+    fixed = src.replace(
+        '"reduces over N, story TBD"',
+        '"resolved(collective): per-shard partial sums + psum"',
+    )
+    p.write_text(fixed)
+    findings = run_analysis({k: [str(p)] for k in CHECKER_KEYS})
+    assert [f for f in findings if f.rule == RULE_SHARD] == []
+
+
 # ----- eval_shape cross-check (runtime complement) ---------------------------
 
 
