@@ -1304,6 +1304,43 @@ def main():
             f"{len(cs['problems'])} oracle problems)",
             file=sys.stderr,
         )
+        # config15: device-fault soak (ISSUE 15) — degraded-mode
+        # throughput at a FIXED device-fault rate on top of the config7
+        # control-plane mix: dispatch errors/hangs, poisoned readbacks,
+        # hbm_oom, and mesh loss are absorbed by the per-kernel circuit
+        # breakers + epoch-guarded resident resync (spread pods keep a
+        # device-dispatch stream under the seams).  Keys are deliberately
+        # FLOOR-LESS on this box (config15_devicefault_cpu_only marks the
+        # run; test_bench_floors refuses a ratcheted floor from it).
+        cs15 = run_chaos_soak(
+            n_nodes=int(os.environ.get("BENCH_CHAOS_NODES", "24")),
+            n_pods=int(os.environ.get("BENCH_DEVICE_CHAOS_PODS", "400")),
+            fault_rate=float(os.environ.get("BENCH_CHAOS_RATE", "0.15")) / 2,
+            device_fault_rate=float(
+                os.environ.get("BENCH_DEVICE_FAULT_RATE", "0.3")
+            ),
+        )
+        configs["config15_devicefault_pods_per_s"] = (
+            0.0 if cs15["problems"] else round(cs15["pods_per_s"], 1)
+        )
+        configs["config15_devicefault_recovery_p99_ms"] = round(
+            cs15["recovery_p99_s"] * 1000, 2
+        )
+        configs["config15_devicefault_injected_total"] = cs15[
+            "injected_total"
+        ]
+        configs["config15_devicefault_breaker_trips"] = cs15["breaker_trips"]
+        configs["config15_devicefault_cpu_only"] = (
+            jax.default_backend() == "cpu"
+        )
+        print(
+            f"# config15 device-fault soak: {cs15['bound']} pods in "
+            f"{cs15['wall_s']:.2f}s ({cs15['injected_total']} faults, "
+            f"{cs15['breaker_trips']} breaker trips, recovery p99 "
+            f"{cs15['recovery_p99_s'] * 1000:.1f} ms, "
+            f"{len(cs15['problems'])} oracle problems)",
+            file=sys.stderr,
+        )
         # config9: open-loop serving tier — offered-rate vs p50/p99 bind
         # latency through the real serving loop with the SLO tier live.
         # Keys ride the JSON floor-less (presence-without-floor tolerance);

@@ -1,6 +1,6 @@
 """Invariant analyzers for the TPU scheduler (``python -m kubernetes_tpu.analysis``).
 
-Ten AST checkers guard the contracts the concurrency layering, the
+Eleven AST checkers guard the contracts the concurrency layering, the
 device boundary, and the named-axis shape algebra rely on (the
 race-detector/vet role the reference scheduler gets from the Go
 toolchain):
@@ -35,7 +35,13 @@ toolchain):
     module's ``_KTPU_N_COLLECTIVES`` roster (the multichip collective
     inventory, MULTICHIP.md), and every roster entry must carry a
     ``resolved(collective|local|replicated): <how>`` sharding story —
-    the worklist is a burn-down, not a parking lot.
+    the worklist is a burn-down, not a parking lot;
+  * ``breaker`` — every module-level jit root must carry a
+    ``_KTPU_BREAKER_FALLBACKS`` entry (observability/kernels.py) naming
+    the parity-certified engine its open circuit breaker routes to
+    (``fallback(<engine>): <how>``) or an explicit ``no_fallback: <why>``
+    waiver — the device-fault tier's drain story is analyzer-gated
+    (ISSUE 15, CHAOS.md "Device seams").
 
 Plus a runtime sanitizer (``KTPU_SANITIZE=1``, see ``sanitizer.py``),
 including the jit recompile hook (``scheduler_tpu_jit_recompiles_total``)
@@ -57,6 +63,7 @@ from kubernetes_tpu.analysis.core import (
     render_json,
     render_text,
 )
+from kubernetes_tpu.analysis.breaker import BreakerChecker
 from kubernetes_tpu.analysis.clamp import ClampChecker
 from kubernetes_tpu.analysis.d2h import D2HChecker
 from kubernetes_tpu.analysis.donation import DonationChecker
@@ -145,6 +152,12 @@ DONATION_MODULES = JIT_MODULES + (
     "fastpath.py",
 )
 CLAMP_MODULES = JIT_MODULES + (os.path.join("cache", "device_mirror.py"),)
+# breaker-fallback roster rule (ISSUE 15): the jit-root surface plus the
+# module that owns the _KTPU_BREAKER_FALLBACKS literal
+BREAKER_MODULES = JIT_MODULES + (
+    os.path.join("cache", "device_mirror.py"),
+    os.path.join("observability", "kernels.py"),
+)
 # the symbolic shape/dtype/shard interpreter walks everything reachable
 # from the jit roots; device_mirror's delta splicer is a root too
 SHAPE_MODULES = JIT_MODULES + (os.path.join("cache", "device_mirror.py"),)
@@ -175,6 +188,7 @@ def default_targets() -> Dict[str, List[str]]:
         "shape": [os.path.join(_PKG_ROOT, p) for p in SHAPE_MODULES],
         "dtype": [os.path.join(_PKG_ROOT, p) for p in SHAPE_MODULES],
         "shard": [os.path.join(_PKG_ROOT, p) for p in SHAPE_MODULES],
+        "breaker": [os.path.join(_PKG_ROOT, p) for p in BREAKER_MODULES],
     }
 
 
@@ -236,6 +250,7 @@ def run_analysis(
         ("shape", ShapeChecker, {"engine_cache": lambda: engine_cache}),
         ("dtype", DtypeChecker, {"engine_cache": lambda: engine_cache}),
         ("shard", ShardChecker, {"engine_cache": lambda: engine_cache}),
+        ("breaker", BreakerChecker, {}),
     )
     for key, cls, extra in plan:
         start = _time.perf_counter()

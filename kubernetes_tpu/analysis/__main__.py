@@ -91,9 +91,9 @@ def apply_baseline(findings: List[Finding], path: str):
 def main(argv: List[str] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m kubernetes_tpu.analysis",
-        description="Static invariant analysis — ten rule families: "
+        description="Static invariant analysis — eleven rule families: "
         "lock-discipline, plugin-purity, jit-boundary, d2h-leak, "
-        "donation, slice-clamp, retrace, shape, dtype, shard.",
+        "donation, slice-clamp, retrace, shape, dtype, shard, breaker.",
     )
     ap.add_argument("paths", nargs="*", help="files to analyze (default: shipped tree)")
     ap.add_argument("--json", action="store_true", help="JSON report on stdout")

@@ -1,0 +1,154 @@
+"""Breaker-fallback roster checker (rule ``breaker``, ISSUE 15).
+
+Every module-level jit root is a dispatch the per-kernel circuit breaker
+can park — and a parked kernel with no registered fallback is a drain
+that silently stops.  This rule makes the fallback story a BURN-DOWN,
+the same discipline as the shard rule's ``resolved(...)`` roster: each
+discovered root must carry an entry in ``_KTPU_BREAKER_FALLBACKS``
+(observability/kernels.py) whose value leads with
+
+    ``fallback(<engine>): <how>``   — the parity-certified engine that
+                                      replaces it when the breaker opens
+    ``no_fallback: <why>``          — an explicit waiver (diagnostic-only
+                                      roots, the parity harness itself)
+
+Roots are discovered statically (module-level defs decorated ``jax.jit``
+or ``functools.partial(jax.jit, ...)`` — the same surface the sanitizer's
+runtime discovery walks); the roster literal is read without importing
+anything, so fixture files carrying their own roots and rosters analyze
+identically.  Stale entries (naming a vanished root of an analyzed
+module) are findings too — the roster must not rot into a parking lot.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Sequence, Tuple
+
+from kubernetes_tpu.analysis.core import (
+    Checker,
+    RULE_BREAKER,
+    SourceModule,
+    module_literal,
+)
+
+ROSTER_NAME = "_KTPU_BREAKER_FALLBACKS"
+
+# a registered story must lead with its mechanism and carry substance
+_STORY_RE = re.compile(r"^(fallback\([a-z0-9_-]+\):\s+\S|no_fallback:\s+\S)")
+
+
+def _is_jit_decorator(d: ast.expr) -> bool:
+    """``@jax.jit`` or ``@functools.partial(jax.jit, ...)`` (either
+    imported-module or from-imported ``partial`` spelling)."""
+    if isinstance(d, ast.Attribute) and d.attr == "jit":
+        return True
+    if isinstance(d, ast.Call):
+        f = d.func
+        named_partial = (
+            isinstance(f, ast.Attribute) and f.attr == "partial"
+        ) or (isinstance(f, ast.Name) and f.id == "partial")
+        if named_partial and d.args:
+            a0 = d.args[0]
+            if isinstance(a0, ast.Attribute) and a0.attr == "jit":
+                return True
+    return False
+
+
+def discover_roots(mod: SourceModule) -> Dict[str, int]:
+    """``{"<module short>.<fn>": def lineno}`` for every module-level jit
+    root of one analyzed file."""
+    short = os.path.basename(mod.path)
+    if short.endswith(".py"):
+        short = short[:-3]
+    out: Dict[str, int] = {}
+    for node in mod.tree.body:
+        if isinstance(node, ast.FunctionDef) and any(
+            _is_jit_decorator(d) for d in node.decorator_list
+        ):
+            out[f"{short}.{node.name}"] = node.lineno
+    return out
+
+
+def _roster_of(mod: SourceModule) -> Tuple[Dict[str, str], Dict[str, int]]:
+    """(entries, entry key linenos) of a module's roster literal."""
+    roster = module_literal(mod.tree, ROSTER_NAME)
+    if not isinstance(roster, dict):
+        return {}, {}
+    lines: Dict[str, int] = {}
+    for node in mod.tree.body:
+        if isinstance(node, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == ROSTER_NAME
+            for t in node.targets
+        ):
+            if isinstance(node.value, ast.Dict):
+                for k in node.value.keys:
+                    if isinstance(k, ast.Constant):
+                        lines[str(k.value)] = k.lineno
+    return {str(k): str(v) for k, v in roster.items()}, lines
+
+
+class BreakerChecker(Checker):
+    rule = RULE_BREAKER
+
+    def run(self, mods: Sequence[SourceModule]) -> None:
+        roster: Dict[str, str] = {}
+        roster_lines: Dict[str, Tuple[SourceModule, int]] = {}
+        roots: Dict[str, Tuple[SourceModule, int]] = {}
+        analyzed_shorts = set()
+        # the rule engages only when the analyzed set carries a roster:
+        # the shipped tree always does (observability/kernels.py is a
+        # registered target), and a fixture opting in defines its own —
+        # a lone jit-root fixture for ANOTHER rule must not cross-fire.
+        # Deleting the shipped roster outright is caught by the runtime
+        # coverage test (jit-root roster ⊆ breaker_fallbacks()).
+        if not any(
+            module_literal(mod.tree, ROSTER_NAME) is not None for mod in mods
+        ):
+            return
+        for mod in mods:
+            short = os.path.basename(mod.path)
+            if short.endswith(".py"):
+                short = short[:-3]
+            analyzed_shorts.add(short)
+            entries, lines = _roster_of(mod)
+            for key, story in entries.items():
+                roster[key] = story
+                roster_lines[key] = (mod, lines.get(key, 1))
+            for name, lineno in discover_roots(mod).items():
+                roots[name] = (mod, lineno)
+
+        for name, (mod, lineno) in sorted(roots.items()):
+            story = roster.get(name)
+            if story is None:
+                self.emit(
+                    mod,
+                    lineno,
+                    f"jit root {name} has no breaker fallback "
+                    f"registration: add a {ROSTER_NAME} entry leading "
+                    "with 'fallback(<engine>): <how>' naming the "
+                    "parity-certified engine an open breaker routes to, "
+                    "or an explicit 'no_fallback: <why>' waiver",
+                )
+            elif not _STORY_RE.match(story):
+                rmod, rline = roster_lines[name]
+                self.emit(
+                    rmod,
+                    rline,
+                    f"breaker fallback entry for {name} does not lead "
+                    "with 'fallback(<engine>): <how>' or "
+                    "'no_fallback: <why>' — the roster is a burn-down, "
+                    "not a parking lot",
+                )
+        # stale entries: the named module was analyzed but the root is gone
+        for key, (rmod, rline) in sorted(roster_lines.items()):
+            short = key.split(".", 1)[0]
+            if short in analyzed_shorts and key not in roots:
+                self.emit(
+                    rmod,
+                    rline,
+                    f"breaker fallback entry {key} names no existing "
+                    "module-level jit root — delete the stale entry",
+                )

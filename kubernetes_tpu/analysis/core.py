@@ -30,6 +30,7 @@ RULE_RETRACE = "retrace"
 RULE_SHAPE = "shape"
 RULE_DTYPE = "dtype"
 RULE_SHARD = "shard"
+RULE_BREAKER = "breaker"
 RULE_BARE_SUPPRESSION = "bare-suppression"
 
 ALL_RULES = (
@@ -43,6 +44,7 @@ ALL_RULES = (
     RULE_SHAPE,
     RULE_DTYPE,
     RULE_SHARD,
+    RULE_BREAKER,
     RULE_BARE_SUPPRESSION,
 )
 

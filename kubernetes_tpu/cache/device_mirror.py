@@ -128,6 +128,19 @@ class DeviceClusterCache:
         return start, size
 
     def sync(self, mirror, vocab) -> DeviceCluster:
+        # chaos seam (ISSUE 15 hbm_oom): an installed device-fault
+        # injector can fail this donation/placement the way a real
+        # RESOURCE_EXHAUSTED would; Scheduler._sync_device_cluster owns
+        # the recovery (invalidate → rebuild-from-mirror, bounded retry)
+        from kubernetes_tpu.observability.kernels import fault_injector
+
+        inj = fault_injector()
+        if inj is not None and inj.sync_fault() is not None:
+            self.invalidate()
+            raise RuntimeError(
+                "RESOURCE_EXHAUSTED: out of memory placing resident "
+                "cluster snapshot (chaos hbm_oom)"
+            )
         nt = mirror.nodes
         ep = mirror.existing  # materializes/append-updates the host tensors
         key = (

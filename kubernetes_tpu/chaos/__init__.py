@@ -11,7 +11,17 @@ vocabulary and the record/replay workflow.
     python -m kubernetes_tpu.chaos --replay /tmp/j.jsonl
 """
 
-from kubernetes_tpu.chaos.faults import ALL_KINDS, FaultPlan, Injection
+from kubernetes_tpu.chaos.device import (
+    DeviceFaultError,
+    DeviceFaultInjector,
+    install as install_device_faults,
+)
+from kubernetes_tpu.chaos.faults import (
+    ALL_KINDS,
+    DEVICE_KINDS,
+    FaultPlan,
+    Injection,
+)
 from kubernetes_tpu.chaos.journal import (
     Journal,
     JournalRecorder,
@@ -36,6 +46,10 @@ from kubernetes_tpu.chaos.runner import (
 
 __all__ = [
     "ALL_KINDS",
+    "DEVICE_KINDS",
+    "DeviceFaultError",
+    "DeviceFaultInjector",
+    "install_device_faults",
     "FaultPlan",
     "Injection",
     "Journal",

@@ -40,10 +40,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = ap.parse_args(argv)
 
     if args.list:
+        # one line per scenario, description included — the catalogue is
+        # the single source of truth, so a new scenario shows up here
+        # (and under --all) the day it lands, no hand-maintained list
         for name, scn in sorted(SCENARIOS.items()):
             print(
-                f"{name:20s} seed={scn.seed} kind={scn.kind} mode={scn.mode} "
-                f"pods={scn.n_pods} rates={scn.rates}"
+                f"{name:20s} {scn.desc or '(no description)'}\n"
+                f"{'':20s}   seed={scn.seed} kind={scn.kind} "
+                f"mode={scn.mode} pods={scn.n_pods} "
+                f"faults={sorted(scn.rates) or ['none']}"
             )
         return 0
 

@@ -1,0 +1,170 @@
+"""Device-tier fault injection (ISSUE 15: dispatch-boundary chaos).
+
+The control-plane seams (proxy.py) fault transports, sinks, and leases;
+this module faults the DEVICE tier — the jit roots themselves — at the
+choke points the DispatchLedger already owns (observability/kernels.py
+wraps every registered root) plus the two host↔device edges the ledger
+doesn't call through: ``Scheduler._d2h`` readbacks and the
+``DeviceClusterCache.sync`` snapshot placement.
+
+  * ``dispatch_error`` — a backend ``RuntimeError`` raised from a chosen
+    jit root before the kernel runs (the jaxlib INTERNAL-error shape);
+  * ``dispatch_hang``  — the dispatch stalls past the ledger's watchdog
+    deadline (the hung-collective shape: the result still arrives, but
+    the breaker books the stall as a failure — you cannot preempt an XLA
+    dispatch, so detection-on-return is the honest model);
+  * ``poisoned_output`` — a guarded readback's host copy is overwritten
+    with NaN (floats) / out-of-range sentinels (ints); the harvest-side
+    validator rejects it and re-fetches (the device array was never
+    corrupted, so the retry heals — and a REAL non-finite kernel output
+    keeps failing and routes to the fallback engine);
+  * ``hbm_oom``       — the resident-snapshot donation/placement fails
+    (RESOURCE_EXHAUSTED), forcing the rebuild-from-mirror path;
+  * ``mesh_device_loss`` — a device drops from the mesh: the next
+    multichip dispatch fails and ``Scheduler._degrade_mesh`` re-forms a
+    smaller mesh (or single-chip) with the same parity guarantee.
+
+Draw discipline matches faults.py exactly: stateless
+``(seed, kind, seam, key)`` hashing with per-seam ATTEMPT ordinals as
+keys — the scheduling loop sequences dispatches, so the ordinals (and
+therefore the entire fault schedule) are a pure function of the seed,
+and journal replay re-derives it from the header alone.  The injector
+installs into the ledger's module-global hook
+(``kernels.set_fault_injector``): the hot path never imports chaos.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from kubernetes_tpu.chaos import faults
+
+# Lock-discipline registry (kubernetes_tpu.analysis reads this literal):
+# per-seam attempt ordinals are bumped from the scheduling loop, HTTP
+# planner handlers, and harvest paths concurrently.
+_KTPU_GUARDED = {
+    "DeviceFaultInjector": {
+        "lock": "_mu",
+        "guards": {"_ordinals": None},
+    },
+}
+
+# the int sentinel poisoned readbacks write — far outside any legal
+# node/choice/count range, so range validators always catch it
+POISON_I32 = np.iinfo(np.int32).min
+
+
+class DeviceFaultError(RuntimeError):
+    """An injected device fault (shaped like the jaxlib failure class it
+    models).  ``kind`` is the faults.py vocabulary entry; the ledger's
+    breaker reads it to pick retry semantics (an error injected BEFORE
+    the kernel ran retries in place — the args are intact; a mesh loss
+    does not — the mesh must re-form first)."""
+
+    def __init__(self, kind: str, kernel: str, msg: str):
+        super().__init__(msg)
+        self.kind = kind
+        self.kernel = kernel
+
+
+class DeviceFaultInjector:
+    """Seeded device-fault schedule over a FaultPlan.
+
+    ``hang_s`` is the stall an injected ``dispatch_hang`` sleeps — kept
+    tiny (the breaker verdict is what matters, not the wall time; the
+    chaos contract DEFINES the stall as past the watchdog deadline, so
+    the ledger books the failure without racing a real clock).  Replay
+    passes ``hang_s=0`` the same way it skips bind-delay sleeps.
+    """
+
+    def __init__(self, plan: faults.FaultPlan, hang_s: float = 0.02):
+        self.plan = plan
+        self.hang_s = hang_s
+        self._mu = threading.Lock()
+        self._ordinals: Dict[str, int] = {}
+
+    def _next(self, seam: str) -> int:
+        with self._mu:
+            n = self._ordinals.get(seam, 0)
+            self._ordinals[seam] = n + 1
+            return n
+
+    # -- seam: jit-root dispatch (the _LedgerRoot wrapper) -------------------
+
+    def dispatch_fault(self, kernel: str) -> Optional[str]:
+        """Draw for the next dispatch attempt of ``kernel``; fires the
+        plan's injection record when a fault is delivered.  Returns the
+        kind (the ledger raises/stalls accordingly) or None."""
+        attempt = self._next(f"dispatch:{kernel}")
+        kind = self.plan.dispatch_fault(kernel, attempt)
+        if kind is not None:
+            self.plan.fire(kind, f"dispatch:{kernel}", attempt)
+        return kind
+
+    def raise_for(self, kind: str, kernel: str) -> None:
+        """Materialize a drawn dispatch fault as the backend error it
+        models (hangs don't raise — the ledger stalls and books them)."""
+        if kind == faults.MESH_DEVICE_LOSS:
+            raise DeviceFaultError(
+                kind,
+                kernel,
+                f"INTERNAL: device lost from mesh during {kernel} "
+                "(chaos mesh_device_loss)",
+            )
+        raise DeviceFaultError(
+            kind,
+            kernel,
+            f"INTERNAL: Failed to execute XLA computation {kernel} "
+            "(chaos dispatch_error)",
+        )
+
+    # -- seam: guarded readback (Scheduler._d2h) -----------------------------
+
+    def poison(self, kernel: str, fetched) -> Tuple[object, bool]:
+        """Maybe corrupt one guarded fetch's HOST copy: floats → NaN,
+        signed ints → POISON_I32 (out of every legal range).  The device
+        array is untouched — a re-fetch reads clean data, which is
+        exactly the one-shot-per-attempt healing the breaker's bounded
+        retry leans on."""
+        attempt = self._next(f"d2h:{kernel}")
+        kind = self.plan.readback_fault(kernel, attempt)
+        if kind is None:
+            return fetched, False
+        self.plan.fire(kind, f"d2h:{kernel}", attempt)
+        import jax
+
+        def corrupt(leaf):
+            if not isinstance(leaf, np.ndarray) or leaf.size == 0:
+                return leaf
+            out = np.array(leaf)  # writable copy; the original may be a view
+            if np.issubdtype(out.dtype, np.floating):
+                out.flat[0] = np.nan
+            elif np.issubdtype(out.dtype, np.signedinteger):
+                out.flat[0] = np.asarray(POISON_I32, out.dtype)
+            return out
+
+        return jax.tree_util.tree_map(corrupt, fetched), True
+
+    # -- seam: resident snapshot placement (DeviceClusterCache.sync) ---------
+
+    def sync_fault(self) -> Optional[str]:
+        """Draw for the next snapshot donation/placement; raises inside
+        the caller as RESOURCE_EXHAUSTED when it fires."""
+        attempt = self._next("hbm:sync")
+        kind = self.plan.hbm_fault(attempt)
+        if kind is not None:
+            self.plan.fire(kind, "hbm:sync", attempt)
+        return kind
+
+
+def install(injector: Optional[DeviceFaultInjector]) -> None:
+    """Route the ledger's (and _d2h's / sync's) chaos hook through
+    ``injector`` — None uninstalls.  Process-global, like the ledger's
+    root wrappers; the chaos runner installs for the scenario's duration
+    and uninstalls in a finally."""
+    from kubernetes_tpu.observability import kernels as kernels_mod
+
+    kernels_mod.set_fault_injector(injector)

@@ -44,6 +44,22 @@ NODE_FLAP = "node_flap"  # heartbeat suppression → NotReady → evict
 LEASE_CONTENTION = "lease_contention"  # lease CAS loses → leader failover
 CLOCK_SKEW = "clock_skew"  # elector clock offset (failover scenarios)
 
+# ----- device-tier seams (ISSUE 15; injected at the DispatchLedger's
+# choke points — observability/kernels.py — and Scheduler._d2h) ----------
+DISPATCH_ERROR = "dispatch_error"  # backend RuntimeError from a jit root
+DISPATCH_HANG = "dispatch_hang"  # dispatch stalls past the watchdog
+POISONED_OUTPUT = "poisoned_output"  # NaN/out-of-range on readback
+HBM_OOM = "hbm_oom"  # resident-state donation/placement fails
+MESH_DEVICE_LOSS = "mesh_device_loss"  # device drops from the mesh
+
+DEVICE_KINDS = (
+    DISPATCH_ERROR,
+    DISPATCH_HANG,
+    POISONED_OUTPUT,
+    HBM_OOM,
+    MESH_DEVICE_LOSS,
+)
+
 ALL_KINDS = (
     WATCH_CUT,
     COMPACT,
@@ -54,7 +70,7 @@ ALL_KINDS = (
     NODE_FLAP,
     LEASE_CONTENTION,
     CLOCK_SKEW,
-)
+) + DEVICE_KINDS
 
 # Lock-discipline registry (kubernetes_tpu.analysis reads this literal):
 # the injection log and one-shot ledger are appended from binding workers,
@@ -200,6 +216,40 @@ class FaultPlan:
         ):
             return True
         return self._roll(LEASE_CONTENTION, f"lease:{holder}", attempt)
+
+    # ----- seam: device dispatches (key = per-kernel attempt ordinal) -------
+
+    def dispatch_fault(self, kernel: str, attempt: int) -> Optional[str]:
+        """Device fault for dispatch attempt #attempt of jit root
+        ``kernel`` — the DispatchLedger wrapper's pre-call draw.  Re-draws
+        per ATTEMPT, so a breaker retry of an injected error heals with
+        probability (1 - r) exactly like the REST seams; the key is the
+        injector's per-kernel attempt ordinal (dispatches are sequenced by
+        the scheduling loop, so the ordinal — and therefore the whole
+        schedule — is a pure function of the seed).  Mesh loss outranks an
+        error outranks a hang: the rarest, most structural fault wins a
+        multi-way draw."""
+        seam = f"dispatch:{kernel}"
+        for kind in (MESH_DEVICE_LOSS, DISPATCH_ERROR, DISPATCH_HANG):
+            if self._roll(kind, seam, attempt):
+                return kind
+        return None
+
+    def readback_fault(self, kernel: str, attempt: int) -> Optional[str]:
+        """Poisoned-output draw for readback attempt #attempt of a
+        GUARDED fetch (Scheduler._d2h with a validating harvest).  Per
+        attempt: a poisoned fetch re-fetches and heals, like a transport
+        retry — the device array itself was never corrupted."""
+        if self._roll(POISONED_OUTPUT, f"d2h:{kernel}", attempt):
+            return POISONED_OUTPUT
+        return None
+
+    def hbm_fault(self, attempt: int) -> Optional[str]:
+        """Resident-state donation/placement failure draw for sync
+        attempt #attempt (the DeviceClusterCache.sync seam)."""
+        if self._roll(HBM_OOM, "hbm:sync", attempt):
+            return HBM_OOM
+        return None
 
     # ----- seam: node heartbeats -------------------------------------------
 

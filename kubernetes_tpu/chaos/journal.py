@@ -289,6 +289,20 @@ def replay(source, scheduler_factory=None) -> ReplayResult:
     else:
         sched = scheduler_factory(clock)
 
+    # device-fault seams (ISSUE 15): rebuilt from the same header seed,
+    # so the replayed scheduler suffers the identical dispatch-boundary
+    # faults — breaker routing may differ in timing, but every fallback
+    # engine is bit-identical, so the decision comparison still gates.
+    # hang_s=0: stalls are not semantic, like the bind-delay sleeps.
+    device_injector = None
+    from kubernetes_tpu.chaos import faults as _faults
+
+    if any(k in _faults.DEVICE_KINDS for k in plan.rates):
+        from kubernetes_tpu.chaos.device import DeviceFaultInjector, install
+
+        device_injector = DeviceFaultInjector(plan, hang_s=0.0)
+        install(device_injector)
+
     result = ReplayResult()
     bound: Dict[str, str] = {}
     sink = chaos_binding_sink(
@@ -308,40 +322,46 @@ def replay(source, scheduler_factory=None) -> ReplayResult:
 
     in_drain = False
     buffered: List[dict] = []
-    for entry in entries[1:]:
-        kind = entry["kind"]
-        if kind == "clock":
-            clock.now = entry["now"]
-        elif kind == "delivery":
-            result.deliveries += 1
-            if in_drain:
-                # raced the live dispatch (bind confirmations, relist
-                # echoes): invisible to the drain that was running
-                buffered.append(entry)
-            else:
-                _apply_delivery(sched, entry)
-        elif kind == "drain_start":
-            in_drain = True
-        elif kind == "drain_end":
-            outs = sched.schedule_pending()
-            got = decisions_of(outs)
-            want = entry.get("decisions", [])
-            if got != want:
-                result.mismatches.append(
-                    f"drain {entry.get('n')}: got {got} want {want}"
-                )
-            for d in want:
-                if d["code"] == "SUCCESS" and d["node"]:
-                    result.expected[d["pod"]] = d["node"]
-            for d in got:
-                if d["code"] == "SUCCESS" and d["node"]:
-                    result.placements[d["pod"]] = d["node"]
-            result.drains += 1
-            in_drain = False
-            for pending in buffered:
-                _apply_delivery(sched, pending)
-            buffered.clear()
-        # "fault" / "note" entries are informational
-    for pending in buffered:
-        _apply_delivery(sched, pending)
+    try:
+        for entry in entries[1:]:
+            kind = entry["kind"]
+            if kind == "clock":
+                clock.now = entry["now"]
+            elif kind == "delivery":
+                result.deliveries += 1
+                if in_drain:
+                    # raced the live dispatch (bind confirmations, relist
+                    # echoes): invisible to the drain that was running
+                    buffered.append(entry)
+                else:
+                    _apply_delivery(sched, entry)
+            elif kind == "drain_start":
+                in_drain = True
+            elif kind == "drain_end":
+                outs = sched.schedule_pending()
+                got = decisions_of(outs)
+                want = entry.get("decisions", [])
+                if got != want:
+                    result.mismatches.append(
+                        f"drain {entry.get('n')}: got {got} want {want}"
+                    )
+                for d in want:
+                    if d["code"] == "SUCCESS" and d["node"]:
+                        result.expected[d["pod"]] = d["node"]
+                for d in got:
+                    if d["code"] == "SUCCESS" and d["node"]:
+                        result.placements[d["pod"]] = d["node"]
+                result.drains += 1
+                in_drain = False
+                for pending in buffered:
+                    _apply_delivery(sched, pending)
+                buffered.clear()
+            # "fault" / "note" entries are informational
+        for pending in buffered:
+            _apply_delivery(sched, pending)
+    finally:
+        if device_injector is not None:
+            from kubernetes_tpu.chaos.device import install
+
+            install(None)
     return result

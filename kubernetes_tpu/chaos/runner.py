@@ -77,24 +77,38 @@ class Scenario:
     lease_duration_s: float = 8.0
     flap_grace_s: float = 6.0
     synthetic: bool = False  # draw pods from workloads.synthetic instead
+    # spread-constrained pods force every batch onto a device dispatch
+    # (wave/gang engine) — the device-fault scenarios need a dispatch
+    # stream for their seams to draw on; plain pods ride the host greedy
+    spread: bool = False
+    # one-line catalogue description (``--list``); every scenario must
+    # carry one (tested) so the CLI is self-documenting
+    desc: str = ""
 
 
 SCENARIOS: Dict[str, Scenario] = {
     s.name: s
     for s in (
         # deterministic in-proc scenarios (same seed → byte-identical journal)
-        Scenario("bind-conflict", seed=101, rates={faults.BIND_CONFLICT: 0.25}),
+        Scenario(
+            "bind-conflict",
+            seed=101,
+            rates={faults.BIND_CONFLICT: 0.25},
+            desc="binding sink 409s → unreserve/forget/requeue unwind",
+        ),
         Scenario(
             "bind-slow",
             seed=102,
             rates={faults.BIND_SLOW: 0.4},
             bind_delay_s=0.005,
+            desc="stalled binds overlap later dispatches, then confirm",
         ),
         Scenario(
             "unschedulable-burst",
             seed=103,
             rates={faults.BIND_CONFLICT: 0.15},
             unschedulable=3,
+            desc="hopeless pods + bind 409s: FailedScheduling path under churn",
         ),
         Scenario(
             "leader-failover",
@@ -103,6 +117,7 @@ SCENARIOS: Dict[str, Scenario] = {
             rates={faults.LEASE_CONTENTION: 0.1},
             n_pods=24,
             rounds=2,
+            desc="scripted lease blackout deposes A; B takes over in budget",
         ),
         # full-stack HTTP scenarios (reflector/relist/watch-cache in the loop)
         Scenario(
@@ -110,12 +125,14 @@ SCENARIOS: Dict[str, Scenario] = {
             seed=105,
             mode="http",
             rates={faults.WATCH_CUT: 0.06},
+            desc="mid-stream watch EOFs → re-watch at current rv, no relist",
         ),
         Scenario(
             "compaction",
             seed=106,
             mode="http",
             rates={faults.COMPACT: 0.06},
+            desc="forced 410 compactions → relist + exact diff resync",
         ),
         Scenario(
             "api-errors",
@@ -128,6 +145,7 @@ SCENARIOS: Dict[str, Scenario] = {
                 faults.API_TIMEOUT: 0.2,
                 faults.WATCH_CUT: 0.04,
             },
+            desc="REST transport errors/timeouts on a busy list/patch stream",
         ),
         Scenario(
             "node-flap",
@@ -136,6 +154,42 @@ SCENARIOS: Dict[str, Scenario] = {
             mode="http",
             n_pods=24,
             rounds=2,
+            desc="heartbeat loss → NotReady taint, evictions, recovery",
+        ),
+        # device-fault scenarios (ISSUE 15): dispatch-boundary seams —
+        # spread pods force every batch onto a device dispatch so the
+        # fault draws have a kernel stream to land on; recovery rides the
+        # per-kernel breakers + serial fallbacks, bit-identically
+        Scenario(
+            "device-errors",
+            seed=110,
+            spread=True,
+            rates={faults.DISPATCH_ERROR: 0.4},
+            desc="backend RuntimeErrors from jit roots → retry/breaker/serial",
+        ),
+        Scenario(
+            "device-hang",
+            seed=111,
+            spread=True,
+            rates={faults.DISPATCH_HANG: 0.5},
+            desc="dispatches stall past the watchdog → breaker parks kernel",
+        ),
+        Scenario(
+            "device-poison",
+            seed=112,
+            spread=True,
+            rates={faults.POISONED_OUTPUT: 0.6},
+            desc="NaN/out-of-range readbacks → guarded re-fetch heals",
+        ),
+        Scenario(
+            "mesh-loss",
+            seed=113,
+            spread=True,
+            rates={
+                faults.MESH_DEVICE_LOSS: 0.3,
+                faults.DISPATCH_ERROR: 0.15,
+            },
+            desc="device drops from the mesh → degrade to smaller/single-chip",
         ),
         Scenario(
             "mixed-soak",
@@ -144,13 +198,26 @@ SCENARIOS: Dict[str, Scenario] = {
             n_pods=48,
             rounds=3,
             unschedulable=2,
+            # NOTE deliberately not spread=True: over the racing HTTP
+            # tier, equal-scored node pairs make live-vs-replay tie
+            # order delivery-race-sensitive (a latent property of
+            # spread workloads over HTTP, independent of device faults
+            # — the four inproc device scenarios carry the
+            # dispatch-heavy spread coverage with byte-identical
+            # journals).  Device faults still ride the fast path's
+            # static_eval dispatches and the snapshot-sync seam here.
             rates={
                 faults.WATCH_CUT: 0.02,
                 faults.COMPACT: 0.02,
                 faults.API_ERROR: 0.08,
                 faults.BIND_CONFLICT: 0.15,
                 faults.BIND_SLOW: 0.15,
+                # device seams folded in (ISSUE 15)
+                faults.DISPATCH_ERROR: 0.12,
+                faults.POISONED_OUTPUT: 0.1,
+                faults.HBM_OOM: 0.08,
             },
+            desc="every control-plane seam + device faults, one soak",
         ),
     )
 }
@@ -176,7 +243,7 @@ def _mk_nodes(n: int) -> List[Node]:
     ]
 
 
-def _mk_pod(i: int, rng, unschedulable: bool = False) -> Pod:
+def _mk_pod(i: int, rng, unschedulable: bool = False, spread: bool = False) -> Pod:
     if unschedulable:
         requests = {"cpu": "64", "memory": "1Ti"}
     else:
@@ -184,10 +251,27 @@ def _mk_pod(i: int, rng, unschedulable: bool = False) -> Pod:
             "cpu": f"{rng.choice([100, 250, 500])}m",
             "memory": f"{rng.choice([128, 256, 512])}Mi",
         }
+    tsc = ()
+    if spread and not unschedulable:
+        # a zone-spread constraint makes the batch wave-shaped: every
+        # drain rides a device dispatch (the device-fault seams' stream)
+        from kubernetes_tpu.api.types import LabelSelector, TopologySpreadConstraint
+
+        tsc = (
+            TopologySpreadConstraint(
+                max_skew=2,
+                topology_key="topology.kubernetes.io/zone",
+                when_unsatisfiable="ScheduleAnyway",
+                label_selector=LabelSelector(
+                    match_labels={"app": f"app-{i % 5}"}
+                ),
+            ),
+        )
     return Pod(
         name=f"chaos-{i}",
         uid=f"default/chaos-{i}",
         labels={"app": f"app-{i % 5}"},
+        topology_spread_constraints=tsc,
         containers=[Container(name="c", requests=requests)],
     )
 
@@ -358,6 +442,18 @@ class _Ctx:
         self.plan.on_inject = on_inject
         self.recorder = JournalRecorder(self.journal)
 
+        # device-fault tier (ISSUE 15): when the plan carries device
+        # kinds, install the injector into the DispatchLedger's chaos
+        # hook for the scenario's duration (close() uninstalls) — the
+        # same plan, so journal replay re-derives the schedule from the
+        # header's seed alone
+        self.device_injector = None
+        if any(k in faults.DEVICE_KINDS for k in scn.rates):
+            from kubernetes_tpu.chaos.device import DeviceFaultInjector, install
+
+            self.device_injector = DeviceFaultInjector(self.plan)
+            install(self.device_injector)
+
     # -- wiring --------------------------------------------------------------
 
     def connect(self) -> None:
@@ -402,6 +498,11 @@ class _Ctx:
             )
 
     def close(self) -> None:
+        if self.device_injector is not None:
+            from kubernetes_tpu.chaos.device import install
+
+            install(None)
+            self.device_injector = None
         if self.controller is not None:
             self.controller.stop()
         if self.source is not None:
@@ -555,7 +656,9 @@ def _drive_basic(ctx: _Ctx) -> None:
             pods.append(
                 _mk_synthetic_pod(i, ctx.rng)
                 if scn.synthetic and not hopeless
-                else _mk_pod(i, ctx.rng, unschedulable=hopeless)
+                else _mk_pod(
+                    i, ctx.rng, unschedulable=hopeless, spread=scn.spread
+                )
             )
         made += n
         ctx.create_pods(pods)
@@ -803,10 +906,33 @@ def run_chaos_soak(
     rounds: int = 4,
     seed: int = 2026,
     fault_rate: float = 0.15,
+    device_fault_rate: float = 0.0,
     progress=None,
 ):
     """The bench's config7 shape: a fixed-rate mixed-fault soak over the
-    HTTP tier; reports throughput under chaos + recovery latency."""
+    HTTP tier; reports throughput under chaos + recovery latency.  A
+    nonzero ``device_fault_rate`` folds the device seams in (the bench's
+    config15 shape: degraded-mode throughput with per-kernel breakers and
+    epoch-guarded resync absorbing dispatch faults) — spread pods force
+    every batch onto a device dispatch so the seams have a stream."""
+    rates = {
+        faults.WATCH_CUT: fault_rate / 10,
+        faults.COMPACT: fault_rate / 10,
+        faults.API_ERROR: fault_rate / 2,
+        faults.API_TIMEOUT: fault_rate / 2,
+        faults.BIND_CONFLICT: fault_rate / 2,
+        faults.BIND_SLOW: fault_rate / 2,
+    }
+    if device_fault_rate > 0:
+        rates.update(
+            {
+                faults.DISPATCH_ERROR: device_fault_rate / 2,
+                faults.DISPATCH_HANG: device_fault_rate / 4,
+                faults.POISONED_OUTPUT: device_fault_rate / 2,
+                faults.HBM_OOM: device_fault_rate / 4,
+                faults.MESH_DEVICE_LOSS: device_fault_rate / 10,
+            }
+        )
     scn = Scenario(
         name="bench-soak",
         seed=seed,
@@ -815,14 +941,8 @@ def run_chaos_soak(
         n_pods=n_pods,
         rounds=rounds,
         unschedulable=0,
-        rates={
-            faults.WATCH_CUT: fault_rate / 10,
-            faults.COMPACT: fault_rate / 10,
-            faults.API_ERROR: fault_rate / 2,
-            faults.API_TIMEOUT: fault_rate / 2,
-            faults.BIND_CONFLICT: fault_rate / 2,
-            faults.BIND_SLOW: fault_rate / 2,
-        },
+        spread=device_fault_rate > 0,
+        rates=rates,
     )
     ctx = _Ctx(scn, None)
     ctx.evicted = 0
@@ -844,6 +964,7 @@ def run_chaos_soak(
     p99 = hist.percentile(0.99)
     if math.isinf(p99):
         p99 = hist.buckets[-1]
+    kstats = ctx.sched.kernels.stats()
     out = {
         "pods_per_s": bound / max(wall, 1e-9),
         "bound": bound,
@@ -851,6 +972,7 @@ def run_chaos_soak(
         "injected_total": sum(ctx.plan.injected_counts().values()),
         "injected": ctx.plan.injected_counts(),
         "recovery_p99_s": p99,
+        "breaker_trips": kstats["breaker_trips"],
         "problems": problems,
     }
     if progress:

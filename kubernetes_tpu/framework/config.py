@@ -179,6 +179,15 @@ class SchedulerConfiguration:
     # and the host committer finishes them (right when host heaps beat
     # serial device steps — CPU backends).
     resident_serial_tail: bool = False
+    # TPU extension: epoch-guarded crash consistency for the resident/
+    # carry HBM state (ISSUE 15) — every device-path fast batch rides a
+    # tiny usage_checksum dispatch, validated against the host-tracked
+    # exact sum BEFORE the round's commits touch the committer; a
+    # mismatch (dispatch died mid-round, clobbered donation) resyncs the
+    # lineage from the host committer instead of committing torn usage
+    # rows.  Off = no checksum dispatch (the epoch counter alone still
+    # guards cross-dispatch staleness).
+    resident_epoch_guard: bool = True
     # TPU extension: the workloads tier (ops/coscheduling.py) — gang/
     # coscheduling all-or-nothing admission + batched DRA claim allocation
     # + volume-topology kernel masks ride one fused dispatch with
@@ -513,6 +522,7 @@ def load_config(source) -> SchedulerConfiguration:
         resident_run_max=d.get("residentRunMax", 16384),
         resident_window=d.get("residentWindow", 2048),
         resident_serial_tail=d.get("residentSerialTail", False),
+        resident_epoch_guard=d.get("residentEpochGuard", True),
         gang_dispatch=d.get("gangDispatch", True),
         planner_kernel=d.get("plannerKernel", True),
         kernel_ledger=d.get("kernelLedger", True),
@@ -576,6 +586,7 @@ def dump_config(cfg: SchedulerConfiguration) -> dict:
         "residentRunMax": cfg.resident_run_max,
         "residentWindow": cfg.resident_window,
         "residentSerialTail": cfg.resident_serial_tail,
+        "residentEpochGuard": cfg.resident_epoch_guard,
         "gangDispatch": cfg.gang_dispatch,
         "plannerKernel": cfg.planner_kernel,
         "kernelLedger": cfg.kernel_ledger,

@@ -899,6 +899,44 @@ class SchedulerMetrics:
                 ("device", "kind"),
             )
         )
+        # --- device-fault tier (ISSUE 15): per-kernel circuit breakers +
+        # epoch-guarded resident-state recovery ---
+        self.kernel_breaker_state = r.register(
+            Gauge(
+                "scheduler_tpu_kernel_breaker_state",
+                "Per-kernel circuit breaker state (0=closed, 1=open, "
+                "2=half_open).  Open routes the dispatch family to its "
+                "registered fallback engine — every trip is also visible "
+                'in scheduler_tpu_wave_fallback_total{reason="breaker"}.',
+                ("kernel",),
+            )
+        )
+        self.kernel_breaker_trips = r.register(
+            Counter(
+                "scheduler_tpu_kernel_breaker_trips_total",
+                "Breaker trips (closed/half_open → open) per kernel.",
+                ("kernel",),
+            )
+        )
+        self.kernel_breaker_failures = r.register(
+            Counter(
+                "scheduler_tpu_kernel_breaker_failures_total",
+                "Failures booked against per-kernel breakers, by kind "
+                "(dispatch_error / dispatch_hang / mesh_device_loss / "
+                "poisoned_output / hbm_oom / sentinel).",
+                ("kernel", "kind"),
+            )
+        )
+        self.resident_resyncs = r.register(
+            Counter(
+                "scheduler_tpu_resident_resyncs_total",
+                "Epoch-guarded resident-state resyncs: the device usage "
+                "lineage was dropped and rebuilt from the host committer "
+                "(reason: dispatch_failed / checksum_mismatch / "
+                "epoch_stale / mesh_degraded / hbm_oom).",
+                ("reason",),
+            )
+        )
         self.recorder = MetricAsyncRecorder()
 
     def expose(self) -> str:

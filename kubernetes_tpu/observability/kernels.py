@@ -83,9 +83,183 @@ _KTPU_GUARDED = {
             "_cost_hits": None,
             "_cost_misses": None,
             "_regressions": None,
+            "_breakers": None,
         },
     },
 }
+
+# ---------------------------------------------------------------------------
+# per-kernel circuit breaker (ISSUE 15: the device-fault robustness tier)
+# ---------------------------------------------------------------------------
+
+# a kernel whose dispatches keep failing trips OPEN after this many
+# consecutive failed dispatches (abandoned retries, real backend errors,
+# watchdog stalls, poisoned readbacks, and sentinel sustained-breach
+# verdicts all count one each; any success resets the streak)
+BREAKER_TRIP_THRESHOLD = 3
+# in-place retries per dispatch for faults raised BEFORE the kernel ran
+# (injected errors: the args — possibly donated — are still live; a real
+# backend error never retries in place, its buffers may be consumed)
+BREAKER_RETRIES = 2
+BREAKER_BACKOFF_S = 0.0  # per-attempt backoff (scaled by attempt number)
+# cooldown is counted in DENIED dispatch-family requests, not wall time:
+# routing checks are sequenced by the scheduling loop, so breaker state
+# transitions — and therefore the chaos fault schedule that depends on
+# dispatch ordinals — replay deterministically from the seed alone
+BREAKER_HALF_OPEN_AFTER = 8
+
+BREAKER_CLOSED = "closed"
+BREAKER_OPEN = "open"
+BREAKER_HALF_OPEN = "half_open"
+_BREAKER_GAUGE = {BREAKER_CLOSED: 0, BREAKER_OPEN: 1, BREAKER_HALF_OPEN: 2}
+
+
+class DispatchFailed(RuntimeError):
+    """A kernel dispatch was abandoned (retries exhausted, a real backend
+    error, or an un-retryable injected fault).  Callers route the batch
+    to the kernel's registered fallback engine — the breaker fallback
+    roster below names it — and, for ``kind == "mesh_device_loss"``,
+    degrade the mesh first (Scheduler._degrade_mesh)."""
+
+    def __init__(self, kernel: str, cause, kind: str = "dispatch_error"):
+        super().__init__(f"dispatch of {kernel} failed ({kind}): {cause}")
+        self.kernel = kernel
+        self.cause = cause
+        self.kind = kind
+
+
+class BreakerOpen(DispatchFailed):
+    """A dispatch reached an OPEN breaker (the routing gates normally
+    prevent this; an ungated site falls back exactly like a failure)."""
+
+    def __init__(self, kernel: str):
+        super().__init__(kernel, "circuit breaker open", kind="breaker_open")
+
+
+# Every registered jit root must declare how the scheduler drains when
+# its breaker is open: a ``fallback(<engine>): <how>`` story naming the
+# parity-certified engine that replaces it, or an explicit
+# ``no_fallback: <why>`` waiver.  The static analyzer's ``breaker`` rule
+# (kubernetes_tpu/analysis/breaker.py) gates this literal against the
+# discovered jit-root surface — the same burn-down discipline as the
+# shard rule's ``resolved(...)`` roster.
+_KTPU_BREAKER_FALLBACKS = {
+    "chain.chain_dispatch": (
+        "fallback(direct): the chained pipeline drains and the live batch "
+        "degrades to per-pod host-oracle cycles; later batches redispatch "
+        "on the direct wave/scan path (same verdict kernels, no overlap)"
+    ),
+    "coscheduling.workloads_run": (
+        "fallback(serial-oracle): the workloads gate refuses and the batch "
+        "degrades to the per-pod host-plugin cycle — the gangDispatch "
+        "kill-switch path (WORKLOADS.md; decision-identical for DRA/volume "
+        "pods, gangs lose quorum semantics exactly as documented there)"
+    ),
+    "coscheduling.workloads_schedule": (
+        "fallback(serial-oracle): inner admission scan of workloads_run — "
+        "same routing gate, same per-pod host-plugin fallback"
+    ),
+    "counterfactual.counterfactual_run": (
+        "fallback(serial-oracle): fork specs replay through "
+        "oracle/planner.serial_plan — the plannerKernel kill-switch engine "
+        "(decision-identical, plan_vs_serial_oracle)"
+    ),
+    "explain.explain_masks": (
+        "no_fallback: read-only diagnosis endpoint — a failure surfaces as "
+        "an error field in /debug/explain; no placement depends on it"
+    ),
+    "fastpath.sig_scan": (
+        "fallback(host-committer): the FastCommitter lazy-heap greedy "
+        "answers the batch bit-identically (tests/test_fastpath.py); the "
+        "device lineage re-materializes from it at the next dispatch"
+    ),
+    "fastpath.static_eval": (
+        "fallback(scan): a failed static eval fails the fast gate and the "
+        "batch takes the direct gang-scan path, which reads no "
+        "per-signature rows"
+    ),
+    "gang.gang_run": (
+        "fallback(serial-oracle): pods degrade to one-pod host-oracle "
+        "cycles (_schedule_one_extender) — the fallback ladder's floor, "
+        "bit-identical by the parity property"
+    ),
+    "gang.gang_schedule": (
+        "fallback(serial-oracle): inner scan of gang_run — same routing "
+        "gate, same per-pod host-oracle fallback"
+    ),
+    "pipeline._pipeline": (
+        "no_fallback: the standalone parity harness's reference engine — "
+        "it IS the ladder's floor and runs outside the Scheduler"
+    ),
+    "preemption.narrow_candidates": (
+        "fallback(superset): narrowing is an optimization — on failure the "
+        "preemption evaluator walks the full candidate node set "
+        "(superset-sound by construction)"
+    ),
+    "resident.resident_run": (
+        "fallback(host-committer): the epoch-guarded resync drops the "
+        "device lineage and the FastCommitter greedy finishes the run "
+        "bit-identically (RESIDENT.md fallback matrix)"
+    ),
+    "resident.usage_checksum": (
+        "no_fallback: the epoch guard's integrity probe — a failure here "
+        "IS the fault signal, booked against the resident family's breaker"
+    ),
+    "wave.wave_run": (
+        "fallback(scan): wave-shaped batches ride the gang scan — the "
+        "waveDispatch kill-switch path, bit-identical to queue order by "
+        "construction (WAVE.md)"
+    ),
+    "wave.wave_schedule": (
+        "fallback(scan): inner conflict-resolution scan of wave_run — "
+        "same gate, same gang-scan fallback"
+    ),
+}
+
+
+def breaker_fallbacks() -> Dict[str, str]:
+    """The breaker fallback roster (copy) — tests assert runtime jit-root
+    coverage against it; the static analyzer reads the literal."""
+    return dict(_KTPU_BREAKER_FALLBACKS)
+
+
+class _BreakerState:
+    """Per-kernel breaker bookkeeping; mutated under the ledger's _mu
+    (the ``_breakers`` dict is the registered guarded state)."""
+
+    __slots__ = (
+        "state",
+        "failures",
+        "denials",
+        "trips",
+        "last_kind",
+        "half_open_probes",
+        "latched",
+    )
+
+    def __init__(self) -> None:
+        self.state = BREAKER_CLOSED
+        self.failures = 0  # consecutive, resets on success
+        self.denials = 0  # while open — the count-based cooldown
+        self.trips = 0
+        self.last_kind = ""
+        self.half_open_probes = 0
+        self.latched = False  # force_breaker_open: no half-open cooldown
+
+
+# chaos hook (chaos/device.py installs a DeviceFaultInjector; None in
+# production).  Module-global like the active-ledger ref: the hot path
+# reads one global and never imports the chaos package.
+_fault_injector = None
+
+
+def set_fault_injector(inj) -> None:
+    global _fault_injector
+    _fault_injector = inj
+
+
+def fault_injector():
+    return _fault_injector
 
 # the sentinel's defaults: a kernel must have this many warm (non-compile)
 # samples before its baseline judges anything; a sustained run of
@@ -243,6 +417,11 @@ class DispatchLedger:
         sentinel_min_samples: int = SENTINEL_MIN_SAMPLES,
         sentinel_sustain: int = SENTINEL_SUSTAIN,
         sentinel_floor_s: float = SENTINEL_FLOOR_S,
+        breaker_trip_threshold: int = BREAKER_TRIP_THRESHOLD,
+        breaker_retries: int = BREAKER_RETRIES,
+        breaker_backoff_s: float = BREAKER_BACKOFF_S,
+        breaker_half_open_after: int = BREAKER_HALF_OPEN_AFTER,
+        watchdog_s: Optional[float] = None,
     ):
         self.enabled = True
         self.prom = prom
@@ -253,6 +432,18 @@ class DispatchLedger:
         self.sentinel_min_samples = sentinel_min_samples
         self.sentinel_sustain = sentinel_sustain
         self.sentinel_floor_s = sentinel_floor_s
+        self.breaker_trip_threshold = breaker_trip_threshold
+        self.breaker_retries = breaker_retries
+        self.breaker_backoff_s = breaker_backoff_s
+        self.breaker_half_open_after = breaker_half_open_after
+        # per-dispatch watchdog deadline: a warm (non-compile) dispatch
+        # slower than this books a "dispatch_hang" breaker failure — the
+        # hung-collective detector.  None = off (the default: CPU test
+        # boxes jitter by seconds; chaos scenarios and accelerator
+        # deployments set it).  An INJECTED hang always books the failure
+        # regardless — the chaos contract defines its stall as past the
+        # deadline, so the verdict never races a real clock.
+        self.watchdog_s = watchdog_s
         self._mu = threading.Lock()
         self._kstats: Dict[str, _KernelStats] = {}
         # (kernel, bucket) → cost dict or None (lowering failed)
@@ -260,6 +451,7 @@ class DispatchLedger:
         self._cost_hits = 0
         self._cost_misses = 0
         self._regressions: List[dict] = []
+        self._breakers: Dict[str, _BreakerState] = {}
 
     # -- dispatch recording ---------------------------------------------------
 
@@ -268,9 +460,66 @@ class DispatchLedger:
         result.  Called by the ``_LedgerRoot`` wrappers; host-side calls
         only — an in-trace call (one root tracing through another, or an
         ``eval_shape`` of the wrapper) passes straight through, because
-        it is not a dispatch and its tracer args have no dispatch cost."""
+        it is not a dispatch and its tracer args have no dispatch cost.
+
+        Fault boundary (ISSUE 15): an installed chaos injector draws a
+        device fault per ATTEMPT; injected errors retry in place with
+        backoff (the kernel never ran — the args, donated or not, are
+        live), real backend errors never do (their buffers may be
+        consumed).  Either way the per-kernel breaker books the failure,
+        and an abandoned dispatch raises ``DispatchFailed`` for the
+        caller's registered fallback engine."""
         if not jax.core.trace_state_clean():
             return fn(*args, **kwargs)
+        # an OPEN breaker that a routing gate didn't consult: deny here
+        # (counts toward the same half-open cooldown the gates feed)
+        if not self._breaker_admit(name):
+            raise BreakerOpen(name)
+        attempt = 0
+        while True:
+            inj = _fault_injector
+            stall = 0.0
+            injected_hang = False
+            if inj is not None:
+                kind = inj.dispatch_fault(name)
+                if kind == "dispatch_hang":
+                    injected_hang = True
+                    stall = inj.hang_s
+                elif kind is not None:
+                    # error/mesh-loss raised BEFORE the kernel runs
+                    self._breaker_failure(name, kind)
+                    if kind == "dispatch_error" and attempt < self.breaker_retries:
+                        attempt += 1
+                        if self.breaker_backoff_s:
+                            time.sleep(self.breaker_backoff_s * attempt)
+                        continue
+                    try:
+                        inj.raise_for(kind, name)
+                    except RuntimeError as e:
+                        raise DispatchFailed(name, e, kind=kind) from e
+            try:
+                return self._record_dispatch(
+                    name,
+                    fn,
+                    args,
+                    kwargs,
+                    stall_s=stall,
+                    injected_hang=injected_hang,
+                )
+            except DispatchFailed:
+                raise
+            except Exception as e:  # noqa: BLE001 — backend failure class
+                # a REAL dispatch failure: the kernel may have consumed
+                # its donated inputs, so no in-place retry — the breaker
+                # books it and the caller's fallback engine (with the
+                # epoch-guarded resync where resident state is involved)
+                # takes the batch
+                self._breaker_failure(name, "dispatch_error")
+                raise DispatchFailed(name, e) from e
+
+    def _record_dispatch(
+        self, name: str, fn, args, kwargs, stall_s=0.0, injected_hang=False
+    ):
         # the bucket key is built BEFORE the call: args may be donated,
         # and their metadata (shapes AND shardings) must be read while
         # they're live
@@ -290,6 +539,10 @@ class DispatchLedger:
             except Exception:  # noqa: BLE001 — cost analysis is optional
                 spec = None
         t0 = self._clock()
+        if stall_s:
+            # injected dispatch_hang: the stall rides the execute wall
+            # exactly where a hung collective's would
+            time.sleep(stall_s)
         out = fn(*args, **kwargs)
         dt = self._clock() - t0
         size_after = fn._cache_size()
@@ -340,6 +593,19 @@ class DispatchLedger:
                 cat="device",
                 compile=bool(compiled),
             )
+        # watchdog verdict: an injected hang is a breach BY CONTRACT
+        # (its stall is DEFINED as past the deadline, even when replay
+        # skips the sleep itself); a real dispatch breaches only when
+        # warm (compile storms are not hangs) and a deadline is set
+        hung = injected_hang or (
+            self.watchdog_s is not None
+            and not compiled
+            and dt > self.watchdog_s
+        )
+        if hung:
+            self._breaker_failure(name, "dispatch_hang")
+        else:
+            self._breaker_success(name)
         if breach is not None:
             self._file_breach(name, breach)
         return out
@@ -381,9 +647,14 @@ class DispatchLedger:
     def _file_breach(self, name: str, record: dict) -> None:
         """Outside ``_mu``: count the regression and hand the record to
         the SLO tier's freeze→dump→re-arm machinery (when installed —
-        the record is already retained in ``_regressions`` either way)."""
+        the record is already retained in ``_regressions`` either way).
+        A sustained-breach verdict also counts toward the kernel's
+        breaker trip threshold: a kernel that got pathologically slow is
+        drained through its fallback engine the same way a faulting one
+        is (ISSUE 15 satellite)."""
         if self.prom is not None:
             self.prom.kernel_regressions.inc(kernel=name)
+        self._breaker_failure(name, "sentinel")
         getter = self.slo_getter
         slo = getter() if getter is not None else None
         if slo is not None:
@@ -391,6 +662,137 @@ class DispatchLedger:
                 slo.external_breach(dict(record))
             except Exception:  # noqa: BLE001 — accounting must not
                 pass  # break the dispatch that happened to breach
+
+    # -- circuit breaker (ISSUE 15) -------------------------------------------
+
+    def _breaker_of_locked(self, name: str) -> _BreakerState:
+        b = self._breakers.get(name)
+        if b is None:
+            b = self._breakers[name] = _BreakerState()
+        return b
+
+    def _set_breaker_gauge(self, name: str, state: str) -> None:
+        prom = self.prom
+        if prom is not None:
+            prom.kernel_breaker_state.set(
+                _BREAKER_GAUGE[state], kernel=name
+            )
+
+    def _breaker_failure(self, name: str, kind: str) -> None:
+        """Book one failed dispatch/readback/verdict against ``name``'s
+        breaker; trips it open at the threshold (a half-open probe's
+        failure re-trips immediately)."""
+        with self._mu:
+            b = self._breaker_of_locked(name)
+            b.last_kind = kind
+            b.failures += 1
+            tripped = False
+            if b.state == BREAKER_HALF_OPEN or (
+                b.state == BREAKER_CLOSED
+                and b.failures >= self.breaker_trip_threshold
+            ):
+                b.state = BREAKER_OPEN
+                b.denials = 0
+                b.trips += 1
+                tripped = True
+            state = b.state
+        prom = self.prom
+        if prom is not None:
+            prom.kernel_breaker_failures.inc(kernel=name, kind=kind)
+            if tripped:
+                prom.kernel_breaker_trips.inc(kernel=name)
+        self._set_breaker_gauge(name, state)
+
+    def _breaker_success(self, name: str) -> None:
+        """A clean dispatch: reset the streak; a half-open probe's
+        success closes the breaker (recovery)."""
+        with self._mu:
+            b = self._breakers.get(name)
+            if b is None:
+                return
+            changed = b.state != BREAKER_CLOSED
+            if b.state == BREAKER_HALF_OPEN:
+                b.half_open_probes += 1
+            b.failures = 0
+            b.denials = 0
+            b.state = BREAKER_CLOSED
+        if changed:
+            self._set_breaker_gauge(name, BREAKER_CLOSED)
+
+    def _breaker_admit(self, name: str) -> bool:
+        """Should a dispatch of ``name`` proceed?  Closed/half-open →
+        yes; open → no, but the denial counts toward the COUNT-BASED
+        cooldown (deterministic under replay — no wall clock), and the
+        request that crosses it becomes the half-open probe."""
+        with self._mu:
+            b = self._breakers.get(name)
+            if b is None or b.state == BREAKER_CLOSED:
+                return True
+            if b.state == BREAKER_HALF_OPEN:
+                return True
+            if b.latched:
+                return False
+            b.denials += 1
+            if b.denials < self.breaker_half_open_after:
+                return False
+            b.state = BREAKER_HALF_OPEN
+        self._set_breaker_gauge(name, BREAKER_HALF_OPEN)
+        return True  # this request is the probe
+
+    def breaker_allows(self, kernel: str) -> bool:
+        """The routing-gate check: False routes the dispatch family to
+        its registered fallback engine (the caller bumps
+        ``scheduler_tpu_wave_fallback_total{reason="breaker"}``)."""
+        if not self.enabled:
+            return True
+        return self._breaker_admit(kernel)
+
+    def breaker_state(self, kernel: str) -> str:
+        with self._mu:
+            b = self._breakers.get(kernel)
+            return b.state if b is not None else BREAKER_CLOSED
+
+    def record_breaker_failure(self, kernel: str, kind: str) -> None:
+        """Public failure feed for faults detected OUTSIDE the dispatch
+        wrapper: poisoned readbacks (Scheduler's guarded fetches) and
+        resident-snapshot placement failures."""
+        self._breaker_failure(kernel, kind)
+
+    def force_breaker_open(self, kernel: str) -> None:
+        """Latch ``kernel``'s breaker open (tests / paritycheck's
+        breaker-degraded parity run): denials never reach the half-open
+        cooldown until ``reset_breaker``."""
+        with self._mu:
+            b = self._breaker_of_locked(kernel)
+            b.state = BREAKER_OPEN
+            b.latched = True
+        self._set_breaker_gauge(kernel, BREAKER_OPEN)
+
+    def reset_breaker(self, kernel: str) -> None:
+        with self._mu:
+            b = self._breakers.get(kernel)
+            if b is None:
+                return
+            b.state = BREAKER_CLOSED
+            b.failures = 0
+            b.denials = 0
+            b.latched = False
+        self._set_breaker_gauge(kernel, BREAKER_CLOSED)
+
+    def breaker_rows(self) -> Dict[str, dict]:
+        """Per-kernel breaker snapshot (the /debug/kernels column)."""
+        with self._mu:
+            return {
+                name: {
+                    "state": b.state,
+                    "failures": b.failures,
+                    "denials": b.denials,
+                    "trips": b.trips,
+                    "half_open_probes": b.half_open_probes,
+                    "last_kind": b.last_kind,
+                }
+                for name, b in self._breakers.items()
+            }
 
     # -- d2h attribution (fed by Scheduler._d2h) ------------------------------
 
@@ -455,6 +857,8 @@ class DispatchLedger:
         kernel's most-dispatched bucket (first call pays the lowering;
         memoized after)."""
         names = set(roster()) | self._seen()
+        with self._mu:
+            names |= set(self._breakers)  # breaker-only rows still show
         want_cost: List[Tuple[str, tuple, object]] = []
         rows = []
         with self._mu:
@@ -480,6 +884,7 @@ class DispatchLedger:
                     for b in ks.buckets.values()
                     if b.get("devices", 1) > 1
                 )
+                brk = self._breakers.get(name)
                 row = {
                     "kernel": name,
                     "dispatches": ks.dispatches,
@@ -496,6 +901,10 @@ class DispatchLedger:
                     "d2h_s": round(ks.d2h_s, 6),
                     "baseline_s": round(ks.baseline_s, 6),
                     "regressions": ks.regressions,
+                    # breaker column: closed kernels that never faulted
+                    # show "closed"/0 so the table is uniformly shaped
+                    "breaker": brk.state if brk is not None else BREAKER_CLOSED,
+                    "breaker_trips": brk.trips if brk is not None else 0,
                 }
                 if cost and ks.buckets:
                     key, b = max(
@@ -542,6 +951,14 @@ class DispatchLedger:
                 "cost_memo_hits": self._cost_hits,
                 "cost_memo_misses": self._cost_misses,
                 "regressions": list(self._regressions),
+                "breakers_open": sum(
+                    1
+                    for b in self._breakers.values()
+                    if b.state != BREAKER_CLOSED
+                ),
+                "breaker_trips": sum(
+                    b.trips for b in self._breakers.values()
+                ),
             }
 
     def hbm_rows(self) -> List[dict]:
@@ -584,6 +1001,7 @@ class DispatchLedger:
         out["cost_memo_hits"] = st["cost_memo_hits"]
         out["cost_memo_misses"] = st["cost_memo_misses"]
         out["regressions"] = st["regressions"]
+        out["breakers"] = self.breaker_rows()
         return out
 
 

@@ -78,6 +78,11 @@ _KTPU_N_COLLECTIVES = {
     "usage rows — same whole-array lineage as _upd_keys: every replica "
     "applies identical rank-1 commits, so the round needs no collective "
     "(the [S,N] speculation keys partition over the pods axis instead)",
+    "usage_checksum": "resolved(replicated): full reductions over the "
+    "N-leading resident usage rows (the ISSUE 15 epoch guard's integrity "
+    "probe) — the lineage is whole-array per dispatch (not node-sharded, "
+    "see _upd_keys), so every replica computes the identical scalar and "
+    "no collective is inserted",
 }
 NEG = jnp.iinfo(jnp.int64).min // 4  # "no committed node yet" threshold
 UNRESOLVED = -2  # choice sentinel: pod not reached before the round cap
@@ -433,3 +438,29 @@ def resident_run(
         )
     stats = jnp.stack([rounds, q.astype(I64), tail_left.astype(I64)])
     return choices, (used, nz0, nz1, num_pods), stats
+
+
+# ---------------------------------------------------------------------------
+# epoch guard (ISSUE 15): cheap device-side integrity probe of the
+# resident usage lineage
+# ---------------------------------------------------------------------------
+
+# ktpu: axes(used=i64[N,Rn], nz0=i64[N], nz1=i64[N], num_pods=i32[N])
+# ktpu: accum(i64, i32, bool)
+@jax.jit
+def usage_checksum(used, nz0, nz1, num_pods):
+    """Cheap device-side checksum of the resident usage state: the exact
+    i64 sum of every row.  The host committer tracks the same quantity
+    incrementally (base sum + per-harvest commit deltas — the commit
+    arithmetic is identical int math on both sides), so before a round's
+    commits are applied the two MUST agree; a mismatch means the lineage
+    is torn (a dispatch died mid-round, or a donated buffer was clobbered)
+    and the harvest resyncs from the host committer instead of silently
+    committing torn usage rows.  One tiny dispatch per device-path batch,
+    async-fetched alongside the choices readback."""
+    return (
+        jnp.sum(used)
+        + jnp.sum(nz0)
+        + jnp.sum(nz1)
+        + jnp.sum(num_pods.astype(I64))
+    )

@@ -168,6 +168,13 @@ def simulate_forks(
         if use_kernel is None
         else use_kernel
     ) and not sched._sampling_active(fwk)
+    # device-fault tier: an open counterfactual breaker routes fork specs
+    # through the serial forked-snapshot oracle (the plannerKernel
+    # kill-switch engine — decision-identical per fork)
+    if kernel_ok and sched._breaker_blocked(
+        "counterfactual.counterfactual_run"
+    ):
+        kernel_ok = False
 
     forks = list(forks)
     pods = list(pods)
@@ -357,53 +364,76 @@ def simulate_forks(
 
     # the fused dispatch + its d2h run OUTSIDE the lock (device-path rule:
     # a first-shape XLA compile must not stall the scheduling loop)
+    from kubernetes_tpu.observability import kernels as kernels_mod
+
     tr = sched.tracer
     t_disp = time.perf_counter()
-    out_dev = cf_ops.counterfactual_run(
-        dc,
-        db,
-        hostname_dev,
-        v_cap,
-        g_cap,
-        wt["tid_sp"],
-        wt["rep_sp_p"],
-        wt["rep_sp_c"],
-        wt["tid_ip"],
-        wt["rep_ip_p"],
-        wt["rep_ip_u"],
-        wt["ip_cdv_tab"],
-        jnp.asarray(gid),
-        jnp.asarray(gfirst),
-        jnp.asarray(glast),
-        jnp.asarray(gneed),
-        **planes,
-        **(volt or {}),
-        has_interpod=has_interpod,
-        has_spread=has_spread,
-        has_images=has_images,
-        enabled=enabled,
-        weights=weights,
-        extra_score=extra_score,
-        d_cap=d_cap,
-        d2_cap=wt["d2_cap"],
-        fit_strategy=fwk.fit_strategy(),
-        **tables,
-    )
-    # planner dispatches are host-tracer-visible like every scheduling
-    # path: dispatch/harvest halves as spans, alongside the
-    # scheduler_tpu_plan_* metrics and the `plan` flight event (_observe)
-    if tr.enabled:
-        tr.complete(
-            "dispatch.plan", t_disp, cat="plan", planner=planner,
-            forks=len(forks), pods=len(ordered),
+    try:
+        out_dev = cf_ops.counterfactual_run(
+            dc,
+            db,
+            hostname_dev,
+            v_cap,
+            g_cap,
+            wt["tid_sp"],
+            wt["rep_sp_p"],
+            wt["rep_sp_c"],
+            wt["tid_ip"],
+            wt["rep_ip_p"],
+            wt["rep_ip_u"],
+            wt["ip_cdv_tab"],
+            jnp.asarray(gid),
+            jnp.asarray(gfirst),
+            jnp.asarray(glast),
+            jnp.asarray(gneed),
+            **planes,
+            **(volt or {}),
+            has_interpod=has_interpod,
+            has_spread=has_spread,
+            has_images=has_images,
+            enabled=enabled,
+            weights=weights,
+            extra_score=extra_score,
+            d_cap=d_cap,
+            d2_cap=wt["d2_cap"],
+            fit_strategy=fwk.fit_strategy(),
+            **tables,
         )
-    t_harvest = time.perf_counter()
-    fetched = {
-        k: np.asarray(v)
-        for k, v in sched._d2h(
-            out_dev, kernel="counterfactual.counterfactual_run"
-        ).items()
-    }
+        # planner dispatches are host-tracer-visible like every scheduling
+        # path: dispatch/harvest halves as spans, alongside the
+        # scheduler_tpu_plan_* metrics and the `plan` flight event (_observe)
+        if tr.enabled:
+            tr.complete(
+                "dispatch.plan", t_disp, cat="plan", planner=planner,
+                forks=len(forks), pods=len(ordered),
+            )
+        t_harvest = time.perf_counter()
+        fetched = {
+            k: np.asarray(v)
+            for k, v in sched._d2h_guarded(
+                out_dev, kernel="counterfactual.counterfactual_run"
+            ).items()
+        }
+    except kernels_mod.DispatchFailed as e:
+        # abandoned kernel dispatch: the same fork specs replay through
+        # the serial forked-snapshot oracle, decision-identically, while
+        # the breaker keeps the kernel parked
+        sched._note_dispatch_failure(e)
+        with sched._mu:
+            snap = _serial_snapshot(sched, gang_positions)
+        t_ser = time.perf_counter()
+        sim = _simulate_serial(
+            sched, forks, ordered, needs, target_node, *snap
+        )
+        sim.skipped.update(skipped)
+        sim.wall_s = time.perf_counter() - t0
+        if tr.enabled:
+            tr.complete(
+                "plan.serial", t_ser, cat="plan", planner=planner,
+                forks=len(forks),
+            )
+        _observe(sched, planner, sim)
+        return sim
     if tr.enabled:
         tr.complete(
             "harvest.plan", t_harvest, cat="plan", planner=planner,

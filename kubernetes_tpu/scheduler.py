@@ -952,6 +952,19 @@ class Scheduler:
                         rec = self._try_dispatch_chained(
                             fwk, group, outcomes, can_restart=True
                         )
+                if isinstance(rec, tuple) and rec and rec[0] == "serial":
+                    # breaker fallback for an abandoned chained dispatch:
+                    # settle the pipeline (its commits must land first),
+                    # then drain the live batch serially OUTSIDE the
+                    # scheduler lock
+                    flush(0)
+                    t0 = time.perf_counter()
+                    outs = self._schedule_batch_serial(fwk, rec[1])
+                    self._record_batch_metrics(
+                        profile_name, rec[1], outs, time.perf_counter() - t0
+                    )
+                    outcomes.extend(outs)
+                    continue
                 if isinstance(rec, dict):
                     # pipelined: keep up to two batches in flight so the
                     # harvest of batch k overlaps k+1's device compute AND
@@ -1103,6 +1116,178 @@ class Scheduler:
         if led.enabled:
             led.record_d2h(kernel, nb, time.perf_counter() - t0)
         return out
+
+    # ----- device-fault tier (ISSUE 15): breaker routing, guarded
+    # readbacks, epoch-guarded resync, mesh degradation -----------------------
+
+    def _breaker_blocked(self, kernel: str) -> bool:
+        """Routing-gate check against ``kernel``'s circuit breaker: True
+        routes the dispatch family to its registered fallback engine
+        (kernels._KTPU_BREAKER_FALLBACKS) and counts the event in
+        scheduler_tpu_wave_fallback_total{reason="breaker"} — degraded
+        placements stay bit-identical (the fallbacks are the engines
+        paritycheck certifies), only the flops move."""
+        led = self.kernels
+        if not led.enabled:
+            return False
+        if led.breaker_allows(kernel):
+            return False
+        self.prom.wave_fallback.inc(reason="breaker")
+        return True
+
+    def _note_dispatch_failure(self, exc) -> None:
+        """Bookkeeping for an abandoned kernel dispatch: log it, count
+        the breaker-routed fallback (every fallback site calls this, so
+        the wave_fallback{reason="breaker"} series — the engagement
+        evidence CHAOS.md and the paritycheck assert lean on — can never
+        silently miss a site), and for a mesh device loss re-form the
+        mesh before the next dispatch (the current batch rides the
+        serial fallback either way)."""
+        kind = getattr(exc, "kind", "dispatch_error")
+        logger.warning(
+            "kernel dispatch abandoned (%s: %s) — batch takes the "
+            "fallback engine",
+            kind,
+            exc,
+        )
+        self.prom.wave_fallback.inc(reason="breaker")
+        if kind == "mesh_device_loss":
+            self._degrade_mesh()
+
+    def _degrade_mesh(self) -> bool:
+        """A device dropped from the mesh: re-form ``meshDispatch`` on a
+        smaller device set — halving, preserving the configured
+        meshPodsAxis layout when it still divides — or fall back to
+        single-chip, rebuild the device snapshot cache against the new
+        placement, and resync the fast lineage's device copy.  Decisions
+        are unaffected — the mesh only changes where the flops run
+        (multichip_vs_singlechip parity) — so degradation is a pure
+        capacity event.  Caveat: jax reports no per-device health, so
+        the smaller mesh is drawn from the same device list and may
+        still contain the dead chip — the next loss halves again, and
+        the floor is always the single-chip engine (then the serial
+        oracle under its breaker)."""
+        from kubernetes_tpu.cache.device_mirror import DeviceClusterCache
+        from kubernetes_tpu.parallel import mesh as pmesh
+
+        with self._mu:
+            if self.mesh is None:
+                new_mesh = None
+            else:
+                n = int(self.mesh.devices.size) // 2
+                pa = self.config.mesh_pods_axis
+                if not (pa and n >= 2 and n % pa == 0):
+                    pa = None  # make_mesh default (pods-major, pow2)
+                new_mesh = (
+                    pmesh.make_mesh(n_devices=n, pods_axis=pa)
+                    if n >= 2
+                    else None
+                )
+            self.mesh = new_mesh
+            self.mirror.node_pad_multiple = (
+                new_mesh.shape["nodes"] if new_mesh is not None else 1
+            )
+            self._dc_cache = DeviceClusterCache(mesh=new_mesh)
+            self._chain = None
+            holder = getattr(self, "_fastdev", None)
+            if holder is not None:
+                # the old placement's device copy is suspect — the host
+                # committer stays authoritative; rematerialize on the
+                # degraded mesh at the next dispatch
+                holder["dev"] = None
+                holder["epoch"] = holder.get("epoch", 0) + 1
+                holder["dev_sum"] = None
+        self.prom.resident_resyncs.inc(reason="mesh_degraded")
+        logger.warning(
+            "mesh degraded to %s after device loss",
+            dict(new_mesh.shape) if new_mesh is not None else "single-chip",
+        )
+        return True
+
+    def _sync_device_cluster(self, vocab):
+        """DeviceClusterCache.sync with hbm_oom recovery: a failed
+        donation/placement (chaos hbm_oom, or a real RESOURCE_EXHAUSTED)
+        invalidates the cache and rebuilds the snapshot whole from the
+        host mirror — the full-pack path.  Bounded retries; persistent
+        failure surfaces as DispatchFailed so callers route the batch to
+        the serial fallback."""
+        from kubernetes_tpu.observability import kernels as kernels_mod
+
+        last = None
+        for _ in range(3):
+            try:
+                return self._dc_cache.sync(self.mirror, vocab)
+            except kernels_mod.DispatchFailed:
+                raise
+            except Exception as e:  # noqa: BLE001 — backend failure class
+                last = e
+                self.kernels.record_breaker_failure(
+                    "device_mirror.apply", "hbm_oom"
+                )
+                self.prom.resident_resyncs.inc(reason="hbm_oom")
+                self._dc_cache.invalidate()
+        raise kernels_mod.DispatchFailed(
+            "device_mirror.apply", last, kind="hbm_oom"
+        )
+
+    def _d2h_guarded(self, value, kernel: str, validate=None, retries: int = 2):
+        """Blocking fetch (through ``_d2h``) with readback validation:
+        float leaves must be finite, signed-int leaves must not carry the
+        poison sentinel, and ``validate(fetched)`` (when given) must
+        return None.  A bad readback books a poisoned_output breaker
+        failure and re-fetches — the device array is intact, so an
+        injected poison heals, while a REAL non-finite kernel output
+        keeps failing and raises DispatchFailed for the caller's fallback
+        engine.  Chaos poison is injected here (and ONLY here: unguarded
+        fetches are never corrupted — a fault nobody validates would be
+        an undetectable wrong answer, not a recoverable one)."""
+        import numpy as np
+
+        from kubernetes_tpu.observability import kernels as kernels_mod
+
+        poison_i32 = -(2**31)
+        attempt = 0
+        while True:
+            out = self._d2h(value, kernel=kernel)
+            inj = kernels_mod.fault_injector()
+            if inj is not None and self.kernels.enabled:
+                out, _fired = inj.poison(kernel, out)
+            err = None
+            for leaf in jax.tree_util.tree_leaves(out):
+                if not isinstance(leaf, np.ndarray) or leaf.size == 0:
+                    continue
+                if np.issubdtype(leaf.dtype, np.floating):
+                    if not np.isfinite(leaf).all():
+                        err = "non-finite float readback"
+                        break
+                elif np.issubdtype(leaf.dtype, np.signedinteger):
+                    if (leaf == leaf.dtype.type(poison_i32)).any():
+                        err = "out-of-range int readback"
+                        break
+            if err is None and validate is not None:
+                err = validate(out)
+            if err is None:
+                return out
+            self.kernels.record_breaker_failure(kernel, "poisoned_output")
+            if attempt >= retries:
+                raise kernels_mod.DispatchFailed(
+                    kernel, err, kind="poisoned_output"
+                )
+            attempt += 1
+
+    def _schedule_batch_serial(self, fwk, batch) -> List[ScheduleOutcome]:
+        """Breaker fallback: the batch degrades to one-pod host-oracle
+        cycles — the fallback ladder's floor, bit-identical to the device
+        engines by the parity property.  This is the drain path while a
+        kernel family's breaker is open (or after its dispatch was
+        abandoned mid-batch)."""
+        outs: List[ScheduleOutcome] = []
+        for qp in batch:
+            if qp.pod.nominated_node_name:
+                outs.extend(self._schedule_one_nominated(fwk, qp))
+            else:
+                outs.extend(self._schedule_one_extender(fwk, qp))
+        return outs
 
     def _record_batch_metrics(self, profile, group, outs, dt: float) -> None:
         """Attempt counters + latency histograms (metrics.go:86-147).  The
@@ -1406,7 +1591,15 @@ class Scheduler:
                 namespace_labels=self.namespace_labels,
             )
             t_sync = time.perf_counter()
-            dc = self._dc_cache.sync(self.mirror, vocab)
+            from kubernetes_tpu.observability import kernels as kernels_mod
+
+            try:
+                dc = self._sync_device_cluster(vocab)
+            except kernels_mod.DispatchFailed as e:
+                # persistent snapshot-placement failure (hbm_oom class):
+                # the batch drains on the serial host-oracle path
+                self._note_dispatch_failure(e)
+                return outcomes + self._schedule_batch_serial(fwk, batch)
             db = self._place_db(DeviceBatch.from_host(pb))
             self.prom.recorder.observe(
                 self.prom.snapshot_pack_duration,
@@ -1446,12 +1639,19 @@ class Scheduler:
             )
             wt = None
             if wave_shaped:
-                if self.config.wave_dispatch:
+                if not self.config.wave_dispatch:
+                    self.prom.wave_fallback.inc(reason="kill_switch")
+                elif self._breaker_blocked("wave.wave_run"):
+                    pass  # open breaker: the batch rides the scan fallback
+                else:
                     wt = self._wave_tables(pb)
                     if wt is None:
                         self.prom.wave_fallback.inc(reason="dup_hostname")
-                else:
-                    self.prom.wave_fallback.inc(reason="kill_switch")
+            # an OPEN gang-scan breaker has no device engine left under it:
+            # the batch degrades to one-pod host-oracle cycles (the ladder's
+            # floor, bit-identical by the parity property)
+            if wt is None and self._breaker_blocked("gang.gang_run"):
+                return outcomes + self._schedule_batch_serial(fwk, batch)
             self.metrics[
                 "wave_batches" if wt is not None else "scan_batches"
             ] += 1
@@ -1506,52 +1706,76 @@ class Scheduler:
             fit_strategy=fwk.fit_strategy(),
             **tables,
         )
-        if wt is not None:
-            from kubernetes_tpu.ops import wave as wave_ops
+        path = "wave" if wt is not None else "scan"
+        kroot = "wave.wave_run" if wt is not None else "gang.gang_run"
+        n_bound = len(self.mirror.nodes.names)
 
-            chosen, n_feas, reason_counts, tallies, wstats_dev = (
-                wave_ops.wave_run(
+        def _validate_direct(fetched):
+            import numpy as np
+
+            arr = np.asarray(fetched)
+            ch, nf = arr[0], arr[1]
+            if ((ch < -1) | (ch >= n_bound)).any():
+                return "chosen index out of node range"
+            if ((nf < 0) | (nf > n_bound)).any():
+                return "n_feas out of range"
+            return None
+
+        try:
+            if wt is not None:
+                from kubernetes_tpu.ops import wave as wave_ops
+
+                chosen, n_feas, reason_counts, tallies, wstats_dev = (
+                    wave_ops.wave_run(
+                        dc,
+                        db,
+                        hostname_key,
+                        v_cap,
+                        wt["tid_sp"],
+                        wt["rep_sp_p"],
+                        wt["rep_sp_c"],
+                        wt["tid_ip"],
+                        wt["rep_ip_p"],
+                        wt["rep_ip_u"],
+                        wt["ip_cdv_tab"],
+                        d2_cap=wt["d2_cap"],
+                        has_ports=wt["has_ports"],
+                        tid_pt=wt["tid_pt"],
+                        port_conf=wt["port_conf"],
+                        sample_k=sample_k,
+                        sample_start=sample_start,
+                        tie_key=tie_key,
+                        attempt_base=attempt_base,
+                        **shared_kw,
+                    )
+                )
+            else:
+                chosen, n_feas, reason_counts, tallies = gang.gang_run(
                     dc,
                     db,
                     hostname_key,
                     v_cap,
-                    wt["tid_sp"],
-                    wt["rep_sp_p"],
-                    wt["rep_sp_c"],
-                    wt["tid_ip"],
-                    wt["rep_ip_p"],
-                    wt["rep_ip_u"],
-                    wt["ip_cdv_tab"],
-                    d2_cap=wt["d2_cap"],
-                    has_ports=wt["has_ports"],
-                    tid_pt=wt["tid_pt"],
-                    port_conf=wt["port_conf"],
+                    has_ports=has_ports,
                     sample_k=sample_k,
                     sample_start=sample_start,
                     tie_key=tie_key,
                     attempt_base=attempt_base,
                     **shared_kw,
                 )
+            t_d2h = time.perf_counter()
+            self.phases.add("device", t_d2h - t_gang)
+            both = self._d2h_guarded(
+                jnp.stack([chosen, n_feas]),
+                kernel=kroot,
+                validate=_validate_direct,
             )
-        else:
-            chosen, n_feas, reason_counts, tallies = gang.gang_run(
-                dc,
-                db,
-                hostname_key,
-                v_cap,
-                has_ports=has_ports,
-                sample_k=sample_k,
-                sample_start=sample_start,
-                tie_key=tie_key,
-                attempt_base=attempt_base,
-                **shared_kw,
-            )
-        path = "wave" if wt is not None else "scan"
-        kroot = "wave.wave_run" if wt is not None else "gang.gang_run"
-        t_d2h = time.perf_counter()
-        self.phases.add("device", t_d2h - t_gang)
-        both = self._d2h(jnp.stack([chosen, n_feas]), kernel=kroot)
-        self.phases.add("d2h", time.perf_counter() - t_d2h)
+            self.phases.add("d2h", time.perf_counter() - t_d2h)
+        except kernels_mod.DispatchFailed as e:
+            # abandoned dispatch (or unrecoverable readback): nothing was
+            # committed — the batch drains on the serial host-oracle path,
+            # bit-identically, while the breaker keeps the kernel parked
+            self._note_dispatch_failure(e)
+            return outcomes + self._schedule_batch_serial(fwk, batch)
         chosen, n_feas = both[0], both[1]
         if sample_k is not None:
             self._next_start_node_index = int(
@@ -1725,6 +1949,10 @@ class Scheduler:
         (no extenders/host-filter/host-score involvement, not a fast-path
         candidate, mirror already initialized)."""
         if self.extenders or self.mirror.nodes is None:
+            return False
+        # device-fault tier: an open chain breaker routes batches to the
+        # direct path (same verdict kernels, no pipeline overlap)
+        if self._breaker_blocked("chain.chain_dispatch"):
             return False
         # bit-compat sampling threads a rotation cursor through every
         # attempt — the direct path owns that state
@@ -2014,6 +2242,7 @@ class Scheduler:
         pending record (dict), "handled" (nothing left to schedule),
         "flush" (pipeline must settle before the chain can restart), or
         None (fall back to the direct path)."""
+        from kubernetes_tpu.observability import kernels as kernels_mod
         from kubernetes_tpu.ops import chain as chain_ops
 
         with self._mu:
@@ -2048,8 +2277,15 @@ class Scheduler:
                     # pipeline must settle before a host-state restart
                     return "flush"
                 # (re)start: the host mirror is current (pipeline settled —
-                # can_restart) so its tensors are the ground truth
-                dc = self._dc_cache.sync(self.mirror, vocab)
+                # can_restart) so its tensors are the ground truth.  A
+                # persistent placement failure (hbm_oom class) bails to
+                # the direct path, which owns the serial fallback — this
+                # is still the side-effect-free prep, so None is safe.
+                try:
+                    dc = self._sync_device_cluster(vocab)
+                except kernels_mod.DispatchFailed as e:
+                    self._note_dispatch_failure(e)
+                    return None
                 # the chain will donate/diverge these buffers — the delta
                 # cache must not touch them again
                 self._dc_cache.invalidate()
@@ -2092,7 +2328,11 @@ class Scheduler:
                 )
                 self.mirror._epod_slots = None  # full existing repack
                 self.mirror._existing_version = -1
-                dc = self._dc_cache.sync(self.mirror, vocab)
+                try:
+                    dc = self._sync_device_cluster(vocab)
+                except kernels_mod.DispatchFailed as e:
+                    self._note_dispatch_failure(e)
+                    return None  # direct path owns the serial fallback
                 self._dc_cache.invalidate()
                 ch = {
                     "dc": dc,
@@ -2199,27 +2439,40 @@ class Scheduler:
                     port_conf=wt["port_conf"],
                 )
             t0 = time.perf_counter()
-            out = chain_ops.chain_dispatch(
-                ch["dc"],
-                db,
-                self._hostname_dev(vocab),
-                jnp.asarray(ch["e"], I32),
-                jnp.asarray(ch["m"], I32),
-                v_cap,
-                has_interpod=has_interpod,
-                has_spread=has_spread,
-                has_ports=has_ports,
-                has_images=has_images,
-                enabled=enabled,
-                weights=weights,
-                nom_node=nom_node,
-                nom_prio=nom_prio,
-                nom_req=nom_req,
-                append_terms=append_terms,
-                fit_strategy=fit_strategy,
-                **wave_kw,
-                **tables,
-            )
+            try:
+                out = chain_ops.chain_dispatch(
+                    ch["dc"],
+                    db,
+                    self._hostname_dev(vocab),
+                    jnp.asarray(ch["e"], I32),
+                    jnp.asarray(ch["m"], I32),
+                    v_cap,
+                    has_interpod=has_interpod,
+                    has_spread=has_spread,
+                    has_ports=has_ports,
+                    has_images=has_images,
+                    enabled=enabled,
+                    weights=weights,
+                    nom_node=nom_node,
+                    nom_prio=nom_prio,
+                    nom_req=nom_req,
+                    append_terms=append_terms,
+                    fit_strategy=fit_strategy,
+                    **wave_kw,
+                    **tables,
+                )
+            except kernels_mod.DispatchFailed as e:
+                # the chained cluster was donated into the dead dispatch —
+                # drop the chain (the next batch rebuilds from the host
+                # mirror) and hand the LIVE batch back for the serial
+                # host-oracle fallback; nothing was committed, so the
+                # fallback is exact.  The serial drain itself runs in
+                # the caller OUTSIDE this lock — the snapshot-under-lock
+                # / replay-outside-lock discipline every other serial
+                # engine follows.
+                self._note_dispatch_failure(e)
+                self._chain = None
+                return ("serial", batch)
             if wt is not None:
                 dc2, results, reasons, wstats = out
             else:
@@ -2265,7 +2518,37 @@ class Scheduler:
         tr = self.tracer
         t_h = tr.now() if tr.enabled else None
         t_d2h = time.perf_counter()
-        both = self._d2h(rec["results"], kernel="chain.chain_dispatch")
+        from kubernetes_tpu.observability import kernels as kernels_mod
+
+        n_bound = len(self.mirror.nodes.names)
+
+        def _validate_chain(fetched):
+            import numpy as np
+
+            arr = np.asarray(fetched)
+            if ((arr[0] < -1) | (arr[0] >= n_bound)).any():
+                return "chosen index out of node range"
+            return None
+
+        try:
+            both = self._d2h_guarded(
+                rec["results"],
+                kernel="chain.chain_dispatch",
+                validate=_validate_chain,
+            )
+        except kernels_mod.DispatchFailed as e:
+            # unrecoverable harvest: the chain's device state already
+            # includes these commits, so drop it (the next batch rebuilds
+            # from the host mirror) and re-derive the batch serially —
+            # bit-identical placements, so host state stays consistent
+            self._note_dispatch_failure(e)
+            with self._mu:
+                self._chain = None
+            outcomes.extend(
+                self._schedule_batch_serial(rec["fwk"], rec["batch"])
+            )
+            self._flush_binds()
+            return outcomes
         self.phases.add("d2h", time.perf_counter() - t_d2h)
         wstats = rec.get("wave_stats")
         self.prom.recorder.observe(
@@ -2653,6 +2936,13 @@ class Scheduler:
 
         if not self._workloads_eligible(fwk, batch):
             return None
+        # device-fault tier: an open workloads breaker refuses the path
+        # BEFORE any side effect — the caller falls through to the
+        # existing machinery, i.e. the gangDispatch kill-switch fallback
+        # (decision-identical for DRA/volume pods; gang pods schedule
+        # individually, exactly the documented degraded semantics)
+        if self._breaker_blocked("coscheduling.workloads_run"):
+            return None
         outcomes: List[ScheduleOutcome] = []
         self._chain = None
         with self._mu:
@@ -2779,7 +3069,20 @@ class Scheduler:
                 namespace_labels=self.namespace_labels,
             )
             t_sync = time.perf_counter()
-            dc = self._dc_cache.sync(self.mirror, vocab)
+            from kubernetes_tpu.observability import kernels as kernels_mod
+
+            try:
+                dc = self._sync_device_cluster(vocab)
+            except kernels_mod.DispatchFailed as e:
+                # persistent snapshot-placement failure (hbm_oom class)
+                # PAST the commit point (PreFilter failures and quorum
+                # rejections already emitted): finish the live pods on
+                # the ordinary machinery — the same move as the
+                # wave-tables drift guard below; nothing double-processes
+                self._note_dispatch_failure(e)
+                return outcomes + self._schedule_batch(
+                    ordered, try_workloads=False
+                )
             db = self._place_db(DeviceBatch.from_host(pb))
             self.phases.add("h2d", time.perf_counter() - t_sync)
             v_cap = bucket_cap(len(vocab.label_vals))
@@ -2848,9 +3151,12 @@ class Scheduler:
             self.metrics["workload_batches"] += 1
 
         # 5. one fused dispatch (outside the lock, like every device path)
+        from kubernetes_tpu.observability import kernels as kernels_mod
+
         t_gang = time.perf_counter()
-        chosen_dev, n_feas_dev, reason_counts, tallies, wl_dev = (
-            cos_ops.workloads_run(
+        try:
+            chosen_dev, n_feas_dev, reason_counts, tallies, wl_dev = (
+                cos_ops.workloads_run(
                 dc,
                 db,
                 hostname_key,
@@ -2877,25 +3183,43 @@ class Scheduler:
                 nom_node=nom_node,
                 nom_prio=nom_prio,
                 nom_req=nom_req,
-                d2_cap=wt["d2_cap"],
-                fit_strategy=fwk.fit_strategy(),
-                **tables,
+                    d2_cap=wt["d2_cap"],
+                    fit_strategy=fwk.fit_strategy(),
+                    **tables,
+                )
             )
-        )
-        t_d2h = time.perf_counter()
-        self.phases.add("device", t_d2h - t_gang)
-        fetched = self._d2h(
-            (
-                chosen_dev,
-                n_feas_dev,
-                wl_dev["raw"],
-                wl_dev["spec"],
-                wl_dev["gang_admit"],
-                wl_dev["gang_landed"],
-                wl_dev["claim_node"] if dt is not None else None,
-            ),
-            kernel="coscheduling.workloads_run",
-        )
+            t_d2h = time.perf_counter()
+            self.phases.add("device", t_d2h - t_gang)
+            n_bound = len(self.mirror.nodes.names)
+
+            def _validate_wl(fetched):
+                import numpy as np
+
+                ch = np.asarray(fetched[0])
+                if ((ch < -1) | (ch >= n_bound)).any():
+                    return "chosen index out of node range"
+                return None
+
+            fetched = self._d2h_guarded(
+                (
+                    chosen_dev,
+                    n_feas_dev,
+                    wl_dev["raw"],
+                    wl_dev["spec"],
+                    wl_dev["gang_admit"],
+                    wl_dev["gang_landed"],
+                    wl_dev["claim_node"] if dt is not None else None,
+                ),
+                kernel="coscheduling.workloads_run",
+                validate=_validate_wl,
+            )
+        except kernels_mod.DispatchFailed as e:
+            # abandoned workloads dispatch: nothing committed yet — the
+            # live batch degrades to per-pod host-plugin cycles (gang
+            # members schedule individually, the documented kill-switch
+            # semantics) while the breaker keeps the kernel parked
+            self._note_dispatch_failure(e)
+            return outcomes + self._schedule_batch_serial(fwk, ordered)
         chosen, n_feas, raw, spec, gang_admit, gang_landed, claim_node = (
             fetched
         )
@@ -3295,6 +3619,10 @@ class Scheduler:
                 reps.append(qp.pod)
 
         w_taint, w_naff = weights[0], weights[1]
+        if reps and self._breaker_blocked("fastpath.static_eval"):
+            # open static-eval breaker: fail the fast gate — the batch
+            # takes the direct scan path, which reads no signature rows
+            return None
         if reps:
             has_images = any(p.images for p in reps)
             pb = pack_pod_batch(
@@ -3308,15 +3636,23 @@ class Scheduler:
             )
             db = self._place_db(DeviceBatch.from_host(pb))
             dc = self._static_device_cluster()
-            res = ops_fp.static_eval(
-                dc, db, enabled=enabled, has_images=has_images
-            )
-            res = {
-                k: np.asarray(v)
-                for k, v in self._d2h(
-                    res, kernel="fastpath.static_eval"
-                ).items()
-            }
+            from kubernetes_tpu.observability import kernels as kernels_mod
+
+            try:
+                res = ops_fp.static_eval(
+                    dc, db, enabled=enabled, has_images=has_images
+                )
+                res = {
+                    k: np.asarray(v)
+                    for k, v in self._d2h_guarded(
+                        res, kernel="fastpath.static_eval"
+                    ).items()
+                }
+            except kernels_mod.DispatchFailed as e:
+                # abandoned static eval: the fast gate fails and the batch
+                # rides the direct scan path (no signature rows needed)
+                self._note_dispatch_failure(e)
+                return None
             for k, s in order.items():
                 row = {name: res[name][s] for name in res}
                 # Normalized static scores are argmax-neutral ONLY when
@@ -3386,6 +3722,12 @@ class Scheduler:
                 # committer lags exactly these, so the host path is legal
                 # only at zero
                 "p_cap": 64,
+                # epoch guard (ISSUE 15): lineage epoch (bumped on every
+                # device-state rematerialization/resync — a pending record
+                # from an older epoch re-derives on the committer) + the
+                # host-tracked exact sum of the device usage state
+                "epoch": 0,
+                "dev_sum": None,
             }
             if getattr(self, "fast_shadow_check", False):
                 # invariant-checking mode: a second host FastCommitter
@@ -3421,12 +3763,27 @@ class Scheduler:
         pod_sigs = [sigs[k] for k in keys]
         t0 = time.perf_counter()
 
+        # device-fault tier: an open breaker parks its kernel — resident
+        # degrades to sig_scan, sig_scan degrades to the host committer
+        # (every rung bit-identical, tests/test_fastpath.py /
+        # tests/test_resident.py)
+        res_on = getattr(self.config, "resident_drain", False)
+        if res_on and self._breaker_blocked("resident.resident_run"):
+            res_on = False
+        device_ok = res_on or not self._breaker_blocked("fastpath.sig_scan")
+        if not device_ok and holder["dev_inflight"] > 0:
+            # no device engine available and the host committer lags the
+            # unharvested pipeline — the caller flushes and retries or
+            # takes the direct path; nothing is committed here
+            return None
+
         # ---- host path: no unharvested device batches + small batch →
         # the greedy answers locally in O(P · log N) with no device link
         # involvement at all (host records already advanced the committer
         # at dispatch, so they may stay pending)
-        if holder["dev_inflight"] == 0 and len(batch) < getattr(
-            self.config, "fast_device_min", 1024
+        if holder["dev_inflight"] == 0 and (
+            not device_ok
+            or len(batch) < getattr(self.config, "fast_device_min", 1024)
         ):
             if holder["heaps_dirty"]:
                 # device-batch replays changed scores under the lazy heaps
@@ -3492,6 +3849,10 @@ class Scheduler:
                 # one upload per host→device transition, folded into this
                 # dispatch's async pipeline
                 fc = holder["fc"]
+                used_np = np.asarray(fc.used_rows, np.int64)
+                nz0_np = np.asarray(fc.nz0, np.int64)
+                nz1_np = np.asarray(fc.nz1, np.int64)
+                npods_np = np.asarray(fc.num_pods, np.int32)
                 holder["alloc"] = jnp.asarray(
                     np.asarray(fc.alloc_rows, np.int64)
                 )
@@ -3499,16 +3860,27 @@ class Scheduler:
                     np.asarray(fc.allowed, np.int32)
                 )
                 holder["dev"] = (
-                    jnp.asarray(np.asarray(fc.used_rows, np.int64)),
-                    jnp.asarray(np.asarray(fc.nz0, np.int64)),
-                    jnp.asarray(np.asarray(fc.nz1, np.int64)),
-                    jnp.asarray(np.asarray(fc.num_pods, np.int32)),
+                    jnp.asarray(used_np),
+                    jnp.asarray(nz0_np),
+                    jnp.asarray(nz1_np),
+                    jnp.asarray(npods_np),
+                )
+                # epoch guard: a fresh lineage epoch plus the exact host
+                # sum of the uploaded state — each harvest advances the
+                # sum by its commits and checks it against the device
+                # checksum before trusting a round's results
+                holder["epoch"] = holder.get("epoch", 0) + 1
+                holder["dev_sum"] = int(
+                    int(used_np.sum())
+                    + int(nz0_np.sum())
+                    + int(nz1_np.sum())
+                    + int(npods_np.sum())
                 )
             used, nz0, nz1, num_pods = holder["dev"]
             t_dev = time.perf_counter()
             self.phases.add("h2d", t_dev - t_h2d)
             rstats_dev = None
-            if getattr(self.config, "resident_drain", False):
+            if res_on:
                 # resident drain loop (ops/resident.py): the whole run is
                 # placed on device through the speculation/admission fixed
                 # point — same donated usage state as sig_scan, one d2h
@@ -3563,6 +3935,15 @@ class Scheduler:
                     w_img=w_img,
                     check_fit=check_fit,
                 )
+            # epoch guard: the device-side checksum of the NEW state rides
+            # the same async pipeline; the harvest validates it against
+            # the host-tracked sum BEFORE committing the round
+            csum_dev = None
+            if getattr(self.config, "resident_epoch_guard", True):
+                from kubernetes_tpu.ops import resident as ops_res
+
+                csum_dev = ops_res.usage_checksum(*holder["dev"])
+                csum_dev.copy_to_host_async()
             # start the device→host result copy NOW; by harvest time the
             # data is local and the blocking fetch is cheap (the same
             # latency-hiding discipline as the chained gang pipeline)
@@ -3571,22 +3952,57 @@ class Scheduler:
                 rstats_dev.copy_to_host_async()
             holder["dev_inflight"] += 1
             self.phases.add("device", time.perf_counter() - t_dev)
-        except Exception:
-            # the donated state buffers may be gone — drop the holder so the
-            # next fast batch rebuilds from the mirror, and let the caller
-            # error-requeue this batch
-            logger.exception("sig_scan dispatch failed; dropping fast state")
-            self._fastdev = None
-            # the dropped lineage's commits live only in the CACHE; force
-            # the next _sync_mirror_external to repack from it, or the
-            # rebuilt committer would start from the drain-start mirror
-            # and double-book every node's capacity.  Locked: an unlocked
-            # `+=` racing an informer handler's bump can LOSE one of the
-            # two — an epoch that silently never advances is exactly the
-            # stale-lineage reuse this counter exists to prevent.
-            with self._mu:
-                self._external_mutations += 1
-            return None
+        except Exception as e:
+            # a dispatch died mid-round: the donated usage buffers are in
+            # an unknown state — but the HOST committer is still the
+            # committed truth, so the epoch-guarded resync only drops the
+            # device lineage (epoch bump invalidates any unharvested
+            # record dispatched against it) and answers this batch on the
+            # committer, bit-identically.  No torn usage row can commit:
+            # nothing reached the cache from the dead dispatch.
+            from kubernetes_tpu.observability import kernels as kernels_mod
+
+            if not isinstance(e, kernels_mod.DispatchFailed):
+                logger.exception(
+                    "fast-path dispatch failed; resyncing device lineage"
+                )
+            self._note_dispatch_failure(e)
+            holder["dev"] = None
+            holder["epoch"] = holder.get("epoch", 0) + 1
+            holder["dev_sum"] = None
+            self.prom.resident_resyncs.inc(reason="dispatch_failed")
+            if holder["dev_inflight"] > 0:
+                # unharvested records exist: their harvests re-derive on
+                # the committer (epoch mismatch); this batch retries via
+                # the caller's flush-and-fallback discipline
+                return None
+            if holder["heaps_dirty"]:
+                holder["fc"].invalidate_heaps()
+                holder["heaps_dirty"] = False
+            t_dev = time.perf_counter()
+            choices = holder["fc"].run(pod_sigs)
+            self.phases.add("device", time.perf_counter() - t_dev)
+            with self._mu:  # metrics is a registered lock-guarded field
+                self.metrics["fast_batches"] += 1
+            rec = {
+                "kind": "fast",
+                "fwk": fwk,
+                "state": state,
+                "batch": batch,
+                "keys": keys,
+                "pod_sigs": pod_sigs,
+                "choices_host": choices,
+                "choices_dev": None,
+                "rstats_dev": None,
+                "rows": cache,
+                "weights": weights,
+                "check_fit": check_fit,
+                "holder": holder,
+                "t0": t0,
+                "record_metrics": False,
+            }
+            self._trace_dispatch("fast", t0, batch, rec)
+            return rec
         with self._mu:  # metrics is a registered lock-guarded field
             self.metrics["fast_batches"] += 1
         rec = {
@@ -3599,6 +4015,8 @@ class Scheduler:
             "choices_host": None,
             "choices_dev": choices_dev,
             "rstats_dev": rstats_dev,
+            "csum_dev": csum_dev,
+            "epoch": holder["epoch"],
             "rows": cache,
             "weights": weights,
             "check_fit": check_fit,
@@ -3628,26 +4046,69 @@ class Scheduler:
         pod_sigs = rec["pod_sigs"]
         holder = rec["holder"]
         outcomes: List[ScheduleOutcome] = []
+        from kubernetes_tpu.observability import kernels as kernels_mod
+
         choices = rec["choices_host"]
-        if choices is None:
+        torn = None  # epoch-guard verdict: why the device round was discarded
+        if choices is None and rec.get("epoch") is not None and rec[
+            "epoch"
+        ] != rec["holder"].get("epoch"):
+            # the lineage was resynced AFTER this dispatch (a later
+            # dispatch died, hbm_oom, mesh degrade): its results ride a
+            # dead epoch — discard them un-fetched and re-derive on the
+            # host committer, bit-identically
+            torn = "epoch_stale"
+        if choices is None and torn is None:
             rstats_dev = rec.get("rstats_dev")
+            csum_dev = rec.get("csum_dev")
+            kern = (
+                "resident.resident_run"
+                if rstats_dev is not None
+                else "fastpath.sig_scan"
+            )
+            n_fc = holder["fc"].n
+
+            def _validate_choices(fetched):
+                ch = np.asarray(fetched[0])[: len(batch)]
+                if ((ch < -2) | (ch >= n_fc)).any():
+                    return "choice index out of node range"
+                return None
+
             t_d2h = time.perf_counter()
-            if rstats_dev is not None:
-                fetched = self._d2h(
-                    (rec["choices_dev"], rstats_dev),
-                    kernel="resident.resident_run",
+            try:
+                fetched = self._d2h_guarded(
+                    (rec["choices_dev"], rstats_dev, csum_dev),
+                    kernel=kern,
+                    validate=_validate_choices,
                 )
-                choices_np = np.asarray(fetched[0])[: len(batch)]
-                rstats = np.asarray(fetched[1])
+            except kernels_mod.DispatchFailed as e:
+                # unrecoverable readback: treat exactly like a torn round
+                self._note_dispatch_failure(e)
+                torn = "checksum_mismatch"
             else:
-                choices_np = np.asarray(
-                    self._d2h(
-                        rec["choices_dev"], kernel="fastpath.sig_scan"
-                    )
-                )[: len(batch)]
-                rstats = None
-            choices = choices_np.tolist()
+                choices_np = np.asarray(fetched[0])[: len(batch)]
+                rstats = (
+                    np.asarray(fetched[1]) if rstats_dev is not None else None
+                )
+                csum = int(fetched[2]) if csum_dev is not None else None
+                choices = choices_np.tolist()
             self.phases.add("d2h", time.perf_counter() - t_d2h)
+        if torn is not None:
+            # epoch-guarded resync: nothing from the dead round reaches
+            # the cache or the committer — the host committer (still the
+            # committed truth) answers the batch instead
+            holder["dev"] = None
+            holder["dev_sum"] = None
+            holder["dev_inflight"] -= 1
+            self.prom.resident_resyncs.inc(reason=torn)
+            if holder["heaps_dirty"]:
+                holder["fc"].invalidate_heaps()
+                holder["heaps_dirty"] = False
+            t_res = time.perf_counter()
+            choices = holder["fc"].run(pod_sigs)
+            self.phases.add("resident_rounds", time.perf_counter() - t_res)
+            rec["rstats_dev"] = None  # the path label below reads it
+        elif rec["choices_host"] is None:
             holder["dev_inflight"] -= 1
             t_res = time.perf_counter()
             if rstats is not None:
@@ -3668,6 +4129,8 @@ class Scheduler:
             fc = holder["fc"]
             rn = fc.rn
             sel = choices_np >= 0
+            agg = add0 = add1 = cnt = None
+            nodes = None
             if sel.any():
                 st_np = holder["stack"]
                 sids = np.fromiter(
@@ -3681,6 +4144,53 @@ class Scheduler:
                 add1 = np.zeros(fc.n, np.int64)
                 np.add.at(add1, nodes, st_np["nz_np"][sids, 1])
                 cnt = np.bincount(nodes, minlength=fc.n)
+            # epoch guard: the device state's checksum must equal the
+            # host-tracked base sum plus EXACTLY this round's commit
+            # delta (identical int arithmetic on both sides) — validated
+            # BEFORE anything touches the committer, so a dispatch that
+            # died mid-round can never commit torn usage rows.  The base
+            # is read at HARVEST time (holder["dev_sum"]): harvests are
+            # FIFO, so with two batches in flight the earlier harvest has
+            # already folded its delta in by the time the later validates.
+            if csum is not None and holder.get("dev_sum") is not None:
+                delta = 0
+                if agg is not None:
+                    delta = int(
+                        int(agg.sum())
+                        + int(add0.sum())
+                        + int(add1.sum())
+                        + int(cnt.sum())
+                    )
+                expected = holder["dev_sum"] + delta
+                if csum != expected:
+                    # torn state: discard the round, resync the lineage,
+                    # and answer on the committer (bit-identical)
+                    logger.warning(
+                        "resident usage checksum mismatch (device %d != "
+                        "expected %d) — resyncing from the host committer",
+                        csum,
+                        expected,
+                    )
+                    self.kernels.record_breaker_failure(
+                        kern, "poisoned_output"
+                    )
+                    self.prom.resident_resyncs.inc(
+                        reason="checksum_mismatch"
+                    )
+                    self.prom.wave_fallback.inc(reason="breaker")
+                    holder["dev"] = None
+                    holder["dev_sum"] = None
+                    if holder["heaps_dirty"]:
+                        fc.invalidate_heaps()
+                        holder["heaps_dirty"] = False
+                    choices = fc.run(pod_sigs)
+                    choices_np = np.asarray(choices)
+                    rstats = None
+                    sel = np.zeros(0, bool)  # committer already committed
+                    agg = None
+                else:
+                    holder["dev_sum"] = expected
+            if agg is not None:
                 used_rows = fc.used_rows
                 nz0l, nz1l, npods = fc.nz0, fc.nz1, fc.num_pods
                 for n in np.unique(nodes).tolist():
@@ -3691,7 +4201,7 @@ class Scheduler:
                     nz0l[n] += int(add0[n])
                     nz1l[n] += int(add1[n])
                     npods[n] += int(cnt[n])
-            holder["heaps_dirty"] = True
+                holder["heaps_dirty"] = True
             unresolved = choices_np == -2  # ops/resident.py UNRESOLVED
             if unresolved.any():
                 # host-committer tail: the fixed point handed back its
@@ -3707,6 +4217,7 @@ class Scheduler:
                     choices[i] = c
                 holder["heaps_dirty"] = False
                 holder["dev"] = None
+                holder["dev_sum"] = None
             if rstats is not None:
                 self.phases.add(
                     "resident_rounds", time.perf_counter() - t_res
@@ -4747,6 +5258,12 @@ class Scheduler:
                     tree.update(bnode=bnode, bprio=bprio, breq=breq)
                 from kubernetes_tpu.ops import wire
 
+                # device-fault tier: narrowing is an optimization — an
+                # open breaker (or the best-effort except below, for an
+                # abandoned dispatch) leaves the FULL candidate set, which
+                # is superset-sound by construction
+                if self._breaker_blocked("preemption.narrow_candidates"):
+                    return
                 t = wire.device_put_packed(tree)
                 masks_dev = ops_preemption.narrow_candidates(
                     dc,

@@ -987,6 +987,73 @@ def check_multichip_vs_singlechip(
     }
 
 
+def check_breaker_open_vs_oracle(n_nodes=300, n_pods=900) -> dict:
+    """Breaker-degraded drain vs the serial oracle (ISSUE 15): with the
+    wave AND gang-scan breakers latched open, every cross-pod batch
+    drains on the one-pod host-oracle fallback — placements must be
+    bit-identical to the oracle (that is the entire point of routing an
+    open breaker to a parity-certified engine), and the fallback must
+    actually ENGAGE (wave_fallback{reason=breaker} > 0, zero device
+    batches) or the claim is vacuous."""
+    import copy
+
+    from kubernetes_tpu.framework.config import SchedulerConfiguration
+    from kubernetes_tpu.oracle.pipeline import schedule_one
+    from kubernetes_tpu.oracle.state import OracleState
+    from kubernetes_tpu.scheduler import Scheduler
+
+    nodes = _basic_nodes(n_nodes, zones=6)
+    pods = _cross_pod_pods(n_pods)
+    t0 = time.perf_counter()
+    s = Scheduler(configuration=SchedulerConfiguration())
+    s.kernels.force_breaker_open("wave.wave_run")
+    s.kernels.force_breaker_open("gang.gang_run")
+    s.kernels.force_breaker_open("chain.chain_dispatch")
+    got: Dict[str, Optional[str]] = {}
+    s.binding_sink = lambda pod, node: got.__setitem__(pod.name, node)
+    s.mirror.e_cap_hint = len(pods) + s.config.batch_size + 128
+    for n in nodes:
+        s.on_node_add(n)
+    for p in copy.deepcopy(pods):
+        s.on_pod_add(p)
+    outs = s.schedule_pending()
+    for o in outs:
+        got.setdefault(o.pod.name, o.node)
+    breaker_fallbacks = int(
+        s.prom.wave_fallback.value(reason="breaker")
+    )
+    device_batches = (
+        s.metrics["wave_batches"] + s.metrics["scan_batches"]
+    )
+
+    state = OracleState.build(nodes)
+    want: Dict[str, Optional[str]] = {}
+    for pod in copy.deepcopy(pods):
+        r = schedule_one(pod, state)
+        want[pod.name] = r.node
+        if r.node is not None:
+            pod.node_name = r.node
+            state.place(pod)
+    diffs = _diff(got, want)
+    n_diffs = len(diffs)
+    if breaker_fallbacks == 0 or device_batches > 0:
+        n_diffs += 1
+        diffs = [
+            ("__breaker_engaged__", breaker_fallbacks, device_batches)
+        ] + diffs
+    return {
+        "nodes": n_nodes,
+        "pods": n_pods,
+        "breaker_fallbacks": breaker_fallbacks,
+        "device_batches": device_batches,
+        "bound_degraded": sum(1 for v in got.values() if v),
+        "bound_oracle": sum(1 for v in want.values() if v),
+        "diffs": n_diffs,
+        "first_diffs": diffs[:5],
+        "wall_s": round(time.perf_counter() - t0, 1),
+    }
+
+
 def run_checks(ns_nodes=10000, ns_pods=50000) -> dict:
     checks = {
         "cross_batch_devfast_vs_hostgreedy": check_cross_batch(
@@ -1001,6 +1068,7 @@ def run_checks(ns_nodes=10000, ns_pods=50000) -> dict:
         "dra_allocation_vs_serial_oracle": check_dra_vs_oracle(),
         "plan_vs_serial_oracle": check_plan_vs_oracle(),
         "multichip_vs_singlechip": check_multichip_vs_singlechip(),
+        "breaker_open_vs_serial_oracle": check_breaker_open_vs_oracle(),
     }
     return {
         "checks": checks,

@@ -1,0 +1,26 @@
+"""Seeded `breaker`-rule violations: jit roots without a breaker
+fallback registration, a malformed roster story, and a stale entry —
+the fallback roster is a burn-down, not a parking lot."""
+
+import jax
+import jax.numpy as jnp
+
+# a malformed story (no fallback(<engine>): / no_fallback: lead) and a
+# stale entry naming a vanished root are findings; `orphan_root` below
+# has no entry at all
+_KTPU_BREAKER_FALLBACKS = {
+    "breaker_bad.sloppy_root": "we should think about this",  # VIOLATION
+    "breaker_bad.vanished_root": "fallback(serial): long gone",  # VIOLATION
+}
+
+
+# ktpu: axes(x=i64[P])
+@jax.jit
+def orphan_root(x):  # VIOLATION
+    return x + 1
+
+
+# ktpu: axes(x=i64[P])
+@jax.jit
+def sloppy_root(x):
+    return x * 2

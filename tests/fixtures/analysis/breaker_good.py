@@ -1,0 +1,26 @@
+"""Negative twin of breaker_bad.py: every jit root carries a breaker
+fallback registration — a parity-certified fallback engine or an
+explicit no_fallback waiver."""
+
+import jax
+import jax.numpy as jnp
+
+_KTPU_BREAKER_FALLBACKS = {
+    "breaker_good.covered_root": "fallback(serial-oracle): the host "
+    "replay engine answers the batch bit-identically when the breaker "
+    "is open",
+    "breaker_good.waived_root": "no_fallback: diagnostic-only probe — a "
+    "failure surfaces in the debug response, no placement depends on it",
+}
+
+
+# ktpu: axes(x=i64[P])
+@jax.jit
+def covered_root(x):
+    return x + 1
+
+
+# ktpu: axes(x=i64[P])
+@jax.jit
+def waived_root(x):
+    return x * 2

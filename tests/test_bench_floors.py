@@ -159,6 +159,35 @@ def test_no_multichip_floors_from_virtual_device_runs():
     )
 
 
+def test_no_devicefault_floors_from_cpu_only_runs():
+    """ISSUE 15 ratchet guard: config15_devicefault_* numbers on this box
+    come from a CPU-only backend (no accelerator behind the dispatch
+    stream the faults land on) and are marked
+    config15_devicefault_cpu_only in the bench JSON.  They are
+    recovery/engagement evidence, NOT throughput facts — refuse a
+    ratcheted config15 floor/ceiling whenever the latest recorded bench
+    is CPU-only."""
+    bench = _latest_bench()
+    if bench is None:
+        pytest.skip("no BENCH_r*.json recorded yet")
+    results = _bench_configs(bench)
+    if not results.get("config15_devicefault_cpu_only"):
+        pytest.skip("latest bench has no CPU-only device-fault line")
+    floors_doc = _load(os.path.join(ROOT, "BENCH_FLOORS.json"))
+    offending = [
+        k
+        for store in ("floors", "ceilings")
+        for k in floors_doc.get(store, {})
+        if k.startswith("config15_devicefault")
+    ]
+    assert offending == [], (
+        "config15_devicefault floors/ceilings ratcheted from a CPU-only "
+        f"bench run: {offending} (BENCH_FLOORS _comment_environment "
+        "discipline — calibrate degraded-mode throughput on a real "
+        "accelerator box)"
+    )
+
+
 def test_new_keys_without_floors_are_tolerated():
     """A bench result key with no recorded floor (or a non-scalar value)
     must never fail the gate — new config lines land a round before their

@@ -1,0 +1,519 @@
+"""Device-fault tier (ISSUE 15): per-kernel circuit breakers, chaos
+injection at the dispatch boundary, and epoch-guarded resident-state
+recovery.
+
+The contract under test everywhere: a device fault may move WHERE the
+work runs (retry, fallback engine, resync) but never WHAT is decided —
+degraded placements are bit-identical to a clean run, and no torn usage
+row ever reaches the committer/cache (the mirror-consistency probe must
+stay clean after every recovery).
+"""
+
+import copy
+
+import pytest
+
+from kubernetes_tpu.api.types import (
+    Container,
+    LabelSelector,
+    Node,
+    Pod,
+    TopologySpreadConstraint,
+)
+from kubernetes_tpu.api.resource import Resource
+from kubernetes_tpu.analysis import sanitizer
+from kubernetes_tpu.chaos.device import DeviceFaultError
+from kubernetes_tpu.framework.config import SchedulerConfiguration
+from kubernetes_tpu.observability import kernels as kernels_mod
+from kubernetes_tpu.scheduler import Scheduler
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+
+def _nodes(n):
+    return [
+        Node(
+            name=f"n{i}",
+            labels={
+                "topology.kubernetes.io/zone": f"z{i % 3}",
+                "kubernetes.io/hostname": f"n{i}",
+            },
+            capacity=Resource.from_map(
+                {"cpu": "16", "memory": "64Gi", "pods": 110}
+            ),
+        )
+        for i in range(n)
+    ]
+
+
+def _plain_pods(n, prefix="p"):
+    return [
+        Pod(
+            name=f"{prefix}{i}",
+            uid=f"default/{prefix}{i}",
+            labels={"app": f"a{i % 3}"},
+            containers=[
+                Container(
+                    name="c",
+                    requests={"cpu": f"{100 + (i % 3) * 50}m", "memory": "128Mi"},
+                )
+            ],
+        )
+        for i in range(n)
+    ]
+
+
+def _spread_pods(n, prefix="s"):
+    return [
+        Pod(
+            name=f"{prefix}{i}",
+            uid=f"default/{prefix}{i}",
+            labels={"app": f"a{i % 2}"},
+            topology_spread_constraints=(
+                TopologySpreadConstraint(
+                    max_skew=1,
+                    topology_key="topology.kubernetes.io/zone",
+                    when_unsatisfiable="ScheduleAnyway",
+                    label_selector=LabelSelector(
+                        match_labels={"app": f"a{i % 2}"}
+                    ),
+                ),
+            ),
+            containers=[
+                Container(name="c", requests={"cpu": "200m", "memory": "128Mi"})
+            ],
+        )
+        for i in range(n)
+    ]
+
+
+def _drain(nodes, pods, sched=None, **cfg_kw):
+    if sched is None:
+        cfg = SchedulerConfiguration()
+        for k, v in cfg_kw.items():
+            setattr(cfg, k, v)
+        sched = Scheduler(configuration=cfg)
+    got = {}
+    sched.binding_sink = lambda pod, node: got.__setitem__(pod.name, node)
+    for n in nodes:
+        sched.on_node_add(n)
+    for p in pods:
+        sched.on_pod_add(p)
+    outs = sched.schedule_pending()
+    for o in outs:
+        got.setdefault(o.pod.name, o.node)
+    return got, sched
+
+
+class TargetedInjector:
+    """Duck-typed chaos injector aiming one fault kind at one kernel for
+    a bounded number of draws — the unit-test complement of the seeded
+    FaultPlan-driven DeviceFaultInjector."""
+
+    def __init__(
+        self,
+        kernel=None,
+        kind=None,
+        times=1,
+        hang_s=0.0,
+        poison_times=0,
+        sync_times=0,
+    ):
+        self.kernel = kernel
+        self.kind = kind
+        self.times = times
+        self.hang_s = hang_s
+        self.poison_times = poison_times
+        self.sync_times = sync_times
+        self.fired = []
+
+    def dispatch_fault(self, kernel):
+        if (
+            self.kind in ("dispatch_error", "dispatch_hang", "mesh_device_loss")
+            and self.times > 0
+            and (self.kernel is None or kernel == self.kernel)
+        ):
+            self.times -= 1
+            self.fired.append((self.kind, kernel))
+            return self.kind
+        return None
+
+    def raise_for(self, kind, kernel):
+        raise DeviceFaultError(kind, kernel, f"injected {kind} for {kernel}")
+
+    def poison(self, kernel, fetched):
+        if self.poison_times > 0 and (
+            self.kernel is None or kernel == self.kernel
+        ):
+            self.poison_times -= 1
+            self.fired.append(("poisoned_output", kernel))
+            import jax
+            import numpy as np
+
+            def corrupt(leaf):
+                if not isinstance(leaf, np.ndarray) or leaf.size == 0:
+                    return leaf
+                out = np.array(leaf)
+                if np.issubdtype(out.dtype, np.signedinteger):
+                    out.flat[0] = np.asarray(-(2**31), out.dtype)
+                elif np.issubdtype(out.dtype, np.floating):
+                    out.flat[0] = np.nan
+                return out
+
+            return jax.tree_util.tree_map(corrupt, fetched), True
+        return fetched, False
+
+    def sync_fault(self):
+        if self.sync_times > 0:
+            self.sync_times -= 1
+            self.fired.append(("hbm_oom", "sync"))
+            return "hbm_oom"
+        return None
+
+
+@pytest.fixture()
+def injector_slot():
+    """Install/uninstall discipline for the process-global chaos hook."""
+    installed = []
+
+    def install(inj):
+        kernels_mod.set_fault_injector(inj)
+        installed.append(inj)
+        return inj
+
+    yield install
+    kernels_mod.set_fault_injector(None)
+
+
+def _no_torn_rows(sched):
+    """The no-torn-usage-rows oracle: every mirror row the scheduler
+    claims current must match a fresh recomputation from the cache."""
+    with sched._mu:
+        sanitizer.check_mirror_consistency(sched.cache, sched.mirror)
+
+
+class _FakeRoot:
+    """Stands in for a PjitFunction in ledger-level tests."""
+
+    def __init__(self, clock, dt=0.0):
+        self.clock = clock
+        self.dt = dt
+
+    def _cache_size(self):
+        return 1
+
+    def __call__(self, *a, **k):
+        self.clock.t += self.dt
+        return 0
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+# ---------------------------------------------------------------------------
+# breaker state machine
+# ---------------------------------------------------------------------------
+
+
+def test_breaker_full_cycle_closed_open_half_open_closed():
+    """The acceptance state machine, deterministically: consecutive
+    failures trip open, count-based denials cool down to half-open, a
+    probe success closes; a probe failure re-trips."""
+    led = kernels_mod.DispatchLedger()
+    k = "t.k"
+    assert led.breaker_state(k) == kernels_mod.BREAKER_CLOSED
+    for _ in range(led.breaker_trip_threshold - 1):
+        led.record_breaker_failure(k, "dispatch_error")
+        assert led.breaker_state(k) == kernels_mod.BREAKER_CLOSED
+    led.record_breaker_failure(k, "dispatch_error")
+    assert led.breaker_state(k) == kernels_mod.BREAKER_OPEN
+
+    # denials are the cooldown: exactly half_open_after-1 denials, then
+    # the crossing request is admitted as the probe
+    verdicts = [led.breaker_allows(k) for _ in range(led.breaker_half_open_after)]
+    assert verdicts == [False] * (led.breaker_half_open_after - 1) + [True]
+    assert led.breaker_state(k) == kernels_mod.BREAKER_HALF_OPEN
+
+    # probe failure → straight back to open
+    led.record_breaker_failure(k, "dispatch_hang")
+    assert led.breaker_state(k) == kernels_mod.BREAKER_OPEN
+    rows = led.breaker_rows()[k]
+    assert rows["trips"] == 2 and rows["last_kind"] == "dispatch_hang"
+
+    # cool down again; this time the probe succeeds → closed, streak reset
+    for _ in range(led.breaker_half_open_after):
+        led.breaker_allows(k)
+    assert led.breaker_state(k) == kernels_mod.BREAKER_HALF_OPEN
+    clock = _Clock()
+    led._clock = clock
+    led.dispatch(k, _FakeRoot(clock), (), {})
+    assert led.breaker_state(k) == kernels_mod.BREAKER_CLOSED
+    assert led.breaker_rows()[k]["failures"] == 0
+
+
+def test_injected_error_retries_in_place_then_abandons(injector_slot):
+    """A pre-call injected error retries with the args intact; when every
+    attempt faults the dispatch is abandoned as DispatchFailed and the
+    breaker books one failure per attempt."""
+    led = kernels_mod.DispatchLedger()
+    clock = _Clock()
+    led._clock = clock
+    fn = _FakeRoot(clock)
+
+    inj = injector_slot(TargetedInjector(kernel="t.r", kind="dispatch_error", times=1))
+    # one fault, retries available → heals in place, result returned
+    assert led.dispatch("t.r", fn, (), {}) == 0
+    assert led.breaker_state("t.r") == kernels_mod.BREAKER_CLOSED
+    assert len(inj.fired) == 1
+
+    injector_slot(TargetedInjector(kernel="t.r", kind="dispatch_error", times=99))
+    with pytest.raises(kernels_mod.DispatchFailed) as ei:
+        led.dispatch("t.r", fn, (), {})
+    assert ei.value.kind == "dispatch_error"
+    assert led.breaker_state("t.r") == kernels_mod.BREAKER_OPEN
+
+
+def test_watchdog_books_injected_and_real_hangs(injector_slot):
+    """An injected hang books a breaker failure by contract; a real
+    dispatch past the watchdog deadline books one by the clock."""
+    led = kernels_mod.DispatchLedger(watchdog_s=0.5)
+    clock = _Clock()
+    led._clock = clock
+
+    injector_slot(TargetedInjector(kernel="t.h", kind="dispatch_hang", times=1, hang_s=0.0))
+    led.dispatch("t.h", _FakeRoot(clock), (), {})
+    assert led.breaker_rows()["t.h"]["failures"] == 1
+
+    kernels_mod.set_fault_injector(None)
+    slow = _FakeRoot(clock, dt=1.0)  # real 1s dispatch > 0.5s deadline
+    led.dispatch("t.h", slow, (), {})
+    assert led.breaker_rows()["t.h"]["failures"] == 2
+    fast = _FakeRoot(clock, dt=0.01)
+    led.dispatch("t.h", fast, (), {})
+    assert led.breaker_rows()["t.h"]["failures"] == 0  # success resets
+
+
+def test_breaker_roster_covers_every_runtime_jit_root():
+    """Satellite: the analyzer gates the literal; this is the runtime
+    backstop — every discovered jit root must carry a fallback story."""
+    roster = kernels_mod.breaker_fallbacks()
+    for name in sanitizer._discover_jit_roots():
+        assert name in roster, f"jit root {name} missing a breaker fallback"
+        story = roster[name]
+        assert story.startswith(("fallback(", "no_fallback:")), (name, story)
+
+
+def test_breaker_column_in_kernels_snapshot():
+    led = kernels_mod.DispatchLedger()
+    led.record_breaker_failure("wave.wave_run", "dispatch_error")
+    snap = led.snapshot(cost=False)
+    assert "breakers" in snap
+    assert snap["breakers"]["wave.wave_run"]["failures"] == 1
+    row = next(r for r in snap["kernels"] if r["kernel"] == "wave.wave_run")
+    assert row["breaker"] == kernels_mod.BREAKER_CLOSED
+    assert "breaker_trips" in row
+
+
+# ---------------------------------------------------------------------------
+# scheduler-level fallbacks: decisions never change
+# ---------------------------------------------------------------------------
+
+
+def test_mid_round_dispatch_error_epoch_resync_no_torn_rows(injector_slot):
+    """THE acceptance case: a dispatch_error kills resident_run mid-round
+    (every retry too).  The epoch-guarded resync must drop the device
+    lineage, answer the batch on the host committer BIT-IDENTICALLY, and
+    leave zero torn usage rows behind."""
+    nodes = _nodes(8)
+    pods = _plain_pods(48)
+    want, _ = _drain(nodes, copy.deepcopy(pods), fast_device_min=1)
+
+    injector_slot(
+        TargetedInjector(
+            kernel="resident.resident_run", kind="dispatch_error", times=99
+        )
+    )
+    got, sched = _drain(nodes, copy.deepcopy(pods), fast_device_min=1)
+    assert got == want
+    assert sched.prom.resident_resyncs.value(reason="dispatch_failed") >= 1
+    assert (
+        sched.prom.wave_fallback.value(reason="breaker") >= 1
+    ), "fallback not engaged — the resident path never faulted"
+    _no_torn_rows(sched)
+    # the faulting kernel's breaker tripped open (3 attempts = threshold)
+    assert (
+        sched.kernels.breaker_state("resident.resident_run")
+        == kernels_mod.BREAKER_OPEN
+    )
+
+
+def test_torn_device_state_checksum_resync(monkeypatch):
+    """A clobbered donation — simulated by tampering the device usage
+    rows BETWEEN two batches of one drain, exactly where a dispatch that
+    died mid-round leaves them — must be caught by the device-side
+    checksum BEFORE the round's commits reach the committer: resync,
+    recompute on the host, zero torn rows, identical placements."""
+    nodes = _nodes(8)
+    pods = _plain_pods(48)
+    cfg = dict(fast_device_min=1, resident_drain=False)
+    want, _ = _drain(nodes, copy.deepcopy(pods), **cfg)
+
+    # the kernel returns correct choices but TORN state — exactly what a
+    # dispatch that died after its last partial write would leave behind
+    import kubernetes_tpu.ops.fastpath as ops_fp
+
+    real = ops_fp.sig_scan
+    state = {"tampered": False}
+
+    def torn_scan(*a, **k):
+        choices, st = real(*a, **k)
+        used, nz0, nz1, npods = st
+        state["tampered"] = True
+        return choices, (used.at[0, 0].add(7), nz0, nz1, npods)
+
+    monkeypatch.setattr(ops_fp, "sig_scan", torn_scan)
+    got, sched = _drain(nodes, copy.deepcopy(pods), **cfg)
+    assert state["tampered"], "sig_scan device path never engaged"
+    assert got == want
+    assert (
+        sched.prom.resident_resyncs.value(reason="checksum_mismatch") >= 1
+    ), "the torn state was never detected"
+    _no_torn_rows(sched)
+
+
+def test_sentinel_trip_drains_via_fallback():
+    """ISSUE 15 satellite: a sustained latency-regression verdict counts
+    toward the breaker trip threshold — a sentinel-tripped kernel's
+    batches drain via its registered fallback engine, bit-identically."""
+    nodes = _nodes(6)
+    pods = _spread_pods(18)
+    want, _ = _drain(nodes, copy.deepcopy(pods))
+
+    sched = Scheduler(configuration=SchedulerConfiguration())
+    led = sched.kernels
+    clock = _Clock()
+    led._clock = clock
+    led.sentinel_min_samples = 2
+    led.sentinel_sustain = 1
+    led.sentinel_floor_s = 0.0
+    led.breaker_trip_threshold = 1
+    # teach a fast baseline for the wave kernel, then one pathologically
+    # slow sample → sustained breach → sentinel verdict → breaker OPEN
+    fast = _FakeRoot(clock, dt=0.01)
+    for _ in range(2):
+        led.dispatch("wave.wave_run", fast, (), {})
+    led.dispatch("wave.wave_run", _FakeRoot(clock, dt=10.0), (), {})
+    assert led.breaker_state("wave.wave_run") == kernels_mod.BREAKER_OPEN
+    assert led.stats()["regressions"], "sentinel breach not filed"
+    led._clock = __import__("time").perf_counter
+
+    got, sched = _drain(nodes, copy.deepcopy(pods), sched=sched)
+    assert got == want
+    assert sched.metrics["wave_batches"] == 0, "wave ran despite the trip"
+    assert sched.metrics["scan_batches"] >= 1, "scan fallback not engaged"
+    assert sched.prom.wave_fallback.value(reason="breaker") >= 1
+
+
+def test_poisoned_readback_heals_on_refetch(injector_slot):
+    """A poisoned guarded fetch re-fetches the intact device array: same
+    placements, one breaker failure booked, no fallback needed."""
+    nodes = _nodes(6)
+    pods = _spread_pods(18)
+    want, _ = _drain(nodes, copy.deepcopy(pods))
+
+    injector_slot(
+        TargetedInjector(kernel="wave.wave_run", poison_times=1)
+    )
+    got, sched = _drain(nodes, copy.deepcopy(pods))
+    assert got == want
+    assert sched.metrics["wave_batches"] >= 1, "wave path not engaged"
+    assert (
+        sched.prom.kernel_breaker_failures.value(
+            kernel="wave.wave_run", kind="poisoned_output"
+        )
+        >= 1
+    )
+
+
+def test_hbm_oom_rebuilds_snapshot_from_mirror(injector_slot):
+    """A failed resident-snapshot placement invalidates the device cache
+    and rebuilds whole from the host mirror — the drain is unaffected."""
+    nodes = _nodes(6)
+    pods = _spread_pods(18)
+    want, _ = _drain(nodes, copy.deepcopy(pods))
+
+    injector_slot(TargetedInjector(sync_times=1))
+    got, sched = _drain(nodes, copy.deepcopy(pods))
+    assert got == want
+    assert sched.prom.resident_resyncs.value(reason="hbm_oom") >= 1
+
+
+def test_mesh_device_loss_degrades_and_drains(injector_slot):
+    """A mesh device loss re-forms the mesh smaller (or single-chip) and
+    the batch that hit it drains serially — placements unchanged (the
+    mesh only moves flops; multichip_vs_singlechip parity)."""
+    nodes = _nodes(6)
+    pods = _spread_pods(18)
+    want, _ = _drain(nodes, copy.deepcopy(pods))
+
+    injector_slot(
+        TargetedInjector(
+            kernel="wave.wave_run", kind="mesh_device_loss", times=1
+        )
+    )
+    got, sched = _drain(nodes, copy.deepcopy(pods))
+    assert got == want
+    assert sched.prom.resident_resyncs.value(reason="mesh_degraded") >= 1
+    # under the tier-1 8-virtual-device env the mesh re-forms smaller;
+    # on a true single-device backend it degrades to None either way
+    import jax
+
+    if len(jax.devices()) > 1:
+        assert sched.mesh is None or sched.mesh.devices.size < len(
+            jax.devices()
+        )
+    else:
+        assert sched.mesh is None
+
+
+def test_breaker_open_workloads_falls_back_decision_identical():
+    """gangDispatch-covered pods with the workloads breaker latched open
+    take the kill-switch fallback path — decision-identical for plain
+    pods (the documented degraded semantics)."""
+    nodes = _nodes(6)
+    pods = _spread_pods(12, prefix="wl")
+    want, _ = _drain(nodes, copy.deepcopy(pods))
+
+    sched = Scheduler(configuration=SchedulerConfiguration())
+    sched.kernels.force_breaker_open("coscheduling.workloads_run")
+    got, sched = _drain(nodes, copy.deepcopy(pods), sched=sched)
+    assert got == want
+
+
+def test_every_scenario_has_description_and_all_is_automatic():
+    """ISSUE 15 satellite: --list is self-documenting and --all derives
+    from the catalogue, not a hand-maintained list."""
+    from kubernetes_tpu.chaos import SCENARIOS
+    from kubernetes_tpu.chaos.__main__ import main as chaos_main
+
+    for name, scn in SCENARIOS.items():
+        assert scn.desc, f"scenario {name} has no one-line description"
+    # --list prints one entry per catalogued scenario, descriptions included
+    import contextlib
+    import io
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        assert chaos_main(["--list"]) == 0
+    out = buf.getvalue()
+    for name, scn in SCENARIOS.items():
+        assert name in out
+        assert scn.desc in out

@@ -29,13 +29,14 @@ from kubernetes_tpu.analysis.core import (
     RULE_RETRACE,
     RULE_SHAPE,
     RULE_SHARD,
+    RULE_BREAKER,
 )
 
 FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures", "analysis")
 
 CHECKER_KEYS = (
     "locks", "purity", "jit", "d2h", "donation", "clamp", "retrace",
-    "shape", "dtype", "shard",
+    "shape", "dtype", "shard", "breaker",
 )
 
 
@@ -147,6 +148,7 @@ def test_cli_help_lists_all_rules(capsys):
         ("shape_bad.py", RULE_SHAPE),
         ("dtype_bad.py", RULE_DTYPE),
         ("shard_bad.py", RULE_SHARD),
+        ("breaker_bad.py", RULE_BREAKER),
     ],
 )
 def test_positive_fixture_caught(name, rule):
@@ -171,6 +173,7 @@ def test_positive_fixture_caught(name, rule):
         "shape_good.py",
         "dtype_good.py",
         "shard_good.py",
+        "breaker_good.py",
     ],
 )
 def test_negative_fixture_silent(name):
