@@ -943,6 +943,9 @@ def run_arrival_harness(
             blackbox_capacity=16384,
         )
     )
+    # control-plane pipeline tier rides the same flight-recorder sink:
+    # per-hop lag decomposition for the config16_pipeline_* bench keys
+    cp = sched.install_controlplane()
 
     server = SchedulerServer(sched, poll_interval_s=poll_interval_s)
     server.start()
@@ -1035,6 +1038,8 @@ def run_arrival_harness(
         "max_rate_at_slo": max_rate,
         "slo_p99_ms": slo_p99_s * 1000,
         "breaches": slo.snapshot()["breaches_total"],
+        "pipeline": cp.hop_summary(),
+        "staleness": cp.staleness(),
     }
 
 
@@ -1353,6 +1358,12 @@ def main():
         configs["config9_serving_curve"] = ar["curve"]
         configs["config9_serving_max_rate_at_slo"] = ar["max_rate_at_slo"]
         configs["config9_serving_slo_p99_ms"] = ar["slo_p99_ms"]
+        # config16: per-hop pipeline decomposition from the control-plane
+        # tier riding the same serving run — floor-less like config9
+        configs["config16_pipeline_hops"] = ar["pipeline"]
+        configs["config16_pipeline_staleness_peak_s"] = ar["staleness"][
+            "peak_s"
+        ]
         print(
             "# config9 serving: max sustainable rate at SLO "
             f"(p99 e2e ≤ {ar['slo_p99_ms']:g} ms) = "

@@ -105,6 +105,10 @@ LOCK_MODULES = (
     # device telemetry ledger: the scheduling loop records dispatches,
     # the planner thread records d2h, HTTP handlers read tables/costs
     os.path.join("observability", "kernels.py"),
+    # control-plane pipeline tier: chains are stamped from apiserver
+    # handler threads, reflector threads, informer handlers, and the
+    # flight-recorder sink; scrape-time sync reads from HTTP handlers
+    os.path.join("observability", "controlplane.py"),
     # workloads tier: the GangDirectory registry/bookkeeping is mutated by
     # informer handlers, the workloads dispatch, and bind-failure unwinds
     os.path.join("workloads", "gang.py"),

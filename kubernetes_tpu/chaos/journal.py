@@ -140,6 +140,12 @@ class JournalRecorder:
         tracer = getattr(sched, "tracer", None)
         if tracer is not None:
             tracer.logical_time = journal.now
+        # the control-plane monitor's chain breadcrumbs carry the same
+        # logical stamps, so a replayed journal reconstructs chains
+        # byte-identically (kind, rv, lt)
+        cp = getattr(sched, "controlplane", None)
+        if cp is not None:
+            cp.logical_time = journal.now
         self._originals = (
             sched,
             {
@@ -200,6 +206,9 @@ class JournalRecorder:
         tracer = getattr(sched, "tracer", None)
         if tracer is not None and tracer.logical_time == self.journal.now:
             tracer.logical_time = None
+        cp = getattr(sched, "controlplane", None)
+        if cp is not None and cp.logical_time == self.journal.now:
+            cp.logical_time = None
 
 
 def decisions_of(outcomes) -> List[dict]:
@@ -303,6 +312,17 @@ def replay(source, scheduler_factory=None) -> ReplayResult:
         device_injector = DeviceFaultInjector(plan, hang_s=0.0)
         install(device_injector)
 
+    # control-plane chain replay: when the factory installed a monitor,
+    # drive its logical clock from the entry stream's own ``t`` stamps —
+    # exactly the values Journal.now() returned live (the delivery entry
+    # is appended before its handler runs; drain-time breadcrumbs see the
+    # drain_start entry's t), so reconstructed chains compare byte-for-
+    # byte on (kind, rv, lt) against the recording run's.
+    cp = getattr(sched, "controlplane", None)
+    lt_cursor = [0]
+    if cp is not None and cp.logical_time is None:
+        cp.logical_time = lambda: lt_cursor[0]
+
     result = ReplayResult()
     bound: Dict[str, str] = {}
     sink = chaos_binding_sink(
@@ -334,8 +354,10 @@ def replay(source, scheduler_factory=None) -> ReplayResult:
                     # echoes): invisible to the drain that was running
                     buffered.append(entry)
                 else:
+                    lt_cursor[0] = entry["t"]
                     _apply_delivery(sched, entry)
             elif kind == "drain_start":
+                lt_cursor[0] = entry["t"]
                 in_drain = True
             elif kind == "drain_end":
                 outs = sched.schedule_pending()
@@ -354,10 +376,12 @@ def replay(source, scheduler_factory=None) -> ReplayResult:
                 result.drains += 1
                 in_drain = False
                 for pending in buffered:
+                    lt_cursor[0] = pending["t"]
                     _apply_delivery(sched, pending)
                 buffered.clear()
             # "fault" / "note" entries are informational
         for pending in buffered:
+            lt_cursor[0] = pending["t"]
             _apply_delivery(sched, pending)
     finally:
         if device_injector is not None:

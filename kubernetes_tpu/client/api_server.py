@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Deque, Dict, List, Optional, Tuple
@@ -53,6 +54,17 @@ class _WatchCache:
         self.events: Deque[Tuple[int, bytes]] = deque(maxlen=window)  # (rv, wire line)
         self.rv = 0
         self.cond = threading.Condition()
+        # observability counters (controlplane tier scrapes deltas):
+        # compactions that dropped events, and 410s served — always-on
+        # plain ints under the cond, like rv
+        self.compactions = 0
+        self.gone_total = 0
+        # active watcher registry: watcher id → last rv delivered to that
+        # stream.  Registration/removal under the cond; the per-iteration
+        # position update is a plain dict store (GIL-atomic) so the watch
+        # loop never takes the lock just to report progress.
+        self.watchers: Dict[int, int] = {}
+        self._watcher_seq = 0
 
     def record(self, event_type: str, envelope: dict) -> int:
         with self.cond:
@@ -84,12 +96,14 @@ class _WatchCache:
         """Events with rv' > rv; None ⇒ rv fell out of the window (410)."""
         with self.cond:
             if self._stale(rv):
+                self.gone_total += 1
                 return None  # compacted away → 410 Gone
             out = [e for e in self.events if e[0] > rv]
             if out:
                 return out
             self.cond.wait(timeout)
             if self._stale(rv):
+                self.gone_total += 1
                 return None
             return [e for e in self.events if e[0] > rv]
 
@@ -98,6 +112,8 @@ class _WatchCache:
         compaction shape, on demand — the chaos runner's forced-410 lever).
         Wakes blocked watchers so stale ones see the 410 immediately."""
         with self.cond:
+            if len(self.events) > keep:
+                self.compactions += 1
             while len(self.events) > keep:
                 self.events.popleft()
             self.cond.notify_all()
@@ -107,6 +123,11 @@ class ApiServer:
     def __init__(self, api, host: str = "127.0.0.1", port: int = 0):
         self.api = api
         self._mu = threading.Lock()
+        # optional ControlPlaneMonitor (observability/controlplane.py),
+        # set by monitor.attach_api_server: api-write breadcrumbs +
+        # per-request accounting.  Every producer site gates on one
+        # attribute read, so the unwired server pays a load + branch.
+        self.cp = None
         self.caches: Dict[str, _WatchCache] = {
             "nodes": _WatchCache(),
             "pods": _WatchCache(),
@@ -137,6 +158,23 @@ class ApiServer:
             def log_message(self, fmt, *args):  # noqa: D401 — quiet
                 pass
 
+            # per-request accounting context, set by _begin at the top of
+            # each verb handler and consumed by _json at response time
+            _acct = None
+
+            def _begin(self, verb: str) -> None:
+                cp = server.cp
+                if cp is None or not cp.enabled:
+                    self._acct = None
+                    return
+                parts = [
+                    p for p in urlparse(self.path).path.split("/") if p
+                ]
+                res = parts[2] if len(parts) >= 3 and parts[0] == "api" else (
+                    parts[0] if parts else "other"
+                )
+                self._acct = (cp, verb, res, time.monotonic())
+
             def _json(self, code: int, payload) -> None:
                 body = json.dumps(payload).encode()
                 self.send_response(code)
@@ -144,8 +182,14 @@ class ApiServer:
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+                acct = self._acct
+                if acct is not None:
+                    self._acct = None
+                    cp, verb, res, t0 = acct
+                    cp.note_request(verb, res, code, time.monotonic() - t0)
 
             def do_GET(self):  # noqa: N802
+                self._begin("GET")
                 u = urlparse(self.path)
                 parts = [p for p in u.path.split("/") if p]
                 q = parse_qs(u.query)
@@ -168,7 +212,21 @@ class ApiServer:
                 return self._json(404, {"error": "not found"})
 
             def _watch(self, res: str, rv: int) -> None:
+                self._acct = None  # a stream, not a request latency
                 cache = server.caches[res]
+                # join the watcher registry: fanout lag is the cache head
+                # rv minus this stream's delivered rv, scraped on demand
+                with cache.cond:
+                    cache._watcher_seq += 1
+                    wid = cache._watcher_seq
+                    cache.watchers[wid] = rv
+                try:
+                    self._watch_stream(cache, rv, wid)
+                finally:
+                    with cache.cond:
+                        cache.watchers.pop(wid, None)
+
+            def _watch_stream(self, cache, rv: int, wid: int) -> None:
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Transfer-Encoding", "chunked")
@@ -199,6 +257,7 @@ class ApiServer:
                     # pending event's pre-serialized line — a burst of N
                     # events costs one write+flush instead of N
                     rv = events[-1][0]
+                    cache.watchers[wid] = rv  # plain store — progress report
                     if not chunk_raw(b"".join(e[1] for e in events)):
                         return
                 try:
@@ -207,6 +266,7 @@ class ApiServer:
                     pass
 
             def do_POST(self):  # noqa: N802
+                self._begin("POST")
                 parts = [p for p in urlparse(self.path).path.split("/") if p]
                 length = int(self.headers.get("Content-Length", 0))
                 body = json.loads(self.rfile.read(length) or b"{}")
@@ -282,6 +342,7 @@ class ApiServer:
                 return self._json(404, {"error": "not found"})
 
             def do_PUT(self):  # noqa: N802
+                self._begin("PUT")
                 parts = [p for p in urlparse(self.path).path.split("/") if p]
                 length = int(self.headers.get("Content-Length", 0))
                 body = json.loads(self.rfile.read(length) or b"{}")
@@ -303,6 +364,7 @@ class ApiServer:
                 return self._json(404, {"error": "not found"})
 
             def do_PATCH(self):  # noqa: N802
+                self._begin("PATCH")
                 parts = [p for p in urlparse(self.path).path.split("/") if p]
                 length = int(self.headers.get("Content-Length", 0))
                 body = json.loads(self.rfile.read(length) or b"{}")
@@ -387,6 +449,7 @@ class ApiServer:
                 return self._json(404, {"error": "not found"})
 
             def do_DELETE(self):  # noqa: N802
+                self._begin("DELETE")
                 parts = [p for p in urlparse(self.path).path.split("/") if p]
                 if len(parts) == 4 and parts[2] == "pods":
                     server.api.delete_pod(unquote(parts[3]))
@@ -410,7 +473,12 @@ class ApiServer:
     # ----- store access -----------------------------------------------------
 
     def _record(self, res: str, etype: str, obj) -> None:
-        self.caches[res].record(etype, encode(obj))
+        rv = self.caches[res].record(etype, encode(obj))
+        cp = self.cp
+        if cp is not None and cp.enabled:
+            # the api_write breadcrumb: this event's rv + its watch-cache
+            # entry time — the root of every pod's causal pipeline chain
+            cp.note_api_write(res, rv, obj)
 
     # Creates are IDEMPOTENT for replays of the same SPEC (the client's
     # transport-level POST retry can re-send a create whose response was

@@ -299,6 +299,11 @@ class Reflector:
         self.synced = threading.Event()
         self.relists = 0
         self.watch_timeouts = 0  # idle read expiries re-watched without relist
+        # optional ControlPlaneMonitor (observability/controlplane.py),
+        # set via RemoteClusterSource → monitor.attach_source: stamps the
+        # watch_delivery hop + newest-delivered clock per decoded event.
+        # One attribute read + branch when unwired.
+        self.cp = None
 
     # ----- list + diff (DeltaFIFO Replace) ---------------------------------
 
@@ -361,7 +366,11 @@ class Reflector:
                     if evt.get("type") == "BOOKMARK":
                         continue
                     self.rv = evt["rv"]
-                    self._apply(evt["type"], decode(evt["object"]))
+                    obj = decode(evt["object"])
+                    cp = self.cp
+                    if cp is not None and cp.enabled:
+                        cp.note_delivery(self.resource, evt["rv"], obj)
+                    self._apply(evt["type"], obj)
                 return  # server closed the stream: caller relists
             except ApiError as e:
                 if e.code != 410:

@@ -937,6 +937,102 @@ class SchedulerMetrics:
                 ("reason",),
             )
         )
+        # --- control-plane pipeline tier (observability/controlplane.py):
+        # the serving/watch path's accounting, synced on scrape ---
+        self.apiserver_request_duration = r.register(
+            Histogram(
+                "scheduler_tpu_apiserver_request_duration_seconds",
+                "API server request latency by verb/resource/status "
+                "(apiserver_request_duration_seconds's shape), accumulated "
+                "off-registry in the handler threads and merged on scrape.",
+                ("verb", "resource", "status"),
+                buckets=wide_duration_buckets(),
+            )
+        )
+        self.watch_window_events = r.register(
+            Gauge(
+                "scheduler_tpu_watch_window_events",
+                "Watch-cache sliding-window occupancy per resource "
+                "(events retained; 410s start when watchers fall behind "
+                "the window), sampled on scrape.",
+                ("resource",),
+            )
+        )
+        self.watch_fanout_lag = r.register(
+            Gauge(
+                "scheduler_tpu_watch_fanout_lag_events",
+                "Max per-watcher fanout lag in events (cache head rv minus "
+                "the slowest active watcher's delivered rv), sampled on "
+                "scrape.",
+                ("resource",),
+            )
+        )
+        self.watch_compactions = r.register(
+            Counter(
+                "scheduler_tpu_watch_compactions_total",
+                "Watch-cache compactions that dropped retained events "
+                "(the etcd-compaction shape; the chaos runner's forced-410 "
+                "lever), refreshed on scrape.",
+                ("resource",),
+            )
+        )
+        self.watch_relists = r.register(
+            Counter(
+                "scheduler_tpu_watch_relists_total",
+                "410 Gone responses served by the watch cache (each one "
+                "forces a client relist — reflector.go:340), refreshed on "
+                "scrape.",
+                ("resource",),
+            )
+        )
+        self.informer_delivery_lag = r.register(
+            Histogram(
+                "scheduler_tpu_informer_delivery_lag_seconds",
+                "API-write to reflector-delivery lag per resource (the "
+                "watch cache's rv stamp joined against the client's decode "
+                "time — in-process clocks).",
+                ("resource",),
+                buckets=wide_duration_buckets(),
+            )
+        )
+        self.pipeline_hop_duration = r.register(
+            Histogram(
+                "scheduler_tpu_pipeline_hop_seconds",
+                "Per-hop duration of the end-to-end pod pipeline "
+                "(api_write → watch_delivery → informer_handler → enqueue "
+                "→ pop → assumed → bind_start → bound), joined per pod "
+                "from causal-chain breadcrumbs when the chain closes.",
+                ("hop",),
+                buckets=wide_duration_buckets(),
+            )
+        )
+        self.snapshot_staleness = r.register(
+            Gauge(
+                "scheduler_tpu_snapshot_staleness_seconds",
+                "Newest-delivered minus newest-applied informer event at "
+                "the last batch dispatch — how stale the scheduling "
+                "snapshot ran; sustained breaches file a "
+                "snapshot_staleness black-box dump.",
+            )
+        )
+        self.queue_depth = r.register(
+            Gauge(
+                "scheduler_tpu_queue_depth",
+                "Scheduling-queue depth per sub-queue (active / backoff / "
+                "unschedulable / gated), sampled on scrape under the "
+                "scheduler lock.",
+                ("queue",),
+            )
+        )
+        self.queue_oldest_age = r.register(
+            Gauge(
+                "scheduler_tpu_queue_oldest_age_seconds",
+                "Age of the oldest pod per sub-queue (monotonic clock "
+                "since first enqueue), sampled on scrape under the "
+                "scheduler lock.",
+                ("queue",),
+            )
+        )
         self.recorder = MetricAsyncRecorder()
 
     def expose(self) -> str:

@@ -22,6 +22,12 @@ endpoints, rebuilt for the batched TPU hot loop (see OBSERVABILITY.md):
     per-kernel dispatch/compile/d2h accounting over every registered
     jit root, lazy XLA cost estimates, and the execute-time regression
     sentinel wired into the SLO tier's black-box dump.
+  * ``ControlPlaneMonitor`` — the control-plane pipeline tier
+    (controlplane.py): per-pod causal chains across the watch path
+    (api_write → watch_delivery → informer_handler → enqueue → pop →
+    assumed → bind_start → bound), apiserver per-request accounting,
+    and the snapshot-staleness sentinel filing through the SLO tier's
+    black-box machinery.
 
 Served over HTTP by ``server.SchedulerServer`` (the full catalogue is
 the JSON index at ``/debug/``):
@@ -31,8 +37,13 @@ the JSON index at ``/debug/``):
     /debug/explain?pod=<uid|name>
     /debug/slo?action=status|trace          (default: status)
     /debug/kernels?cost=0|1                 (the per-kernel table)
+    /debug/pipeline?pod=<uid|name>          (default: hop summary)
 """
 
+from kubernetes_tpu.observability.controlplane import (
+    ControlPlaneConfig,
+    ControlPlaneMonitor,
+)
 from kubernetes_tpu.observability.flightrecorder import FlightRecorder
 from kubernetes_tpu.observability.kernels import DispatchLedger
 from kubernetes_tpu.observability.tracer import Tracer
@@ -54,6 +65,8 @@ __all__ = [
     "Tracer",
     "FlightRecorder",
     "DispatchLedger",
+    "ControlPlaneConfig",
+    "ControlPlaneMonitor",
     "SLOConfig",
     "SLOEvaluator",
     "SLOObjective",

@@ -52,6 +52,7 @@ _KTPU_GUARDED = {
         "readonly": [
             "pending_pods",
             "stats",
+            "depth_age_stats",
             "_find",
             "_entry_live",
             "_is_worth_requeuing",
@@ -573,6 +574,35 @@ class SchedulingQueue:
         """Live counts per sub-queue (feeds the pending_pods gauge)."""
         p = self.pending_pods()
         return {name: len(pods) for name, pods in p.items()}
+
+    def depth_age_stats(self) -> Dict[str, Tuple[int, float]]:
+        """Per-sub-queue (depth, oldest-pod age in seconds) — the
+        queue_depth / queue_oldest_age gauges' scrape feed.  Age derives
+        from the REAL monotonic first-enqueue stamp (never the injectable
+        ordering clock), so a manual-clock test can't skew it."""
+        now = self.mono_clock()
+        live: Dict[str, List[QueuedPodInfo]] = {
+            "active": [
+                qp
+                for _, eid, qp in self._active
+                if self._entry_live(qp, eid, "active")
+            ],
+            "backoff": [
+                qp
+                for _, eid, qp in self._backoff
+                if self._entry_live(qp, eid, "backoff")
+            ],
+            "unschedulable": list(self._unschedulable.values()),
+            "gated": list(self._gated.values()),
+        }
+        out: Dict[str, Tuple[int, float]] = {}
+        for name, qps in live.items():
+            oldest = max(
+                (now - qp.mono_timestamp for qp in qps if qp.mono_timestamp),
+                default=0.0,
+            )
+            out[name] = (len(qps), max(oldest, 0.0))
+        return out
 
     def pending_pods(self) -> Dict[str, List[Pod]]:
         """PendingPods introspection (:1146)."""

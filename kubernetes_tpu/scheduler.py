@@ -503,6 +503,10 @@ class Scheduler:
         # steady-state SLO tier (observability/slo.py) — None until
         # install_slo wires it; /debug/slo serves {"enabled": false} then
         self.slo = None
+        # control-plane pipeline tier (observability/controlplane.py) —
+        # None until install_controlplane; every producer site below is
+        # one attribute read + None check when off
+        self.controlplane = None
         # device telemetry ledger (observability/kernels.py): per-kernel
         # dispatch/compile/d2h accounting over every registered jit root,
         # plus the execute-time regression sentinel (breaches reuse the
@@ -565,6 +569,9 @@ class Scheduler:
 
     def on_node_add(self, node: Node) -> None:
         with self._mu:
+            cp = self.controlplane
+            if cp is not None and cp.enabled:
+                cp.note_applied()
             self._invalidate_view()
             self._external_mutations += 1
             self.cache.add_node(node)
@@ -574,6 +581,9 @@ class Scheduler:
 
     def on_node_update(self, old: Node, new: Node) -> None:
       with self._mu:
+        cp = self.controlplane
+        if cp is not None and cp.enabled:
+            cp.note_applied()
         import copy as _copy
 
         probe = _copy.copy(old)
@@ -611,6 +621,9 @@ class Scheduler:
 
     def on_node_delete(self, node: Node) -> None:
       with self._mu:
+        cp = self.controlplane
+        if cp is not None and cp.enabled:
+            cp.note_applied()
         self._invalidate_view()
         self._external_mutations += 1
         self.cache.remove_node(node.name)
@@ -638,6 +651,13 @@ class Scheduler:
 
     def on_pod_add(self, pod: Pod) -> None:
       with self._mu:
+        cp = self.controlplane
+        if cp is not None and cp.enabled:
+            cp.note_applied()
+            if not pod.node_name:
+                # the informer_handler hop: stamped ahead of queue.add so
+                # the chain orders informer_handler < enqueue
+                cp.note_pod_handled(pod.uid)
         if pod.node_name:
             self.gangs.note_placed(pod)
             # Confirmation of OUR assumed pod on the same node changes no
@@ -678,6 +698,9 @@ class Scheduler:
 
     def on_pod_update(self, old: Pod, new: Pod) -> None:
       with self._mu:
+        cp = self.controlplane
+        if cp is not None and cp.enabled:
+            cp.note_applied()
         if new.node_name:
             self.gangs.note_placed(new)
             ps = self.cache.pod_states.get(new.uid)
@@ -741,6 +764,9 @@ class Scheduler:
 
     def on_pod_delete(self, pod: Pod) -> None:
       with self._mu:
+        cp = self.controlplane
+        if cp is not None and cp.enabled:
+            cp.note_applied()
         self.gangs.note_removed(pod)
         if pod.node_name:
             self._external_mutations += 1
@@ -1081,6 +1107,12 @@ class Scheduler:
         bid = self._batch_seq
         if rec is not None:
             rec["bid"] = bid
+        cp = self.controlplane
+        if cp is not None and cp.enabled:
+            # the staleness sentinel samples at every dispatch: how far
+            # behind the newest DELIVERED informer event the snapshot this
+            # batch scheduled against ran
+            cp.note_dispatch(bid)
         tr = self.tracer
         if tr.enabled:
             tr.complete(
@@ -1359,6 +1391,16 @@ class Scheduler:
         if slo is not None:
             for objective, burn in slo.gauge_rows():
                 self.prom.slo_burn_rate.set(burn, objective=objective)
+        # queue depth + oldest-pod age per sub-queue: the age walk reads
+        # live heap entries, so it samples under the scheduler lock
+        with self._mu:
+            depth_age = self.queue.depth_age_stats()
+        for queue_name, (depth, age) in depth_age.items():
+            self.prom.queue_depth.set(depth, queue=queue_name)
+            self.prom.queue_oldest_age.set(age, queue=queue_name)
+        cp = self.controlplane
+        if cp is not None:
+            cp.sync_registry(self.prom)
         # live device memory where the backend reports it (None on CPU)
         if self.kernels.enabled:
             for row in self.kernels.hbm_rows():
@@ -1388,10 +1430,56 @@ class Scheduler:
         # producer threads at one buffer append — joining runs inline at
         # an amortized threshold, with the worker as the idle-tail backstop
         self.flight.enabled = True
-        self.flight.sink = ev.ingest_async
+        sink = ev.ingest_async
+        cp = self.controlplane
+        if cp is not None:
+            # keep the control-plane monitor upstream of the evaluator —
+            # install order between the two tiers must not matter
+            sink = cp.make_sink(sink)
+        self.flight.sink = sink
         if cfg.blackbox:
             self.tracer.blackbox_start(cfg.blackbox_capacity)
         return ev
+
+    def install_controlplane(self, config=None, api_server=None, source=None):
+        """Install the control-plane pipeline tier
+        (observability/controlplane.py): causal per-pod chains across
+        api_write → watch_delivery → informer_handler → enqueue → pop →
+        assumed → bind_start → bound (served at /debug/pipeline), the
+        snapshot-staleness sentinel sampled at every dispatch (sustained
+        breaches file through the SLO tier's black-box machinery when
+        installed), and — with ``api_server``/``source`` wired — the
+        serving tier's per-request and delivery-lag accounting.  Returns
+        the monitor (also at ``self.controlplane``)."""
+        from kubernetes_tpu.observability.controlplane import (
+            ControlPlaneConfig,
+            ControlPlaneMonitor,
+        )
+
+        self_ref = weakref.ref(self)
+
+        def _slo_of():
+            s = self_ref()
+            return s.slo if s is not None else None
+
+        mon = ControlPlaneMonitor(
+            config or ControlPlaneConfig(),
+            tracer=self.tracer,
+            slo_getter=_slo_of,
+        )
+        # a chaos journal attached before install already stamps the
+        # tracer — inherit its logical clock for chain breadcrumbs
+        mon.logical_time = self.tracer.logical_time
+        self.controlplane = mon
+        # scheduler-side hops ride the existing breadcrumb stream: chain
+        # in front of whatever sink is installed (the SLO evaluator's)
+        self.flight.enabled = True
+        self.flight.sink = mon.make_sink(self.flight.sink)
+        if api_server is not None:
+            mon.attach_api_server(api_server)
+        if source is not None:
+            mon.attach_source(source)
+        return mon
 
     def expose_metrics(self) -> str:
         """Prometheus text exposition (the /metrics handler body)."""

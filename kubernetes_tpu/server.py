@@ -86,6 +86,13 @@ DEBUG_ENDPOINTS = (
         "device telemetry ledger: per-kernel dispatches, p50/p99 execute, "
         "compiles, est. FLOPs, d2h bytes, HBM, sentinel state",
     ),
+    (
+        "/debug/pipeline",
+        "?pod=<uid|name>",
+        "control-plane per-hop lag waterfall for one pod (api_write → "
+        "watch_delivery → informer_handler → enqueue → pop → assumed → "
+        "bind_start → bound); default: hop summary + staleness sentinel",
+    ),
 )
 
 
@@ -477,6 +484,31 @@ class SchedulerServer:
                         return
                     want_cost = q.get("cost", ["1"])[0] not in ("0", "false")
                     self._send_json(led.snapshot(cost=want_cost))
+                elif path == "/debug/pipeline":
+                    # the control-plane pipeline tier (observability/
+                    # controlplane.py): per-pod causal chain + hop
+                    # waterfall; without ?pod=, the aggregate hop summary
+                    # and staleness sentinel state
+                    cp = getattr(sched, "controlplane", None)
+                    if cp is None:
+                        self._send_json({"enabled": False})
+                        return
+                    ref = q.get("pod", [None])[0]
+                    if ref is None:
+                        self._send_json(cp.snapshot())
+                        return
+                    from kubernetes_tpu.observability import find_pod
+
+                    pod = find_pod(sched, ref)
+                    uid = pod.uid if pod is not None else ref
+                    out = cp.pipeline_for(uid)
+                    if out is None:
+                        self._send_json(
+                            {"error": f"no pipeline chain for pod {ref!r}"},
+                            code=404,
+                        )
+                        return
+                    self._send_json(out)
                 elif path == "/debug/slo":
                     # the steady-state SLO tier (observability/slo.py):
                     # live SLI snapshot + per-stage breakdown + last-breach

@@ -1,0 +1,744 @@
+"""Control-plane observability tier: pipeline chains, per-hop lag
+attribution, apiserver/watch-cache accounting, and the snapshot-staleness
+sentinel (observability/controlplane.py).
+
+Covers the ISSUE 19 acceptance surface:
+  * per-pod causal chains close on a REAL drain and the per-hop durations
+    telescope to the enqueue→bound e2e latency (within the 5% bound);
+  * /debug/pipeline serves the waterfall, the aggregate summary, and 404s
+    for unknown pods through the real HTTP server;
+  * scheduling decisions are bit-identical with the full tier enabled vs
+    disabled, and the disabled path stays a None attribute;
+  * the staleness sentinel files through SLOEvaluator.external_breach —
+    freeze → named black-box dump → re-arm — with a real evaluator;
+  * chaos interplay: a journal-recorded run and its replay reconstruct
+    byte-identical chains (kind, rv, lt) — backed by a checked-in fixture;
+  * watch-cache compaction/410 counters and queue depth/age gauges land
+    in /metrics on scrape;
+  * every DEBUG_ENDPOINTS row is exercised by an HTTP round-trip test
+    somewhere in tests/ (catalogue drift guard);
+  * [slow] enabled-tier drain overhead stays within the 2% budget
+    (median-of-ratios).
+"""
+
+import gc
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.api.resource import Resource
+from kubernetes_tpu.api.types import Container, Node, Pod
+from kubernetes_tpu.chaos.journal import (
+    JOURNAL_VERSION,
+    Journal,
+    JournalRecorder,
+    LogicalClock,
+    decisions_of,
+    replay,
+)
+from kubernetes_tpu.observability.controlplane import (
+    SEGMENTS,
+    ControlPlaneConfig,
+    ControlPlaneMonitor,
+)
+from kubernetes_tpu.scheduler import Scheduler
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+FIXTURE = os.path.join(HERE, "fixtures", "journals", "pipeline-chains.jsonl")
+
+
+def _node(name, cpu="4"):
+    return Node(
+        name=name,
+        labels={"kubernetes.io/hostname": name},
+        capacity=Resource.from_map({"cpu": cpu, "memory": "16Gi", "pods": 110}),
+    )
+
+
+def _pod(name, cpu="100m", uid=""):
+    return Pod(
+        name=name,
+        uid=uid,
+        containers=[Container(name="c", requests={"cpu": cpu, "memory": "64Mi"})],
+    )
+
+
+def _wait(predicate, timeout=15.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.02)
+    return predicate()
+
+
+def _get(port, path):
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10
+        ) as r:
+            return r.status, json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+
+
+def _drained_sched(n_nodes=4, n_pods=12, config=None):
+    """Real drain with the tier installed: returns (sched, monitor, pods)
+    once every pod's chain has closed."""
+    sched = Scheduler()
+    bound = {}
+    sched.binding_sink = lambda pod, node: bound.__setitem__(pod.uid, node)
+    mon = sched.install_controlplane(config)
+    for i in range(n_nodes):
+        sched.on_node_add(_node(f"n{i}"))
+    pods = [_pod(f"p{i}") for i in range(n_pods)]
+    for p in pods:
+        sched.on_pod_add(p)
+    sched.schedule_pending()
+    assert _wait(lambda: mon.snapshot()["done_chains"] == n_pods), (
+        f"chains never closed: {mon.snapshot()}"
+    )
+    return sched, mon, pods
+
+
+# ---------------------------------------------------------------------------
+# pipeline chains + the hop-sum property
+# ---------------------------------------------------------------------------
+
+
+def test_pipeline_chain_closes_with_ordered_hops():
+    _sched, mon, pods = _drained_sched()
+    for p in pods:
+        pl = mon.pipeline_for(p.uid)
+        assert pl is not None and pl["complete"]
+        kinds = [c["kind"] for c in pl["chain"]]
+        # in-proc source: no apiserver/watch stamps, handler onward only
+        assert kinds[0] == "informer_handler" and kinds[-1] == "bound"
+        assert kinds.index("enqueue") < kinds.index("pop")
+        # consecutive stamps → named hops; monotonic waterfall
+        assert len(pl["hops"]) == len(kinds) - 1
+        for hop in pl["hops"]:
+            assert hop["hop"] in SEGMENTS.values()
+            assert hop["t1"] >= hop["t0"]
+
+
+def test_hop_sum_matches_e2e_within_5_percent():
+    """The per-hop decomposition must ACCOUNT for the e2e SLI: hops from
+    the enqueue stamp onward telescope to enqueue→bound."""
+    _sched, mon, pods = _drained_sched(n_pods=16)
+    for p in pods:
+        pl = mon.pipeline_for(p.uid)
+        e2e = pl["e2e_s"]
+        assert e2e is not None and e2e > 0
+        enq = next(c["mono"] for c in pl["chain"] if c["kind"] == "enqueue")
+        covered = sum(
+            h["duration_s"] for h in pl["hops"] if h["t0"] >= enq
+        )
+        assert abs(covered - e2e) <= 0.05 * e2e + 1e-9
+
+
+def test_hop_summary_and_registry_sync():
+    sched, mon, pods = _drained_sched()
+    summary = mon.hop_summary()
+    for hop in ("queue_wait", "dispatch", "bind"):
+        assert summary[hop]["count"] >= len(pods)
+        assert summary[hop]["sum_s"] >= 0.0
+        assert summary[hop]["p99_s"] >= summary[hop]["p50_s"] >= 0.0
+    # scrape path: refresh_gauges → sync_registry → /metrics text
+    text = sched.expose_metrics()
+    assert 'scheduler_tpu_pipeline_hop_seconds_count{hop="queue_wait"}' in text
+    assert "scheduler_tpu_snapshot_staleness_seconds" in text
+    # hop counts are cumulative across scrapes, not drained by them
+    # (the bench reads hop_summary after its scrapes)
+    assert mon.hop_summary()["queue_wait"]["count"] >= len(pods)
+    # second scrape syncs only deltas — counts must not double
+    t2 = sched.expose_metrics()
+    line = next(
+        ln
+        for ln in t2.splitlines()
+        if ln.startswith(
+            'scheduler_tpu_pipeline_hop_seconds_count{hop="queue_wait"}'
+        )
+    )
+    assert float(line.rsplit(" ", 1)[1]) == summary["queue_wait"]["count"]
+
+
+def test_queue_depth_and_age_gauges_on_scrape():
+    sched = Scheduler()
+    sched.install_controlplane()
+    for i in range(2):
+        sched.on_node_add(_node(f"n{i}"))
+    # one pod that can never fit → parked unschedulable with an age
+    sched.on_pod_add(_pod("giant", cpu="64"))
+    sched.schedule_pending()
+    time.sleep(0.05)
+    text = sched.expose_metrics()
+    line = next(
+        ln
+        for ln in text.splitlines()
+        if ln.startswith('scheduler_tpu_queue_depth{queue="unschedulable"}')
+    )
+    assert float(line.rsplit(" ", 1)[1]) == 1.0
+    age = next(
+        ln
+        for ln in text.splitlines()
+        if ln.startswith(
+            'scheduler_tpu_queue_oldest_age_seconds{queue="unschedulable"}'
+        )
+    )
+    assert float(age.rsplit(" ", 1)[1]) > 0.0
+    assert 'scheduler_tpu_queue_depth{queue="active"}' in text
+
+
+def test_pipeline_spans_land_on_synthetic_controlplane_track():
+    sched = Scheduler()
+    bound = {}
+    sched.binding_sink = lambda pod, node: bound.__setitem__(pod.uid, node)
+    mon = sched.install_controlplane()
+    sched.tracer.start()
+    for i in range(2):
+        sched.on_node_add(_node(f"n{i}"))
+    sched.on_pod_add(_pod("traced"))
+    sched.schedule_pending()
+    assert _wait(lambda: mon.snapshot()["done_chains"] == 1)
+    sched.tracer.stop()
+    trace = sched.tracer.export()
+    spans = [
+        e for e in trace["traceEvents"] if e.get("cat") == "controlplane"
+    ]
+    assert spans, "no spans on the control-plane track"
+    assert {e["name"] for e in spans} <= set(SEGMENTS.values())
+    assert all(e["args"]["pod"] for e in spans)
+    # all hops share the synthetic track, named for Perfetto
+    tids = {e["tid"] for e in spans}
+    assert len(tids) == 1
+    meta = [
+        e
+        for e in trace["traceEvents"]
+        if e.get("ph") == "M" and e["args"].get("name") == "controlplane"
+    ]
+    assert meta and meta[0]["tid"] in tids
+
+
+# ---------------------------------------------------------------------------
+# decision identity: tier enabled vs disabled (the "observer effect" gate)
+# ---------------------------------------------------------------------------
+
+
+def _decisions(with_tier):
+    sched = Scheduler()
+    sched.binding_sink = lambda pod, node: None
+    if with_tier:
+        from kubernetes_tpu.observability.slo import SLOConfig
+
+        sched.install_slo(SLOConfig(eval_interval_s=0.0))
+        sched.install_controlplane()
+    for i in range(6):
+        sched.on_node_add(_node(f"n{i}"))
+    # mixed batch: schedulable spread + one that can't fit
+    for i in range(24):
+        sched.on_pod_add(_pod(f"d{i}", uid=f"default/d{i}"))
+    sched.on_pod_add(_pod("giant", cpu="64", uid="default/giant"))
+    return decisions_of(sched.schedule_pending())
+
+
+def test_decisions_identical_with_full_tier_enabled():
+    assert _decisions(False) == _decisions(True)
+
+
+def test_disabled_tier_is_absent_by_default():
+    from kubernetes_tpu.client import ApiServer, Reflector
+    from kubernetes_tpu.testing.fake_cluster import FakeCluster
+
+    sched = Scheduler()
+    assert sched.controlplane is None
+    server = ApiServer(FakeCluster())
+    assert server.cp is None  # producer sites gate on this one attribute
+    assert Reflector.__init__ is not None
+    r = Reflector.__new__(Reflector)
+    r.cp = None
+    assert r.cp is None
+
+
+# ---------------------------------------------------------------------------
+# snapshot-staleness sentinel → SLO black-box machinery
+# ---------------------------------------------------------------------------
+
+
+def test_staleness_breach_freezes_and_dumps_blackbox(tmp_path):
+    from kubernetes_tpu.observability.slo import SLOConfig, SLOObjective
+
+    sched = Scheduler()
+    sched.install_slo(
+        SLOConfig(
+            objectives=[SLOObjective("e2e_p99", "e2e", 0.99, 30.0)],
+            dump_dir=str(tmp_path),
+            breach_cooldown_s=0.0,
+            blackbox=True,
+            blackbox_capacity=1024,
+        )
+    )
+    mon = sched.install_controlplane(
+        ControlPlaneConfig(staleness_threshold_s=0.5, staleness_consecutive=3)
+    )
+    # a healthy gap: no breach, gauge tracks the last sample
+    mon._delivered_mono = 10.0
+    mon._applied_mono = 9.9
+    mon.note_dispatch(1)
+    assert mon.staleness()["breaches"] == 0
+    assert abs(mon.staleness()["last_s"] - 0.1) < 1e-9
+    # sustained staleness: breach only on the Nth CONSECUTIVE hit
+    mon._applied_mono = 1.0
+    mon.note_dispatch(2)
+    mon.note_dispatch(3)
+    assert mon.staleness()["breaches"] == 0
+    mon.note_dispatch(4)
+    st = mon.staleness()
+    assert st["breaches"] == 1 and st["peak_s"] >= 9.0
+    dump = tmp_path / "blackbox-0001-snapshot_staleness.json"
+    assert _wait(lambda: dump.exists(), timeout=10)
+    trace = json.loads(dump.read_text())
+    assert isinstance(trace["traceEvents"], list)
+    snap = sched.slo.snapshot()
+    assert snap["breaches_total"] == 1
+    rec = snap["last_breach"]
+    assert rec["objective"] == "snapshot_staleness"
+    assert rec["staleness_s"] >= 9.0 and rec["bid"] == 4
+    # re-armed: the counter reset, so the NEXT sustained run files again
+    mon.note_dispatch(5)
+    mon.note_dispatch(6)
+    mon.note_dispatch(7)
+    assert mon.staleness()["breaches"] == 2
+    assert _wait(
+        lambda: (tmp_path / "blackbox-0002-snapshot_staleness.json").exists(),
+        timeout=10,
+    )
+
+
+def test_staleness_breach_without_slo_tier_only_counts():
+    sched = Scheduler()
+    mon = sched.install_controlplane(
+        ControlPlaneConfig(staleness_threshold_s=0.1, staleness_consecutive=1)
+    )
+    mon._delivered_mono = 5.0
+    mon._applied_mono = 0.0
+    mon.note_dispatch(1)  # no evaluator installed — must not raise
+    assert mon.staleness()["breaches"] == 1
+
+
+# ---------------------------------------------------------------------------
+# the serving tier end-to-end: apiserver + reflector stamps
+# ---------------------------------------------------------------------------
+
+
+def test_full_watch_path_chain_over_http():
+    from kubernetes_tpu.client import ApiClient, ApiServer, RemoteClusterSource
+    from kubernetes_tpu.testing.fake_cluster import FakeCluster
+
+    api = FakeCluster()
+    server = ApiServer(api).start()
+    source = RemoteClusterSource(f"http://127.0.0.1:{server.port}")
+    sched = Scheduler()
+    bound = {}
+    try:
+        source.connect(sched)
+        mon = sched.install_controlplane(api_server=server, source=source)
+        source.start()
+        assert source.wait_for_sync()
+        client = ApiClient(f"http://127.0.0.1:{server.port}")
+        for i in range(3):
+            client.create_node(_node(f"n{i}"))
+        pods = [_pod(f"w{i}", uid=f"default/w{i}") for i in range(4)]
+        for p in pods:
+            client.create_pod(p)
+        assert _wait(lambda: len(sched.queue) >= 4)
+        sched.schedule_pending()
+        assert _wait(lambda: len(api.bindings) == 4)
+        assert _wait(lambda: mon.snapshot()["done_chains"] >= 4)
+        pl = mon.pipeline_for("default/w0")
+        kinds = [c["kind"] for c in pl["chain"]]
+        # the full causal path, rooted at the API write
+        assert kinds[0] == "api_write" and kinds[-1] == "bound"
+        assert "watch_delivery" in kinds and "informer_handler" in kinds
+        hops = {h["hop"] for h in pl["hops"]}
+        assert {"watch_fanout", "informer_deliver", "queue_wait"} <= hops
+        # the api_write stamp carries the event's resourceVersion
+        rv = next(c["rv"] for c in pl["chain"] if c["kind"] == "api_write")
+        assert isinstance(rv, int) and rv >= 1
+        # scrape: per-request accounting + serving-tier gauges land
+        text = sched.expose_metrics()
+        assert "scheduler_tpu_apiserver_request_duration_seconds" in text
+        assert "scheduler_tpu_watch_window_events" in text
+        assert "scheduler_tpu_informer_delivery_lag_seconds" in text
+        assert "scheduler_tpu_watch_fanout_lag_events" in text
+    finally:
+        source.stop()
+        server.stop()
+
+
+def test_watch_cache_compaction_and_relist_counters(tmp_path):
+    from kubernetes_tpu.client import ApiServer
+    from kubernetes_tpu.testing.fake_cluster import FakeCluster
+
+    api = FakeCluster()
+    server = ApiServer(api).start()
+    sched = Scheduler()
+    try:
+        mon = sched.install_controlplane(api_server=server)
+        cache = server.caches["pods"]
+        for i in range(8):
+            api.create_pod(_pod(f"c{i}"))
+        cache.compact(0)  # forced etcd-style compaction (the chaos lever)
+        assert cache.since(1, timeout=0.01) is None  # 410 → relist counted
+        assert cache.compactions == 1 and cache.gone_total >= 1
+        text = sched.expose_metrics()
+        comp = next(
+            ln
+            for ln in text.splitlines()
+            if ln.startswith(
+                'scheduler_tpu_watch_compactions_total{resource="pods"}'
+            )
+        )
+        assert float(comp.rsplit(" ", 1)[1]) == 1.0
+        relist = next(
+            ln
+            for ln in text.splitlines()
+            if ln.startswith(
+                'scheduler_tpu_watch_relists_total{resource="pods"}'
+            )
+        )
+        assert float(relist.rsplit(" ", 1)[1]) >= 1.0
+        # counters are monotonic deltas — a second scrape must not double
+        text2 = sched.expose_metrics()
+        comp2 = next(
+            ln
+            for ln in text2.splitlines()
+            if ln.startswith(
+                'scheduler_tpu_watch_compactions_total{resource="pods"}'
+            )
+        )
+        assert comp2 == comp
+        assert mon.snapshot()["enabled"]
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# /debug/pipeline over the real HTTP server
+# ---------------------------------------------------------------------------
+
+
+def test_debug_pipeline_http_round_trip():
+    from kubernetes_tpu.server import SchedulerServer
+    from kubernetes_tpu.testing.fake_cluster import FakeCluster
+
+    api = FakeCluster()
+    sched = Scheduler()
+    api.connect(sched)
+    mon = sched.install_controlplane()
+    for i in range(3):
+        api.create_node(_node(f"n{i}"))
+    server = SchedulerServer(sched, ground_truth=api.ground_truth)
+    server.start()
+    try:
+        port = server.port
+        api.create_pod(_pod("piped"))
+        assert _wait(lambda: mon.snapshot()["done_chains"] >= 1)
+        # default: aggregate summary + sentinel state
+        code, snap = _get(port, "/debug/pipeline")
+        assert code == 200 and snap["enabled"]
+        assert snap["done_chains"] >= 1 and "queue_wait" in snap["hops"]
+        assert "staleness" in snap and "threshold_s" in snap["staleness"]
+        # per-pod waterfall, resolved BY NAME like the other endpoints
+        code, pl = _get(port, "/debug/pipeline?pod=piped")
+        assert code == 200 and pl["complete"]
+        assert [c["kind"] for c in pl["chain"]][-1] == "bound"
+        assert pl["hops"] and all("duration_s" in h for h in pl["hops"])
+        # unknown pod → 404 with a usable error body
+        code, err = _get(port, "/debug/pipeline?pod=nope")
+        assert code == 404 and "no pipeline chain" in err["error"]
+        # catalogued in the index
+        code, index = _get(port, "/debug/")
+        assert code == 200
+        assert "/debug/pipeline" in [e["path"] for e in index["endpoints"]]
+    finally:
+        server.stop()
+
+
+def test_debug_pipeline_without_tier_reports_disabled():
+    from kubernetes_tpu.server import SchedulerServer
+    from kubernetes_tpu.testing.fake_cluster import FakeCluster
+
+    api = FakeCluster()
+    sched = Scheduler()
+    api.connect(sched)
+    server = SchedulerServer(sched, ground_truth=api.ground_truth)
+    server.start()
+    try:
+        code, body = _get(server.port, "/debug/pipeline")
+        assert code == 200 and body == {"enabled": False}
+    finally:
+        server.stop()
+
+
+def test_every_debug_endpoint_has_http_round_trip_coverage():
+    """Catalogue drift guard: a DEBUG_ENDPOINTS row nobody exercises over
+    HTTP is documentation rot — every path must appear, quoted, in a test
+    file that actually opens HTTP connections."""
+    from kubernetes_tpu.server import DEBUG_ENDPOINTS
+
+    sources = {}
+    for fn in sorted(os.listdir(HERE)):
+        if fn.endswith(".py"):
+            with open(os.path.join(HERE, fn), encoding="utf-8") as f:
+                sources[fn] = f.read()
+    for path, _params, _desc in DEBUG_ENDPOINTS:
+        hits = [
+            fn
+            for fn, src in sources.items()
+            if (f'"{path}"' in src or f'"{path}?' in src)
+            and "urllib" in src
+        ]
+        assert hits, (
+            f"{path} is catalogued in DEBUG_ENDPOINTS but no HTTP "
+            f"round-trip test under tests/ requests it"
+        )
+
+
+# ---------------------------------------------------------------------------
+# chaos interplay: journal record/replay chain identity
+# ---------------------------------------------------------------------------
+
+
+def _record_pipeline_scenario(path=None):
+    """Deterministic fault-free recording: 4 nodes, 8 pods, one drain.
+    Explicit uids keep the journal independent of the process-global uid
+    counter (the fixture README discipline).  Returns (journal, live
+    chain signatures)."""
+    journal = Journal(path)
+    journal.append(
+        "header",
+        version=JOURNAL_VERSION,
+        scenario="pipeline-chains",
+        seed=7,
+        rates={},
+        clock0=1000.0,
+        sink_many=False,
+    )
+    sched = Scheduler(clock=LogicalClock(1000.0))
+    mon = sched.install_controlplane()
+    recorder = JournalRecorder(journal)
+    recorder.attach(sched)
+    bound = {}
+    sched.binding_sink = lambda pod, node: bound.__setitem__(pod.uid, node)
+    for i in range(4):
+        sched.on_node_add(_node(f"pl-n{i}"))
+    pods = [_pod(f"pl-{i}", uid=f"default/pl-{i}") for i in range(8)]
+    for p in pods:
+        sched.on_pod_add(p)
+    journal.append("drain_start", n=0)
+    outs = sched.schedule_pending()
+    # drain_end is appended only after every chain CLOSED: the bound
+    # breadcrumbs must read the drain_start entry's logical time, exactly
+    # what the replayer's cursor reproduces
+    assert _wait(lambda: mon.snapshot()["done_chains"] == len(pods))
+    journal.append("drain_end", n=0, decisions=decisions_of(outs))
+    recorder.detach()
+    sigs = {p.uid: mon.chain_signature(p.uid) for p in pods}
+    return journal, sigs
+
+
+def _replay_with_monitor(source):
+    holder = {}
+
+    def factory(clock):
+        s = Scheduler(clock=clock)
+        s.install_controlplane()
+        holder["sched"] = s
+        return s
+
+    rr = replay(source, scheduler_factory=factory)
+    return rr, holder["sched"]
+
+
+def test_recorded_and_replayed_chains_are_byte_identical(tmp_path):
+    path = str(tmp_path / "pipeline-chains.jsonl")
+    journal, live_sigs = _record_pipeline_scenario(path)
+    journal.dump()
+    rr, sched2 = _replay_with_monitor(path)
+    assert rr.ok, rr.mismatches[:2]
+    mon2 = sched2.controlplane
+    assert _wait(lambda: mon2.snapshot()["done_chains"] == len(live_sigs))
+    replay_sigs = {uid: mon2.chain_signature(uid) for uid in live_sigs}
+    # byte-for-byte: kind, rv, AND the journal logical-time stamps
+    assert json.dumps(replay_sigs, sort_keys=True) == json.dumps(
+        live_sigs, sort_keys=True
+    )
+    # every live chain actually carried logical stamps (not all-None)
+    assert all(
+        any(ent[2] is not None and ent[2] > 0 for ent in sig)
+        for sig in live_sigs.values()
+    )
+
+
+def test_pipeline_fixture_is_current_and_replays():
+    """The checked-in journal is a regression corpus: re-recording the
+    scenario must reproduce it byte-for-byte (else re-record per the
+    fixtures README), and replaying it must rebuild the same chains."""
+    journal, live_sigs = _record_pipeline_scenario()
+    with open(FIXTURE, encoding="utf-8") as f:
+        assert journal.serialize() == f.read()
+    rr, sched2 = _replay_with_monitor(FIXTURE)
+    assert rr.ok, rr.mismatches[:2]
+    mon2 = sched2.controlplane
+    assert _wait(lambda: mon2.snapshot()["done_chains"] == len(live_sigs))
+    for uid, sig in live_sigs.items():
+        assert mon2.chain_signature(uid) == sig
+
+
+# ---------------------------------------------------------------------------
+# overhead budget (slow tier)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_enabled_tier_overhead_within_budget():
+    """ISSUE 19 acceptance: the full tier costs ≤2% on a 25k-pod drain.
+
+    Two gates, because a shared single-core box cannot resolve 2% of
+    wall clock (bare-vs-bare drains here spread ±10% run to run):
+
+    1. DETERMINISTIC budget certification — always binding.  The tier's
+       only hot-path work is the flight-recorder sink closure (chain
+       stitching is deferred to the next read).  Count the sink
+       invocations and events a real tiered drain makes inside the
+       timed window, microbench the per-invocation and per-event cost
+       on the installed sink (min over tight-loop reps — the one timing
+       a noisy box can certify), and require the projected sink cost
+       ≤ 2% of the fastest measured drain.  Also assert the drain never
+       tripped the inline-drain backlog bound, i.e. the hot path really
+       did defer, and that the deferred chains still stitch on read.
+
+    2. A/B median-of-ratios (the ISSUE statistic) on process CPU time
+       with a clean-heap protocol (gc.collect between drains, collector
+       disabled inside the window), gated at 1.02 plus the measured
+       bare-vs-bare spread — a quiet box enforces ~2%, a noisy one
+       cannot flake on scheduler-independent jitter; gate 1 still binds.
+    """
+    n_nodes = int(os.environ.get("CP_OVERHEAD_NODES", "200"))
+    n_pods = int(os.environ.get("CP_OVERHEAD_PODS", "25000"))
+    counted = {"calls": 0, "events": 0}
+
+    def drain_cpu(with_tier):
+        sched = Scheduler()
+        bound = {}
+
+        def sink_many(pairs):
+            for pod, _node_name in pairs:
+                bound[pod.uid] = True
+            return [None] * len(pairs)
+
+        sched.binding_sink = lambda pod, node: bound.__setitem__(pod.uid, True)
+        sched.binding_sink_many = sink_many
+        sched.mirror.e_cap_hint = n_pods + sched.config.batch_size + 128
+        if with_tier:
+            sched.install_controlplane()
+            inner = sched.flight.sink
+
+            def counting_sink(mono, events):
+                counted["calls"] += 1
+                counted["events"] += len(events)
+                inner(mono, events)
+
+            sched.flight.sink = counting_sink
+        per_node = (n_pods + 256) // n_nodes + 16  # pod slots must cover the load
+        for i in range(n_nodes):
+            sched.on_node_add(
+                Node(
+                    name=f"o{i}",
+                    labels={"kubernetes.io/hostname": f"o{i}"},
+                    capacity=Resource.from_map(
+                        {"cpu": "64", "memory": "256Gi", "pods": per_node}
+                    ),
+                )
+            )
+        # warm drain: compile cost must not land in either timing
+        for i in range(256):
+            sched.on_pod_add(_pod(f"warm{i}", cpu="10m"))
+        sched.schedule_pending()
+        assert _wait(lambda: len(bound) == 256, timeout=60)
+        for i in range(n_pods):
+            sched.on_pod_add(_pod(f"load{i}", cpu="10m"))
+        calls0, events0 = counted["calls"], counted["events"]
+        gc.collect()
+        gc.disable()
+        c0 = time.process_time()
+        sched.schedule_pending()
+        ok = _wait(lambda: len(bound) == 256 + n_pods, timeout=300)
+        dt = time.process_time() - c0
+        gc.enable()
+        assert ok
+        if with_tier:
+            counted["window_calls"] = counted["calls"] - calls0
+            counted["window_events"] = counted["events"] - events0
+            cpm = sched.controlplane
+            # the hot path deferred: stitching is still pending and the
+            # backlog never crossed the inline-drain bound...
+            assert 0 < len(cpm._pending) <= cpm.config.max_pending_batches
+            # ...and the deferred work is intact — chains stitch on read
+            assert cpm.hop_summary().get("bind", {}).get("count", 0) > 0
+            assert not cpm._pending
+        return dt
+
+    drain_cpu(False)  # cold-start run, discarded
+    gc.collect()
+    bases, ratios = [], []
+    for _ in range(3):
+        base = drain_cpu(False)
+        gc.collect()
+        tiered = drain_cpu(True)
+        gc.collect()
+        bases.append(base)
+        ratios.append(tiered / base)
+
+    # gate 1: projected hot-path sink cost against the fastest drain.
+    bench = Scheduler()
+    bench.install_controlplane(
+        ControlPlaneConfig(max_pending_batches=1 << 30)
+    )
+    sink = bench.flight.sink
+    cpm = bench.controlplane
+    batch = [(f"default/mb-{i}", "pop", None) for i in range(32)]
+    per_call = per_event = float("inf")
+    for _ in range(5):
+        cpm._pending.clear()
+        t0 = time.process_time()
+        for _ in range(20000):
+            sink(0.0, batch)
+        per_call = min(per_call, (time.process_time() - t0) / 20000)
+        t0 = time.process_time()
+        for _ in range(20000):
+            list(batch)  # record_many's one per-event cost: the sink copy
+        per_event = min(per_event, (time.process_time() - t0) / (20000 * 32))
+    projected = (
+        counted["window_calls"] * per_call
+        + counted["window_events"] * per_event
+    )
+    floor = min(bases)
+    assert projected <= 0.02 * floor, (
+        f"sink cost {projected * 1e3:.2f}ms over {counted['window_calls']} "
+        f"calls/{counted['window_events']} events > 2% of {floor:.3f}s drain"
+    )
+
+    # gate 2: the A/B statistic, with the box's own noise as allowance
+    ratios.sort()
+    noise = max(bases) / min(bases) - 1.0
+    limit = 1.02 + noise
+    assert ratios[1] <= limit, (
+        f"median overhead ratio {ratios[1]:.4f} > {limit:.4f} "
+        f"(1.02 + measured bare spread {noise:.4f})"
+    )
