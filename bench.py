@@ -1061,6 +1061,221 @@ def _arrival_env_kwargs():
     return kw
 
 
+def run_wire_harness(
+    n_nodes=200,
+    rates=(100.0, 400.0),
+    duration_s=2.0,
+    codec="binary",
+    dist="poisson",
+    seed=4242,
+    slo_p99_s=1.0,
+    warm_pods=256,
+    settle_timeout_s=120.0,
+    poll_interval_s=0.002,
+    max_pods_per_rate=50_000,
+    progress=None,
+):
+    """Wire-tier arrival sweep (config17): the config9 open-loop shape
+    pushed through the FULL HTTP control plane — driver ApiClient writes
+    pods to the apiserver, the reflector-fed RemoteClusterSource feeds
+    the scheduler, and bindings travel back over POST /bindings — with
+    ``codec`` selecting the wire format end to end (WIRE.md).  Run twice
+    (binary vs json) the rate-vs-latency curves and the control-plane
+    hop decomposition (watch_fanout + informer_deliver) isolate what the
+    frame codec buys at the wire, and ``wire_bytes`` reports how many
+    bytes each codec moved.  Latency is enqueue→bound measured by the
+    harness (arrival stamp → binding-sink return), independent of the
+    tiers under test."""
+    from kubernetes_tpu.api.types import Container, Pod
+    from kubernetes_tpu.client import ApiClient, ApiServer, RemoteClusterSource
+    from kubernetes_tpu.scheduler import Scheduler
+    from kubernetes_tpu.server import SchedulerServer
+    from kubernetes_tpu.testing.fake_cluster import FakeCluster
+
+    def log(msg):
+        if progress:
+            progress(msg)
+
+    rng = random.Random(seed)
+    api = FakeCluster(pv_controller=False)
+    server = ApiServer(api).start()
+    endpoint = f"http://127.0.0.1:{server.port}"
+    source = RemoteClusterSource(endpoint, codec=codec)
+    sched = Scheduler()
+    bound_at = {}
+    source.connect(sched)
+    # stamp bound_at around the client sinks connect() installed — the
+    # harness measures the same wall the wire adds, not the sink's word
+    real_bind, real_many = sched.binding_sink, sched.binding_sink_many
+
+    def bind(pod, node):
+        real_bind(pod, node)
+        bound_at[pod.uid] = time.monotonic()
+
+    def bind_many(pairs):
+        errs = real_many(pairs)
+        now = time.monotonic()
+        for (pod, _node), err in zip(pairs, errs):
+            if err is None:
+                bound_at[pod.uid] = now
+        return errs
+
+    sched.binding_sink, sched.binding_sink_many = bind, bind_many
+    mon = sched.install_controlplane(api_server=server, source=source)
+    source.start()
+    driver = ApiClient(endpoint, codec=codec)
+    counter = [0]
+
+    def mk():
+        i = counter[0]
+        counter[0] += 1
+        return Pod(
+            name=f"wire-{i}",
+            labels={"app": f"app-{i % 16}"},
+            containers=[
+                Container(
+                    name="c",
+                    requests={
+                        "cpu": f"{rng.choice([100, 250])}m",
+                        "memory": "128Mi",
+                    },
+                )
+            ],
+        )
+
+    srv = SchedulerServer(sched, poll_interval_s=poll_interval_s)
+    curve = []
+    try:
+        if not source.wait_for_sync():
+            raise RuntimeError("wire harness: informers never synced")
+        driver.create_nodes(_basic_nodes(n_nodes))
+        # warm through the full path (jit shapes + http keep-alives)
+        # before any latency sample is taken
+        warm = [mk() for _ in range(warm_pods)]
+        driver.create_pods(warm)
+        srv.start()
+        warm_deadline = time.monotonic() + settle_timeout_s
+        while time.monotonic() < warm_deadline and any(
+            p.uid not in bound_at for p in warm
+        ):
+            time.sleep(0.005)
+        for rate in rates:
+            created = {}
+            t0 = time.monotonic()
+            t_end = t0 + duration_s
+            t_next = t0
+            while True:
+                now = time.monotonic()
+                if now >= t_end:
+                    break
+                if len(created) >= max_pods_per_rate:
+                    break
+                while (
+                    t_next <= now
+                    and t_next < t_end
+                    and len(created) < max_pods_per_rate
+                ):
+                    p = mk()
+                    created[p.uid] = t_next
+                    driver.create_pod(p)
+                    gap = (
+                        rng.expovariate(rate)
+                        if dist == "poisson"
+                        else 1.0 / rate
+                    )
+                    t_next += gap
+                time.sleep(min(0.001, max(t_next - now, 0.0001)))
+            offered = len(created)
+            deadline = time.monotonic() + settle_timeout_s
+            last_n, last_progress = -1, time.monotonic()
+            while time.monotonic() < deadline and any(
+                u not in bound_at for u in created
+            ):
+                n = len(bound_at)
+                if n != last_n:
+                    last_n, last_progress = n, time.monotonic()
+                elif time.monotonic() - last_progress > 10.0:
+                    break
+                time.sleep(0.005)
+            lats = sorted(
+                bound_at[u] - created[u] for u in created if u in bound_at
+            )
+            unbound = offered - len(lats)
+
+            def q(p):
+                if not lats:
+                    return None
+                rank = int(p * (offered - 1))  # censor unbound above real
+                return lats[rank] if rank < len(lats) else None
+
+            p50, p99 = q(0.50), q(0.99)
+            ok = unbound == 0 and p99 is not None and p99 <= slo_p99_s
+            curve.append(
+                {
+                    "rate": rate,
+                    "offered": offered,
+                    "bound": len(lats),
+                    "unbound": unbound,
+                    "p50_ms": round(p50 * 1000, 2) if p50 is not None else None,
+                    "p99_ms": round(p99 * 1000, 2) if p99 is not None else None,
+                    "met_slo": ok,
+                }
+            )
+            log(
+                f"wire[{codec}] {rate:g}/s: {offered} offered, "
+                f"{unbound} unbound, p50 {curve[-1]['p50_ms']} ms, "
+                f"p99 {curve[-1]['p99_ms']} ms"
+                f" ({'SLO ok' if ok else 'SLO MISS'})"
+            )
+    finally:
+        srv.stop()
+        source.stop()
+        server.stop()
+    hops = mon.hop_summary()
+    fanout = hops.get("watch_fanout", {})
+    deliver = hops.get("informer_deliver", {})
+    with server._wire_mu:
+        wire_bytes = {
+            f"{c}_{d}": n for (c, d), n in sorted(server.wire_bytes.items())
+        }
+    return {
+        "codec": codec,
+        "curve": curve,
+        "max_rate_at_slo": max(
+            (c["rate"] for c in curve if c["met_slo"]), default=0.0
+        ),
+        "slo_p99_ms": slo_p99_s * 1000,
+        "pipeline": hops,
+        # the two hops the codec targets, as mean ms/event — sums scale
+        # with pod count, means compare across runs
+        "hop_ms": {
+            "watch_fanout": round(fanout.get("mean_s", 0.0) * 1000, 3),
+            "informer_deliver": round(deliver.get("mean_s", 0.0) * 1000, 3),
+        },
+        "hop_sum_ms": round(
+            (fanout.get("sum_s", 0.0) + deliver.get("sum_s", 0.0)) * 1000, 1
+        ),
+        "wire_bytes": wire_bytes,
+    }
+
+
+def _wire_env_kwargs():
+    """BENCH_WIRE_* env knobs for the config17 wire sweep (50k-scale on a
+    real box: BENCH_WIRE_NODES=5000 BENCH_WIRE_RATES=...)."""
+    kw = {}
+    if "BENCH_WIRE_NODES" in os.environ:
+        kw["n_nodes"] = int(os.environ["BENCH_WIRE_NODES"])
+    if "BENCH_WIRE_RATES" in os.environ:
+        kw["rates"] = tuple(
+            float(x) for x in os.environ["BENCH_WIRE_RATES"].split(",")
+        )
+    if "BENCH_WIRE_SECONDS" in os.environ:
+        kw["duration_s"] = float(os.environ["BENCH_WIRE_SECONDS"])
+    if "BENCH_WIRE_SLO_P99_S" in os.environ:
+        kw["slo_p99_s"] = float(os.environ["BENCH_WIRE_SLO_P99_S"])
+    return kw
+
+
 def analyze_preflight(err=None) -> bool:
     """`--analyze`: static-analysis preflight.  Bench JSON is ratchet
     input (BENCH_FLOORS) — numbers recorded from a tree that violates the
@@ -1371,6 +1586,55 @@ def main():
             + ", ".join(
                 f"{c['rate']:g}/s→p99 {c['p99_ms']} ms" for c in ar["curve"]
             ),
+            file=sys.stderr,
+        )
+        # config17: wire-codec tier (WIRE.md) — the config9 open-loop
+        # sweep through the FULL HTTP control plane, run codec-on vs
+        # codec-off, plus a chaos-ENABLED hollow-node soak riding binary
+        # frames (control-plane + device faults simultaneously).  Keys
+        # are deliberately FLOOR-LESS; config17_wire_cpu_only marks the
+        # run and test_bench_floors refuses a ratcheted floor from it.
+        wire_kw = _wire_env_kwargs()
+        for codec in ("binary", "json"):
+            wr = run_wire_harness(
+                codec=codec,
+                progress=lambda m: print(f"# config17 {m}", file=sys.stderr),
+                **wire_kw,
+            )
+            configs[f"config17_wire_curve_{codec}"] = wr["curve"]
+            configs[f"config17_wire_max_rate_at_slo_{codec}"] = wr[
+                "max_rate_at_slo"
+            ]
+            configs[f"config17_wire_hop_ms_{codec}"] = wr["hop_ms"]
+            configs[f"config17_wire_hop_sum_ms_{codec}"] = wr["hop_sum_ms"]
+            configs[f"config17_wire_bytes_{codec}"] = wr["wire_bytes"]
+            print(
+                f"# config17 wire[{codec}]: max rate at SLO "
+                f"{wr['max_rate_at_slo']:g}/s, fanout+deliver sum "
+                f"{wr['hop_sum_ms']:g} ms, bytes {wr['wire_bytes']}",
+                file=sys.stderr,
+            )
+        cs17 = run_chaos_soak(
+            n_nodes=int(os.environ.get("BENCH_WIRE_CHAOS_NODES", "24")),
+            n_pods=int(os.environ.get("BENCH_WIRE_CHAOS_PODS", "400")),
+            fault_rate=float(os.environ.get("BENCH_CHAOS_RATE", "0.15")) / 2,
+            device_fault_rate=float(
+                os.environ.get("BENCH_DEVICE_FAULT_RATE", "0.3")
+            ),
+            codec="binary",
+            hollow_nodes=int(os.environ.get("BENCH_WIRE_HOLLOW_NODES", "8")),
+        )
+        configs["config17_wire_soak_pods_per_s"] = (
+            0.0 if cs17["problems"] else round(cs17["pods_per_s"], 1)
+        )
+        configs["config17_wire_soak_injected_total"] = cs17["injected_total"]
+        configs["config17_wire_soak_hollow_nodes"] = cs17["hollow_nodes"]
+        configs["config17_wire_cpu_only"] = jax.default_backend() == "cpu"
+        print(
+            f"# config17 wire soak (binary, {cs17['hollow_nodes']} hollow): "
+            f"{cs17['bound']} pods in {cs17['wall_s']:.2f}s "
+            f"({cs17['injected_total']} faults, "
+            f"{len(cs17['problems'])} oracle problems)",
             file=sys.stderr,
         )
         # config10/config11: the workloads tier (gang coscheduling + DRA;

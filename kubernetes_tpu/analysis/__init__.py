@@ -93,6 +93,10 @@ LOCK_MODULES = (
     os.path.join("chaos", "faults.py"),
     os.path.join("chaos", "proxy.py"),
     os.path.join("chaos", "journal.py"),
+    # wire codec: pure by design (empty registry) — vetted so any mutable
+    # module state a future change adds lands under the lock checker;
+    # frames are encoded on apiserver handler + watch-cache append threads
+    os.path.join("client", "wire_codec.py"),
     # observability: the span buffer and flight-recorder ring are appended
     # from the scheduling loop, binding workers, informer threads, and HTTP
     # debug handlers; explain holds the Scheduler lock across its prep

@@ -53,8 +53,11 @@ class ChaosClient(ApiClient):
         plan: faults.FaultPlan,
         timeout: float = 10.0,
         watch_timeout: Optional[float] = None,
+        codec: str = "binary",
     ):
-        super().__init__(endpoint, timeout=timeout, watch_timeout=watch_timeout)
+        super().__init__(
+            endpoint, timeout=timeout, watch_timeout=watch_timeout, codec=codec
+        )
         self.plan = plan
         self._chaos_mu = threading.Lock()
         self._chaos_seq = {}
@@ -82,6 +85,13 @@ class ChaosClient(ApiClient):
         stream_no = self._seq(f"watch {resource}")
         n = 0
         for evt in super().watch_stream(resource, rv):
+            if evt.get("type") == "BOOKMARK":
+                # a timing artifact (idle-interval keepalive), not a
+                # delivery — never burns a fault ordinal, so the fault
+                # sequence is a function of the event stream alone
+                # (identical across wire codecs and idle-gap jitter)
+                yield evt
+                continue
             kind = self.plan.watch_event_fault(resource, stream_no, n)
             if kind is not None:
                 self.plan.fire(kind, f"watch:{resource}", f"{stream_no}:{n}")

@@ -81,6 +81,11 @@ class Scenario:
     # (wave/gang engine) — the device-fault scenarios need a dispatch
     # stream for their seams to draw on; plain pods ride the host greedy
     spread: bool = False
+    # wire codec for http-mode clients ("binary" | "json"); inproc
+    # scenarios have no wire, so the field is inert there.  Faults are
+    # injected above the codec seam (ChaosClient wraps decoded events),
+    # so journals replay identically under either value.
+    codec: str = "binary"
     # one-line catalogue description (``--list``); every scenario must
     # carry one (tested) so the CLI is self-documenting
     desc: str = ""
@@ -481,8 +486,8 @@ class _Ctx:
             self.apiserver = ApiServer(self.api).start()
             endpoint = f"http://127.0.0.1:{self.apiserver.port}"
             self.endpoint = endpoint
-            self.client = ApiClient(endpoint)  # clean driver-side client
-            chaos_client = ChaosClient(endpoint, self.plan)
+            self.client = ApiClient(endpoint, codec=scn.codec)  # clean driver
+            chaos_client = ChaosClient(endpoint, self.plan, codec=scn.codec)
             self.source = RemoteClusterSource(endpoint, client=chaos_client)
             self.source.connect(self.sched)
             self.source.start()
@@ -907,6 +912,8 @@ def run_chaos_soak(
     seed: int = 2026,
     fault_rate: float = 0.15,
     device_fault_rate: float = 0.0,
+    codec: str = "binary",
+    hollow_nodes: int = 0,
     progress=None,
 ):
     """The bench's config7 shape: a fixed-rate mixed-fault soak over the
@@ -914,7 +921,12 @@ def run_chaos_soak(
     nonzero ``device_fault_rate`` folds the device seams in (the bench's
     config15 shape: degraded-mode throughput with per-kernel breakers and
     epoch-guarded resync absorbing dispatch faults) — spread pods force
-    every batch onto a device dispatch so the seams have a stream."""
+    every batch onto a device dispatch so the seams have a stream.
+
+    ``codec`` selects the wire format for every http-tier client in the
+    soak, and a nonzero ``hollow_nodes`` runs a kubemark HollowFleet
+    against the same apiserver (extra heartbeat + pods-watch load riding
+    the frames under fault injection — the config17 wire-soak shape)."""
     rates = {
         faults.WATCH_CUT: fault_rate / 10,
         faults.COMPACT: fault_rate / 10,
@@ -943,16 +955,30 @@ def run_chaos_soak(
         unschedulable=0,
         spread=device_fault_rate > 0,
         rates=rates,
+        codec=codec,
     )
     ctx = _Ctx(scn, None)
     ctx.evicted = 0
     ctx.failover_stall_s = None
+    fleet = None
     t0 = time.perf_counter()
     try:
         ctx.connect()
+        if hollow_nodes > 0:
+            from kubernetes_tpu.kubemark import HollowFleet
+
+            # adopt (don't register) — _drive_basic registers the same
+            # node names through the driver client; the fleet's agents
+            # just heartbeat them and report bound pods Running, adding
+            # kubelet-shaped wire load on top of the fault stream
+            fleet = HollowFleet(ctx.endpoint, heartbeat_interval_s=1.0, codec=codec)
+            fleet.adopt(_mk_nodes(min(hollow_nodes, n_nodes)))
+            fleet.start()
         _drive_basic(ctx)
         problems = check_invariants(ctx)
     finally:
+        if fleet is not None:
+            fleet.stop()
         ctx.close()
     wall = time.perf_counter() - t0
     bound = len(ctx.api.bindings)
@@ -974,6 +1000,8 @@ def run_chaos_soak(
         "recovery_p99_s": p99,
         "breaker_trips": kstats["breaker_trips"],
         "problems": problems,
+        "codec": codec,
+        "hollow_nodes": hollow_nodes,
     }
     if progress:
         progress(

@@ -5,6 +5,7 @@ The wire half of SURVEY §2.4 — apiserver ↔ clients speak list + watch
 stream through RemoteClusterSource exactly like the in-proc FakeCluster.
 """
 
+from kubernetes_tpu.client import wire_codec
 from kubernetes_tpu.client.api_server import ApiServer
 from kubernetes_tpu.client.client import (
     ApiClient,
@@ -23,4 +24,5 @@ __all__ = [
     "RemoteLeaseStore",
     "SharedInformer",
     "pods_by_node_indexer",
+    "wire_codec",
 ]

@@ -24,6 +24,12 @@ scheduler-relevant surface:
 Writes go through the wrapped FakeCluster so its watch fan-out, PV
 controller, and binding semantics stay authoritative; this server records
 the fan-out into the watch cache and serves it over the wire.
+
+WIRE FORMAT is content-negotiated (see client/wire_codec.py + WIRE.md):
+JSON is the default — a request carrying ``Accept:`` /
+``Content-Type: application/vnd.ktpu.wire+binary`` rides the binary
+codec instead, where every watch event is encoded ONCE at append time
+and the same bytes are shared by every watcher and the list path.
 """
 
 from __future__ import annotations
@@ -38,22 +44,74 @@ from urllib.parse import parse_qs, unquote, urlparse
 
 from kubernetes_tpu.api.codec import decode, encode
 from kubernetes_tpu.api.types import Node, Pod
+from kubernetes_tpu.client import wire_codec
 
 WATCH_WINDOW = 4096  # events kept per resource (watch_cache.go capacity)
 
+# idle-watcher bookmark cadence: how long a stream sleeps ON THE CONDITION
+# VARIABLE before emitting a progress BOOKMARK.  Event delivery never
+# waits on this — record() notifies and the watcher wakes in microseconds;
+# the interval only bounds how stale a quiet stream's rv report gets.
+BOOKMARK_INTERVAL_S = 0.5
+
+
+class _Event:
+    """One recorded watch event.
+
+    The BINARY frame is encoded ONCE at append time — every binary
+    watcher of every stream writes the same bytes, and the nested object
+    blob inside it is ALSO what the binary list path splices, so neither
+    fanout nor list ever re-serializes (cacher.go keeps one encoded
+    object per event the same way).  The JSON line is memoized lazily on
+    first use: JSON is the debug default, not the hot path, so idle
+    debug-format cost is zero.  The legacy ``(rv, line)`` tuple shape is
+    preserved for existing callers that unpack or index."""
+
+    __slots__ = ("rv", "etype", "envelope", "frame", "_line")
+
+    def __init__(self, rv: int, etype: str, envelope: dict, frame: bytes):
+        self.rv = rv
+        self.etype = etype
+        self.envelope = envelope
+        self.frame = frame  # full binary event frame (shared, immutable)
+        self._line: Optional[bytes] = None
+
+    @property
+    def json_line(self) -> bytes:
+        line = self._line
+        if line is None:
+            # benign race: two threads may both serialize; same value,
+            # single-store publish under the GIL
+            line = self._line = (
+                json.dumps(
+                    {"type": self.etype, "rv": self.rv, "object": self.envelope}
+                )
+                + "\n"
+            ).encode()
+        return line
+
+    def __iter__(self):
+        return iter((self.rv, self.json_line))
+
+    def __getitem__(self, i):
+        return (self.rv, self.json_line)[i]
+
 
 class _WatchCache:
-    """Sliding window of events with a condition for long-polling.
+    """Sliding window of events with condition-variable wakeup.
 
-    Each event carries its WIRE BYTES (the JSON line), serialized once at
-    record time — every watcher of every stream writes the same bytes, so
-    per-watcher re-serialization would multiply encode cost by the watcher
-    count (cacher.go keeps one encoded object per event the same way)."""
+    Each event carries its WIRE BYTES, serialized once at record time
+    (see ``_Event``); ``obj_frames`` keeps the latest nested object blob
+    per store key so binary list responses splice instead of re-encoding
+    the full object set per request."""
 
     def __init__(self, window: int = WATCH_WINDOW):
-        self.events: Deque[Tuple[int, bytes]] = deque(maxlen=window)  # (rv, wire line)
+        self.events: Deque[_Event] = deque(maxlen=window)
         self.rv = 0
         self.cond = threading.Condition()
+        # latest nested binary blob per object key (the encode-once side
+        # of the binary LIST path), maintained under the cond in record()
+        self.obj_frames: Dict[str, bytes] = {}
         # observability counters (controlplane tier scrapes deltas):
         # compactions that dropped events, and 410s served — always-on
         # plain ints under the cond, like rv
@@ -66,16 +124,17 @@ class _WatchCache:
         self.watchers: Dict[int, int] = {}
         self._watcher_seq = 0
 
-    def record(self, event_type: str, envelope: dict) -> int:
+    def record(self, event_type: str, envelope: dict, key: Optional[str] = None) -> int:
         with self.cond:
             self.rv += 1
-            line = (
-                json.dumps(
-                    {"type": event_type, "rv": self.rv, "object": envelope}
-                )
-                + "\n"
-            ).encode()
-            self.events.append((self.rv, line))
+            nested = wire_codec.encode_nested(envelope)
+            frame = wire_codec.encode_event(event_type, self.rv, nested)
+            self.events.append(_Event(self.rv, event_type, envelope, frame))
+            if key is not None:
+                if event_type == "DELETED":
+                    self.obj_frames.pop(key, None)
+                else:
+                    self.obj_frames[key] = nested
             self.cond.notify_all()
             return self.rv
 
@@ -89,23 +148,30 @@ class _WatchCache:
         [] there would silently strand a watcher that can never catch up.
         """
         if self.events:
-            return rv < self.events[0][0] - 1
+            return rv < self.events[0].rv - 1
         return rv < self.rv
 
-    def since(self, rv: int, timeout: float) -> Optional[List[Tuple[int, bytes]]]:
-        """Events with rv' > rv; None ⇒ rv fell out of the window (410)."""
+    def since(self, rv: int, timeout: float) -> Optional[List[_Event]]:
+        """Events with rv' > rv; None ⇒ rv fell out of the window (410).
+
+        Blocks on the condition variable until an event lands (record()
+        notifies — an idle watcher adds microseconds of delivery latency,
+        not a poll interval) or ``timeout`` elapses ([] ⇒ still idle; the
+        caller emits a BOOKMARK).  The wait loops against spurious
+        wakeups and concurrent consumers racing for the same notify."""
+        deadline = time.monotonic() + timeout
         with self.cond:
-            if self._stale(rv):
-                self.gone_total += 1
-                return None  # compacted away → 410 Gone
-            out = [e for e in self.events if e[0] > rv]
-            if out:
-                return out
-            self.cond.wait(timeout)
-            if self._stale(rv):
-                self.gone_total += 1
-                return None
-            return [e for e in self.events if e[0] > rv]
+            while True:
+                if self._stale(rv):
+                    self.gone_total += 1
+                    return None  # compacted away → 410 Gone
+                out = [e for e in self.events if e.rv > rv]
+                if out:
+                    return out
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return []
+                self.cond.wait(remaining)
 
     def compact(self, keep: int = 0) -> None:
         """Drop all but the last ``keep`` retained events (the etcd
@@ -132,6 +198,13 @@ class ApiServer:
             "nodes": _WatchCache(),
             "pods": _WatchCache(),
         }
+        # wire-byte accounting: (codec, direction) → total bytes, from the
+        # server's perspective (tx = responses/streams, rx = request
+        # bodies).  Plain dict under a dedicated mutex — handler threads
+        # increment, the controlplane monitor scrapes deltas into
+        # scheduler_tpu_wire_bytes_total at scrape time.
+        self.wire_bytes: Dict[Tuple[str, str], int] = {}
+        self._wire_mu = threading.Lock()
         # subscribe to the store's fan-out so every mutation (from any
         # client, or in-proc drivers) lands in the watch caches
         api.watch_nodes(
@@ -175,18 +248,54 @@ class ApiServer:
                 )
                 self._acct = (cp, verb, res, time.monotonic())
 
-            def _json(self, code: int, payload) -> None:
-                body = json.dumps(payload).encode()
+            # ----- content negotiation (Accept / Content-Type) ---------
+            # JSON stays the DEBUG DEFAULT: a request that doesn't ask for
+            # the binary content type gets exactly the old JSON wire, so
+            # curl sessions, old clients, and the chaos journal's decoded
+            # entries are untouched.
+
+            def _wants_binary(self) -> bool:
+                return wire_codec.CT_BINARY in (self.headers.get("Accept") or "")
+
+            def _read_body(self):
+                """Request body → value, negotiated via Content-Type."""
+                length = int(self.headers.get("Content-Length", 0))
+                raw = self.rfile.read(length) if length else b""
+                ct = self.headers.get("Content-Type") or ""
+                if wire_codec.CT_BINARY in ct:
+                    server._note_wire("binary", "rx", len(raw))
+                    if not raw:
+                        return {}
+                    return wire_codec.decode_frame(raw)[0]
+                server._note_wire("json", "rx", len(raw))
+                return json.loads(raw or b"{}")
+
+            def _send_raw(self, code: int, body: bytes, ctype: str, codec: str) -> None:
                 self.send_response(code)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+                server._note_wire(codec, "tx", len(body))
                 acct = self._acct
                 if acct is not None:
                     self._acct = None
                     cp, verb, res, t0 = acct
                     cp.note_request(verb, res, code, time.monotonic() - t0)
+
+            def _json(self, code: int, payload) -> None:
+                """Negotiated response: named for the historical default —
+                answers in binary when the request's Accept asks for it."""
+                if self._wants_binary():
+                    return self._send_raw(
+                        code,
+                        wire_codec.encode_frame(payload),
+                        wire_codec.CT_BINARY,
+                        "binary",
+                    )
+                return self._send_raw(
+                    code, json.dumps(payload).encode(), "application/json", "json"
+                )
 
             def do_GET(self):  # noqa: N802
                 self._begin("GET")
@@ -206,6 +315,16 @@ class ApiServer:
                         return self._json(404, {"error": "unknown resource"})
                     if q.get("watch", ["0"])[0] in ("1", "true"):
                         return self._watch(res, int(q.get("resourceVersion", ["0"])[0]))
+                    if self._wants_binary():
+                        # encode-once list: splice the watch cache's
+                        # per-object blobs instead of re-serializing the
+                        # full object set per request
+                        return self._send_raw(
+                            200,
+                            server.list_frame(res),
+                            wire_codec.CT_BINARY,
+                            "binary",
+                        )
                     return self._json(200, server.list_payload(res))
                 if parts == ["healthz"]:
                     return self._json(200, {"ok": True})
@@ -221,31 +340,40 @@ class ApiServer:
                     wid = cache._watcher_seq
                     cache.watchers[wid] = rv
                 try:
-                    self._watch_stream(cache, rv, wid)
+                    self._watch_stream(cache, rv, wid, self._wants_binary())
                 finally:
                     with cache.cond:
                         cache.watchers.pop(wid, None)
 
-            def _watch_stream(self, cache, rv: int, wid: int) -> None:
+            def _watch_stream(self, cache, rv: int, wid: int, binary: bool) -> None:
                 self.send_response(200)
-                self.send_header("Content-Type", "application/json")
+                self.send_header(
+                    "Content-Type",
+                    wire_codec.CT_BINARY if binary else "application/json",
+                )
                 self.send_header("Transfer-Encoding", "chunked")
                 self.end_headers()
+                codec = "binary" if binary else "json"
 
                 def chunk_raw(data: bytes) -> bool:
                     try:
                         self.wfile.write(hex(len(data))[2:].encode() + b"\r\n")
                         self.wfile.write(data + b"\r\n")
                         self.wfile.flush()
+                        server._note_wire(codec, "tx", len(data))
                         return True
                     except (BrokenPipeError, ConnectionError, OSError):
                         return False
 
                 def chunk(payload: dict) -> bool:
+                    # control frames (bookmark/410) — built per stream,
+                    # they carry stream-local state
+                    if binary:
+                        return chunk_raw(wire_codec.encode_frame(payload))
                     return chunk_raw((json.dumps(payload) + "\n").encode())
 
                 while True:
-                    events = cache.since(rv, timeout=0.5)
+                    events = cache.since(rv, timeout=BOOKMARK_INTERVAL_S)
                     if events is None:
                         chunk({"type": "ERROR", "code": 410})
                         break
@@ -253,12 +381,20 @@ class ApiServer:
                         if not chunk({"type": "BOOKMARK", "rv": rv}):
                             return
                         continue
-                    # coalesced emission: ONE chunked frame carries every
-                    # pending event's pre-serialized line — a burst of N
-                    # events costs one write+flush instead of N
-                    rv = events[-1][0]
+                    # coalesced emission: ONE chunked write carries every
+                    # pending event's pre-serialized bytes — a burst of N
+                    # events costs one write+flush instead of N, and the
+                    # bytes are the SHARED per-event encoding (binary
+                    # frames or memoized JSON lines), never re-serialized
+                    # per watcher
+                    rv = events[-1].rv
                     cache.watchers[wid] = rv  # plain store — progress report
-                    if not chunk_raw(b"".join(e[1] for e in events)):
+                    payload = (
+                        b"".join(e.frame for e in events)
+                        if binary
+                        else b"".join(e.json_line for e in events)
+                    )
+                    if not chunk_raw(payload):
                         return
                 try:
                     self.wfile.write(b"0\r\n\r\n")
@@ -268,8 +404,7 @@ class ApiServer:
             def do_POST(self):  # noqa: N802
                 self._begin("POST")
                 parts = [p for p in urlparse(self.path).path.split("/") if p]
-                length = int(self.headers.get("Content-Length", 0))
-                body = json.loads(self.rfile.read(length) or b"{}")
+                body = self._read_body()
                 if len(parts) == 3 and parts[2] in ("nodes", "pods"):
                     mk = (
                         server._create_node
@@ -311,7 +446,18 @@ class ApiServer:
                                 server.api.bind(pod, item["node"])
                                 results.append(None)
                             except RuntimeError as e:
-                                results.append({"code": 409, "error": str(e)})
+                                # the 409 carries the EXISTING binding so a
+                                # client whose transport-level retry races
+                                # its own applied first attempt can tell
+                                # conflict-on-retry (node matches: success)
+                                # from a real double-bind
+                                results.append(
+                                    {
+                                        "code": 409,
+                                        "error": str(e),
+                                        "node": pod.node_name,
+                                    }
+                                )
                             except KeyError as e:
                                 results.append({"code": 404, "error": str(e)})
                     return self._json(200, {"results": results})
@@ -335,7 +481,12 @@ class ApiServer:
                         try:
                             server.api.bind(pod, body["node"])
                         except RuntimeError as e:
-                            return self._json(409, {"error": str(e)})
+                            # carry the existing binding (see the bulk
+                            # route): conflict-on-retry where the node
+                            # matches is the client's success signal
+                            return self._json(
+                                409, {"error": str(e), "node": pod.node_name}
+                            )
                         except KeyError as e:
                             return self._json(404, {"error": str(e)})
                     return self._json(201, {"ok": True})
@@ -344,8 +495,7 @@ class ApiServer:
             def do_PUT(self):  # noqa: N802
                 self._begin("PUT")
                 parts = [p for p in urlparse(self.path).path.split("/") if p]
-                length = int(self.headers.get("Content-Length", 0))
-                body = json.loads(self.rfile.read(length) or b"{}")
+                body = self._read_body()
                 if len(parts) == 4 and parts[2] == "nodes":
                     server.api.update_node(decode(body))
                     return self._json(200, {"ok": True})
@@ -366,8 +516,7 @@ class ApiServer:
             def do_PATCH(self):  # noqa: N802
                 self._begin("PATCH")
                 parts = [p for p in urlparse(self.path).path.split("/") if p]
-                length = int(self.headers.get("Content-Length", 0))
-                body = json.loads(self.rfile.read(length) or b"{}")
+                body = self._read_body()
                 if len(parts) == 5 and parts[2] == "pods" and parts[4] == "status":
                     # read-modify-write under the server lock: concurrent
                     # status patches (nomination vs kubelet phase report)
@@ -473,12 +622,20 @@ class ApiServer:
     # ----- store access -----------------------------------------------------
 
     def _record(self, res: str, etype: str, obj) -> None:
-        rv = self.caches[res].record(etype, encode(obj))
+        key = obj.uid if isinstance(obj, Pod) else obj.name
+        rv = self.caches[res].record(etype, encode(obj), key=key)
         cp = self.cp
         if cp is not None and cp.enabled:
             # the api_write breadcrumb: this event's rv + its watch-cache
             # entry time — the root of every pod's causal pipeline chain
             cp.note_api_write(res, rv, obj)
+
+    def _note_wire(self, codec: str, direction: str, n: int) -> None:
+        if not n:
+            return
+        key = (codec, direction)
+        with self._wire_mu:
+            self.wire_bytes[key] = self.wire_bytes.get(key, 0) + n
 
     # Creates are IDEMPOTENT for replays of the same SPEC (the client's
     # transport-level POST retry can re-send a create whose response was
@@ -542,6 +699,30 @@ class ApiServer:
             "resourceVersion": rv,
             "items": [encode(obj) for obj in snapshot.values()],
         }
+
+    def list_frame(self, res: str) -> bytes:
+        """The binary list response: same snapshot+rv discipline as
+        ``list_payload``, but items are the watch cache's per-object
+        nested blobs SPLICED into one frame — encode cost per request is
+        O(items) concatenation, not O(items) serialization.  An object
+        created before this server attached (no recorded event yet) falls
+        back to a one-off encode; an object whose latest MODIFIED hasn't
+        fanned out yet serves its previous blob, which the reflector's
+        idempotent event replay corrects — the same race the JSON path
+        tolerates in the other direction."""
+        cache = self.caches[res]
+        with cache.cond:
+            store = self.api.nodes if res == "nodes" else self.api.pods
+            snapshot = store.copy()
+            frames = dict(cache.obj_frames)
+            rv = cache.rv
+        blobs = []
+        for key, obj in snapshot.items():
+            blob = frames.get(key)
+            if blob is None:
+                blob = wire_codec.encode_nested(encode(obj))
+            blobs.append(blob)
+        return wire_codec.encode_list_frame(rv, blobs)
 
     # ----- lifecycle --------------------------------------------------------
 

@@ -30,25 +30,38 @@ from urllib.parse import quote
 
 from kubernetes_tpu.api.codec import decode, encode
 from kubernetes_tpu.api.types import Node, Pod
+from kubernetes_tpu.client import wire_codec
 
 
 class ApiError(RuntimeError):
-    def __init__(self, code: int, msg: str):
+    def __init__(self, code: int, msg: str, body=None):
         super().__init__(f"HTTP {code}: {msg}")
         self.code = code
+        # the parsed error payload when the response carried one (e.g. a
+        # binding 409's {"error", "node"}) — what lets bind() distinguish
+        # conflict-on-retry from a real double-bind
+        self.body = body
 
 
 class ApiClient:
     """Thin REST client (the generated clientset analogue).  Requests ride
     a THREAD-LOCAL keep-alive connection — per-request TCP setup halves
     full-stack throughput at kubemark scale (client-go pools HTTP/2
-    streams for the same reason)."""
+    streams for the same reason).
+
+    ``codec`` picks the wire format for requests, responses, and watch
+    streams: "binary" (the default — the serving tier's hot path rides
+    client/wire_codec.py frames) or "json" (the server's debug default;
+    what a codec-less client gets).  Decoded structures are identical
+    either way, so everything above ``_req``/``watch_stream`` is
+    codec-blind."""
 
     def __init__(
         self,
         endpoint: str,
         timeout: float = 10.0,
         watch_timeout: Optional[float] = None,
+        codec: str = "binary",
     ):
         self.endpoint = endpoint.rstrip("/")
         self.timeout = timeout
@@ -58,6 +71,9 @@ class ApiClient:
         # timeout behavior (reflector.go timeoutSeconds), so a quiet stream
         # cycles cheaply instead of surfacing as an error + relist.
         self.watch_timeout = watch_timeout
+        if codec not in ("json", "binary"):
+            raise ValueError(f"codec must be 'json' or 'binary', got {codec!r}")
+        self.codec = codec
         parsed = urllib.parse.urlparse(self.endpoint)
         self._host = parsed.hostname or "127.0.0.1"
         self._port = parsed.port or 80
@@ -81,9 +97,26 @@ class ApiClient:
             self._local.conn = conn
         return conn
 
+    @staticmethod
+    def _parse(body: bytes, ctype: str):
+        """Response body → value, by the Content-Type the server chose
+        (robust to a server that negotiated differently than asked)."""
+        if not body:
+            return {}
+        if wire_codec.CT_BINARY in ctype:
+            return wire_codec.decode_frame(body)[0]
+        return json.loads(body)
+
     def _req(self, method: str, path: str, payload=None):
-        data = json.dumps(payload).encode() if payload is not None else None
-        headers = {"Content-Type": "application/json"}
+        binary = self.codec == "binary"
+        ctype = wire_codec.CT_BINARY if binary else "application/json"
+        if payload is None:
+            data = None
+        elif binary:
+            data = wire_codec.encode_frame(payload)
+        else:
+            data = json.dumps(payload).encode()
+        headers = {"Content-Type": ctype, "Accept": ctype}
         # Transport-level failures (keep-alive gone stale, backlog
         # overflow RST during bursts) retry on a fresh connection with
         # backoff — client-go's rest client does the same; API-level
@@ -94,10 +127,24 @@ class ApiClient:
                 conn = self._conn(fresh=attempt > 0)
                 conn.request(method, path, body=data, headers=headers)
                 resp = conn.getresponse()
-                body = resp.read() or b"{}"
+                body = resp.read() or b""
+                resp_ct = resp.getheader("Content-Type") or ""
                 if resp.status >= 400:
-                    raise ApiError(resp.status, body.decode(errors="replace"))
-                return json.loads(body)
+                    try:
+                        parsed = self._parse(body, resp_ct)
+                    except Exception:  # noqa: BLE001 — opaque error body
+                        parsed = None
+                    msg = (
+                        json.dumps(parsed)
+                        if wire_codec.CT_BINARY in resp_ct and parsed is not None
+                        else body.decode(errors="replace")
+                    )
+                    raise ApiError(
+                        resp.status,
+                        msg,
+                        body=parsed if isinstance(parsed, dict) else None,
+                    )
+                return self._parse(body, resp_ct)
             except ApiError:
                 raise
             except (ConnectionError, OSError, http.client.HTTPException) as e:
@@ -146,11 +193,25 @@ class ApiClient:
             raise ApiError(409, f"{len(errs)} bulk create conflicts: {errs[:3]}")
 
     def bind(self, pod: Pod, node_name: str) -> None:
-        self._req(
-            "POST",
-            f"/api/v1/pods/{quote(pod.uid, safe='')}/binding",
-            {"node": node_name},
-        )
+        try:
+            self._req(
+                "POST",
+                f"/api/v1/pods/{quote(pod.uid, safe='')}/binding",
+                {"node": node_name},
+            )
+        except ApiError as e:
+            # Idempotent retry: ``_req`` re-sends a binding POST whose
+            # response was lost after the server applied it.  A 409 whose
+            # recorded binding MATCHES the requested node is that retry
+            # observing its own first attempt — success, not conflict
+            # (assignPod's same-node CAS is a no-op for the same reason).
+            if (
+                e.code == 409
+                and isinstance(e.body, dict)
+                and e.body.get("node") == node_name
+            ):
+                return
+            raise
 
     def bind_many(self, items) -> List[Optional[str]]:
         """Bulk bindings: items is [(pod, node_name), ...]; returns a
@@ -164,9 +225,16 @@ class ApiClient:
             ]
         }
         out = self._req("POST", "/api/v1/bindings", payload)
+        results = out.get("results", [None] * len(items))
+        wanted = [node for _, node in items]
         return [
-            None if r is None else f"HTTP {r.get('code')}: {r.get('error')}"
-            for r in out.get("results", [None] * len(items))
+            None
+            if r is None
+            # conflict-on-retry (see bind()): the recorded binding already
+            # matches what this item asked for — success, not an error
+            or (r.get("code") == 409 and r.get("node") == want)
+            else f"HTTP {r.get('code')}: {r.get('error')}"
+            for r, want in zip(results, wanted)
         ]
 
     def patch_pod_status(self, pod: Pod) -> None:
@@ -213,9 +281,14 @@ class ApiClient:
 
     def watch_stream(self, resource: str, rv: int):
         """Yields decoded watch events; raises ApiError(410) on
-        compaction, StopIteration/return on clean EOF."""
+        compaction, StopIteration/return on clean EOF.  The event dicts
+        are codec-identical: {"type", "rv", "object"} whether the stream
+        carried JSON lines or binary frames — the Reflector (and the
+        chaos proxy wrapping this method) never sees the difference."""
+        binary = self.codec == "binary"
         req = urllib.request.Request(
-            f"{self.endpoint}/api/v1/{resource}?watch=1&resourceVersion={rv}"
+            f"{self.endpoint}/api/v1/{resource}?watch=1&resourceVersion={rv}",
+            headers={"Accept": wire_codec.CT_BINARY} if binary else {},
         )
         read_timeout = (
             self.watch_timeout
@@ -223,6 +296,14 @@ class ApiClient:
             else max(self.timeout, 30)
         )
         with urllib.request.urlopen(req, timeout=read_timeout) as resp:
+            if binary:
+                while True:
+                    evt = wire_codec.read_frame(resp)
+                    if evt is None:
+                        return  # clean EOF or cut mid-frame: re-watch
+                    if evt.get("type") == "ERROR" and evt.get("code") == 410:
+                        raise ApiError(410, "resourceVersion compacted")
+                    yield evt
             for line in resp:
                 line = line.strip()
                 if not line:
@@ -406,11 +487,16 @@ class RemoteClusterSource:
     the in-proc FakeCluster (testing/fake_cluster.py), so `server.py
     --api-endpoint` swaps the wire tier in without touching the core."""
 
-    def __init__(self, endpoint: str, client: Optional[ApiClient] = None):
+    def __init__(
+        self,
+        endpoint: str,
+        client: Optional[ApiClient] = None,
+        codec: str = "binary",
+    ):
         # an injected client (e.g. the chaos subsystem's fault-wrapping
         # ChaosClient) rides the whole tier: reflector streams, bindings,
-        # status writes
-        self.client = client or ApiClient(endpoint)
+        # status writes — and carries its own codec
+        self.client = client or ApiClient(endpoint, codec=codec)
         # SHARED informers (one list/watch stream per resource, any number
         # of consumers + named indexes — shared_informer.go:459); the
         # scheduler registers as the first consumer, debuggers/metrics

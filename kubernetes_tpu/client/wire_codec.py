@@ -1,0 +1,460 @@
+"""Binary wire codec for the list/watch/bind hot path.
+
+The reference leans on protobuf precisely because JSON list/watch
+dominates at scale (SURVEY L0-L4); this is the same move shrunk to the
+repo's JSON-safe value domain.  One self-describing, length-prefixed
+FRAME carries any structure the JSON tier carries, and decodes to the
+IDENTICAL Python structure ``json.loads`` would have produced — so every
+parity, journal-replay, and relist guarantee carries over unchanged and
+a decoded object is byte-identical between codecs (same ``json.dumps``).
+
+Frame layout (all integers big-endian):
+
+    frame   := u32 body-length | body
+    body    := value
+    value   := 0x00                          # None
+             | 0x01 | 0x02                   # False | True
+             | 0x03 zigzag-varint            # int (unbounded)
+             | 0x04 f64                      # float (8-byte IEEE double)
+             | 0x05 varint utf8-bytes        # str, inline (registers in the
+                                             #   frame's dynamic table)
+             | 0x06 varint                   # str, STATIC table ref
+             | 0x07 varint                   # str, dynamic table ref
+             | 0x08 varint value*            # list  (count, then items)
+             | 0x09 varint (value value)*    # dict  (count, then k/v pairs;
+                                             #   keys are str values)
+             | 0x0A varint body              # NESTED value: byte length +
+                                             #   a self-contained body with
+                                             #   its OWN dynamic table
+
+STRING INTERNING is two-tier.  The STATIC table is baked into this
+module — every dataclass field name reachable from the codec's KINDS
+(the wire keys), the envelope/protocol keys, event types, and the common
+label/taint vocabulary — so the strings that dominate Node/Pod payloads
+cost one tag + one varint.  Anything else goes inline once per frame and
+by dynamic back-reference after that (repeated label values, node names
+in taint messages).  Both sides derive the static table from the same
+``_build_static_table()``, so there is no negotiation of table versions:
+the table is part of the content type.
+
+The NESTED value (0x0A) is the ZERO-COPY seam: an object envelope is
+encoded ONCE into a nested blob at watch-cache append time, and that
+same blob is spliced verbatim into every watch event frame and every
+binary list response (cacher.go keeps one encoded object per event for
+the same reason).  A nested body carries its own dynamic table, so
+splicing can never desynchronize the enclosing frame's table.
+
+Content negotiation: clients send ``Accept``/``Content-Type`` of
+``CT_BINARY``; the server answers in kind and keeps JSON the default for
+anything that doesn't ask (curl debugging, the chaos journal's decoded
+entries, old clients).  When JSON still wins: see WIRE.md.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import struct
+from typing import Any, Dict, List, Optional, Tuple, get_args, get_type_hints
+
+CT_JSON = "application/json"
+CT_BINARY = "application/vnd.ktpu.wire+binary"
+
+_TAG_NONE = 0x00
+_TAG_FALSE = 0x01
+_TAG_TRUE = 0x02
+_TAG_INT = 0x03
+_TAG_FLOAT = 0x04
+_TAG_STR = 0x05
+_TAG_SREF = 0x06
+_TAG_DREF = 0x07
+_TAG_LIST = 0x08
+_TAG_DICT = 0x09
+_TAG_NESTED = 0x0A
+
+_U32 = struct.Struct("!I")
+_F64 = struct.Struct("!d")
+
+# Lock-discipline registry (kubernetes_tpu.analysis): the codec is PURE —
+# the static table below is built once at import and never mutated, and
+# every encoder/decoder carries its state in locals/instance fields owned
+# by one call.  Registered empty so the checker vets any mutable state a
+# future change introduces here (encoders ride apiserver handler threads,
+# reflector threads, and the watch-cache append path concurrently).
+# Plain assignment — analysis.core.module_literal reads ast.Assign only.
+_KTPU_GUARDED = {}
+
+
+# ---------------------------------------------------------------------------
+# static intern table
+# ---------------------------------------------------------------------------
+
+# envelope / protocol keys and values the server's frames always carry
+_PROTOCOL_STRINGS = (
+    "kind",
+    "object",
+    "type",
+    "rv",
+    "items",
+    "resourceVersion",
+    "results",
+    "error",
+    "code",
+    "ok",
+    "node",
+    "uid",
+    "idempotent",
+    "ADDED",
+    "MODIFIED",
+    "DELETED",
+    "BOOKMARK",
+    "ERROR",
+)
+
+# common label / taint / value vocabulary (the reference's well-known
+# keys) — frames carrying them pay a ref, not the full string
+_COMMON_STRINGS = (
+    "app",
+    "cpu",
+    "memory",
+    "pods",
+    "kubernetes.io/hostname",
+    "topology.kubernetes.io/zone",
+    "topology.kubernetes.io/region",
+    "node.kubernetes.io/not-ready",
+    "node.kubernetes.io/unreachable",
+    "node.kubernetes.io/unschedulable",
+    "NoSchedule",
+    "PreferNoSchedule",
+    "NoExecute",
+    "Exists",
+    "Equal",
+    "In",
+    "NotIn",
+    "DoNotSchedule",
+    "ScheduleAnyway",
+    "Honor",
+    "Ignore",
+    "TCP",
+    "UDP",
+    "Pending",
+    "Running",
+    "Always",
+    "Never",
+    "PreemptLowerPriority",
+    "default",
+    "default-scheduler",
+)
+
+
+def _collect_field_names(cls, seen: set, out: List[str]) -> None:
+    """Every dataclass field name reachable from ``cls`` (the wire keys
+    ``api.codec.to_wire`` emits), depth-first in declaration order —
+    deterministic, so server and client derive the same table."""
+    if not dataclasses.is_dataclass(cls) or cls in seen:
+        return
+    seen.add(cls)
+    try:
+        hints = get_type_hints(cls)
+    except Exception:  # noqa: BLE001 — unresolvable forward ref: skip nest
+        hints = {}
+    for f in dataclasses.fields(cls):
+        if f.name not in out:
+            out.append(f.name)
+        _walk_hint(hints.get(f.name), seen, out)
+
+
+def _walk_hint(hint, seen: set, out: List[str]) -> None:
+    if hint is None:
+        return
+    if dataclasses.is_dataclass(hint):
+        _collect_field_names(hint, seen, out)
+        return
+    for a in get_args(hint):
+        _walk_hint(a, seen, out)
+
+
+def _build_static_table() -> Tuple[str, ...]:
+    from kubernetes_tpu.api.codec import KINDS
+
+    out: List[str] = list(_PROTOCOL_STRINGS)
+    seen: set = set()
+    for kind in sorted(KINDS):
+        if kind not in out:
+            out.append(kind)
+        _collect_field_names(KINDS[kind], seen, out)
+    for s in _COMMON_STRINGS:
+        if s not in out:
+            out.append(s)
+    return tuple(out)
+
+
+STATIC_STRINGS: Tuple[str, ...] = _build_static_table()
+_STATIC_INDEX: Dict[str, int] = {s: i for i, s in enumerate(STATIC_STRINGS)}
+
+
+# ---------------------------------------------------------------------------
+# primitives
+# ---------------------------------------------------------------------------
+
+
+def _write_varint(out: List[bytes], n: int) -> None:
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(bytes((b | 0x80,)))
+        else:
+            out.append(bytes((b,)))
+            return
+
+
+def _read_varint(buf: bytes, pos: int) -> Tuple[int, int]:
+    shift = 0
+    n = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        n |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return n, pos
+        shift += 7
+
+
+def _zigzag(n: int) -> int:
+    return (n << 1) if n >= 0 else (-(n << 1) - 1)
+
+
+def _unzigzag(z: int) -> int:
+    return (z >> 1) if not z & 1 else -((z + 1) >> 1)
+
+
+# ---------------------------------------------------------------------------
+# encoder
+# ---------------------------------------------------------------------------
+
+
+class _Encoder:
+    """One frame's encoding context (the dynamic string table is
+    per-frame; a nested blob carries its own)."""
+
+    def __init__(self):
+        self.out: List[bytes] = []
+        self.dynamic: Dict[str, int] = {}
+
+    def value(self, v: Any) -> None:
+        out = self.out
+        if v is None:
+            out.append(b"\x00")
+        elif v is True:
+            out.append(b"\x02")
+        elif v is False:
+            out.append(b"\x01")
+        elif isinstance(v, int):
+            out.append(b"\x03")
+            _write_varint(out, _zigzag(v))
+        elif isinstance(v, float):
+            out.append(b"\x04")
+            out.append(_F64.pack(v))
+        elif isinstance(v, str):
+            self.string(v)
+        elif isinstance(v, (list, tuple)):
+            out.append(b"\x08")
+            _write_varint(out, len(v))
+            for x in v:
+                self.value(x)
+        elif isinstance(v, dict):
+            out.append(b"\x09")
+            _write_varint(out, len(v))
+            for k, x in v.items():
+                if not isinstance(k, str):
+                    raise TypeError(f"wire_codec: non-str dict key {k!r}")
+                self.string(k)
+                self.value(x)
+        else:
+            raise TypeError(f"wire_codec: unsupported {type(v)!r}")
+
+    def string(self, s: str) -> None:
+        out = self.out
+        idx = _STATIC_INDEX.get(s)
+        if idx is not None:
+            out.append(b"\x06")
+            _write_varint(out, idx)
+            return
+        idx = self.dynamic.get(s)
+        if idx is not None:
+            out.append(b"\x07")
+            _write_varint(out, idx)
+            return
+        self.dynamic[s] = len(self.dynamic)
+        raw = s.encode()
+        out.append(b"\x05")
+        _write_varint(out, len(raw))
+        out.append(raw)
+
+    def splice(self, nested_blob: bytes) -> None:
+        """Append a pre-encoded NESTED blob (from ``encode_nested``) where
+        a value is expected — the zero-copy path: the blob's own dynamic
+        table means no re-encode and no table interaction."""
+        self.out.append(nested_blob)
+
+    def body(self) -> bytes:
+        return b"".join(self.out)
+
+
+def encode_value(v: Any) -> bytes:
+    """Value → frame BODY bytes (no length prefix)."""
+    enc = _Encoder()
+    enc.value(v)
+    return enc.body()
+
+
+def encode_nested(v: Any) -> bytes:
+    """Value → a NESTED blob: splice it into any frame via
+    ``_Encoder.splice`` / the event and list assemblers below."""
+    body = encode_value(v)
+    out: List[bytes] = [b"\x0a"]
+    _write_varint(out, len(body))
+    out.append(body)
+    return b"".join(out)
+
+
+def encode_frame(v: Any) -> bytes:
+    """Value → full length-prefixed frame."""
+    body = encode_value(v)
+    return _U32.pack(len(body)) + body
+
+
+def encode_event(etype: str, rv: int, nested_obj: Optional[bytes]) -> bytes:
+    """One watch event as a full frame:
+    ``{"type": etype, "rv": rv, "object": <spliced blob>}`` — the blob is
+    the object envelope encoded ONCE at watch-cache append time and
+    shared across every watcher's stream and the binary list path."""
+    enc = _Encoder()
+    enc.out.append(b"\x09")
+    _write_varint(enc.out, 3 if nested_obj is not None else 2)
+    enc.string("type")
+    enc.string(etype)
+    enc.string("rv")
+    enc.value(rv)
+    if nested_obj is not None:
+        enc.string("object")
+        enc.splice(nested_obj)
+    body = enc.body()
+    return _U32.pack(len(body)) + body
+
+
+def encode_list_frame(rv: int, nested_items: List[bytes]) -> bytes:
+    """A binary list response as one full frame:
+    ``{"resourceVersion": rv, "items": [<spliced blobs>]}`` — items are
+    the per-object blobs maintained by the watch cache, NOT re-encoded
+    per request (the JSON list path re-serializes the full object set on
+    every call; this path just concatenates)."""
+    enc = _Encoder()
+    enc.out.append(b"\x09")
+    _write_varint(enc.out, 2)
+    enc.string("resourceVersion")
+    enc.value(rv)
+    enc.string("items")
+    enc.out.append(b"\x08")
+    _write_varint(enc.out, len(nested_items))
+    for blob in nested_items:
+        enc.splice(blob)
+    body = enc.body()
+    return _U32.pack(len(body)) + body
+
+
+# ---------------------------------------------------------------------------
+# decoder
+# ---------------------------------------------------------------------------
+
+
+def _decode(buf: bytes, pos: int, dynamic: List[str]) -> Tuple[Any, int]:
+    tag = buf[pos]
+    pos += 1
+    if tag == _TAG_NONE:
+        return None, pos
+    if tag == _TAG_FALSE:
+        return False, pos
+    if tag == _TAG_TRUE:
+        return True, pos
+    if tag == _TAG_INT:
+        z, pos = _read_varint(buf, pos)
+        return _unzigzag(z), pos
+    if tag == _TAG_FLOAT:
+        return _F64.unpack_from(buf, pos)[0], pos + 8
+    if tag == _TAG_STR:
+        n, pos = _read_varint(buf, pos)
+        s = buf[pos : pos + n].decode()
+        dynamic.append(s)
+        return s, pos + n
+    if tag == _TAG_SREF:
+        i, pos = _read_varint(buf, pos)
+        return STATIC_STRINGS[i], pos
+    if tag == _TAG_DREF:
+        i, pos = _read_varint(buf, pos)
+        return dynamic[i], pos
+    if tag == _TAG_LIST:
+        n, pos = _read_varint(buf, pos)
+        out = []
+        for _ in range(n):
+            v, pos = _decode(buf, pos, dynamic)
+            out.append(v)
+        return out, pos
+    if tag == _TAG_DICT:
+        n, pos = _read_varint(buf, pos)
+        d = {}
+        for _ in range(n):
+            k, pos = _decode(buf, pos, dynamic)
+            v, pos = _decode(buf, pos, dynamic)
+            d[k] = v
+        return d, pos
+    if tag == _TAG_NESTED:
+        n, pos = _read_varint(buf, pos)
+        v, _ = _decode(buf, pos, [])  # fresh table: self-contained blob
+        return v, pos + n
+    raise ValueError(f"wire_codec: bad tag 0x{tag:02x} at {pos - 1}")
+
+
+def decode_value(body: bytes) -> Any:
+    """Frame BODY bytes → value (the exact structure ``json.loads`` of
+    the JSON encoding would produce)."""
+    v, pos = _decode(body, 0, [])
+    if pos != len(body):
+        raise ValueError(
+            f"wire_codec: {len(body) - pos} trailing bytes after value"
+        )
+    return v
+
+
+def decode_frame(buf: bytes, offset: int = 0) -> Tuple[Any, int]:
+    """One length-prefixed frame at ``offset`` → (value, next offset)."""
+    (n,) = _U32.unpack_from(buf, offset)
+    start = offset + 4
+    return decode_value(buf[start : start + n]), start + n
+
+
+def read_frame(stream) -> Optional[Any]:
+    """Read one frame from a file-like stream (a dechunked HTTP response
+    body).  Returns None on clean EOF — and on a connection cut mid-frame
+    (truncated read), which the reflector handles exactly like a clean
+    stream end: re-watch/relist from its current rv."""
+    header = _read_exact(stream, 4)
+    if header is None:
+        return None
+    (n,) = _U32.unpack(header)
+    body = _read_exact(stream, n)
+    if body is None:
+        return None
+    return decode_value(body)
+
+
+def _read_exact(stream, n: int) -> Optional[bytes]:
+    chunks: List[bytes] = []
+    got = 0
+    while got < n:
+        chunk = stream.read(n - got)
+        if not chunk:
+            return None
+        chunks.append(chunk)
+        got += len(chunk)
+    return b"".join(chunks)

@@ -45,10 +45,11 @@ class HollowFleet:
         endpoint: str,
         heartbeat_interval_s: float = 10.0,
         report_pod_status: bool = True,
+        codec: str = "binary",
     ):
         from kubernetes_tpu.client import ApiClient, Reflector
 
-        self.client = ApiClient(endpoint)
+        self.client = ApiClient(endpoint, codec=codec)
         self.heartbeat_interval_s = heartbeat_interval_s
         self.report_pod_status = report_pod_status
         self.kubelets: Dict[str, HollowKubelet] = {}

@@ -985,6 +985,16 @@ class SchedulerMetrics:
                 ("resource",),
             )
         )
+        self.wire_bytes_total = r.register(
+            Counter(
+                "scheduler_tpu_wire_bytes_total",
+                "Bytes the API server moved over the list/watch/bind wire, "
+                "split by codec (json vs the length-prefixed binary frames) "
+                "and direction (tx/rx as the server sees them), refreshed "
+                "on scrape.",
+                ("codec", "direction"),
+            )
+        )
         self.informer_delivery_lag = r.register(
             Histogram(
                 "scheduler_tpu_informer_delivery_lag_seconds",

@@ -592,3 +592,12 @@ class ControlPlaneMonitor:
                 prom.watch_compactions.inc(dc, resource=res)
             if dg:
                 prom.watch_relists.inc(dg, resource=res)
+        with api._wire_mu:
+            wire = dict(api.wire_bytes)
+        for (codec, direction), total in wire.items():
+            with self._mu:
+                key = ("wire", codec, direction)
+                d = total - self._cache_synced.get(key, 0)
+                self._cache_synced[key] = total
+            if d:
+                prom.wire_bytes_total.inc(d, codec=codec, direction=direction)

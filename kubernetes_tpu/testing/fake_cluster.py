@@ -243,6 +243,11 @@ class FakeCluster:
             raise RuntimeError(
                 f"pod {pod.key} already bound to {stored.node_name}"
             )
+        if stored.node_name == node_name and node_name:
+            # same-node rebind: a transport-level POST retry replaying an
+            # applied binding.  TRUE no-op — re-firing update handlers
+            # here would fan a duplicate MODIFIED event to every watcher
+            return
         if node_name not in self.nodes:
             raise KeyError(f"binding to unknown node {node_name}")
         old = copy.deepcopy(stored)

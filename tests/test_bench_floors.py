@@ -188,6 +188,34 @@ def test_no_devicefault_floors_from_cpu_only_runs():
     )
 
 
+def test_no_wire_floors_from_cpu_only_runs():
+    """ISSUE 17 ratchet guard: config17_wire_* numbers on this box come
+    from a CPU-only backend (wire sweep + hollow soak CPU-box-sized, the
+    50k scale rides BENCH_WIRE_* on real boxes) and are marked
+    config17_wire_cpu_only in the bench JSON.  They are codec-comparison
+    and engagement evidence, NOT throughput facts — refuse a ratcheted
+    config17 floor/ceiling whenever the latest recorded bench is
+    CPU-only."""
+    bench = _latest_bench()
+    if bench is None:
+        pytest.skip("no BENCH_r*.json recorded yet")
+    results = _bench_configs(bench)
+    if not results.get("config17_wire_cpu_only"):
+        pytest.skip("latest bench has no CPU-only wire line")
+    floors_doc = _load(os.path.join(ROOT, "BENCH_FLOORS.json"))
+    offending = [
+        k
+        for store in ("floors", "ceilings")
+        for k in floors_doc.get(store, {})
+        if k.startswith("config17_wire")
+    ]
+    assert offending == [], (
+        "config17_wire floors/ceilings ratcheted from a CPU-only bench "
+        f"run: {offending} (BENCH_FLOORS _comment_environment discipline "
+        "— calibrate wire-tier throughput on a real box)"
+    )
+
+
 def test_new_keys_without_floors_are_tolerated():
     """A bench result key with no recorded floor (or a non-scalar value)
     must never fail the gate — new config lines land a round before their
